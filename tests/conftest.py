@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-device virtual CPU platform for JAX.
+
+All device-code tests (sharding included) run against 8 virtual CPU devices so
+the multi-chip code paths are exercised without TPU hardware, per the framework's
+test strategy (SURVEY.md section 4). Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# keep XLA/compilation threads polite in CI containers
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import pathlib
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+
+@pytest.fixture(scope="session")
+def repo_root() -> pathlib.Path:
+    return REPO_ROOT
+
+
+@pytest.fixture(scope="session")
+def data_dir(tmp_path_factory) -> pathlib.Path:
+    """Session-scoped scratch directory for generated test data."""
+    return tmp_path_factory.mktemp("data")

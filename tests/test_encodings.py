@@ -1,0 +1,89 @@
+"""Round-trip / GC / hamming tests for the 2-bit and 3-bit encoders.
+
+Coverage modeled on the reference's encoder tests
+(/root/reference/src/sctools/test/test_encodings.py behavioral surface).
+"""
+
+import numpy as np
+import pytest
+
+from sctools_tpu.encodings import TwoBit, ThreeBit
+
+
+@pytest.fixture(scope="module", params=[TwoBit, ThreeBit])
+def encoder_and_sequence(request):
+    length = 8
+    sequence = b"ACGTACGT"
+    return request.param(length), sequence
+
+
+def test_two_bit_roundtrip():
+    seq = b"ACGTTGCA"
+    enc = TwoBit(len(seq))
+    assert enc.decode(enc.encode(seq)) == seq
+
+
+def test_three_bit_roundtrip_with_n():
+    seq = b"ACGTN"
+    enc = ThreeBit()
+    assert enc.decode(enc.encode(seq)) == seq
+
+
+def test_two_bit_lowercase():
+    assert TwoBit.encode(b"acgt") == TwoBit.encode(b"ACGT")
+
+
+def test_two_bit_invalid_raises():
+    with pytest.raises(KeyError):
+        TwoBit.encode(b"AC!T")
+
+
+def test_three_bit_nonstandard_becomes_n():
+    enc = ThreeBit()
+    assert enc.decode(enc.encode(b"AC!T")) == b"ACNT"
+
+
+def test_two_bit_ambiguous_randomized_to_valid_base():
+    enc = TwoBit(4)
+    decoded = enc.decode(enc.encode(b"ACGN"))
+    assert decoded[:3] == b"ACG"
+    assert decoded[3:4] in (b"A", b"C", b"G", b"T")
+
+
+@pytest.mark.parametrize("cls,seq,expected", [
+    (TwoBit, b"ACGT", 2),
+    (TwoBit, b"AAAA", 0),
+    (TwoBit, b"GGCC", 4),
+    (ThreeBit, b"ACGTN", 2),
+    (ThreeBit, b"GGGG", 4),
+])
+def test_gc_content(cls, seq, expected):
+    enc = cls(len(seq))
+    assert enc.gc_content(enc.encode(seq)) == expected
+
+
+@pytest.mark.parametrize("cls", [TwoBit, ThreeBit])
+def test_hamming_distance(cls):
+    enc = cls(6)
+    a = enc.encode(b"ACGTAC")
+    b = enc.encode(b"ACGTAC")
+    assert cls.hamming_distance(a, b) == 0
+    c = enc.encode(b"TCGTAC")
+    assert cls.hamming_distance(a, c) == 1
+    d = enc.encode(b"TCGTCA")
+    assert cls.hamming_distance(a, d) == 3
+
+
+def test_encode_array_matches_scalar():
+    seqs = [b"ACGTACGTACGTACGT", b"TTTTGGGGCCCCAAAA", b"GATTACAGATTACAGA"]
+    arr = np.frombuffer(b"".join(seqs), dtype=np.uint8).reshape(3, 16)
+    packed = TwoBit.encode_array(arr)
+    for i, s in enumerate(seqs):
+        assert int(packed[i]) == TwoBit.encode(s)
+    decoded = TwoBit.decode_array(packed, 16)
+    assert decoded.tobytes() == b"".join(seqs)
+
+
+def test_encode_array_length_limit():
+    with pytest.raises(ValueError):
+        TwoBit.encode_array(np.zeros((1, 33), dtype=np.uint8))

@@ -1,0 +1,70 @@
+import bz2
+import gzip
+
+import pytest
+
+from sctools_tpu.reader import Reader, infer_open, zip_readers
+
+LINES = ["#comment\n", "alpha\n", "beta\n", "gamma\n"]
+
+
+@pytest.fixture(scope="module", params=["plain", "gz", "bz2"])
+def text_file(request, tmp_path_factory):
+    d = tmp_path_factory.mktemp("reader")
+    raw = "".join(LINES).encode()
+    if request.param == "plain":
+        p = d / "f.txt"
+        p.write_bytes(raw)
+    elif request.param == "gz":
+        p = d / "f.txt.gz"
+        p.write_bytes(gzip.compress(raw))
+    else:
+        p = d / "f.txt.bz2"
+        p.write_bytes(bz2.compress(raw))
+    return str(p)
+
+
+def test_infer_open_and_iteration_str(text_file):
+    lines = list(Reader(text_file, mode="r"))
+    assert lines == LINES
+
+
+def test_iteration_bytes(text_file):
+    lines = list(Reader(text_file, mode="rb"))
+    assert lines == [line.encode() for line in LINES]
+
+
+def test_header_comment_skipping(text_file):
+    lines = list(Reader(text_file, mode="r", header_comment_char="#"))
+    assert lines == LINES[1:]
+
+
+def test_multi_file_concatenation(text_file):
+    lines = list(Reader([text_file, text_file], mode="r"))
+    assert lines == LINES * 2
+
+
+def test_len(text_file):
+    assert len(Reader(text_file, mode="r")) == len(LINES)
+
+
+def test_select_record_indices(text_file):
+    got = list(Reader(text_file, mode="r").select_record_indices({1, 3}))
+    assert got == [LINES[1], LINES[3]]
+
+
+def test_zip_readers(text_file):
+    pairs = list(zip_readers(Reader(text_file), Reader(text_file)))
+    assert pairs == [(line, line) for line in LINES]
+
+
+def test_bad_mode_raises(text_file):
+    with pytest.raises(ValueError):
+        Reader(text_file, mode="w")
+
+
+def test_bad_files_type_raises():
+    with pytest.raises(TypeError):
+        Reader(files=123)
+    with pytest.raises(TypeError):
+        Reader(files=[1, 2])

@@ -1,0 +1,43 @@
+import math
+
+import numpy as np
+
+from sctools_tpu.stats import base4_entropy, OnlineGaussianSufficientStatistic
+
+
+def test_base4_entropy_uniform_is_one():
+    x = np.ones((5, 4))
+    assert np.allclose(base4_entropy(x), 1.0)
+
+
+def test_base4_entropy_point_mass_is_zero():
+    x = np.zeros((3, 4))
+    x[:, 1] = 7
+    assert np.allclose(base4_entropy(x), 0.0)
+
+
+def test_base4_entropy_axis0():
+    x = np.ones((4, 2))
+    assert np.allclose(base4_entropy(x, axis=0), 1.0)
+
+
+def test_online_gaussian_matches_numpy():
+    rng = np.random.RandomState(0)
+    values = rng.rand(1000)
+    stat = OnlineGaussianSufficientStatistic()
+    for v in values:
+        stat.update(float(v))
+    assert math.isclose(stat.mean, float(np.mean(values)), rel_tol=1e-12)
+    assert math.isclose(
+        stat.calculate_variance(), float(np.var(values, ddof=1)), rel_tol=1e-10
+    )
+
+
+def test_online_gaussian_degenerate_cases():
+    stat = OnlineGaussianSufficientStatistic()
+    assert stat.mean == 0.0
+    assert math.isnan(stat.calculate_variance())
+    stat.update(5.0)
+    mean, var = stat.mean_and_variance()
+    assert mean == 5.0
+    assert math.isnan(var)
