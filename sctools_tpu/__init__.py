@@ -22,19 +22,27 @@ device, mirroring the reference's ``fastqpreprocessing/`` C++ layer.
 
 __version__ = "0.1.0"
 
+import importlib
+
 from . import consts  # noqa: F401
 
+# submodules resolved lazily so `import sctools_tpu` stays light (no jax import)
 __all__ = [
     "bam",
     "barcode",
     "consts",
-    "count",
     "encodings",
     "fastq",
-    "groups",
     "gtf",
+    "io",
     "metrics",
-    "platform",
+    "ops",
     "reader",
     "stats",
 ]
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

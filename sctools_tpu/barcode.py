@@ -1,0 +1,161 @@
+"""Barcode set analysis and hamming<=1 whitelist correction (host API).
+
+Behavior-compatible with the reference barcode layer (src/sctools/barcode.py:
+30-379): a 2-bit-encoded barcode population with hamming summaries, per-position
+base frequencies and effective diversity, plus the error->barcode correction
+map used by the FASTQ attach pipeline.
+
+TPU note: :class:`ErrorsToCorrectBarcodesMap` keeps the reference's exact
+hash-map semantics for the streaming host path; the bulk device path
+(sctools_tpu.ops.correction) instead corrects packed 2-bit barcode columns with
+a hamming kernel and produces identical corrections (tested against this map).
+"""
+
+import itertools
+from collections import Counter
+from typing import Iterable, Iterator, List, Mapping, Tuple
+
+import numpy as np
+
+from . import consts
+from .encodings import TwoBit
+from .stats import base4_entropy
+
+
+class Barcodes:
+    """A set (multiset) of equal-length barcodes in 2-bit encoding."""
+
+    def __init__(self, barcodes: Mapping[str, int], barcode_length: int):
+        if not isinstance(barcodes, Mapping):
+            raise TypeError(
+                'The argument "barcodes" must be a dict-like object mapping barcodes to counts'
+            )
+        self._mapping: Mapping[str, int] = barcodes
+
+        if not isinstance(barcode_length, int) and barcode_length > 0:
+            raise ValueError('The argument "barcode_length" must be a positive integer')
+        self._barcode_length: int = barcode_length
+
+    def __contains__(self, item) -> bool:
+        return item in self._mapping
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._mapping)
+
+    def __len__(self) -> int:
+        return len(self._mapping)
+
+    def __getitem__(self, item) -> int:
+        return self._mapping[item]
+
+    def summarize_hamming_distances(self) -> Mapping[str, float]:
+        """min/quartiles/max/mean hamming distance over all barcode pairs."""
+        distances: List = []
+        for a, b in itertools.combinations(self, 2):
+            distances.append(TwoBit.hamming_distance(a, b))
+
+        keys: Tuple = (
+            "minimum", "25th percentile", "median", "75th percentile", "maximum",
+            "average",
+        )
+        values: List = list(np.percentile(distances, [0, 25, 50, 75, 100]))
+        values.append(np.mean(distances))
+        return dict(zip(keys, values))
+
+    def base_frequency(self, weighted=False) -> np.ndarray:
+        """(barcode_length, 4) counts of each 2-bit base code by position."""
+        base_counts_by_position: np.ndarray = np.zeros(
+            (self._barcode_length, 4), dtype=np.uint64
+        )
+        keys: np.ndarray = np.fromiter(self._mapping.keys(), dtype=np.uint64)
+
+        for i in reversed(range(self._barcode_length)):
+            binary_base_representations, counts = np.unique(
+                keys & np.uint64(3), return_counts=True
+            )
+            if weighted:
+                raise NotImplementedError
+            base_counts_by_position[i, binary_base_representations] = counts
+            keys = keys >> np.uint64(2)
+
+        return base_counts_by_position
+
+    def effective_diversity(self, weighted=False) -> np.ndarray:
+        """Per-position base-4 entropy of the set; 1.0 == perfect 25% split."""
+        return base4_entropy(self.base_frequency(weighted=weighted))
+
+    @classmethod
+    def from_whitelist(cls, file_: str, barcode_length: int):
+        """One barcode per line, plain text; each gets count 1."""
+        tbe = TwoBit(barcode_length)
+        with open(file_, "rb") as f:
+            return cls(Counter(tbe.encode(barcode[:-1]) for barcode in f), barcode_length)
+
+    @classmethod
+    def from_iterable_encoded(cls, iterable: Iterable[int], barcode_length: int):
+        return cls(Counter(iterable), barcode_length=barcode_length)
+
+    @classmethod
+    def from_iterable_strings(cls, iterable: Iterable[str], barcode_length: int):
+        tbe: TwoBit = TwoBit(barcode_length)
+        return cls(
+            Counter(tbe.encode(b.encode()) for b in iterable), barcode_length=barcode_length
+        )
+
+    @classmethod
+    def from_iterable_bytes(cls, iterable: Iterable[bytes], barcode_length: int):
+        tbe: TwoBit = TwoBit(barcode_length)
+        return cls(Counter(tbe.encode(b) for b in iterable), barcode_length=barcode_length)
+
+
+class ErrorsToCorrectBarcodesMap:
+    """Map from barcodes within hamming distance 1 to their whitelist barcode."""
+
+    def __init__(self, errors_to_barcodes: Mapping[str, str]):
+        if not isinstance(errors_to_barcodes, Mapping):
+            raise TypeError(
+                f'The argument "errors_to_barcodes" must be a mapping of erroneous barcodes '
+                f"to correct barcodes, not {type(errors_to_barcodes)}"
+            )
+        self._map = errors_to_barcodes
+
+    def get_corrected_barcode(self, barcode: str) -> str:
+        """The whitelisted barcode for ``barcode``; KeyError if distance > 1."""
+        return self._map[barcode]
+
+    @staticmethod
+    def _prepare_single_base_error_hash_table(barcodes: Iterable[str]) -> Mapping[str, str]:
+        """whitelist barcode + all its single-base substitutions (ACGTN) -> barcode"""
+        error_map = {}
+        for barcode in barcodes:
+            error_map[barcode] = barcode
+            for i, nucleotide in enumerate(barcode):
+                errors = set("ACGTN")
+                errors.discard(nucleotide)
+                for e in errors:
+                    error_map[barcode[:i] + e + barcode[i + 1 :]] = barcode
+        return error_map
+
+    @classmethod
+    def single_hamming_errors_from_whitelist(cls, whitelist_file: str):
+        with open(whitelist_file, "r") as f:
+            return cls(cls._prepare_single_base_error_hash_table(line[:-1] for line in f))
+
+    def correct_bam(self, bam_file: str, output_bam_file: str) -> None:
+        """Add corrected CB tags to every record of a bam, given raw CR tags.
+
+        Uncorrectable barcodes pass through with CB set to the raw CR value.
+        """
+        from .io.sam import AlignmentFile  # deferred: keep barcode import-light
+
+        with AlignmentFile(bam_file, "rb") as fin:
+            with AlignmentFile(output_bam_file, "wb", template=fin) as fout:
+                for alignment in fin:
+                    try:
+                        tag = self.get_corrected_barcode(alignment.get_tag("CR"))
+                    except KeyError:
+                        tag = alignment.get_tag(consts.RAW_CELL_BARCODE_TAG_KEY)
+                    alignment.set_tag(
+                        tag=consts.CELL_BARCODE_TAG_KEY, value=tag, value_type="Z"
+                    )
+                    fout.write(alignment)

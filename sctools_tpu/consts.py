@@ -37,12 +37,16 @@ SINGLE_CELL_COUNT_MATRIX = 0
 SINGLE_NUCLEI_COUNT_MATRIX = 1
 
 # Integer encoding of the XF alignment-location tag used in packed record tensors.
-# 0 is reserved for "tag missing" so that device code can treat absence uniformly.
+# 0 is reserved for "tag missing" so that device code can treat absence uniformly;
+# 5 marks a tag that is present but carries an unrecognized value (absence and
+# unknown values have different metric semantics: only true absence counts
+# toward reads_unmapped).
 XF_MISSING = 0
 XF_CODING = 1
 XF_INTRONIC = 2
 XF_UTR = 3
 XF_INTERGENIC = 4
+XF_OTHER = 5
 
 XF_VALUE_TO_CODE = {
     CODING_ALIGNMENT_LOCATION_TAG_VALUE: XF_CODING,

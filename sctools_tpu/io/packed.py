@@ -1,0 +1,197 @@
+"""ReadFrame: BAM records as packed struct-of-arrays columns.
+
+The device pipeline's input format. Each alignment collapses to a handful of
+int32/float32 scalars — the same information TagSort extracts per alignment
+into its 17-field TSV tuple (reference fastqpreprocessing/src/
+htslib_tagsort.cpp:73-89,106-218) — with strings dictionary-encoded host-side:
+cell/molecule barcodes, gene names, and query names become indices into
+lexicographically sorted vocabularies, so device sort order over codes equals
+the reference's string sort order (src/sctools/bam.py:698-709), and CSV row
+order matches without any device-side string handling.
+
+Missing tags encode as vocabulary entry "" (which sorts first, like the
+reference's empty-string sort default, bam.py:660) and flag columns record
+true absence where semantics require it (e.g. XF missingness feeding
+reads_unmapped, reference aggregator.py:522-527).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .. import consts
+from .sam import AlignmentReader, BamRecord
+
+_QUAL_THRESHOLD = 30
+
+
+@dataclass
+class ReadFrame:
+    """Columnar batch of alignment records (host numpy; device-ready)."""
+
+    # dictionary-coded strings
+    cell: np.ndarray  # int32 codes into cell_names
+    umi: np.ndarray
+    gene: np.ndarray
+    qname: np.ndarray
+    cell_names: List[str]
+    umi_names: List[str]
+    gene_names: List[str]
+    qname_names: List[str]
+
+    # alignment coordinates / flags
+    ref: np.ndarray  # int32, -1 when unmapped
+    pos: np.ndarray  # int32
+    strand: np.ndarray  # int8, 1 == reverse
+    unmapped: np.ndarray  # bool
+    duplicate: np.ndarray  # bool
+    spliced: np.ndarray  # bool (cigar contains N op)
+
+    # tag-derived fields
+    xf: np.ndarray  # int8, consts.XF_* codes (XF_MISSING when absent)
+    nh: np.ndarray  # int32, -1 when absent
+    perfect_umi: np.ndarray  # int8: 1 match / 0 mismatch / -1 not computable
+    perfect_cb: np.ndarray  # int8: same convention, gated on CB presence
+
+    # quality summaries (float32)
+    umi_frac30: np.ndarray  # fraction of UY qualities > 30
+    cb_frac30: np.ndarray  # fraction of CY qualities > 30
+    genomic_frac30: np.ndarray  # fraction of aligned-portion qualities > 30
+    genomic_mean: np.ndarray  # mean aligned-portion quality
+
+    extras: Dict[str, np.ndarray] = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return len(self.cell)
+
+    @property
+    def n_records(self) -> int:
+        return len(self.cell)
+
+
+def _frac_above(qualities: Sequence[int], threshold: int = _QUAL_THRESHOLD) -> float:
+    if not qualities:
+        return float("nan")
+    return sum(1 for q in qualities if q > threshold) / len(qualities)
+
+
+def _string_qual_frac_above(qual: Optional[str], threshold: int = _QUAL_THRESHOLD) -> float:
+    if not qual:
+        return float("nan")
+    return sum(1 for c in qual if ord(c) - 33 > threshold) / len(qual)
+
+
+def _encode_column(values: List[str]):
+    """values -> (int32 codes, sorted vocabulary). '' sorts first."""
+    arr = np.asarray(values, dtype=object)
+    vocabulary, codes = np.unique(arr, return_inverse=True)
+    return codes.astype(np.int32), [str(v) for v in vocabulary]
+
+
+def frame_from_records(records: Iterable[BamRecord]) -> ReadFrame:
+    """Pack an iterable of BamRecords into a ReadFrame."""
+    cells: List[str] = []
+    umis: List[str] = []
+    genes: List[str] = []
+    qnames: List[str] = []
+    ref: List[int] = []
+    pos: List[int] = []
+    strand: List[int] = []
+    unmapped: List[bool] = []
+    duplicate: List[bool] = []
+    spliced: List[bool] = []
+    xf: List[int] = []
+    nh: List[int] = []
+    perfect_umi: List[int] = []
+    perfect_cb: List[int] = []
+    umi_frac30: List[float] = []
+    cb_frac30: List[float] = []
+    genomic_frac30: List[float] = []
+    genomic_mean: List[float] = []
+
+    for record in records:
+        tags = record.tags
+        cb = tags.get("CB", (None, ""))[1]
+        cr = tags.get("CR", (None, None))[1]
+        ub = tags.get("UB", (None, ""))[1]
+        ur = tags.get("UR", (None, None))[1]
+        ge = tags.get("GE", (None, ""))[1]
+        uy = tags.get("UY", (None, None))[1]
+        cy = tags.get("CY", (None, None))[1]
+        xf_value = tags.get("XF", (None, None))[1]
+        nh_value = tags.get("NH", (None, None))[1]
+
+        cells.append(cb)
+        umis.append(ub)
+        genes.append(ge)
+        qnames.append(record.query_name)
+        ref.append(record.reference_id)
+        pos.append(record.pos)
+        strand.append(1 if record.is_reverse else 0)
+        unmapped.append(record.is_unmapped)
+        duplicate.append(record.is_duplicate)
+        cigar_stats, _ = record.get_cigar_stats()
+        spliced.append(cigar_stats[3] > 0)
+        if xf_value is None:
+            xf.append(consts.XF_MISSING)
+        else:
+            xf.append(consts.XF_VALUE_TO_CODE.get(xf_value, consts.XF_OTHER))
+        nh.append(nh_value if nh_value is not None else -1)
+        if ur is not None and "UB" in tags:
+            perfect_umi.append(1 if ur == ub else 0)
+        else:
+            perfect_umi.append(-1)
+        if "CB" in tags and cr is not None:
+            perfect_cb.append(1 if cr == cb else 0)
+        else:
+            perfect_cb.append(-1)
+        umi_frac30.append(_string_qual_frac_above(uy))
+        cb_frac30.append(_string_qual_frac_above(cy))
+        aligned_quals = record.query_alignment_qualities or []
+        genomic_frac30.append(_frac_above(aligned_quals))
+        genomic_mean.append(
+            float(np.mean(aligned_quals)) if aligned_quals else float("nan")
+        )
+
+    cell_codes, cell_names = _encode_column(cells)
+    umi_codes, umi_names = _encode_column(umis)
+    gene_codes, gene_names = _encode_column(genes)
+    qname_codes, qname_names = _encode_column(qnames)
+
+    return ReadFrame(
+        cell=cell_codes,
+        umi=umi_codes,
+        gene=gene_codes,
+        qname=qname_codes,
+        cell_names=cell_names,
+        umi_names=umi_names,
+        gene_names=gene_names,
+        qname_names=qname_names,
+        ref=np.asarray(ref, dtype=np.int32),
+        pos=np.asarray(pos, dtype=np.int32),
+        strand=np.asarray(strand, dtype=np.int8),
+        unmapped=np.asarray(unmapped, dtype=bool),
+        duplicate=np.asarray(duplicate, dtype=bool),
+        spliced=np.asarray(spliced, dtype=bool),
+        xf=np.asarray(xf, dtype=np.int8),
+        nh=np.asarray(nh, dtype=np.int32),
+        perfect_umi=np.asarray(perfect_umi, dtype=np.int8),
+        perfect_cb=np.asarray(perfect_cb, dtype=np.int8),
+        umi_frac30=np.asarray(umi_frac30, dtype=np.float32),
+        cb_frac30=np.asarray(cb_frac30, dtype=np.float32),
+        genomic_frac30=np.asarray(genomic_frac30, dtype=np.float32),
+        genomic_mean=np.asarray(genomic_mean, dtype=np.float32),
+    )
+
+
+def frame_from_bam(path: str, mode: Optional[str] = None) -> ReadFrame:
+    """Decode a BAM/SAM file into a ReadFrame (pure-Python decode path).
+
+    The C++ native layer provides an accelerated drop-in for this function
+    (sctools_tpu.native) for large inputs.
+    """
+    with AlignmentReader(path, mode) as reader:
+        return frame_from_records(reader)

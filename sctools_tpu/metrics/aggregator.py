@@ -1,0 +1,294 @@
+"""Host (streaming) metrics aggregators — the parity oracle and CPU backend.
+
+Implements the exact streaming semantics of the reference aggregators
+(src/sctools/metrics/aggregator.py:46-595) over this framework's BamRecord:
+one aggregator instance per entity, per-record updates, higher-order metrics
+at finalize. The device engine (sctools_tpu.metrics.device) is tested for
+equality against this implementation; keep quirks here faithful:
+
+- reads with XF == INTERGENIC count toward reads_mapped_intergenic regardless
+  of mapped state, and reads *missing* XF count toward reads_unmapped
+  (reference aggregator.py:522-527);
+- the genes/cells histograms count reads (every record increments), so
+  n_mitochondrial_molecules is read-weighted (aggregator.py:530, 476-482);
+- variance is sample variance, nan below two observations (stats.py:94-99);
+- noise_reads and antisense_reads are always 0 (never implemented upstream).
+"""
+
+from collections import Counter
+from typing import Iterable, Sequence, Set
+
+import numpy as np
+
+from .. import consts
+from ..stats import OnlineGaussianSufficientStatistic
+
+
+def _quality_string_to_numeric(quality_sequence) -> list:
+    return [ord(c) - 33 for c in quality_sequence]
+
+
+def _quality_above_threshold(threshold: int, quality_sequence: Sequence[int]) -> float:
+    return sum(1 for base in quality_sequence if base > threshold) / len(quality_sequence)
+
+
+class MetricAggregator:
+    """Accumulates the 24 common metrics for one entity (cell or gene)."""
+
+    def __init__(self):
+        # count information
+        self.n_reads: int = 0
+        self.noise_reads: int = 0  # never incremented (matches reference)
+        self._fragment_histogram = Counter()
+        self._molecule_histogram = Counter()
+
+        # molecule information
+        self._molecule_barcode_fraction_bases_above_30 = (
+            OnlineGaussianSufficientStatistic()
+        )
+        self.perfect_molecule_barcodes = 0
+
+        self._genomic_reads_fraction_bases_quality_above_30 = (
+            OnlineGaussianSufficientStatistic()
+        )
+        self._genomic_read_quality = OnlineGaussianSufficientStatistic()
+
+        # alignment location information
+        self.reads_mapped_exonic = 0
+        self.reads_mapped_intronic = 0
+        self.reads_mapped_utr = 0
+
+        # alignment uniqueness information
+        self.reads_mapped_uniquely = 0
+        self.reads_mapped_multiple = 0
+        self.duplicate_reads = 0
+
+        # alignment splicing information
+        self.spliced_reads = 0
+        self.antisense_reads = 0
+        self._plus_strand_reads = 0
+
+        # higher-order metrics, filled by finalize()
+        self.molecule_barcode_fraction_bases_above_30_mean: float = None
+        self.molecule_barcode_fraction_bases_above_30_variance: float = None
+        self.genomic_reads_fraction_bases_quality_above_30_mean: float = None
+        self.genomic_reads_fraction_bases_quality_above_30_variance: float = None
+        self.genomic_read_quality_mean: float = None
+        self.genomic_read_quality_variance: float = None
+        self.n_molecules: float = None
+        self.n_fragments: float = None
+        self.reads_per_molecule: float = None
+        self.reads_per_fragment: float = None
+        self.fragments_per_molecule: float = None
+        self.fragments_with_single_read_evidence: int = None
+        self.molecules_with_single_read_evidence: int = None
+
+    def parse_extra_fields(self, tags, record) -> None:
+        raise NotImplementedError
+
+    def parse_molecule(self, tags: Sequence[str], records: Iterable) -> None:
+        """Fold all records of one molecule (one tag triple) into the state."""
+        for record in records:
+            self.parse_extra_fields(tags=tags, record=record)
+
+            self.n_reads += 1
+            self._molecule_histogram[tags] += 1
+
+            self._molecule_barcode_fraction_bases_above_30.update(
+                _quality_above_threshold(
+                    30,
+                    _quality_string_to_numeric(
+                        record.get_tag(consts.QUALITY_MOLECULE_BARCODE_TAG_KEY)
+                    ),
+                )
+            )
+
+            # a missing corrected or raw molecule barcode is tolerated: the
+            # perfect-barcode counter simply doesn't learn from this read
+            try:
+                self.perfect_molecule_barcodes += record.get_tag(
+                    consts.RAW_MOLECULE_BARCODE_TAG_KEY
+                ) == record.get_tag(consts.MOLECULE_BARCODE_TAG_KEY)
+            except KeyError:
+                pass
+
+            self._genomic_reads_fraction_bases_quality_above_30.update(
+                _quality_above_threshold(30, record.query_alignment_qualities)
+            )
+            mean_alignment_quality = float(np.mean(record.query_alignment_qualities))
+            self._genomic_read_quality.update(mean_alignment_quality)
+
+            # everything below concerns aligned reads only
+            if record.is_unmapped:
+                continue
+
+            position = record.pos
+            strand = record.is_reverse
+            reference = record.reference_id
+            self._fragment_histogram[reference, position, strand, tags] += 1
+
+            alignment_location = record.get_tag(consts.ALIGNMENT_LOCATION_TAG_KEY)
+            if alignment_location == consts.CODING_ALIGNMENT_LOCATION_TAG_VALUE:
+                self.reads_mapped_exonic += 1
+            elif alignment_location == consts.INTRONIC_ALIGNMENT_LOCATION_TAG_VALUE:
+                self.reads_mapped_intronic += 1
+            elif alignment_location == consts.UTR_ALIGNMENT_LOCATION_TAG_VALUE:
+                self.reads_mapped_utr += 1
+
+            number_mappings = record.get_tag(consts.NUMBER_OF_HITS_TAG_KEY)
+            if number_mappings == 1:
+                self.reads_mapped_uniquely += 1
+            else:
+                self.reads_mapped_multiple += 1
+
+            if record.is_duplicate:
+                self.duplicate_reads += 1
+
+            # a nonzero N cigar-op base count marks a spliced read
+            cigar_stats, _num_blocks = record.get_cigar_stats()
+            if cigar_stats[3]:
+                self.spliced_reads += 1
+
+            self._plus_strand_reads += not record.is_reverse
+
+    def finalize(self) -> None:
+        self.molecule_barcode_fraction_bases_above_30_mean = (
+            self._molecule_barcode_fraction_bases_above_30.mean
+        )
+        self.molecule_barcode_fraction_bases_above_30_variance = (
+            self._molecule_barcode_fraction_bases_above_30.calculate_variance()
+        )
+        self.genomic_reads_fraction_bases_quality_above_30_mean = (
+            self._genomic_reads_fraction_bases_quality_above_30.mean
+        )
+        self.genomic_reads_fraction_bases_quality_above_30_variance = (
+            self._genomic_reads_fraction_bases_quality_above_30.calculate_variance()
+        )
+        self.genomic_read_quality_mean = self._genomic_read_quality.mean
+        self.genomic_read_quality_variance = (
+            self._genomic_read_quality.calculate_variance()
+        )
+
+        self.n_molecules = len(self._molecule_histogram.keys())
+        self.n_fragments = len(self._fragment_histogram.keys())
+
+        try:
+            self.reads_per_molecule = self.n_reads / self.n_molecules
+        except ZeroDivisionError:
+            self.reads_per_molecule = float("nan")
+        try:
+            self.reads_per_fragment = self.n_reads / self.n_fragments
+        except ZeroDivisionError:
+            self.reads_per_fragment = float("nan")
+        try:
+            self.fragments_per_molecule = self.n_fragments / self.n_molecules
+        except ZeroDivisionError:
+            self.fragments_per_molecule = float("nan")
+
+        self.fragments_with_single_read_evidence = sum(
+            1 for v in self._fragment_histogram.values() if v == 1
+        )
+        self.molecules_with_single_read_evidence = sum(
+            1 for v in self._molecule_histogram.values() if v == 1
+        )
+
+
+class CellMetrics(MetricAggregator):
+    """Cell-specific aggregator: adds the 11 CB-keyed extras."""
+
+    def __init__(self):
+        super().__init__()
+
+        self._cell_barcode_fraction_bases_above_30 = OnlineGaussianSufficientStatistic()
+        self.perfect_cell_barcodes = 0
+
+        self.reads_mapped_intergenic = 0
+        self.reads_unmapped = 0
+        self.reads_mapped_too_many_loci = 0
+
+        self._genes_histogram = Counter()
+
+        self.cell_barcode_fraction_bases_above_30_variance: float = None
+        self.cell_barcode_fraction_bases_above_30_mean: float = None
+        self.n_genes: int = None
+        self.genes_detected_multiple_observations: int = None
+        self.n_mitochondrial_genes: int = None
+        self.n_mitochondrial_molecules: int = None
+        self.pct_mitochondrial_molecules: float = None
+
+    def parse_extra_fields(self, tags, record) -> None:
+        self._cell_barcode_fraction_bases_above_30.update(
+            _quality_above_threshold(
+                30,
+                _quality_string_to_numeric(
+                    record.get_tag(consts.QUALITY_CELL_BARCODE_TAG_KEY)
+                ),
+            )
+        )
+
+        # reads without a corrected CB don't inform the perfect-barcode count
+        if record.has_tag(consts.CELL_BARCODE_TAG_KEY):
+            raw_cell_barcode_tag = record.get_tag(consts.RAW_CELL_BARCODE_TAG_KEY)
+            cell_barcode_tag = record.get_tag(consts.CELL_BARCODE_TAG_KEY)
+            self.perfect_cell_barcodes += raw_cell_barcode_tag == cell_barcode_tag
+
+        try:
+            alignment_location = record.get_tag(consts.ALIGNMENT_LOCATION_TAG_KEY)
+            if alignment_location == consts.INTERGENIC_ALIGNMENT_LOCATION_TAG_VALUE:
+                self.reads_mapped_intergenic += 1
+        except KeyError:
+            self.reads_unmapped += 1
+
+        self._genes_histogram[tags[2]] += 1  # the no-gene group is None
+
+    def finalize(self, mitochondrial_genes: Set[str] = set()) -> None:
+        super().finalize()
+
+        self.cell_barcode_fraction_bases_above_30_mean = (
+            self._cell_barcode_fraction_bases_above_30.mean
+        )
+        self.cell_barcode_fraction_bases_above_30_variance = (
+            self._cell_barcode_fraction_bases_above_30.calculate_variance()
+        )
+
+        self.n_genes = len(self._genes_histogram.keys())
+        self.genes_detected_multiple_observations = sum(
+            1 for v in self._genes_histogram.values() if v > 1
+        )
+        self.n_mitochondrial_genes = sum(
+            1 for g in self._genes_histogram.keys() if g in mitochondrial_genes
+        )
+        self.n_mitochondrial_molecules = sum(
+            c for g, c in self._genes_histogram.items() if g in mitochondrial_genes
+        )
+
+        if self.n_mitochondrial_molecules:
+            tot_molecules = sum(self._genes_histogram.values())
+            self.pct_mitochondrial_molecules = (
+                self.n_mitochondrial_molecules / tot_molecules * 100.0
+            )
+        else:
+            self.pct_mitochondrial_molecules = 0.00
+
+
+class GeneMetrics(MetricAggregator):
+    """Gene-specific aggregator: adds the 2 GE-keyed extras."""
+
+    def __init__(self):
+        super().__init__()
+
+        self._cells_histogram = Counter()
+
+        self.number_cells_detected_multiple: int = None
+        self.number_cells_expressing: int = None
+
+    def parse_extra_fields(self, tags, record) -> None:
+        self._cells_histogram[tags[1]] += 1
+
+    def finalize(self) -> None:
+        super().finalize()
+
+        self.number_cells_expressing = len(self._cells_histogram.keys())
+        self.number_cells_detected_multiple = sum(
+            1 for c in self._cells_histogram.values() if c > 1
+        )

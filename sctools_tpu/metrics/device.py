@@ -1,0 +1,389 @@
+"""Device (JAX) metrics engine: cell/gene QC as sorted-segment reductions.
+
+The TPU-native reformulation of the reference's streaming aggregators
+(src/sctools/metrics/aggregator.py:236-334 parse_molecule, 342-387 finalize,
+492-530 cell extras, 580-595 gene extras). One jit-compiled pass over a padded
+record batch:
+
+1. lexicographic device sort by the tag-key triple (the reference instead
+   pre-sorts the BAM file and walks it with nested iterators,
+   metrics/gatherer.py:134-153);
+2. run detection over the sorted keys realizes the group structure;
+3. every per-group quantity becomes a segment reduction:
+   Counters -> run counting, Welford -> two-pass segment moments,
+   histogram ``.keys()``/value predicates -> run-start flags and run-length
+   predicates.
+
+Fragment statistics need adjacency over (tags, ref, pos, strand), and the cell
+path's gene histogram needs adjacency over (cell, gene); both get auxiliary
+device sorts rather than hash maps.
+
+All shapes are static: callers pad records to a bucket size with key columns
+set to INT32_MAX (sorting after all real data) and valid=False.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import consts
+from ..ops import segments as seg
+from ..ops.stats import segment_mean_and_variance
+
+_I32_MAX = np.iinfo(np.int32).max
+
+
+def _common_metrics(
+    sorted_cols: Dict[str, jnp.ndarray],
+    outer_ids: jnp.ndarray,
+    triple_starts: jnp.ndarray,
+    triple_ids: jnp.ndarray,
+    num_segments: int,
+) -> Dict[str, jnp.ndarray]:
+    """The 24 shared metrics, reduced over the outer (entity) segment."""
+    valid = sorted_cols["valid"]
+    mapped = valid & ~sorted_cols["unmapped"]
+
+    def count_where(mask):
+        return seg.segment_count(outer_ids, num_segments, where=mask)
+
+    n_reads = count_where(valid)
+    perfect_molecule_barcodes = count_where(valid & (sorted_cols["perfect_umi"] == 1))
+
+    xf = sorted_cols["xf"]
+    reads_mapped_exonic = count_where(mapped & (xf == consts.XF_CODING))
+    reads_mapped_intronic = count_where(mapped & (xf == consts.XF_INTRONIC))
+    reads_mapped_utr = count_where(mapped & (xf == consts.XF_UTR))
+
+    nh = sorted_cols["nh"]
+    reads_mapped_uniquely = count_where(mapped & (nh == 1))
+    reads_mapped_multiple = count_where(mapped & (nh != 1))
+    duplicate_reads = count_where(mapped & sorted_cols["duplicate"])
+    spliced_reads = count_where(mapped & sorted_cols["spliced"])
+
+    umi_mean, umi_var, _ = segment_mean_and_variance(
+        sorted_cols["umi_frac30"], outer_ids, num_segments, where=valid
+    )
+    gf_mean, gf_var, _ = segment_mean_and_variance(
+        sorted_cols["genomic_frac30"], outer_ids, num_segments, where=valid
+    )
+    gq_mean, gq_var, _ = segment_mean_and_variance(
+        sorted_cols["genomic_mean"], outer_ids, num_segments, where=valid
+    )
+
+    # molecule histogram: distinct tag triples / triples observed once
+    n_molecules = seg.distinct_runs_per_outer(
+        triple_starts, outer_ids, num_segments, where=valid
+    )
+    molecules_single = seg.runs_with_count_per_outer(
+        triple_ids, outer_ids, num_segments, where=valid, predicate="eq1"
+    )
+
+    zeros = jnp.zeros_like(n_reads)
+    f_reads = n_reads.astype(jnp.float32)
+    f_molecules = n_molecules.astype(jnp.float32)
+
+    return {
+        "n_reads": n_reads,
+        "noise_reads": zeros,  # NotImplemented in the reference; always 0
+        "perfect_molecule_barcodes": perfect_molecule_barcodes,
+        "reads_mapped_exonic": reads_mapped_exonic,
+        "reads_mapped_intronic": reads_mapped_intronic,
+        "reads_mapped_utr": reads_mapped_utr,
+        "reads_mapped_uniquely": reads_mapped_uniquely,
+        "reads_mapped_multiple": reads_mapped_multiple,
+        "duplicate_reads": duplicate_reads,
+        "spliced_reads": spliced_reads,
+        "antisense_reads": zeros,  # never incremented in the reference
+        "molecule_barcode_fraction_bases_above_30_mean": umi_mean,
+        "molecule_barcode_fraction_bases_above_30_variance": umi_var,
+        "genomic_reads_fraction_bases_quality_above_30_mean": gf_mean,
+        "genomic_reads_fraction_bases_quality_above_30_variance": gf_var,
+        "genomic_read_quality_mean": gq_mean,
+        "genomic_read_quality_variance": gq_var,
+        "n_molecules": n_molecules,
+        "n_fragments": zeros,  # filled by _fragment_metrics
+        "reads_per_molecule": jnp.where(
+            n_molecules > 0, f_reads / jnp.maximum(f_molecules, 1), jnp.nan
+        ),
+        "reads_per_fragment": zeros.astype(jnp.float32),  # filled later
+        "fragments_per_molecule": zeros.astype(jnp.float32),  # filled later
+        "fragments_with_single_read_evidence": zeros,
+        "molecules_with_single_read_evidence": molecules_single,
+    }
+
+
+def _fragment_metrics(
+    key_cols: Tuple[jnp.ndarray, ...],
+    frag_cols: Tuple[jnp.ndarray, ...],
+    valid: jnp.ndarray,
+    mapped: jnp.ndarray,
+    num_segments: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(n_fragments, single-read fragments, entity key) per aux outer segment.
+
+    The fragment histogram key is (ref, pos, strand, tags)
+    (reference aggregator.py:299-303) and only mapped reads contribute, so an
+    auxiliary sort over (tags..., ref, pos, strand) with unmapped records
+    pushed to the end provides the adjacency for run counting.
+    """
+    push_back = ~(valid & mapped)
+    sort_keys = [jnp.where(push_back, _I32_MAX, k.astype(jnp.int32)) for k in key_cols]
+    sort_keys += [jnp.where(push_back, _I32_MAX, f.astype(jnp.int32)) for f in frag_cols]
+    (sorted_keys, (sorted_ok,)) = seg.lexsort(sort_keys, [valid & mapped])
+
+    outer_starts = seg.run_starts(sorted_keys[:1])
+    outer_ids = seg.segment_ids_from_starts(outer_starts)
+    frag_starts = seg.run_starts(sorted_keys)
+    frag_ids = seg.segment_ids_from_starts(frag_starts)
+
+    n_fragments_local = seg.distinct_runs_per_outer(
+        frag_starts, outer_ids, num_segments, where=sorted_ok
+    )
+    single_local = seg.runs_with_count_per_outer(
+        frag_ids, outer_ids, num_segments, where=sorted_ok, predicate="eq1"
+    )
+    # Map from this sort's outer segments back to the primary sort's segments:
+    # both enumerate the distinct values of key_cols[0] in ascending order, but
+    # this sort collapses entities with no mapped reads onto the trailing
+    # INT32_MAX bucket. Scatter by the entity's first key value instead.
+    entity_key = seg.segment_min(
+        jnp.where(sorted_ok, sorted_keys[0], _I32_MAX), outer_ids, num_segments
+    )
+    return n_fragments_local, single_local, entity_key
+
+
+def _scatter_by_entity(
+    values: jnp.ndarray,
+    entity_key: jnp.ndarray,
+    primary_entity_key: jnp.ndarray,
+    num_segments: int,
+) -> jnp.ndarray:
+    """Re-align per-entity values from an auxiliary sort onto primary segments.
+
+    ``entity_key[j]`` is the key value of auxiliary segment j (INT32_MAX when
+    unused); ``primary_entity_key[s]`` is the key value of primary segment s.
+    Keys ascend in both, so a searchsorted gather realigns them.
+    """
+    idx = jnp.searchsorted(entity_key, primary_entity_key)
+    idx = jnp.clip(idx, 0, num_segments - 1)
+    gathered = values[idx]
+    found = entity_key[idx] == primary_entity_key
+    return jnp.where(found, gathered, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "kind"))
+def compute_entity_metrics(
+    cols: Dict[str, jnp.ndarray], num_segments: int, kind: str = "cell"
+) -> Dict[str, jnp.ndarray]:
+    """All metrics for one entity axis in a single compiled pass.
+
+    ``kind='cell'``: outer key = cell, triple = (cell, umi, gene) — the sort
+    order GatherCellMetrics requires of its input file (reference
+    metrics/gatherer.py:91-95). ``kind='gene'``: outer key = gene, triple =
+    (gene, cell, umi) (gatherer.py:164-168).
+
+    ``cols`` must contain the ReadFrame columns plus ``valid``; shapes are
+    uniform [N] with padding sorted to the end. ``num_segments`` == N.
+    Returns per-segment metric arrays plus:
+      - ``entity_code``: the entity's vocabulary code per segment
+      - ``segment_valid``: which segments are real
+    """
+    if kind == "cell":
+        key_names = ("cell", "umi", "gene")
+    elif kind == "gene":
+        key_names = ("gene", "cell", "umi")
+    else:
+        raise ValueError(f"kind must be 'cell' or 'gene', got {kind!r}")
+
+    valid = cols["valid"]
+    pad_key = lambda name: jnp.where(valid, cols[name].astype(jnp.int32), _I32_MAX)
+    sort_keys = [pad_key(name) for name in key_names]
+
+    value_names = [
+        "valid", "unmapped", "duplicate", "spliced", "xf", "nh",
+        "perfect_umi", "perfect_cb", "umi_frac30", "cb_frac30",
+        "genomic_frac30", "genomic_mean", "ref", "pos", "strand",
+        "cell", "umi", "gene",
+    ]
+    sorted_keys, sorted_values = seg.lexsort(sort_keys, [cols[n] for n in value_names])
+    s = dict(zip(value_names, sorted_values))
+    s["valid"] = s["valid"].astype(bool)
+    s["unmapped"] = s["unmapped"].astype(bool)
+    s["duplicate"] = s["duplicate"].astype(bool)
+    s["spliced"] = s["spliced"].astype(bool)
+
+    outer_starts = seg.run_starts(sorted_keys[:1])
+    outer_ids = seg.segment_ids_from_starts(outer_starts)
+    triple_starts = seg.run_starts(sorted_keys)
+    triple_ids = seg.segment_ids_from_starts(triple_starts)
+
+    out = _common_metrics(s, outer_ids, triple_starts, triple_ids, num_segments)
+
+    # --- fragments (auxiliary sort including (ref, pos, strand)) ----------
+    valid_sorted = s["valid"]
+    mapped_sorted = ~s["unmapped"]
+    n_frag_local, frag_single_local, frag_entity_key = _fragment_metrics(
+        tuple(s[n] for n in key_names),
+        (s["ref"], s["pos"], s["strand"]),
+        valid_sorted,
+        mapped_sorted,
+        num_segments,
+    )
+    primary_entity_key = seg.segment_min(
+        jnp.where(valid_sorted, s[key_names[0]].astype(jnp.int32), _I32_MAX),
+        outer_ids,
+        num_segments,
+    )
+    n_fragments = _scatter_by_entity(
+        n_frag_local, frag_entity_key, primary_entity_key, num_segments
+    )
+    frag_single = _scatter_by_entity(
+        frag_single_local, frag_entity_key, primary_entity_key, num_segments
+    )
+    f_reads = out["n_reads"].astype(jnp.float32)
+    f_frag = n_fragments.astype(jnp.float32)
+    f_mol = out["n_molecules"].astype(jnp.float32)
+    out["n_fragments"] = n_fragments
+    out["fragments_with_single_read_evidence"] = frag_single
+    out["reads_per_fragment"] = jnp.where(
+        n_fragments > 0, f_reads / jnp.maximum(f_frag, 1), jnp.nan
+    )
+    out["fragments_per_molecule"] = jnp.where(
+        f_mol > 0, f_frag / jnp.maximum(f_mol, 1), jnp.nan
+    )
+
+    if kind == "cell":
+        out.update(
+            _cell_extras(cols, s, outer_ids, primary_entity_key, num_segments)
+        )
+    else:
+        out.update(_gene_extras(s, sorted_keys, outer_ids, num_segments))
+
+    n_entities = jnp.sum(jnp.where(valid_sorted, outer_starts, False).astype(jnp.int32))
+    out["entity_code"] = primary_entity_key
+    out["segment_valid"] = jnp.arange(num_segments, dtype=jnp.int32) < n_entities
+    out["n_entities"] = n_entities
+    return out
+
+
+def _cell_extras(
+    cols: Dict[str, jnp.ndarray],
+    s: Dict[str, jnp.ndarray],
+    outer_ids: jnp.ndarray,
+    primary_entity_key: jnp.ndarray,
+    num_segments: int,
+) -> Dict[str, jnp.ndarray]:
+    """The 11 cell-specific metrics (reference aggregator.py:437-530).
+
+    The genes histogram needs (cell, gene) adjacency, which the primary
+    (cell, umi, gene) sort does not provide — an auxiliary sort supplies it.
+    ``is_mito`` is a per-record flag gathered host-side from the gene
+    vocabulary (reference resolves mito genes from GTF names at
+    platform.py:302-307 and checks membership at aggregator.py:476-482).
+    """
+    valid = s["valid"]
+
+    def count_where(mask):
+        return seg.segment_count(outer_ids, num_segments, where=mask)
+
+    perfect_cell_barcodes = count_where(valid & (s["perfect_cb"] == 1))
+    # XF checks in cell extras ignore mapped state (aggregator.py:522-527):
+    # INTERGENIC counts any read carrying that tag value; a missing XF counts
+    # toward reads_unmapped.
+    reads_mapped_intergenic = count_where(valid & (s["xf"] == consts.XF_INTERGENIC))
+    reads_unmapped = count_where(valid & (s["xf"] == consts.XF_MISSING))
+
+    cb_mean, cb_var, _ = segment_mean_and_variance(
+        s["cb_frac30"], outer_ids, num_segments, where=valid
+    )
+
+    # --- genes histogram via (cell, gene) auxiliary sort ------------------
+    pad = ~cols["valid"]
+    cell_key = jnp.where(pad, _I32_MAX, cols["cell"].astype(jnp.int32))
+    gene_key = jnp.where(pad, _I32_MAX, cols["gene"].astype(jnp.int32))
+    (gk_sorted, (g_valid, g_is_mito)) = seg.lexsort(
+        [cell_key, gene_key], [cols["valid"], cols["is_mito"]]
+    )
+    g_valid = g_valid.astype(bool)
+    g_is_mito = g_is_mito.astype(bool)
+    g_outer_starts = seg.run_starts(gk_sorted[:1])
+    g_outer_ids = seg.segment_ids_from_starts(g_outer_starts)
+    g_pair_starts = seg.run_starts(gk_sorted)
+    g_pair_ids = seg.segment_ids_from_starts(g_pair_starts)
+
+    n_genes_local = seg.distinct_runs_per_outer(
+        g_pair_starts, g_outer_ids, num_segments, where=g_valid
+    )
+    genes_multiple_local = seg.runs_with_count_per_outer(
+        g_pair_ids, g_outer_ids, num_segments, where=g_valid, predicate="gt1"
+    )
+    mito_genes_local = seg.distinct_runs_per_outer(
+        g_pair_starts, g_outer_ids, num_segments, where=g_valid & g_is_mito
+    )
+    mito_reads_local = seg.segment_count(g_outer_ids, num_segments, where=g_valid & g_is_mito)
+
+    g_entity_key = seg.segment_min(
+        jnp.where(g_valid, gk_sorted[0], _I32_MAX), g_outer_ids, num_segments
+    )
+    realign = lambda v: _scatter_by_entity(
+        v, g_entity_key, primary_entity_key, num_segments
+    )
+    n_genes = realign(n_genes_local)
+    genes_detected_multiple_observations = realign(genes_multiple_local)
+    n_mitochondrial_genes = realign(mito_genes_local)
+    n_mitochondrial_molecules = realign(mito_reads_local)
+
+    total_reads = seg.segment_count(outer_ids, num_segments, where=valid)
+    pct = jnp.where(
+        n_mitochondrial_molecules > 0,
+        n_mitochondrial_molecules.astype(jnp.float32)
+        / jnp.maximum(total_reads, 1).astype(jnp.float32)
+        * 100.0,
+        0.0,
+    )
+
+    return {
+        "perfect_cell_barcodes": perfect_cell_barcodes,
+        "reads_mapped_intergenic": reads_mapped_intergenic,
+        "reads_unmapped": reads_unmapped,
+        "reads_mapped_too_many_loci": jnp.zeros_like(perfect_cell_barcodes),
+        "cell_barcode_fraction_bases_above_30_variance": cb_var,
+        "cell_barcode_fraction_bases_above_30_mean": cb_mean,
+        "n_genes": n_genes,
+        "genes_detected_multiple_observations": genes_detected_multiple_observations,
+        "n_mitochondrial_genes": n_mitochondrial_genes,
+        "n_mitochondrial_molecules": n_mitochondrial_molecules,
+        "pct_mitochondrial_molecules": pct,
+    }
+
+
+def _gene_extras(
+    s: Dict[str, jnp.ndarray],
+    sorted_keys,
+    outer_ids: jnp.ndarray,
+    num_segments: int,
+) -> Dict[str, jnp.ndarray]:
+    """The 2 gene-specific metrics (reference aggregator.py:561-595).
+
+    The primary (gene, cell, umi) sort already provides (gene, cell)
+    adjacency, so the cells histogram falls out of run counting directly.
+    """
+    valid = s["valid"]
+    pair_starts = seg.run_starts(sorted_keys[:2])
+    pair_ids = seg.segment_ids_from_starts(pair_starts)
+    number_cells_expressing = seg.distinct_runs_per_outer(
+        pair_starts, outer_ids, num_segments, where=valid
+    )
+    number_cells_detected_multiple = seg.runs_with_count_per_outer(
+        pair_ids, outer_ids, num_segments, where=valid, predicate="gt1"
+    )
+    return {
+        "number_cells_detected_multiple": number_cells_detected_multiple,
+        "number_cells_expressing": number_cells_expressing,
+    }

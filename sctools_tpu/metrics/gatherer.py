@@ -1,0 +1,213 @@
+"""Metric gatherers: drive a BAM through a backend and write the CSV.
+
+The reference gatherer walks a tag-sorted BAM with nested group iterators and
+one Python aggregator per entity (src/sctools/metrics/gatherer.py:116-232).
+Here the default backend packs the whole file into a ReadFrame, computes every
+entity's metrics in one jit-compiled device pass (sctools_tpu.metrics.device),
+and writes rows in entity vocabulary order — which equals the reference's row
+order for its documented sorted-input precondition. ``backend='cpu'`` runs the
+streaming host aggregators instead (exact reference semantics, no device).
+"""
+
+from __future__ import annotations
+
+from contextlib import closing
+from typing import Dict, List, Set
+
+import numpy as np
+
+from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
+from ..io.packed import ReadFrame, frame_from_bam
+from ..io.sam import AlignmentReader
+from ..ops.segments import bucket_size
+from .aggregator import CellMetrics, GeneMetrics
+from .schema import CELL_COLUMNS, GENE_COLUMNS, INT_COLUMNS
+from .writer import MetricCSVWriter
+
+
+def _pad_columns(frame: ReadFrame, is_mito: np.ndarray) -> Dict[str, np.ndarray]:
+    """ReadFrame -> dict of device-ready padded columns (+ valid mask)."""
+    n = frame.n_records
+    padded = bucket_size(n)
+
+    def pad(arr, fill=0, dtype=None):
+        arr = np.asarray(arr)
+        out = np.full(padded, fill, dtype=dtype or arr.dtype)
+        out[:n] = arr
+        return out
+
+    cols = {
+        "cell": pad(frame.cell, 0, np.int32),
+        "umi": pad(frame.umi, 0, np.int32),
+        "gene": pad(frame.gene, 0, np.int32),
+        "ref": pad(frame.ref, 0, np.int32),
+        "pos": pad(frame.pos, 0, np.int32),
+        "strand": pad(frame.strand.astype(np.int32), 0, np.int32),
+        "unmapped": pad(frame.unmapped, False),
+        "duplicate": pad(frame.duplicate, False),
+        "spliced": pad(frame.spliced, False),
+        "xf": pad(frame.xf.astype(np.int32), 0, np.int32),
+        "nh": pad(frame.nh, -1, np.int32),
+        "perfect_umi": pad(frame.perfect_umi.astype(np.int32), -1, np.int32),
+        "perfect_cb": pad(frame.perfect_cb.astype(np.int32), -1, np.int32),
+        "umi_frac30": pad(np.nan_to_num(frame.umi_frac30, nan=0.0), 0.0, np.float32),
+        "cb_frac30": pad(np.nan_to_num(frame.cb_frac30, nan=0.0), 0.0, np.float32),
+        "genomic_frac30": pad(
+            np.nan_to_num(frame.genomic_frac30, nan=0.0), 0.0, np.float32
+        ),
+        "genomic_mean": pad(
+            np.nan_to_num(frame.genomic_mean, nan=0.0), 0.0, np.float32
+        ),
+        "is_mito": pad(is_mito[frame.gene], False),
+        "valid": np.arange(padded) < n,
+    }
+    return cols
+
+
+class MetricGatherer:
+    """Common driver: pack, compute on the selected backend, write csv."""
+
+    entity_kind: str = ""
+    columns: List[str] = []
+
+    def __init__(
+        self,
+        bam_file: str,
+        output_stem: str,
+        mitochondrial_gene_ids: Set[str] = set(),
+        compress: bool = True,
+        backend: str = "device",
+    ):
+        self._bam_file = bam_file
+        self._output_stem = output_stem
+        self._compress = compress
+        self._mitochondrial_gene_ids = mitochondrial_gene_ids
+        self._backend = backend
+
+    @property
+    def bam_file(self) -> str:
+        return self._bam_file
+
+    def extract_metrics(self, mode: str = "rb") -> None:
+        if self._backend == "device":
+            self._extract_device(mode)
+        elif self._backend == "cpu":
+            self._extract_cpu(mode)
+        else:
+            raise ValueError(f"unknown backend {self._backend!r}")
+
+    # ---- device backend --------------------------------------------------
+
+    def _extract_device(self, mode: str) -> None:
+        from . import device as device_engine  # deferred jax import
+
+        frame = frame_from_bam(self._bam_file, mode if mode != "rb" else None)
+        is_mito = np.asarray(
+            [name in self._mitochondrial_gene_ids for name in frame.gene_names],
+            dtype=bool,
+        )
+        if frame.n_records == 0:
+            with closing(MetricCSVWriter(self._output_stem, self._compress)) as out:
+                out.write_header({c: None for c in self.columns})
+            return
+
+        cols = _pad_columns(frame, is_mito)
+        num_segments = len(cols["valid"])
+        result = device_engine.compute_entity_metrics(
+            {k: np.asarray(v) for k, v in cols.items()},
+            num_segments=num_segments,
+            kind=self.entity_kind,
+        )
+        result = {k: np.asarray(v) for k, v in result.items()}
+        self._write_device_rows(frame, result)
+
+    def _entity_names(self, frame: ReadFrame) -> List[str]:
+        return frame.cell_names if self.entity_kind == "cell" else frame.gene_names
+
+    def _row_filter(self, name: str) -> bool:
+        """Whether to emit a row for this entity (gene path drops multi-genes)."""
+        return True
+
+    def _write_device_rows(self, frame: ReadFrame, result: Dict[str, np.ndarray]) -> None:
+        names = self._entity_names(frame)
+        n_entities = int(result["n_entities"])
+        with closing(MetricCSVWriter(self._output_stem, self._compress)) as out:
+            out.write_header({c: None for c in self.columns})
+            for row in range(n_entities):
+                code = int(result["entity_code"][row])
+                name = names[code]
+                if not self._row_filter(name):
+                    continue
+                index = "None" if name == "" else name
+                record = {}
+                for column in self.columns:
+                    value = result[column][row]
+                    if column in INT_COLUMNS:
+                        record[column] = int(value)
+                    else:
+                        record[column] = float(value)
+                out.write(index, record)
+
+    # ---- cpu backend (exact reference streaming semantics) ---------------
+
+    def _extract_cpu(self, mode: str) -> None:
+        raise NotImplementedError
+
+
+class GatherCellMetrics(MetricGatherer):
+    """Per-cell metrics; input must be sorted by CB, UB, GE (gene fastest)."""
+
+    entity_kind = "cell"
+    columns = CELL_COLUMNS
+
+    def _extract_cpu(self, mode: str = "rb") -> None:
+        with AlignmentReader(self._bam_file, mode if mode != "rb" else None) as bam_iterator, closing(
+            MetricCSVWriter(self._output_stem, self._compress)
+        ) as cell_metrics_output:
+            cell_metrics_output.write_header(vars(CellMetrics()))
+            for cell_iterator, cell_tag in iter_cell_barcodes(bam_iterator=iter(bam_iterator)):
+                metric_aggregator = CellMetrics()
+                for molecule_iterator, molecule_tag in iter_molecule_barcodes(
+                    bam_iterator=cell_iterator
+                ):
+                    for gene_iterator, gene_tag in iter_genes(bam_iterator=molecule_iterator):
+                        metric_aggregator.parse_molecule(
+                            tags=(cell_tag, molecule_tag, gene_tag),
+                            records=gene_iterator,
+                        )
+                metric_aggregator.finalize(
+                    mitochondrial_genes=self._mitochondrial_gene_ids
+                )
+                cell_metrics_output.write(cell_tag, vars(metric_aggregator))
+
+
+class GatherGeneMetrics(MetricGatherer):
+    """Per-gene metrics; input must be sorted by GE, CB, UB (molecule fastest)."""
+
+    entity_kind = "gene"
+    columns = GENE_COLUMNS
+
+    def _row_filter(self, name: str) -> bool:
+        # multi-gene groups are skipped entirely, like the counting stage
+        # (reference gatherer.py:211-212)
+        return not (name and len(name.split(",")) > 1)
+
+    def _extract_cpu(self, mode: str = "rb") -> None:
+        with AlignmentReader(self._bam_file, mode if mode != "rb" else None) as bam_iterator, closing(
+            MetricCSVWriter(self._output_stem, self._compress)
+        ) as gene_metrics_output:
+            gene_metrics_output.write_header(vars(GeneMetrics()))
+            for gene_iterator, gene_tag in iter_genes(bam_iterator=iter(bam_iterator)):
+                metric_aggregator = GeneMetrics()
+                if gene_tag and len(gene_tag.split(",")) > 1:
+                    continue
+                for cell_iterator, cell_tag in iter_cell_barcodes(bam_iterator=gene_iterator):
+                    for molecule_iterator, molecule_tag in iter_molecule_barcodes(
+                        bam_iterator=cell_iterator
+                    ):
+                        metric_aggregator.parse_molecule(
+                            tags=(gene_tag, cell_tag, molecule_tag),
+                            records=molecule_iterator,
+                        )
+                metric_aggregator.finalize()
+                gene_metrics_output.write(gene_tag, vars(metric_aggregator))

@@ -1,0 +1,169 @@
+"""Sorted-segment primitives: lexicographic sort, run detection, reductions.
+
+The framework's group-by engine. The reference walks tag-sorted BAMs with
+nested Python iterators (src/sctools/bam.py:492-540 ``iter_tag_groups``) and
+per-group Counter state; here a record batch is a struct-of-arrays, groups are
+*runs* of equal sort keys, and every histogram/Counter becomes a segment
+reduction — the shape XLA tiles well onto TPU.
+
+All functions are jit-compatible with static shapes. Padded (invalid) records
+must carry key values that sort after all real records; reductions mask them
+out via the ``valid`` array.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def lexsort(keys: Sequence[jnp.ndarray], values: Sequence[jnp.ndarray]):
+    """Sort ``values`` (and the keys) lexicographically by ``keys``.
+
+    ``keys[0]`` is the most significant key. Returns (sorted_keys, sorted_values).
+    This is the device analog of the reference's tag-then-queryname sort
+    (src/sctools/bam.py:698-709), and of TagSort's per-batch std::sort
+    (fastqpreprocessing/src/htslib_tagsort.cpp:262-302).
+    """
+    operands = list(keys) + list(values)
+    result = jax.lax.sort(operands, num_keys=len(keys))
+    return result[: len(keys)], result[len(keys):]
+
+
+def sort_permutation(keys: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Permutation that lexicographically sorts ``keys`` (stable)."""
+    n = keys[0].shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    result = jax.lax.sort(list(keys) + [iota], num_keys=len(keys))
+    return result[-1]
+
+
+def run_starts(keys: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Boolean[N]: True where any key differs from the previous record.
+
+    Position 0 is always a start. On key arrays already sorted, runs of True
+    delimit the groups the reference's nested iterators would yield.
+    """
+    starts = jnp.zeros(keys[0].shape[0], dtype=bool).at[0].set(True)
+    for key in keys:
+        changed = jnp.concatenate(
+            [jnp.ones((1,), dtype=bool), key[1:] != key[:-1]]
+        )
+        starts = starts | changed
+    return starts
+
+
+def segment_ids_from_starts(starts: jnp.ndarray) -> jnp.ndarray:
+    """int32[N] run index for each record (0-based, nondecreasing)."""
+    return jnp.cumsum(starts.astype(jnp.int32)) - 1
+
+
+def segment_sum(
+    data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int
+) -> jnp.ndarray:
+    return jax.ops.segment_sum(
+        data, segment_ids, num_segments=num_segments, indices_are_sorted=True
+    )
+
+
+def segment_count(
+    segment_ids: jnp.ndarray, num_segments: int, where: jnp.ndarray = None
+) -> jnp.ndarray:
+    """Number of records per segment, optionally restricted by a mask."""
+    ones = jnp.ones_like(segment_ids, dtype=jnp.int32)
+    if where is not None:
+        ones = jnp.where(where, ones, 0)
+    return segment_sum(ones, segment_ids, num_segments)
+
+
+def segment_min(
+    data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int
+) -> jnp.ndarray:
+    return jax.ops.segment_min(
+        data, segment_ids, num_segments=num_segments, indices_are_sorted=True
+    )
+
+
+def distinct_runs_per_outer(
+    inner_starts: jnp.ndarray,
+    outer_ids: jnp.ndarray,
+    num_segments: int,
+    where: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """Count inner runs inside each outer segment.
+
+    This realizes ``len(histogram.keys())`` (e.g. n_molecules =
+    distinct (cell,umi,gene) triples of a cell, reference aggregator.py:362)
+    as a sum of run-start flags, valid because the batch is sorted so equal
+    keys are adjacent.
+    """
+    flags = inner_starts.astype(jnp.int32)
+    if where is not None:
+        flags = jnp.where(where, flags, 0)
+    return segment_sum(flags, outer_ids, num_segments)
+
+
+def runs_with_count_per_outer(
+    inner_ids: jnp.ndarray,
+    outer_ids: jnp.ndarray,
+    num_segments: int,
+    where: jnp.ndarray = None,
+    predicate: str = "eq1",
+) -> jnp.ndarray:
+    """Count inner runs per outer segment whose record-count satisfies a predicate.
+
+    ``predicate='eq1'`` realizes *_with_single_read_evidence
+    (reference aggregator.py:381-387); ``'gt1'`` realizes
+    genes_detected_multiple_observations / number_cells_detected_multiple
+    (aggregator.py:472-474, 576-578).
+    """
+    num_runs = num_segments  # there can be at most as many runs as records
+    counts = segment_count(inner_ids, num_runs, where=where)
+    if predicate == "eq1":
+        hit = counts == 1
+    elif predicate == "gt1":
+        hit = counts > 1
+    else:
+        raise ValueError(f"unknown predicate {predicate!r}")
+    # owner outer segment of each inner run: all records of an inner run share
+    # one outer id (inner keys refine outer keys), so a min reduction reads it.
+    big = jnp.iinfo(jnp.int32).max
+    owner_src = outer_ids
+    if where is not None:
+        owner_src = jnp.where(where, outer_ids, big)
+    owners = segment_min(owner_src, inner_ids, num_runs)
+    # runs that matched the predicate scatter 1 into their owner
+    safe_owner = jnp.where(owners == big, 0, owners)
+    contrib = jnp.where(hit & (owners != big), 1, 0)
+    return jax.ops.segment_sum(contrib, safe_owner, num_segments=num_segments)
+
+
+def first_index_per_segment(
+    starts: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int
+) -> jnp.ndarray:
+    """Index of the first record of each segment (for gathering group keys)."""
+    n = starts.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    src = jnp.where(starts, iota, jnp.iinfo(jnp.int32).max)
+    return segment_min(src, segment_ids, num_segments)
+
+
+def pad_to(n: int, multiple: int) -> int:
+    """Smallest padded size >= n that is a multiple of ``multiple`` (min 1)."""
+    if n <= 0:
+        return multiple
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def bucket_size(n: int, minimum: int = 4096) -> int:
+    """Power-of-two padded size >= max(n, minimum).
+
+    Bucketing record counts to powers of two bounds the number of distinct
+    compiled shapes (jit specializes per shape) while wasting at most 2x.
+    """
+    size = minimum
+    while size < n:
+        size *= 2
+    return size

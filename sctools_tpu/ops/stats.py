@@ -1,0 +1,47 @@
+"""Segment-parallel moment statistics (mean / sample variance).
+
+Replaces the reference's per-record Welford updates
+(src/sctools/stats.py:58-103, driven one value at a time from
+aggregator.py:266-292) with a two-pass segment reduction: mean first, then
+centered sum of squares. Numerically this is as stable as Welford while being
+embarrassingly parallel; the variance convention matches the Python reference
+(sample variance, nan below two observations) — deliberately not the C++
+sum-of-squares variant (SURVEY.md section 5 quirk 2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .segments import segment_count, segment_sum
+
+
+def segment_mean_and_variance(
+    values: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    where: jnp.ndarray = None,
+):
+    """Per-segment (mean, sample variance, count) of ``values``.
+
+    mean of an empty segment is 0.0 (matching an un-updated reference
+    accumulator, stats.py:79-81); variance of a segment with < 2 records is
+    nan (stats.py:94-99).
+    """
+    dtype = values.dtype
+    count = segment_count(segment_ids, num_segments, where=where)
+    masked = values if where is None else jnp.where(where, values, 0)
+    total = segment_sum(masked, segment_ids, num_segments)
+    safe_count = jnp.maximum(count, 1).astype(dtype)
+    mean = total / safe_count
+    mean = jnp.where(count > 0, mean, 0.0)
+
+    centered = values - mean[segment_ids]
+    sq = centered * centered
+    if where is not None:
+        sq = jnp.where(where, sq, 0)
+    m2 = segment_sum(sq, segment_ids, num_segments)
+    variance = jnp.where(
+        count >= 2, m2 / jnp.maximum(count - 1, 1).astype(dtype), jnp.nan
+    )
+    return mean, variance, count

@@ -1,0 +1,136 @@
+"""Synthetic test-data builders shared across the test suite.
+
+The framework does not ship binary fixtures; all BAM/SAM/FASTQ/GTF inputs are
+generated here (the reference instead checks in ~40 small data files,
+SURVEY.md section 4 — generating keeps fixtures inspectable and lets tests
+parameterize geometry).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from sctools_tpu.io.sam import (
+    AlignmentWriter,
+    BamHeader,
+    BamRecord,
+    FDUP,
+    FREVERSE,
+    FUNMAP,
+)
+
+DEFAULT_REFERENCES = [("chr1", 248956422), ("chr2", 242193529), ("chrM", 16569)]
+
+
+def make_header(references=None) -> BamHeader:
+    references = references if references is not None else DEFAULT_REFERENCES
+    text = "@HD\tVN:1.6\tSO:unsorted\n" + "".join(
+        f"@SQ\tSN:{name}\tLN:{length}\n" for name, length in references
+    )
+    return BamHeader.from_text(text)
+
+
+def make_record(
+    name: str = "read1",
+    cb: Optional[str] = None,
+    cr: Optional[str] = None,
+    cy: Optional[str] = None,
+    ub: Optional[str] = None,
+    ur: Optional[str] = None,
+    uy: Optional[str] = None,
+    ge: Optional[str] = None,
+    xf: Optional[str] = None,
+    nh: Optional[int] = None,
+    reference_id: int = 0,
+    pos: int = 100,
+    unmapped: bool = False,
+    reverse: bool = False,
+    duplicate: bool = False,
+    spliced: bool = False,
+    sequence: str = "ACGTACGTACGTACGTACGTACGTAC",
+    quality: Optional[Sequence[int]] = None,
+    header: Optional[BamHeader] = None,
+) -> BamRecord:
+    """Build a tagged alignment in the 10x vocabulary used by the metrics engine."""
+    flag = 0
+    if unmapped:
+        flag |= FUNMAP
+    if reverse:
+        flag |= FREVERSE
+    if duplicate:
+        flag |= FDUP
+    if quality is None:
+        quality = [37] * len(sequence)
+    if spliced:
+        half = len(sequence) // 2
+        cigar = [(0, half), (3, 400), (0, len(sequence) - half)]
+    else:
+        cigar = [(0, len(sequence))]
+    record = BamRecord(
+        query_name=name,
+        flag=flag,
+        reference_id=-1 if unmapped else reference_id,
+        pos=-1 if unmapped else pos,
+        mapq=0 if unmapped else 255,
+        cigar=[] if unmapped else cigar,
+        sequence=sequence,
+        quality=list(quality),
+        header=header,
+    )
+    for key, value in [
+        ("CB", cb), ("CR", cr), ("CY", cy),
+        ("UB", ub), ("UR", ur), ("UY", uy),
+        ("GE", ge), ("XF", xf),
+    ]:
+        if value is not None:
+            record.set_tag(key, value, "Z")
+    if nh is not None:
+        record.set_tag("NH", nh, "i")
+    return record
+
+
+def write_bam(path: str, records: Sequence[BamRecord], header: Optional[BamHeader] = None,
+              mode: str = "wb") -> str:
+    header = header or make_header()
+    with AlignmentWriter(str(path), header, mode) as writer:
+        for record in records:
+            writer.write(record)
+    return str(path)
+
+
+def random_barcode(rng: random.Random, length: int = 16) -> str:
+    return "".join(rng.choice("ACGT") for _ in range(length))
+
+
+def write_fastq(path: str, records: Sequence[Tuple[str, str, str]]) -> str:
+    """records: (name, sequence, quality) triples; name without '@'."""
+    with open(str(path), "w") as f:
+        for name, seq, qual in records:
+            f.write(f"@{name}\n{seq}\n+\n{qual}\n")
+    return str(path)
+
+
+def write_gtf(path: str, genes: Sequence[Dict], feature: str = "gene") -> str:
+    """genes: dicts with keys chromosome/start/end/gene_name/gene_id."""
+    with open(str(path), "w") as f:
+        f.write("#!genome-build test\n")
+        for g in genes:
+            attrs = f'gene_id "{g["gene_id"]}"; gene_name "{g["gene_name"]}";'
+            f.write(
+                "\t".join(
+                    [
+                        g.get("chromosome", "chr1"),
+                        "test",
+                        g.get("feature", feature),
+                        str(g.get("start", 1)),
+                        str(g.get("end", 1000)),
+                        ".",
+                        g.get("strand", "+"),
+                        ".",
+                        attrs,
+                    ]
+                )
+                + "\n"
+            )
+    return str(path)

@@ -1,0 +1,193 @@
+"""Tests for the bam toolkit: iterators, sorting, tagging, splitting."""
+
+import glob
+import os
+
+import pytest
+
+from sctools_tpu.bam import (
+    SortError,
+    SubsetAlignments,
+    Tagger,
+    TagSortableRecord,
+    get_tag_or_default,
+    iter_cell_barcodes,
+    iter_tag_groups,
+    sort_by_tags_and_queryname,
+    split,
+    verify_sort,
+)
+from sctools_tpu.io.sam import AlignmentReader
+
+from helpers import make_header, make_record, write_bam
+
+
+def _tagged_records(header, cells=("AAAA", "AAAA", "CCCC", None)):
+    return [
+        make_record(name=f"q{i}", cb=cell, ub="ACGT", ge="GENE1", header=header)
+        for i, cell in enumerate(cells)
+    ]
+
+
+def test_iter_tag_groups_runs_and_null():
+    header = make_header()
+    records = _tagged_records(header)
+    groups = list(iter_tag_groups("CB", iter(records)))
+    values = [tag for _reads, tag in groups]
+    assert values == ["AAAA", "CCCC", None]
+
+
+def test_iter_tag_groups_filter_null():
+    header = make_header()
+    records = _tagged_records(header)
+    values = [tag for _r, tag in iter_tag_groups("CB", iter(records), filter_null=True)]
+    assert values == ["AAAA", "CCCC"]
+
+
+def test_iter_tag_groups_empty_iterator():
+    assert list(iter_tag_groups("CB", iter([]))) == []
+
+
+def test_iter_cell_barcodes_counts():
+    header = make_header()
+    records = _tagged_records(header)
+    groups = [(len(list(r)), tag) for r, tag in iter_cell_barcodes(iter(records))]
+    assert groups == [(2, "AAAA"), (1, "CCCC"), (1, None)]
+
+
+def test_sort_by_tags_and_queryname_missing_tag_first():
+    header = make_header()
+    records = [
+        make_record(name="b", cb="CCCC", header=header),
+        make_record(name="a", cb=None, header=header),
+        make_record(name="c", cb="AAAA", header=header),
+    ]
+    ordered = list(sort_by_tags_and_queryname(records, ["CB"]))
+    assert [r.query_name for r in ordered] == ["a", "c", "b"]
+
+
+def test_verify_sort_passes_and_raises():
+    header = make_header()
+    sorted_records = [
+        make_record(name="a", cb="AAAA", header=header),
+        make_record(name="b", cb="CCCC", header=header),
+    ]
+    sortable = [TagSortableRecord.from_aligned_segment(r, ["CB"]) for r in sorted_records]
+    verify_sort(sortable, ["CB"])  # should not raise
+
+    unsorted = [
+        TagSortableRecord.from_aligned_segment(r, ["CB"])
+        for r in reversed(sorted_records)
+    ]
+    with pytest.raises(SortError):
+        verify_sort(unsorted, ["CB"])
+
+
+def test_tag_sortable_record_mismatched_keys():
+    a = TagSortableRecord(["CB"], ["X"], "q")
+    b = TagSortableRecord(["GE"], ["X"], "q")
+    with pytest.raises(ValueError):
+        _ = a < b
+
+
+def test_get_tag_or_default():
+    record = make_record(cb="AAAA")
+    assert get_tag_or_default(record, "CB") == "AAAA"
+    assert get_tag_or_default(record, "ZZ", "dflt") == "dflt"
+
+
+def test_tagger(tmp_path):
+    header = make_header()
+    bam_path = write_bam(
+        tmp_path / "untagged.bam",
+        [make_record(name=f"q{i}", header=header) for i in range(3)],
+        header,
+    )
+
+    def tag_generator():
+        for i in range(3):
+            yield [("CR", f"BC{i:02d}", "Z"), ("UR", "ACGT", "Z")]
+
+    out = str(tmp_path / "tagged.bam")
+    Tagger(bam_path).tag(out, [tag_generator()])
+    got = list(AlignmentReader(out, "rb"))
+    assert [r.get_tag("CR") for r in got] == ["BC00", "BC01", "BC02"]
+    assert all(r.get_tag("UR") == "ACGT" for r in got)
+
+
+def test_tagger_rejects_non_str():
+    with pytest.raises(TypeError):
+        Tagger(123)
+
+
+def test_subset_alignments(tmp_path):
+    header = make_header()  # chr1, chr2, chrM
+    records = [
+        make_record(name="m1", reference_id=0, header=header),
+        make_record(name="u1", unmapped=True, header=header),
+        make_record(name="m2", reference_id=2, header=header),  # chrM
+        make_record(name="m3", reference_id=0, header=header),
+    ]
+    bam_path = write_bam(tmp_path / "subset.bam", records, header)
+    sa = SubsetAlignments(bam_path)
+    indices = sa.indices_by_chromosome(1, "chrM")
+    assert indices == [2]
+    specific, other = sa.indices_by_chromosome(2, "chr1", include_other=1)
+    assert specific == [0, 3]
+    assert other == [1]
+
+
+def test_subset_alignments_bad_extension():
+    with pytest.raises(ValueError):
+        SubsetAlignments("file.txt")
+
+
+def test_split_partitions_barcodes(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    header = make_header()
+    cells = [f"CELL{i}" for i in range(6)]
+    records = [
+        make_record(name=f"q{i}_{j}", cb=cell, header=header)
+        for i, cell in enumerate(cells)
+        for j in range(3)
+    ]
+    bam_path = write_bam(tmp_path / "tosplit.bam", records, header)
+
+    # tiny chunk size forces multiple output files
+    size_mb = os.path.getsize(bam_path) * 1e-6
+    outputs = split(
+        [bam_path], str(tmp_path / "chunk"), ["CB"],
+        approx_mb_per_split=size_mb / 3 + 1e-9, num_processes=2,
+    )
+    assert len(outputs) >= 2
+
+    # every barcode lives in exactly one chunk (the scatter invariant)
+    seen = {}
+    total = 0
+    for chunk in outputs:
+        chunk_cells = set()
+        for record in AlignmentReader(chunk, "rb"):
+            chunk_cells.add(record.get_tag("CB"))
+            total += 1
+        for cell in chunk_cells:
+            assert cell not in seen, f"{cell} appears in two chunks"
+            seen[cell] = chunk
+    assert total == len(records)
+    assert set(seen) == set(cells)
+    # temp scatter directories were cleaned up
+    assert not glob.glob(str(tmp_path / "tosplit_*"))
+
+
+def test_split_raise_missing(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    header = make_header()
+    records = [make_record(name="q", cb=None, header=header)]
+    bam_path = write_bam(tmp_path / "notags.bam", records, header)
+    with pytest.raises(RuntimeError):
+        split([bam_path], str(tmp_path / "x"), ["CB"], raise_missing=True,
+              num_processes=1)
+
+
+def test_split_requires_tags(tmp_path):
+    with pytest.raises(ValueError):
+        split([str(tmp_path / "a.bam")], "x", [])

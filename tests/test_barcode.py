@@ -1,0 +1,72 @@
+import numpy as np
+import pytest
+
+from sctools_tpu.barcode import Barcodes, ErrorsToCorrectBarcodesMap
+from sctools_tpu.io.sam import AlignmentReader
+
+from helpers import make_header, make_record, write_bam
+
+
+@pytest.fixture
+def whitelist_file(tmp_path):
+    path = tmp_path / "wl.txt"
+    path.write_text("AACC\nGGTT\n")
+    return str(path)
+
+
+def test_barcodes_from_whitelist(whitelist_file):
+    barcodes = Barcodes.from_whitelist(whitelist_file, 4)
+    assert len(barcodes) == 2
+
+
+def test_barcodes_base_frequency_and_diversity():
+    barcodes = Barcodes.from_iterable_strings(["AACC", "GGTT", "ACGT", "TGCA"], 4)
+    freq = barcodes.base_frequency()
+    assert freq.shape == (4, 4)
+    assert freq.sum() == 16
+    diversity = barcodes.effective_diversity()
+    assert diversity.shape == (4,)
+    assert np.all((0 <= diversity) & (diversity <= 1))
+
+
+def test_barcodes_hamming_summary():
+    barcodes = Barcodes.from_iterable_strings(["AAAA", "AAAT", "TTTT"], 4)
+    summary = barcodes.summarize_hamming_distances()
+    assert summary["minimum"] == 1.0
+    assert summary["maximum"] == 4.0
+
+
+def test_barcodes_requires_mapping():
+    with pytest.raises(TypeError):
+        Barcodes(["AAAA"], 4)
+
+
+def test_error_map_corrects_within_one(whitelist_file):
+    error_map = ErrorsToCorrectBarcodesMap.single_hamming_errors_from_whitelist(whitelist_file)
+    assert error_map.get_corrected_barcode("AACC") == "AACC"  # exact
+    assert error_map.get_corrected_barcode("TACC") == "AACC"  # one substitution
+    assert error_map.get_corrected_barcode("AANC") == "AACC"  # N counts as an error base
+    with pytest.raises(KeyError):
+        error_map.get_corrected_barcode("TTCC")  # distance 2
+
+
+def test_error_map_requires_mapping():
+    with pytest.raises(TypeError):
+        ErrorsToCorrectBarcodesMap(["AACC"])
+
+
+def test_correct_bam(tmp_path, whitelist_file):
+    header = make_header()
+    records = [
+        make_record(name="ok", cr="AACC", header=header),
+        make_record(name="fixable", cr="TACC", header=header),
+        make_record(name="lost", cr="TTCC", header=header),
+    ]
+    in_bam = write_bam(tmp_path / "in.bam", records, header)
+    out_bam = str(tmp_path / "out.bam")
+
+    error_map = ErrorsToCorrectBarcodesMap.single_hamming_errors_from_whitelist(whitelist_file)
+    error_map.correct_bam(in_bam, out_bam)
+
+    got = {r.query_name: r.get_tag("CB") for r in AlignmentReader(out_bam, "rb")}
+    assert got == {"ok": "AACC", "fixable": "AACC", "lost": "TTCC"}

@@ -1,0 +1,104 @@
+import gzip
+
+import pytest
+
+from sctools_tpu import fastq
+from sctools_tpu.consts import CELL_BARCODE_TAG_KEY
+
+from helpers import write_fastq
+
+RECORDS = [
+    ("r1", "ACGTACGTACGTACGTACGTACGTAC", "I" * 26),
+    ("r2", "TTTTGGGGCCCCAAAATTTTGGGGCC", "I" * 26),
+    ("r3", "GATTACAGATTACAGATTACAGATTA", "I" * 26),
+]
+
+CB = fastq.EmbeddedBarcode(start=0, end=16, sequence_tag="CR", quality_tag="CY")
+UMI = fastq.EmbeddedBarcode(start=16, end=26, sequence_tag="UR", quality_tag="UY")
+
+
+@pytest.fixture(params=["plain", "gz"])
+def fastq_file(request, tmp_path):
+    path = tmp_path / "t.fastq"
+    write_fastq(path, RECORDS)
+    if request.param == "gz":
+        gz = tmp_path / "t.fastq.gz"
+        gz.write_bytes(gzip.compress(path.read_bytes()))
+        return str(gz)
+    return str(path)
+
+
+def test_reader_str_mode(fastq_file):
+    records = list(fastq.Reader(fastq_file, mode="r"))
+    assert len(records) == 3
+    assert records[0].name == "@r1\n"
+    assert records[0].sequence == RECORDS[0][1] + "\n"
+    assert isinstance(records[0], fastq.StrRecord)
+
+
+def test_reader_bytes_mode(fastq_file):
+    records = list(fastq.Reader(fastq_file, mode="rb"))
+    assert records[0].name == b"@r1\n"
+    assert bytes(records[0]).startswith(b"@r1")
+
+
+def test_record_len_and_quality(fastq_file):
+    record = next(iter(fastq.Reader(fastq_file, mode="r")))
+    assert len(record) == 27  # sequence including trailing newline
+    assert record.average_quality() == pytest.approx(ord("I") - 33)
+
+
+def test_record_validation():
+    with pytest.raises(ValueError):
+        fastq.StrRecord(("r1\n", "ACGT\n", "+\n", "IIII\n"))  # name missing @
+    with pytest.raises(TypeError):
+        fastq.StrRecord((1, "ACGT\n", "+\n", "IIII\n"))
+
+
+def test_extract_barcode():
+    record = fastq.StrRecord(("@r\n", "ACGTACGTACGTACGTACGTACGTAC\n", "+\n", "I" * 26 + "\n"))
+    seq_tag, qual_tag = fastq.extract_barcode(record, CB)
+    assert seq_tag == ("CR", "ACGTACGTACGTACGT", "Z")
+    assert qual_tag == ("CY", "I" * 16, "Z")
+
+
+def test_embedded_barcode_generator(fastq_file):
+    gen = fastq.EmbeddedBarcodeGenerator(fastq_file, [CB, UMI])
+    first = next(iter(gen))
+    tags = {t[0]: t[1] for t in first}
+    assert tags["CR"] == RECORDS[0][1][:16]
+    assert tags["UR"] == RECORDS[0][1][16:26]
+
+
+def test_corrected_cell_barcode_generator(tmp_path, fastq_file):
+    whitelist = tmp_path / "wl.txt"
+    # r1's barcode verbatim; r2's barcode with one substitution at pos 0
+    wl_r2 = "A" + RECORDS[1][1][1:16]
+    whitelist.write_text(RECORDS[0][1][:16] + "\n" + wl_r2 + "\n")
+
+    gen = fastq.BarcodeGeneratorWithCorrectedCellBarcodes(
+        fastq_file, embedded_cell_barcode=CB, whitelist=str(whitelist),
+        other_embedded_barcodes=[UMI],
+    )
+    results = list(gen)
+
+    # r1: exact whitelist hit -> corrected tag present, equal to raw
+    tags1 = {t[0]: t[1] for t in results[0]}
+    assert tags1[CELL_BARCODE_TAG_KEY] == RECORDS[0][1][:16]
+    # r2: within hamming 1 -> corrected to whitelist entry
+    tags2 = {t[0]: t[1] for t in results[1]}
+    assert tags2[CELL_BARCODE_TAG_KEY] == wl_r2
+    assert tags2["CR"] == RECORDS[1][1][:16]
+    # r3: beyond hamming 1 -> no corrected tag
+    tags3 = {t[0]: t[1] for t in results[2]}
+    assert CELL_BARCODE_TAG_KEY not in tags3
+
+
+def test_corrected_generator_rejects_bad_other_barcodes(fastq_file, tmp_path):
+    whitelist = tmp_path / "wl.txt"
+    whitelist.write_text("ACGT\n")
+    with pytest.raises(TypeError):
+        fastq.BarcodeGeneratorWithCorrectedCellBarcodes(
+            fastq_file, embedded_cell_barcode=CB, whitelist=str(whitelist),
+            other_embedded_barcodes="notalist",
+        )

@@ -1,0 +1,86 @@
+import pytest
+
+from sctools_tpu import gtf
+
+from helpers import write_gtf
+
+GENES = [
+    dict(gene_id="ENSG1", gene_name="ACTB", chromosome="chr1", start=100, end=500),
+    dict(gene_id="ENSG2", gene_name="GAPDH", chromosome="chr1", start=700, end=900),
+    dict(gene_id="ENSGM", gene_name="mt-Nd1", chromosome="chrM", start=10, end=200),
+    dict(gene_id="ENSGM2", gene_name="MT-CO1", chromosome="chrM", start=300, end=400),
+]
+
+
+@pytest.fixture
+def gtf_file(tmp_path):
+    return write_gtf(tmp_path / "t.gtf", GENES)
+
+
+def test_record_fields(gtf_file):
+    record = next(iter(gtf.Reader(gtf_file)))
+    assert record.seqname == "chr1"
+    assert record.chromosome == "chr1"
+    assert record.feature == "gene"
+    assert record.start == 100
+    assert record.end == 500
+    assert record.strand == "+"
+    assert record.size == 400
+    assert record.get_attribute("gene_id") == "ENSG1"
+    assert record.get_attribute("gene_name") == "ACTB"
+    assert record.get_attribute("nonexistent") is None
+
+
+def test_record_set_attribute(gtf_file):
+    record = next(iter(gtf.Reader(gtf_file)))
+    record.set_attribute("foo", "bar")
+    assert record.get_attribute("foo") == "bar"
+    assert 'foo "bar";' in str(record)
+
+
+def test_filter(gtf_file, tmp_path):
+    exons = [dict(gene_id="E", gene_name="E", feature="exon")]
+    mixed = write_gtf(tmp_path / "mixed.gtf", GENES + exons)
+    records = list(gtf.Reader(mixed).filter(["exon"]))
+    assert len(records) == 1
+    assert records[0].feature == "exon"
+
+
+def test_extract_gene_names(gtf_file):
+    mapping = gtf.extract_gene_names(gtf_file)
+    assert mapping == {"ACTB": 0, "GAPDH": 1, "mt-Nd1": 2, "MT-CO1": 3}
+
+
+def test_extract_gene_names_duplicate_skipped(tmp_path):
+    dup = write_gtf(tmp_path / "dup.gtf", GENES + [GENES[0]])
+    mapping = gtf.extract_gene_names(dup)
+    assert mapping["ACTB"] == 0
+    assert len(mapping) == 4
+
+
+def test_get_mitochondrial_gene_names(gtf_file):
+    mito = gtf.get_mitochondrial_gene_names(gtf_file)
+    assert mito == {"ENSGM", "ENSGM2"}  # matches ^mt- case-insensitively
+
+
+def test_extract_extended_gene_names(gtf_file):
+    locations = gtf.extract_extended_gene_names(gtf_file)
+    assert locations["chr1"] == [((100, 500), "ACTB"), ((700, 900), "GAPDH")]
+    assert locations["chrM"][0][1] == "mt-Nd1"
+
+
+def test_extract_gene_exons(tmp_path):
+    exons = [
+        dict(gene_id="G1", gene_name="G1", feature="exon", start=10, end=20),
+        dict(gene_id="G1", gene_name="G1", feature="exon", start=30, end=40),
+    ]
+    path = write_gtf(tmp_path / "exons.gtf", exons)
+    result = gtf.extract_gene_exons(path)
+    assert result["chr1"] == [([(10, 20), (30, 40)], "G1")]
+
+
+def test_missing_gene_name_raises(tmp_path):
+    path = tmp_path / "bad.gtf"
+    path.write_text('chr1\ttest\tgene\t1\t10\t.\t+\t.\tgene_id "X";\n')
+    with pytest.raises(ValueError):
+        gtf.extract_gene_names(str(path))

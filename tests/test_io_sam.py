@@ -1,0 +1,197 @@
+"""Round-trip tests for the BGZF + BAM/SAM codec."""
+
+import gzip
+import struct
+
+import pytest
+
+from sctools_tpu.io import bgzf
+from sctools_tpu.io.sam import (
+    AlignmentFile,
+    AlignmentReader,
+    AlignmentWriter,
+    BamHeader,
+    BamRecord,
+    merge_bam_files,
+)
+
+from helpers import make_header, make_record, write_bam
+
+
+# ---- BGZF -----------------------------------------------------------------
+
+
+def test_bgzf_roundtrip(tmp_path):
+    payload = b"The quick brown fox jumps over the lazy dog" * 5000
+    path = tmp_path / "x.bgzf"
+    with bgzf.BgzfWriter(str(path)) as writer:
+        writer.write(payload)
+    assert bgzf.is_bgzf(str(path))
+    assert gzip.decompress(path.read_bytes()) == payload
+    blocks = list(bgzf.iter_blocks(open(path, "rb")))
+    assert b"".join(blocks) == payload
+    assert blocks[-1] == b""  # EOF marker block
+    # every non-final block respects the 64 KiB bound
+    assert all(len(b) <= bgzf.MAX_BLOCK_PAYLOAD for b in blocks)
+
+
+def test_bgzf_eof_marker(tmp_path):
+    path = tmp_path / "e.bgzf"
+    with bgzf.BgzfWriter(str(path)) as writer:
+        writer.write(b"abc")
+    assert path.read_bytes().endswith(bgzf.BGZF_EOF)
+
+
+# ---- BAM record codec -----------------------------------------------------
+
+
+def test_bam_record_roundtrip_through_file(tmp_path):
+    header = make_header()
+    records = [
+        make_record(
+            name="q1", cb="AAACCTGA", cr="AAACCTGA", cy="IIIIIIII",
+            ub="ACGTACGTAC", ur="ACGTACGTAC", uy="IIIIIIIIII",
+            ge="GENE1", xf="CODING", nh=1, pos=1234, header=header,
+        ),
+        make_record(name="q2", unmapped=True, header=header),
+        make_record(name="q3", reverse=True, duplicate=True, spliced=True,
+                    reference_id=2, pos=99, header=header),
+    ]
+    path = write_bam(tmp_path / "t.bam", records, header)
+
+    reader = AlignmentReader(path, "rb")
+    assert reader.header.references == header.references
+    got = list(reader)
+    assert len(got) == 3
+
+    r1 = got[0]
+    assert r1.query_name == "q1"
+    assert r1.get_tag("CB") == "AAACCTGA"
+    assert r1.get_tag("XF") == "CODING"
+    assert r1.get_tag("NH") == 1
+    assert r1.pos == 1234
+    assert not r1.is_unmapped
+    assert r1.sequence == records[0].sequence
+    assert r1.quality == records[0].quality
+
+    r2 = got[1]
+    assert r2.is_unmapped
+    assert r2.reference_id == -1
+
+    r3 = got[2]
+    assert r3.is_reverse and r3.is_duplicate
+    assert r3.reference_name == "chrM"
+    stats, counts = r3.get_cigar_stats()
+    assert stats[3] == 400  # N op base count == splice signal
+    assert counts[0] == 2
+
+
+def test_tag_types_roundtrip(tmp_path):
+    header = make_header()
+    record = make_record(name="q", header=header)
+    record.set_tag("Xi", -5, "i")
+    record.set_tag("Xf", 2.5, "f")
+    record.set_tag("Xa", "Q", "A")
+    record.set_tag("XB", ("i", [1, -2, 3]), "B")
+    record.set_tag("XS", "hello world", "Z")
+    path = write_bam(tmp_path / "tags.bam", [record], header)
+    (got,) = list(AlignmentReader(path, "rb"))
+    assert got.get_tag("Xi") == -5
+    assert got.get_tag("Xf") == pytest.approx(2.5)
+    assert got.get_tag("Xa") == "Q"
+    assert got.get_tag("XB") == ("i", [1, -2, 3])
+    assert got.get_tag("XS") == "hello world"
+    with pytest.raises(KeyError):
+        got.get_tag("ZZ")
+    assert not got.has_tag("ZZ")
+
+
+def test_set_tag_none_removes(tmp_path):
+    record = make_record(cb="AAAA")
+    assert record.has_tag("CB")
+    record.set_tag("CB", None)
+    assert not record.has_tag("CB")
+
+
+def test_query_alignment_qualities_excludes_softclip():
+    record = make_record(sequence="ACGTACGTAC", quality=list(range(10)))
+    record.cigar = [(4, 2), (0, 6), (4, 2)]  # 2S6M2S
+    assert record.query_alignment_qualities == list(range(2, 8))
+    assert record.query_alignment_sequence == "GTACGT"
+    # unmapped record: full qualities
+    unmapped = make_record(unmapped=True, sequence="ACGT", quality=[1, 2, 3, 4])
+    assert unmapped.query_alignment_qualities == [1, 2, 3, 4]
+
+
+def test_sam_text_roundtrip(tmp_path):
+    header = make_header()
+    records = [
+        make_record(name="q1", cb="ACGT", nh=2, pos=7, header=header),
+        make_record(name="q2", unmapped=True, header=header),
+    ]
+    path = str(tmp_path / "t.sam")
+    with AlignmentWriter(path, header, "w") as writer:
+        for record in records:
+            writer.write(record)
+
+    text = open(path).read()
+    assert text.startswith("@HD")
+    assert "CB:Z:ACGT" in text and "NH:i:2" in text
+
+    got = list(AlignmentReader(path, "r"))
+    assert got[0].query_name == "q1"
+    assert got[0].pos == 7
+    assert got[0].get_tag("CB") == "ACGT"
+    assert got[0].get_tag("NH") == 2
+    assert got[1].is_unmapped
+
+
+def test_alignment_file_dispatch_and_template(tmp_path):
+    header = make_header()
+    path = write_bam(tmp_path / "a.bam", [make_record(name="x", header=header)], header)
+    reader = AlignmentFile(path, "rb")
+    out = str(tmp_path / "b.bam")
+    writer = AlignmentFile(out, "wb", template=reader)
+    for record in reader:
+        writer.write(record)
+    writer.close()
+    reader.close()
+    (got,) = list(AlignmentReader(out, "rb"))
+    assert got.query_name == "x"
+
+
+def test_format_sniffing(tmp_path):
+    header = make_header()
+    bam_path = write_bam(tmp_path / "sniff.weird_ext", [make_record(header=header)], header)
+    reader = AlignmentReader(bam_path, None)  # no mode hint
+    assert len(list(reader)) == 1
+
+
+def test_merge_bam_files(tmp_path):
+    header = make_header()
+    p1 = write_bam(tmp_path / "m1.bam", [make_record(name="a", header=header)], header)
+    p2 = write_bam(tmp_path / "m2.bam", [make_record(name="b", header=header),
+                                          make_record(name="c", header=header)], header)
+    out = str(tmp_path / "merged.bam")
+    merge_bam_files(out, [p1, p2])
+    names = [r.query_name for r in AlignmentReader(out, "rb")]
+    assert names == ["a", "b", "c"]
+
+
+def test_missing_quality_roundtrip(tmp_path):
+    header = make_header()
+    record = make_record(name="nq", header=header)
+    record.quality = None
+    path = write_bam(tmp_path / "nq.bam", [record], header)
+    (got,) = list(AlignmentReader(path, "rb"))
+    assert got.quality is None
+    # SAM representation should be '*'
+    assert got.to_sam_line(header).split("\t")[10] == "*"
+
+
+def test_non_bam_raises(tmp_path):
+    path = tmp_path / "x.bam"
+    with bgzf.BgzfWriter(str(path)) as writer:
+        writer.write(b"NOTBAM__")
+    with pytest.raises(ValueError):
+        AlignmentReader(str(path), "rb")

@@ -1,0 +1,260 @@
+"""Metrics engine tests: device-vs-oracle parity on randomized data + goldens.
+
+The device engine (sorted-segment reductions) must reproduce the streaming
+host aggregator (exact reference semantics) on arbitrary valid inputs. This is
+the framework's version of the reference's golden-value strategy
+(test_metrics.py there), strengthened with a randomized generator.
+"""
+
+import math
+import random
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sctools_tpu.bam import sort_by_tags_and_queryname
+from sctools_tpu.metrics.gatherer import GatherCellMetrics, GatherGeneMetrics
+from sctools_tpu.metrics.merge import MergeCellMetrics, MergeGeneMetrics
+
+from helpers import make_header, make_record, write_bam
+
+GENES = ["ACTB", "GAPDH", "mt-Nd1", ""]  # "" => no GE tag
+MULTI_GENE = "ACTB,GAPDH"
+MITO_GENES = {"mt-Nd1"}
+XF_VALUES = ["CODING", "INTRONIC", "UTR", "INTERGENIC"]
+
+
+def _random_quality(rng, length):
+    return "".join(chr(rng.randint(2, 40) + 33) for _ in range(length))
+
+
+def random_tagged_records(seed=0, n_records=400, n_cells=6, header=None):
+    """Generate a messy but reference-valid set of tagged alignments."""
+    rng = random.Random(seed)
+    header = header or make_header()
+    cells = [f"CELL{i:02d}AACC" for i in range(n_cells)] + [None]  # None => no CB
+    umis = [f"{u:04d}".replace("0", "A").replace("1", "C").replace("2", "T")
+            .replace("3", "G").replace("4", "A").replace("5", "C")
+            .replace("6", "T").replace("7", "G").replace("8", "A")
+            .replace("9", "C") for u in range(8)]
+    records = []
+    for i in range(n_records):
+        cell = rng.choice(cells)
+        umi = rng.choice(umis)
+        gene = rng.choice(GENES + [MULTI_GENE])
+        unmapped = rng.random() < 0.15
+        kwargs = dict(
+            name=f"q{i:05d}",
+            cb=cell,
+            cr=(cell if rng.random() < 0.8 else "T" + cell[1:]) if cell else None,
+            cy=_random_quality(rng, 16),
+            ub=umi,
+            ur=umi if rng.random() < 0.7 else ("T" + umi[1:]),
+            uy=_random_quality(rng, 10),
+            ge=gene if gene else None,
+            unmapped=unmapped,
+            header=header,
+        )
+        if not unmapped:
+            kwargs.update(
+                xf=rng.choice(XF_VALUES),
+                nh=rng.choice([1, 1, 1, 2, 3]),
+                reference_id=rng.choice([0, 1, 2]),
+                pos=rng.choice([100, 200, 300]),
+                reverse=rng.random() < 0.5,
+                duplicate=rng.random() < 0.2,
+                spliced=rng.random() < 0.3,
+            )
+        quality = [rng.randint(2, 40) for _ in range(26)]
+        kwargs["quality"] = quality
+        records.append(make_record(**kwargs))
+    return records, header
+
+
+def _gather_both(tmp_path, gatherer_cls, sort_tags, seed=0, **kwargs):
+    records, header = random_tagged_records(seed=seed)
+    records = list(sort_by_tags_and_queryname(records, sort_tags))
+    bam = write_bam(tmp_path / "sorted.bam", records, header)
+
+    out_device = str(tmp_path / "device")
+    out_cpu = str(tmp_path / "cpu")
+    gatherer_cls(bam, out_device, backend="device", **kwargs).extract_metrics()
+    gatherer_cls(bam, out_cpu, backend="cpu", **kwargs).extract_metrics()
+
+    df_device = pd.read_csv(out_device + ".csv.gz", index_col=0)
+    df_cpu = pd.read_csv(out_cpu + ".csv.gz", index_col=0)
+    return df_device, df_cpu
+
+
+def _assert_frames_match(df_device, df_cpu):
+    assert list(df_device.index) == list(df_cpu.index)
+    assert list(df_device.columns) == list(df_cpu.columns)
+    for column in df_cpu.columns:
+        a = df_device[column].to_numpy(dtype=float)
+        b = df_cpu[column].to_numpy(dtype=float)
+        np.testing.assert_allclose(
+            a, b, rtol=2e-4, atol=1e-6, equal_nan=True,
+            err_msg=f"column {column} mismatch",
+        )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_cell_metrics_device_matches_oracle(tmp_path, seed):
+    df_device, df_cpu = _gather_both(
+        tmp_path, GatherCellMetrics, ["CB", "UB", "GE"], seed=seed,
+        mitochondrial_gene_ids=MITO_GENES,
+    )
+    assert df_cpu.shape[1] == 35
+    _assert_frames_match(df_device, df_cpu)
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_gene_metrics_device_matches_oracle(tmp_path, seed):
+    df_device, df_cpu = _gather_both(
+        tmp_path, GatherGeneMetrics, ["GE", "CB", "UB"], seed=seed,
+    )
+    assert df_cpu.shape[1] == 26
+    _assert_frames_match(df_device, df_cpu)
+    # the multi-gene group must not produce a row
+    assert MULTI_GENE not in df_device.index
+
+
+def test_cell_metrics_golden_small(tmp_path):
+    """Hand-checkable case: 2 cells, known molecule/fragment structure."""
+    header = make_header()
+    quality = [35] * 26
+    records = [
+        # cell A: 2 reads of one molecule (same umi+gene), one duplicate
+        make_record(name="r1", cb="AAAA", cr="AAAA", cy="I" * 16, ub="ACGT",
+                    ur="ACGT", uy="I" * 10, ge="ACTB", xf="CODING", nh=1,
+                    pos=100, quality=quality, header=header),
+        make_record(name="r2", cb="AAAA", cr="AAAA", cy="I" * 16, ub="ACGT",
+                    ur="ACGT", uy="I" * 10, ge="ACTB", xf="CODING", nh=1,
+                    pos=150, duplicate=True, quality=quality, header=header),
+        # cell B: 1 read, imperfect barcodes, mito gene, spliced, multi-mapped
+        make_record(name="r3", cb="CCCC", cr="TCCC", cy="I" * 16, ub="GGGG",
+                    ur="TGGG", uy="I" * 10, ge="mt-Nd1", xf="UTR", nh=2,
+                    pos=200, spliced=True, quality=quality, header=header),
+    ]
+    records = list(sort_by_tags_and_queryname(records, ["CB", "UB", "GE"]))
+    bam = write_bam(tmp_path / "golden.bam", records, header)
+    out = str(tmp_path / "golden_out")
+    GatherCellMetrics(bam, out, mitochondrial_gene_ids=MITO_GENES,
+                      backend="device").extract_metrics()
+    df = pd.read_csv(out + ".csv.gz", index_col=0)
+
+    assert list(df.index) == ["AAAA", "CCCC"]
+    a = df.loc["AAAA"]
+    assert a["n_reads"] == 2
+    assert a["n_molecules"] == 1
+    assert a["n_fragments"] == 2  # different positions
+    assert a["perfect_molecule_barcodes"] == 2
+    assert a["perfect_cell_barcodes"] == 2
+    assert a["reads_mapped_exonic"] == 2
+    assert a["reads_mapped_uniquely"] == 2
+    assert a["duplicate_reads"] == 1
+    assert a["reads_per_molecule"] == 2.0
+    assert a["fragments_with_single_read_evidence"] == 2
+    assert a["molecules_with_single_read_evidence"] == 0
+    assert a["n_genes"] == 1
+    assert a["n_mitochondrial_genes"] == 0
+    assert a["pct_mitochondrial_molecules"] == 0.0
+
+    b = df.loc["CCCC"]
+    assert b["n_reads"] == 1
+    assert b["perfect_molecule_barcodes"] == 0
+    assert b["perfect_cell_barcodes"] == 0
+    assert b["reads_mapped_utr"] == 1
+    assert b["reads_mapped_multiple"] == 1
+    assert b["spliced_reads"] == 1
+    assert b["n_mitochondrial_genes"] == 1
+    assert b["n_mitochondrial_molecules"] == 1
+    assert b["pct_mitochondrial_molecules"] == 100.0
+    assert math.isnan(b["molecule_barcode_fraction_bases_above_30_variance"])
+
+
+def test_gene_metrics_golden_small(tmp_path):
+    header = make_header()
+    quality = [35] * 26
+    records = [
+        make_record(name="r1", cb="AAAA", cy="I" * 16, ub="ACGT", ur="ACGT",
+                    uy="I" * 10, ge="ACTB", xf="CODING", nh=1, pos=100,
+                    quality=quality, header=header),
+        make_record(name="r2", cb="AAAA", cy="I" * 16, ub="ACGT", ur="ACGT",
+                    uy="I" * 10, ge="ACTB", xf="CODING", nh=1, pos=100,
+                    quality=quality, header=header),
+        make_record(name="r3", cb="CCCC", cy="I" * 16, ub="GGGG", ur="GGGG",
+                    uy="I" * 10, ge="ACTB", xf="CODING", nh=1, pos=300,
+                    quality=quality, header=header),
+    ]
+    records = list(sort_by_tags_and_queryname(records, ["GE", "CB", "UB"]))
+    bam = write_bam(tmp_path / "gg.bam", records, header)
+    out = str(tmp_path / "gg_out")
+    GatherGeneMetrics(bam, out, backend="device").extract_metrics()
+    df = pd.read_csv(out + ".csv.gz", index_col=0)
+
+    assert list(df.index) == ["ACTB"]
+    g = df.loc["ACTB"]
+    assert g["n_reads"] == 3
+    assert g["n_molecules"] == 2  # (ACTB,AAAA,ACGT) and (ACTB,CCCC,GGGG)
+    assert g["number_cells_expressing"] == 2
+    assert g["number_cells_detected_multiple"] == 1  # AAAA saw 2 reads
+    assert g["n_fragments"] == 2  # r1 == r2 fragment key
+
+
+def test_merge_cell_metrics(tmp_path):
+    df = pd.DataFrame(
+        {"n_reads": [5, 3]}, index=["AAAA", "CCCC"],
+    )
+    f1 = str(tmp_path / "c1.csv")
+    f2 = str(tmp_path / "c2.csv")
+    df.to_csv(f1)
+    df.rename(index={"AAAA": "GGGG", "CCCC": "TTTT"}).to_csv(f2)
+    out = str(tmp_path / "merged_cell")
+    MergeCellMetrics([f1, f2], out).execute()
+    merged = pd.read_csv(out + ".csv.gz", index_col=0)
+    assert merged.shape[0] == 4
+    assert set(merged.index) == {"AAAA", "CCCC", "GGGG", "TTTT"}
+
+
+def test_merge_gene_metrics_doubles_counts(tmp_path):
+    """Merging a gene metrics file with itself: counts double, averages hold."""
+    header = make_header()
+    quality = [35] * 26
+    records = [
+        make_record(name=f"r{i}", cb="AAAA", cy="I" * 16, ub=f"ACG{b}",
+                    ur=f"ACG{b}", uy="I" * 10, ge="ACTB", xf="CODING", nh=1,
+                    pos=100 + i, quality=quality, header=header)
+        for i, b in enumerate("TTGG")
+    ]
+    records = list(sort_by_tags_and_queryname(records, ["GE", "CB", "UB"]))
+    bam = write_bam(tmp_path / "mg.bam", records, header)
+    out = str(tmp_path / "mg_out")
+    GatherGeneMetrics(bam, out, backend="device").extract_metrics()
+
+    merged_out = str(tmp_path / "mg_merged")
+    MergeGeneMetrics([out + ".csv.gz", out + ".csv.gz"], merged_out).execute()
+    original = pd.read_csv(out + ".csv.gz", index_col=0)
+    merged = pd.read_csv(merged_out + ".csv.gz", index_col=0)
+
+    assert merged.loc["ACTB", "n_reads"] == 2 * original.loc["ACTB", "n_reads"]
+    assert merged.loc["ACTB", "n_molecules"] == 2 * original.loc["ACTB", "n_molecules"]
+    assert merged.loc["ACTB", "genomic_read_quality_mean"] == pytest.approx(
+        original.loc["ACTB", "genomic_read_quality_mean"]
+    )
+    assert merged.loc["ACTB", "reads_per_molecule"] == pytest.approx(
+        original.loc["ACTB", "reads_per_molecule"]
+    )
+
+
+def test_uncompressed_output(tmp_path):
+    header = make_header()
+    records = [make_record(name="r", cb="AAAA", cy="I" * 16, ub="ACGT",
+                           ur="ACGT", uy="I" * 10, ge="ACTB", xf="CODING",
+                           nh=1, header=header)]
+    bam = write_bam(tmp_path / "u.bam", records, header)
+    out = str(tmp_path / "u_out")
+    GatherCellMetrics(bam, out, compress=False, backend="device").extract_metrics()
+    text = open(out + ".csv").read()
+    assert text.startswith(",n_reads,")
