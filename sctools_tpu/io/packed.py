@@ -27,6 +27,16 @@ from .sam import AlignmentReader, BamRecord
 
 _QUAL_THRESHOLD = 30
 
+# Padding fill per column for device batches. Columns absent here pad with
+# 0/False; these sentinels mean "absent" to the metric semantics (NH missing,
+# perfect-barcode not computable) and must be used by every padder so the
+# policy cannot diverge between the single-device and sharded paths.
+PAD_FILLS = {
+    "nh": -1,
+    "perfect_umi": -1,
+    "perfect_cb": -1,
+}
+
 
 @dataclass
 class ReadFrame:

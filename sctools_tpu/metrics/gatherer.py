@@ -17,7 +17,7 @@ from typing import Dict, List, Set
 import numpy as np
 
 from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
-from ..io.packed import ReadFrame, frame_from_bam
+from ..io.packed import PAD_FILLS, ReadFrame, frame_from_bam
 from ..io.sam import AlignmentReader
 from ..ops.segments import bucket_size
 from .aggregator import CellMetrics, GeneMetrics
@@ -47,9 +47,13 @@ def _pad_columns(frame: ReadFrame, is_mito: np.ndarray) -> Dict[str, np.ndarray]
         "duplicate": pad(frame.duplicate, False),
         "spliced": pad(frame.spliced, False),
         "xf": pad(frame.xf.astype(np.int32), 0, np.int32),
-        "nh": pad(frame.nh, -1, np.int32),
-        "perfect_umi": pad(frame.perfect_umi.astype(np.int32), -1, np.int32),
-        "perfect_cb": pad(frame.perfect_cb.astype(np.int32), -1, np.int32),
+        "nh": pad(frame.nh, PAD_FILLS["nh"], np.int32),
+        "perfect_umi": pad(
+            frame.perfect_umi.astype(np.int32), PAD_FILLS["perfect_umi"], np.int32
+        ),
+        "perfect_cb": pad(
+            frame.perfect_cb.astype(np.int32), PAD_FILLS["perfect_cb"], np.int32
+        ),
         "umi_frac30": pad(np.nan_to_num(frame.umi_frac30, nan=0.0), 0.0, np.float32),
         "cb_frac30": pad(np.nan_to_num(frame.cb_frac30, nan=0.0), 0.0, np.float32),
         "genomic_frac30": pad(

@@ -1,0 +1,33 @@
+"""Mesh construction helpers.
+
+One logical axis (``shard``) is enough for this framework's domain: the record
+space is partitioned by entity hash, and every collective (all_to_all rekey,
+all_gather of disjoint per-entity rows, psum of per-gene partials) rides that
+axis. On real hardware the axis should span ICI; across slices XLA routes the
+same collectives over DCN without code changes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+DEFAULT_AXIS = "shard"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = DEFAULT_AXIS,
+    devices: Optional[Sequence] = None,
+) -> jax.sharding.Mesh:
+    """A 1-D mesh over the first ``n_devices`` available devices."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return jax.sharding.Mesh(np.asarray(devices), (axis_name,))

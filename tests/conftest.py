@@ -7,14 +7,19 @@ test strategy (SURVEY.md section 4). Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# keep XLA/compilation threads polite in CI containers
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+# The container may import jax at interpreter startup (sitecustomize registering
+# a hardware PJRT plugin), in which case the env vars above arrive too late and
+# must be applied through jax.config before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import pathlib
 import sys

@@ -1,0 +1,165 @@
+"""Sharded-pipeline tests on the 8-device virtual CPU mesh.
+
+Validates the framework's distributed contract: partitioning records by entity
+hash, per-shard metric passes under shard_map, and the all_to_all rekeying
+step — the device analog of the reference's SplitBam -> per-chunk gatherer ->
+Merge scatter-gather (SURVEY.md section 2.3). Ground truth is the
+single-device engine over the same records.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from sctools_tpu.io.packed import frame_from_records
+from sctools_tpu.metrics.device import compute_entity_metrics
+from sctools_tpu.metrics.gatherer import _pad_columns
+from sctools_tpu.parallel import (
+    collect_sharded_rows,
+    distributed_metrics_step,
+    make_mesh,
+    partition_columns,
+    shard_assignment,
+    sharded_entity_metrics,
+)
+
+from helpers import make_header, make_record
+
+N_DEVICES = 8
+
+
+def _random_records(n_cells=24, n_genes=12, seed=7):
+    rng = random.Random(seed)
+    header = make_header()
+    cells = ["".join(rng.choice("ACGT") for _ in range(16)) for _ in range(n_cells)]
+    genes = [f"GENE{i}" for i in range(n_genes)]
+    records = []
+    for i in range(600):
+        cb = rng.choice(cells)
+        ge = rng.choice(genes + [None])
+        records.append(
+            make_record(
+                name=f"r{i}",
+                cb=cb,
+                cr=cb if rng.random() < 0.8 else "A" * 16,
+                cy="I" * 16,
+                ub="".join(rng.choice("ACGT") for _ in range(10)),
+                ur=None,
+                uy="I" * 10,
+                ge=ge,
+                xf=rng.choice(["CODING", "INTRONIC", "UTR", "INTERGENIC", None]),
+                nh=rng.choice([1, 1, 1, 2]),
+                reference_id=rng.choice([0, 1]),
+                pos=rng.randrange(1000),
+                unmapped=rng.random() < 0.05,
+                duplicate=rng.random() < 0.1,
+                spliced=rng.random() < 0.2,
+                header=header,
+            )
+        )
+    return records
+
+
+@pytest.fixture(scope="module")
+def padded_cols():
+    frame = frame_from_records(_random_records())
+    is_mito = np.zeros(len(frame.gene_names), dtype=bool)
+    return _pad_columns(frame, is_mito)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= N_DEVICES
+    return make_mesh(N_DEVICES)
+
+
+def _single_device_rows(cols, kind):
+    num_segments = len(cols["valid"])
+    result = compute_entity_metrics(
+        {k: np.asarray(v) for k, v in cols.items()},
+        num_segments=num_segments,
+        kind=kind,
+    )
+    result = {k: np.asarray(v) for k, v in result.items()}
+    rows = {}
+    for r in range(int(result["n_entities"])):
+        code = int(result["entity_code"][r])
+        rows[code] = {
+            k: result[k][r]
+            for k in result
+            if k not in ("entity_code", "segment_valid", "n_entities")
+        }
+    return rows
+
+
+def _assert_rows_equal(got, expected):
+    assert set(got) == set(expected)
+    for code in expected:
+        for metric, value in expected[code].items():
+            np.testing.assert_allclose(
+                got[code][metric],
+                value,
+                rtol=1e-5,
+                atol=1e-6,
+                equal_nan=True,
+                err_msg=f"entity {code} metric {metric}",
+            )
+
+
+def test_shard_assignment_is_mod(padded_cols):
+    codes = np.arange(37)
+    np.testing.assert_array_equal(shard_assignment(codes, 8), codes % 8)
+
+
+def test_partition_preserves_records(padded_cols):
+    stacked = partition_columns(padded_cols, N_DEVICES, key="cell")
+    n_valid = int(np.sum(padded_cols["valid"]))
+    assert int(np.sum(stacked["valid"])) == n_valid
+    # each cell code lands on exactly one shard
+    for s in range(N_DEVICES):
+        cells = np.unique(stacked["cell"][s][stacked["valid"][s]])
+        assert np.all(cells % N_DEVICES == s)
+
+
+def test_sharded_cell_metrics_match_single_device(padded_cols, mesh):
+    stacked = partition_columns(padded_cols, N_DEVICES, key="cell")
+    result = sharded_entity_metrics(stacked, mesh, kind="cell")
+    got = collect_sharded_rows({k: np.asarray(v) for k, v in result.items()})
+    expected = _single_device_rows(padded_cols, "cell")
+    _assert_rows_equal(got, expected)
+
+
+def test_sharded_gene_metrics_match_single_device(padded_cols, mesh):
+    stacked = partition_columns(padded_cols, N_DEVICES, key="gene")
+    result = sharded_entity_metrics(stacked, mesh, kind="gene")
+    got = collect_sharded_rows({k: np.asarray(v) for k, v in result.items()})
+    expected = _single_device_rows(padded_cols, "gene")
+    _assert_rows_equal(got, expected)
+
+
+def test_shard_count_mesh_mismatch_raises(padded_cols, mesh):
+    stacked = partition_columns(padded_cols, 4, key="cell")
+    with pytest.raises(ValueError, match="4 shards"):
+        sharded_entity_metrics(stacked, mesh, kind="cell")
+
+
+def test_distributed_step_capacity_too_small_raises(padded_cols, mesh):
+    stacked = partition_columns(padded_cols, N_DEVICES, key="cell")
+    with pytest.raises(ValueError, match="too small"):
+        distributed_metrics_step(stacked, mesh, capacity=1)
+
+
+def test_distributed_step_cell_and_gene(padded_cols, mesh):
+    """Full step: cell metrics on cell-sharded data, gene via all_to_all."""
+    stacked = partition_columns(padded_cols, N_DEVICES, key="cell")
+    cell_result, gene_result = distributed_metrics_step(stacked, mesh)
+    got_cell = collect_sharded_rows(
+        {k: np.asarray(v) for k, v in cell_result.items()}
+    )
+    got_gene = collect_sharded_rows(
+        {k: np.asarray(v) for k, v in gene_result.items()}
+    )
+    _assert_rows_equal(got_cell, _single_device_rows(padded_cols, "cell"))
+    _assert_rows_equal(got_gene, _single_device_rows(padded_cols, "gene"))
