@@ -145,6 +145,13 @@ def sharded_entity_metrics(
     """
     n_shards, shard_size = stacked_cols["cell"].shape
     _check_shard_count(n_shards, mesh, axis_name)
+    return _build_sharded_metrics(mesh, axis_name, shard_size, kind)(stacked_cols)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sharded_metrics(mesh, axis_name: str, shard_size: int, kind: str):
+    """Compiled per-shard metrics pass, cached so repeat batches of one shape
+    reuse a single executable instead of re-tracing the shard_map closure."""
 
     @functools.partial(
         jax.shard_map,
@@ -159,7 +166,7 @@ def sharded_entity_metrics(
         )
         return _expand_local(out)
 
-    return run(stacked_cols)
+    return jax.jit(run)
 
 
 def _check_shard_count(n_shards: int, mesh: jax.sharding.Mesh, axis_name: str):
@@ -201,7 +208,7 @@ def distributed_metrics_step(
     if concrete:
         required = required_reshard_capacity(stacked_cols, "gene", n_shards)
         if capacity is None:
-            cap = max(seg.bucket_size(required, minimum=8), 8)
+            cap = seg.bucket_size(required, minimum=8)
         elif capacity < required:
             raise ValueError(
                 f"reshard capacity={capacity} too small: a (src,dst) shard "
@@ -211,6 +218,17 @@ def distributed_metrics_step(
             cap = capacity
     else:
         cap = capacity if capacity is not None else shard_size
+
+    return _build_distributed_step(mesh, axis_name, n_shards, shard_size, cap)(
+        stacked_cols
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _build_distributed_step(
+    mesh, axis_name: str, n_shards: int, shard_size: int, cap: int
+):
+    """Compiled full pipeline step, cached per (mesh, shapes, capacity)."""
 
     @functools.partial(
         jax.shard_map,
@@ -230,7 +248,7 @@ def distributed_metrics_step(
         )
         return _expand_local(cell_out), _expand_local(gene_out)
 
-    return step(stacked_cols)
+    return jax.jit(step)
 
 
 def collect_sharded_rows(

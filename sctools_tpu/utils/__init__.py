@@ -1,0 +1,5 @@
+"""Utility helpers: synthetic workload generation, timing."""
+
+from .synth import make_synthetic_columns
+
+__all__ = ["make_synthetic_columns"]

@@ -82,16 +82,8 @@ def _single_device_rows(cols, kind):
         num_segments=num_segments,
         kind=kind,
     )
-    result = {k: np.asarray(v) for k, v in result.items()}
-    rows = {}
-    for r in range(int(result["n_entities"])):
-        code = int(result["entity_code"][r])
-        rows[code] = {
-            k: result[k][r]
-            for k in result
-            if k not in ("entity_code", "segment_valid", "n_entities")
-        }
-    return rows
+    # reuse the production row extraction by viewing the result as 1 shard
+    return collect_sharded_rows({k: np.asarray(v)[None] for k, v in result.items()})
 
 
 def _assert_rows_equal(got, expected):
@@ -108,7 +100,7 @@ def _assert_rows_equal(got, expected):
             )
 
 
-def test_shard_assignment_is_mod(padded_cols):
+def test_shard_assignment_is_mod():
     codes = np.arange(37)
     np.testing.assert_array_equal(shard_assignment(codes, 8), codes % 8)
 
