@@ -31,6 +31,7 @@ __all__ = [
     "bam",
     "barcode",
     "consts",
+    "count",
     "encodings",
     "fastq",
     "gtf",

@@ -1,0 +1,106 @@
+"""Device kernel for UMI-deduplicated molecule counting.
+
+The TPU reformulation of the reference's streaming count loop
+(src/sctools/count.py:134-349): query-name groups become runs of a device
+sort, the CellRanger eligibility rule becomes a per-group distinct-run count,
+and the (cell, umi, gene) dedup set becomes unique-run detection on a second
+sort. The reference's single- and multi-alignment branches (count.py:262-292)
+collapse to one rule here: a query is counted iff exactly ONE distinct
+eligible gene is implicated across its alignments — which reproduces both
+branches (a lone ineligible alignment implicates 0 genes; a lone eligible one
+implicates 1; multi-maps need a unique gene).
+
+Eligibility per alignment (count.py:264-268, 276-284): GE tag present, XF tag
+present and != INTERGENIC, and the gene name is not a multi-gene "a,b" string
+(host precomputes that flag per vocabulary entry).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import segments as seg
+
+_I32_MAX = np.iinfo(np.int32).max
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def count_molecules(cols: Dict[str, jnp.ndarray], num_segments: int):
+    """Unique (cell, molecule, gene) triples from query-name groups.
+
+    ``cols``: 1-D arrays of length ``num_segments`` — qname/cell/umi/gene
+    codes, ``eligible`` (bool, per-alignment eligibility precomputed host
+    side), ``cb_ok``/``ub_ok`` (bool, barcode tag present), ``valid``.
+    Records of one query need NOT be adjacent (the sort groups them); the
+    reference instead requires a queryname-sorted file and silently
+    miscounts otherwise (count.py:149-153) — sorting on device removes that
+    footgun.
+
+    Returns [num_segments] arrays:
+      - ``is_molecule``: marks entries; one per unique counted triple
+      - ``cell``, ``gene``: codes of the triple
+      - ``first_index``: smallest original record index of any query group
+        that yields the triple (reproduces the reference's
+        first-observation cell ordering, count.py:319-329)
+    """
+    valid = cols["valid"].astype(bool)
+    eligible = valid & cols["eligible"].astype(bool)
+    idx = jnp.arange(num_segments, dtype=jnp.int32)
+
+    qname_key = jnp.where(valid, cols["qname"].astype(jnp.int32), _I32_MAX)
+    gene_key = jnp.where(eligible, cols["gene"].astype(jnp.int32), _I32_MAX)
+
+    # group alignments by query; eligible genes adjacent within each group
+    (s_keys, (s_idx, s_eligible, s_valid)) = seg.lexsort(
+        [qname_key, gene_key], [idx, eligible, valid]
+    )
+    s_qname, s_gene = s_keys
+    group_starts = seg.run_starts([s_qname])
+    group_ids = seg.segment_ids_from_starts(group_starts)
+    pair_starts = seg.run_starts([s_qname, s_gene])
+
+    distinct_genes = seg.distinct_runs_per_outer(
+        pair_starts, group_ids, num_segments, where=s_eligible.astype(bool)
+    )
+    chosen_gene = seg.segment_min(s_gene, group_ids, num_segments)
+    first_idx = seg.segment_min(
+        jnp.where(s_valid.astype(bool), s_idx, _I32_MAX), group_ids, num_segments
+    )
+
+    # tags come from the group's first alignment in FILE order
+    # (count.py:86-95 reads alignments[0])
+    safe_first = jnp.clip(first_idx, 0, num_segments - 1)
+    group_cell = cols["cell"].astype(jnp.int32)[safe_first]
+    group_umi = cols["umi"].astype(jnp.int32)[safe_first]
+    group_cb_ok = cols["cb_ok"].astype(bool)[safe_first]
+    group_ub_ok = cols["ub_ok"].astype(bool)[safe_first]
+    group_valid = first_idx < _I32_MAX
+
+    keep = group_valid & (distinct_genes == 1) & group_cb_ok & group_ub_ok
+
+    # dedup triples: one count per unique (cell, gene, umi)
+    mcell = jnp.where(keep, group_cell, _I32_MAX)
+    mgene = jnp.where(keep, chosen_gene, _I32_MAX)
+    mumi = jnp.where(keep, group_umi, _I32_MAX)
+    (d_keys, (d_first, d_keep)) = seg.lexsort(
+        [mcell, mgene, mumi], [first_idx, keep]
+    )
+    d_cell, d_gene, _ = d_keys
+    triple_starts = seg.run_starts(list(d_keys))
+    triple_ids = seg.segment_ids_from_starts(triple_starts)
+    triple_first = seg.segment_min(
+        jnp.where(d_keep.astype(bool), d_first, _I32_MAX), triple_ids, num_segments
+    )
+
+    is_molecule = triple_starts & d_keep.astype(bool)
+    return {
+        "is_molecule": is_molecule,
+        "cell": d_cell,
+        "gene": d_gene,
+        "first_index": triple_first[triple_ids],
+    }
