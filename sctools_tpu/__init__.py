@@ -34,12 +34,16 @@ __all__ = [
     "count",
     "encodings",
     "fastq",
+    "groups",
     "gtf",
     "io",
     "metrics",
     "ops",
+    "parallel",
+    "platform",
     "reader",
     "stats",
+    "utils",
 ]
 
 

@@ -1,0 +1,186 @@
+"""Aggregation of external QC outputs (Picard, HISAT2, RSEM) for SS2 pipelines.
+
+Rebuild of the reference's groups module (src/sctools/groups.py:11-195) without
+the crimson dependency: Picard metric files are parsed directly (``## METRICS
+CLASS`` section, tab-separated, numbers coerced). One deliberate deviation:
+the reference appends a partial snapshot DataFrame per input file and writes
+them all (groups.py:71-74, a pandas-1.x ``.append`` pattern that emits
+duplicated partial blocks); this implementation writes only the complete
+final table — the last block of the reference's output, which is what
+downstream consumers read.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Union
+
+import pandas as pd
+
+_DROP_KEYS = ("SAMPLE", "LIBRARY", "READ_GROUP", "CATEGORY")
+
+
+def _coerce(value: str):
+    if value == "" or value == "?":
+        return None
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            continue
+    return value
+
+
+def parse_picard_metrics(file_name: str) -> Dict:
+    """Parse a Picard metrics file's METRICS CLASS section.
+
+    Returns {"class": <java class name>, "contents": dict | list[dict]} —
+    the subset of crimson.picard.parse output the aggregators consume
+    (single data row -> dict, several rows -> list of dicts).
+    """
+    class_name: Optional[str] = None
+    header: Optional[List[str]] = None
+    rows: List[Dict] = []
+    with open(file_name) as fileobj:
+        in_metrics = False
+        for line in fileobj:
+            line = line.rstrip("\n")
+            if line.startswith("## METRICS CLASS"):
+                class_name = line.split("\t", 1)[1].strip()
+                in_metrics = True
+                continue
+            if not in_metrics:
+                continue
+            if line.startswith("##") or line == "":
+                if rows or header:
+                    break  # end of metrics section (histogram follows)
+                continue
+            fields = line.split("\t")
+            if header is None:
+                header = fields
+            else:
+                row = {k: _coerce(v) for k, v in zip(header, fields)}
+                rows.append(row)
+    if class_name is None:
+        raise ValueError(f"{file_name}: no '## METRICS CLASS' section found")
+    contents: Union[Dict, List[Dict]] = rows[0] if len(rows) == 1 else rows
+    return {"metrics": {"class": class_name, "contents": contents}}
+
+
+def write_aggregated_picard_metrics_by_row(file_names, output_name) -> None:
+    """Aggregate per-cell Picard row metrics into one CSV.
+
+    Input basenames must look like 'samplename_qc.<class>.txt' (reference
+    groups.py:16-19). AlignmentSummaryMetrics rows are flattened per CATEGORY
+    (key '<METRIC>.<CATEGORY>'); multi-line InsertSizeMetrics keep the first
+    line (reference groups.py:38-59).
+    """
+    metrics: Dict[str, Dict] = {}
+    metric_class: Dict[str, str] = {}
+    for file_name in file_names:
+        cell_id = os.path.basename(file_name).split("_qc")[0]
+        metrics.setdefault(cell_id, {})
+        parsed = parse_picard_metrics(file_name)
+        class_name = parsed["metrics"]["class"].split(".")[2]
+        contents = parsed["metrics"]["contents"]
+        if class_name == "AlignmentSummaryMetrics":
+            if isinstance(contents, dict):
+                contents = [contents]
+            rows = {}
+            for m in contents:
+                cat = m["CATEGORY"]
+                rows.update(
+                    {
+                        f"{k}.{cat}": v
+                        for k, v in m.items()
+                        if k not in _DROP_KEYS
+                    }
+                )
+        elif class_name == "InsertSizeMetrics":
+            rows = contents[0] if isinstance(contents, list) else contents
+        else:
+            rows = contents
+        row_values = {k: v for k, v in rows.items() if k not in _DROP_KEYS}
+        metrics[cell_id].update(row_values)
+        for key in row_values:
+            metric_class.setdefault(key, class_name)
+
+    df = pd.DataFrame.from_dict(metrics, orient="columns")
+    df.insert(0, "Class", pd.Series(metric_class))
+    df.T.to_csv(output_name + ".csv")
+
+
+def write_aggregated_picard_metrics_by_table(file_names, output_name) -> None:
+    """One CSV per Picard table-metrics file, named by metrics class
+    (reference groups.py:77-96)."""
+    for file_name in file_names:
+        cell_id = os.path.basename(file_name).split("_qc")[0]
+        class_name = os.path.basename(file_name).split(".")[1]
+        parsed = parse_picard_metrics(file_name)
+        contents = parsed["metrics"]["contents"]
+        if isinstance(contents, dict):
+            contents = [contents]
+        dat = pd.DataFrame.from_dict(contents)
+        dat.insert(0, "Sample", cell_id)
+        dat.to_csv(output_name + "_" + class_name + ".csv", index=False)
+
+
+def write_aggregated_qc_metrics(file_names, output_name) -> None:
+    """Outer-join previously aggregated QC CSVs column-wise
+    (reference groups.py:99-117)."""
+    df = pd.DataFrame()
+    for file_name in file_names:
+        dat = pd.read_csv(file_name, index_col=0)
+        df = pd.concat([df, dat], axis=1, join="outer")
+    df.to_csv(output_name + ".csv", index=True)
+
+
+def parse_hisat2_log(file_names, output_name) -> None:
+    """Aggregate HISAT2 alignment summaries; '_qc' logs are genome
+    alignments (HISAT2G), '_rsem' logs transcriptome (HISAT2T)
+    (reference groups.py:120-152)."""
+    metrics: Dict[str, Dict] = {}
+    tag = "NONE"
+    for file_name in file_names:
+        if "_qc" in file_name:
+            cell_id = os.path.basename(file_name).split("_qc")[0]
+            tag = "HISAT2G"
+        elif "_rsem" in file_name:
+            cell_id = os.path.basename(file_name).split("_rsem")[0]
+            tag = "HISAT2T"
+        else:
+            cell_id = os.path.basename(file_name)
+        with open(file_name) as fileobj:
+            lines = [x.strip().split(":") for x in fileobj.readlines()]
+        lines.pop(0)  # drop the section's first row
+        metrics[cell_id] = {
+            x[0]: x[1].strip().split(" ")[0] for x in lines if len(x) > 1
+        }
+    df = pd.DataFrame.from_dict(metrics, orient="columns")
+    df.insert(0, "Class", tag)
+    df.T.to_csv(output_name + ".csv")
+
+
+def parse_rsem_cnt(file_names, output_name) -> None:
+    """Aggregate RSEM .cnt statistics per cell (reference groups.py:155-195)."""
+    metrics: Dict[str, Dict] = {}
+    for file_name in file_names:
+        cell_id = os.path.basename(file_name).split("_rsem")[0]
+        with open(file_name) as fileobj:
+            n0, n1, n2, n_tot = fileobj.readline().strip().split(" ")
+            n_unique, n_multi, n_uncertain = fileobj.readline().strip().split(" ")
+            n_hits, read_type = fileobj.readline().strip().split(" ")
+        metrics[cell_id] = {
+            "unalignable reads": n0,
+            "alignable reads": n1,
+            "filtered reads": n2,
+            "total reads": n_tot,
+            "unique aligned": n_unique,
+            "multiple mapped": n_multi,
+            "total alignments": n_hits,
+            "strand": read_type,
+            "uncertain reads": n_uncertain,
+        }
+    df = pd.DataFrame.from_dict(metrics, orient="columns")
+    df.insert(0, "Class", "RSEM")
+    df.T.to_csv(output_name + ".csv")

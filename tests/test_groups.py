@@ -1,0 +1,139 @@
+"""QC-aggregation tests with generated Picard/HISAT2/RSEM fixtures."""
+
+import textwrap
+
+import pandas as pd
+import pytest
+
+from sctools_tpu import groups
+
+
+def _write_picard_alignment(path, total=1000):
+    path.write_text(textwrap.dedent(f"""\
+        ## htsjdk.samtools.metrics.StringHeader
+        # CollectMultipleMetrics INPUT=x.bam
+        ## METRICS CLASS\tpicard.analysis.AlignmentSummaryMetrics
+        CATEGORY\tTOTAL_READS\tPF_READS\tSAMPLE
+        FIRST_OF_PAIR\t{total // 2}\t{total // 2}\t
+        SECOND_OF_PAIR\t{total // 2}\t{total // 2}\t
+        PAIR\t{total}\t{total}\t
+
+        ## HISTOGRAM\tjava.lang.Integer
+        x\ty
+        1\t2
+        """))
+    return str(path)
+
+
+def _write_picard_duplication(path):
+    path.write_text(textwrap.dedent("""\
+        ## htsjdk.samtools.metrics.StringHeader
+        # MarkDuplicates INPUT=x.bam
+        ## METRICS CLASS\tpicard.sam.DuplicationMetrics
+        LIBRARY\tREAD_PAIRS_EXAMINED\tPERCENT_DUPLICATION
+        lib1\t400\t0.25
+        """))
+    return str(path)
+
+
+def _write_hisat2_log(path):
+    path.write_text(textwrap.dedent("""\
+        HISAT2 summary stats:
+        Total reads: 1000
+        Aligned 0 time: 100 (10.00%)
+        Aligned 1 time: 800 (80.00%)
+        Aligned >1 times: 100 (10.00%)
+        Overall alignment rate: 90.00%
+        """))
+    return str(path)
+
+
+def _write_rsem_cnt(path):
+    path.write_text("100 850 50 1000\n700 150 25\n1200 0\n")
+    return str(path)
+
+
+def test_picard_parser_multi_and_single_row(tmp_path):
+    parsed = groups.parse_picard_metrics(
+        _write_picard_alignment(tmp_path / "c1_qc.alignment_summary_metrics.txt")
+    )
+    assert parsed["metrics"]["class"] == "picard.analysis.AlignmentSummaryMetrics"
+    contents = parsed["metrics"]["contents"]
+    assert isinstance(contents, list) and len(contents) == 3
+    assert contents[2]["CATEGORY"] == "PAIR"
+    assert contents[2]["TOTAL_READS"] == 1000
+
+    parsed = groups.parse_picard_metrics(
+        _write_picard_duplication(tmp_path / "c1_qc.duplication_metrics.txt")
+    )
+    contents = parsed["metrics"]["contents"]
+    assert isinstance(contents, dict)
+    assert contents["PERCENT_DUPLICATION"] == 0.25
+
+
+def test_aggregated_picard_by_row(tmp_path):
+    files = [
+        _write_picard_alignment(tmp_path / "cellA_qc.alignment_summary_metrics.txt"),
+        _write_picard_duplication(tmp_path / "cellA_qc.duplication_metrics.txt"),
+        _write_picard_alignment(
+            tmp_path / "cellB_qc.alignment_summary_metrics.txt", total=500
+        ),
+    ]
+    out = str(tmp_path / "picard_row")
+    groups.write_aggregated_picard_metrics_by_row(files, out)
+    df = pd.read_csv(out + ".csv", index_col=0)
+    assert "TOTAL_READS.PAIR" in df.columns
+    assert float(df.loc["cellA", "TOTAL_READS.PAIR"]) == 1000
+    assert float(df.loc["cellB", "TOTAL_READS.PAIR"]) == 500
+    assert float(df.loc["cellA", "PERCENT_DUPLICATION"]) == 0.25
+    assert df.loc["Class", "TOTAL_READS.PAIR"] == "AlignmentSummaryMetrics"
+    # CATEGORY/SAMPLE columns are dropped
+    assert not any(c.startswith("SAMPLE") for c in df.columns)
+
+
+def test_aggregated_picard_by_table(tmp_path):
+    files = [_write_picard_duplication(tmp_path / "cellA_qc.duplication_metrics.txt")]
+    out = str(tmp_path / "picard_table")
+    groups.write_aggregated_picard_metrics_by_table(files, out)
+    df = pd.read_csv(out + "_duplication_metrics.csv")
+    assert df.loc[0, "Sample"] == "cellA"
+    assert df.loc[0, "READ_PAIRS_EXAMINED"] == 400
+
+
+def test_hisat2_log(tmp_path):
+    files = [
+        _write_hisat2_log(tmp_path / "cellA_qc.log"),
+        _write_hisat2_log(tmp_path / "cellB_rsem.log"),
+    ]
+    out = str(tmp_path / "hisat2")
+    groups.parse_hisat2_log(files, out)
+    df = pd.read_csv(out + ".csv", index_col=0)
+    assert int(df.loc["cellA", "Total reads"]) == 1000
+    assert df.loc["cellB", "Overall alignment rate"] == "90.00%"
+
+
+def test_rsem_cnt(tmp_path):
+    files = [_write_rsem_cnt(tmp_path / "cellA_rsem.cnt")]
+    out = str(tmp_path / "rsem")
+    groups.parse_rsem_cnt(files, out)
+    df = pd.read_csv(out + ".csv", index_col=0)
+    assert int(df.loc["cellA", "total reads"]) == 1000
+    assert int(df.loc["cellA", "unique aligned"]) == 700
+    assert (df.loc["Class"] == "RSEM").all()
+
+
+def test_aggregated_qc_outer_join(tmp_path):
+    files = [
+        _write_picard_alignment(tmp_path / "cellA_qc.alignment_summary_metrics.txt"),
+    ]
+    picard_out = str(tmp_path / "picard_row")
+    groups.write_aggregated_picard_metrics_by_row(files, picard_out)
+    hisat_files = [_write_hisat2_log(tmp_path / "cellA_qc.log")]
+    hisat_out = str(tmp_path / "hisat2")
+    groups.parse_hisat2_log(hisat_files, hisat_out)
+
+    out = str(tmp_path / "all_qc")
+    groups.write_aggregated_qc_metrics([picard_out + ".csv", hisat_out + ".csv"], out)
+    df = pd.read_csv(out + ".csv", index_col=0)
+    assert "TOTAL_READS.PAIR" in df.columns
+    assert "Total reads" in df.columns
