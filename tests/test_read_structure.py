@@ -1,0 +1,90 @@
+"""Read-structure DSL tests (slide-seq style segmented barcodes)."""
+
+import pytest
+
+from sctools_tpu import platform
+from sctools_tpu.fastq import ReadStructure, ReadStructureBarcodeGenerator
+from sctools_tpu.io.sam import AlignmentReader
+
+from helpers import make_header, make_record, write_bam, write_fastq
+
+
+def test_parse_slideseq_structure():
+    rs = ReadStructure("8C18X6C9M1X")
+    assert rs.spans("C") == [(0, 8), (26, 32)]
+    assert rs.spans("M") == [(32, 41)]
+    assert rs.spans("X") == [(8, 26), (41, 42)]
+    assert rs.length == 42
+    assert rs.barcode_length("C") == 14
+
+
+def test_parse_rejects_malformed():
+    with pytest.raises(ValueError):
+        ReadStructure("8C3")  # trailing digits
+    with pytest.raises(ValueError):
+        ReadStructure("C8")  # letter before digits
+    with pytest.raises(ValueError):
+        ReadStructure("8Q")  # unknown kind
+
+
+def test_extract_concatenates_split_segments():
+    rs = ReadStructure("2C3X2C2M")
+    assert rs.extract("AACCCGGTT", "C") == "AAGG"
+    assert rs.extract("AACCCGGTT", "M") == "TT"
+
+
+def test_generator_yields_tags(tmp_path):
+    rs = "2C3X2C2M"
+    seq = "AACCCGGTT"
+    path = write_fastq(tmp_path / "r1.fastq", [("r1", seq, "I" * len(seq))])
+    gen = ReadStructureBarcodeGenerator(path, rs)
+    tags = next(iter(gen))
+    tag_dict = {t[0]: t[1] for t in tags}
+    assert tag_dict["CR"] == "AAGG"
+    assert tag_dict["UR"] == "TT"
+    assert tag_dict["CY"] == "IIII"
+
+
+def test_generator_whitelist_correction(tmp_path):
+    rs = "2C3X2C2M"
+    whitelist = tmp_path / "wl.txt"
+    whitelist.write_text("AAGG\nCCTT\n")
+    # mutate one base of AAGG -> TAGG; should correct to AAGG
+    path = write_fastq(tmp_path / "r1.fastq", [("r1", "TACCCGGTT", "I" * 9)])
+    gen = ReadStructureBarcodeGenerator(path, rs, whitelist=str(whitelist))
+    tags = {t[0]: t[1] for t in next(iter(gen))}
+    assert tags["CR"] == "TAGG"
+    assert tags["CB"] == "AAGG"
+
+
+def test_attach_barcodes_read_structure_cli(tmp_path):
+    seq = "AACCCGGTT"
+    r1 = write_fastq(tmp_path / "r1.fastq", [("r1", seq, "I" * len(seq))])
+    header = make_header()
+    u2 = write_bam(
+        tmp_path / "u2.bam", [make_record(name="r1", unmapped=True, header=header)],
+        header,
+    )
+    out = str(tmp_path / "tagged.bam")
+    rc = platform.BarcodePlatform.attach_barcodes(
+        ["--r1", r1, "--u2", u2, "-o", out, "--read-structure", "2C3X2C2M"]
+    )
+    assert rc == 0
+    with AlignmentReader(out) as f:
+        record = next(iter(f))
+    assert record.get_tag("CR") == "AAGG"
+    assert record.get_tag("UR") == "TT"
+
+
+def test_read_structure_rejects_position_args(tmp_path):
+    import argparse
+
+    with pytest.raises(argparse.ArgumentTypeError):
+        platform.BarcodePlatform.attach_barcodes(
+            [
+                "--r1", "x", "--u2", "y", "-o", "z",
+                "--read-structure", "8C2M",
+                "--cell-barcode-start-position", "0",
+                "--cell-barcode-length", "8",
+            ]
+        )

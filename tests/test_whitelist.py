@@ -1,0 +1,115 @@
+"""Device whitelist correction vs the reference-semantics hash-map oracle.
+
+The oracle is barcode.ErrorsToCorrectBarcodesMap — the exact reimplementation
+of the reference's error map (src/sctools/barcode.py:255-379) including its
+last-writer-wins behavior for barcodes within distance 1 of several
+whitelist entries.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from sctools_tpu.barcode import ErrorsToCorrectBarcodesMap
+from sctools_tpu.ops.whitelist import WhitelistCorrector, onehot_barcodes
+
+RNG = random.Random(23)
+LENGTH = 16
+
+
+def _random_barcode():
+    return "".join(RNG.choice("ACGT") for _ in range(LENGTH))
+
+
+def _mutate(barcode, n_positions, alphabet="ACGT"):
+    positions = RNG.sample(range(LENGTH), n_positions)
+    out = list(barcode)
+    for p in positions:
+        choices = [c for c in alphabet if c != out[p]]
+        out[p] = RNG.choice(choices)
+    return "".join(out)
+
+
+@pytest.fixture(scope="module")
+def whitelist():
+    return sorted({_random_barcode() for _ in range(300)})
+
+
+@pytest.fixture(scope="module")
+def oracle(whitelist):
+    return ErrorsToCorrectBarcodesMap(
+        ErrorsToCorrectBarcodesMap._prepare_single_base_error_hash_table(whitelist)
+    )
+
+
+def _oracle_correct(oracle, barcode):
+    try:
+        return oracle.get_corrected_barcode(barcode)
+    except KeyError:
+        return None
+
+
+@pytest.fixture(scope="module", params=["jnp", "pallas"])
+def corrector(request, whitelist):
+    if request.param == "jnp":
+        return WhitelistCorrector(whitelist, use_pallas=False)
+    return WhitelistCorrector(whitelist, use_pallas=True, interpret=True)
+
+
+def test_matches_oracle_on_mixed_queries(corrector, oracle, whitelist):
+    queries = []
+    for _ in range(60):
+        queries.append(RNG.choice(whitelist))  # exact
+        queries.append(_mutate(RNG.choice(whitelist), 1))  # 1 substitution
+        queries.append(_mutate(RNG.choice(whitelist), 1, "N"))  # 1 N
+        queries.append(_mutate(RNG.choice(whitelist), 2))  # 2 subs: usually miss
+        queries.append(_mutate(RNG.choice(whitelist), 2, "N"))  # 2 Ns: always miss
+        queries.append(_random_barcode())  # random
+    got = corrector.correct(queries)
+    expected = [_oracle_correct(oracle, q) for q in queries]
+    assert got == expected
+
+
+def test_two_n_never_matches(corrector, whitelist):
+    queries = [_mutate(whitelist[0], 2, "N") for _ in range(8)]
+    assert corrector.correct(queries) == [None] * 8
+
+
+def test_last_whitelist_entry_wins_on_ambiguity(oracle):
+    # two whitelist barcodes at distance 2; a query between them (distance 1
+    # from both) resolves to the LAST entry, like the reference's dict
+    base = "A" * LENGTH
+    w1 = "C" + base[1:]
+    w2 = base[:-1] + "G"
+    query = "C" + base[1:-1] + "G"
+    for ordering in ([w1, w2], [w2, w1]):
+        corr = WhitelistCorrector(ordering, use_pallas=False)
+        assert corr.correct([query]) == [ordering[-1]]
+        oracle2 = ErrorsToCorrectBarcodesMap(
+            ErrorsToCorrectBarcodesMap._prepare_single_base_error_hash_table(ordering)
+        )
+        assert _oracle_correct(oracle2, query) == ordering[-1]
+
+
+def test_onehot_zeroes_n(whitelist):
+    onehot = onehot_barcodes(["N" * LENGTH, "A" * LENGTH], LENGTH)
+    assert onehot[0].sum() == 0
+    assert onehot[1].sum() == LENGTH
+
+
+def test_empty_query_batch(whitelist):
+    corrector = WhitelistCorrector(whitelist, use_pallas=False)
+    assert corrector.correct([]) == []
+
+
+def test_length_mismatched_queries_never_correct(corrector, whitelist):
+    # the reference map holds only whitelist-length keys; a one-short query
+    # must not pass the threshold via truncation
+    short = whitelist[0][:-1]
+    long = whitelist[0] + "A"
+    assert corrector.correct([short, long, whitelist[0]]) == [
+        None,
+        None,
+        whitelist[0],
+    ]
