@@ -36,17 +36,32 @@ import jax.numpy as jnp
 import numpy as np
 
 _BASE_TO_COL = {"A": 0, "C": 1, "G": 2, "T": 3}
+# byte value -> one-hot column (A=0 C=1 G=2 T=3); 4 = no column (N / other)
+_COL_LUT = np.full(256, 4, dtype=np.uint8)
+for _base, _col in _BASE_TO_COL.items():
+    _COL_LUT[ord(_base)] = _col
+    _COL_LUT[ord(_base.lower())] = _col
 
 
 def onehot_barcodes(barcodes: Sequence[str], length: int) -> np.ndarray:
-    """[n, length*4] float32 one-hot; N (or any non-ACGT) rows are all zero."""
-    out = np.zeros((len(barcodes), length * 4), dtype=np.float32)
-    for i, barcode in enumerate(barcodes):
-        for pos, base in enumerate(barcode[:length]):
-            col = _BASE_TO_COL.get(base)
-            if col is not None:
-                out[i, pos * 4 + col] = 1.0
-    return out
+    """[n, length*4] float32 one-hot; N (or any non-ACGT) rows are all zero.
+
+    Vectorized: barcodes are truncated/padded to ``length`` bytes, mapped
+    through a byte LUT, and scattered with fancy indexing — no per-base
+    Python loop on the correction hot path.
+    """
+    n = len(barcodes)
+    out = np.zeros((n, length, 5), dtype=np.float32)
+    if n == 0:
+        return out[:, :, :4].reshape(n, length * 4)
+    fixed = [b[:length].ljust(length, "\0") for b in barcodes]
+    flat = np.frombuffer("".join(fixed).encode("latin-1"), dtype=np.uint8)
+    cols = _COL_LUT[flat].reshape(n, length)
+    rows = np.repeat(np.arange(n), length)
+    positions = np.tile(np.arange(length), n)
+    out[rows, positions, cols.reshape(-1)] = 1.0
+    # column 4 collected the N/other hits; drop it
+    return out[:, :, :4].reshape(n, length * 4)
 
 
 @functools.partial(jax.jit, static_argnames=("length",))

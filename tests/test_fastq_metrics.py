@@ -1,0 +1,116 @@
+"""fastq_metrics and samplefastq capability tests."""
+
+import pytest
+
+from sctools_tpu import platform
+from sctools_tpu.fastq_metrics import FastQMetrics, compute_fastq_metrics
+
+from helpers import write_fastq
+
+
+def _reads():
+    # structure 4C2X3M: cell barcode [0:4), skip [4:6), umi [6:9)
+    return [
+        ("r1", "AAAACCGGG", "IIIIIIIII"),
+        ("r2", "AAAACCTTT", "IIIIIIIII"),
+        ("r3", "CCCCAAGGG", "IIIIIIIII"),
+        ("r4", "NAAACCGGG", "IIIIIIIII"),
+    ]
+
+
+def test_fastq_metrics_counts_and_pwm(tmp_path):
+    path = write_fastq(tmp_path / "r1.fastq", _reads())
+    metrics = FastQMetrics("4C2X3M")
+    assert metrics.ingest(path) == 4
+    assert metrics.barcode_counts == {"AAAA": 2, "CCCC": 1, "NAAA": 1}
+    assert metrics.umi_counts == {"GGG": 3, "TTT": 1}
+    # position 1 of the barcode: A=3 (r1,r2 + r4 has N), C=1, N=1
+    pwm = metrics.barcode_pwm.counts
+    assert pwm[0].tolist() == [2, 1, 0, 0, 1]  # A C G T N at position 1
+    assert pwm[1].tolist() == [3, 1, 0, 0, 0]
+
+
+def test_shard_merge_and_outputs(tmp_path):
+    p1 = write_fastq(tmp_path / "s1.fastq", _reads()[:2])
+    p2 = write_fastq(tmp_path / "s2.fastq", _reads()[2:])
+    prefix = str(tmp_path / "out")
+    total = compute_fastq_metrics([p1, p2], "4C2X3M", prefix)
+    assert total.barcode_counts["AAAA"] == 2
+
+    xc = open(prefix + ".numReads_perCell_XC.txt").read().strip().splitlines()
+    assert xc[0] == "2\tAAAA"  # sorted most-to-fewest
+    assert len(xc) == 3
+    xm = open(prefix + ".numReads_perCell_XM.txt").read().strip().splitlines()
+    assert xm[0] == "3\tGGG"
+    dist = open(prefix + ".barcode_distribution_XC.txt").read().strip().splitlines()
+    assert dist[0] == "position\tA\tC\tG\tT\tN"
+    assert dist[1] == "1\t2\t1\t0\t0\t1"
+    assert len(dist) == 1 + 4
+
+
+def test_fastq_metrics_cli(tmp_path):
+    path = write_fastq(tmp_path / "r1.fastq", _reads())
+    prefix = str(tmp_path / "cli")
+    rc = platform.GenericPlatform.fastq_metrics(
+        ["--R1", path, "--read-structure", "4C2X3M", "--sample-id", prefix]
+    )
+    assert rc == 0
+    assert (tmp_path / "cli.barcode_distribution_XM.txt").exists()
+
+
+def test_sample_fastq(tmp_path):
+    # slide-seq style: 8C + 6C split barcode, 4M umi
+    wl = tmp_path / "wl.txt"
+    wl.write_text("AAAAAAAACCCCCC\n")
+    good_r1 = "AAAAAAAA" + "CCCCCC" + "GGGG"  # exact whitelist hit
+    onesub = "TAAAAAAA" + "CCCCCC" + "GGGG"  # hamming 1 -> corrected
+    bad_r1 = "TTTTTTTT" + "GGGGGG" + "AAAA"  # no match
+    r1 = write_fastq(
+        tmp_path / "r1.fastq",
+        [("a", good_r1, "I" * 18), ("b", onesub, "I" * 18), ("c", bad_r1, "I" * 18)],
+    )
+    r2 = write_fastq(
+        tmp_path / "r2.fastq",
+        [("a", "ACGT" * 5, "J" * 20), ("b", "TGCA" * 5, "J" * 20),
+         ("c", "GGGG" * 5, "J" * 20)],
+    )
+    prefix = str(tmp_path / "sampled")
+    rc = platform.GenericPlatform.sample_fastq(
+        ["--R1", r1, "--R2", r2, "--white-list", str(wl),
+         "--read-structure", "8C6C4M", "--output-prefix", prefix]
+    )
+    assert rc == 0
+    r1_lines = open(prefix + ".R1").read().strip().splitlines()
+    r2_lines = open(prefix + ".R2").read().strip().splitlines()
+    assert len(r1_lines) == 2 * 4  # two kept reads
+    from sctools_tpu.samplefastq import SLIDESEQ_LINKER
+
+    # kept R1 = barcode[0:8] + linker + barcode[8:14] + umi + T
+    assert r1_lines[1] == "AAAAAAAA" + SLIDESEQ_LINKER + "CCCCCC" + "GGGG" + "T"
+    # the one-substitution read keeps its RAW barcode in the output
+    assert r1_lines[5].startswith("TAAAAAAA" + SLIDESEQ_LINKER)
+    assert r2_lines[1] == "ACGT" * 5
+    assert r2_lines[0] == "@a"
+    assert len(r2_lines) == 2 * 4  # exactly 4 lines per record, no blanks
+    assert "" not in r1_lines and "" not in r2_lines
+
+
+def test_sample_fastq_mismatched_shards_error(tmp_path):
+    wl = tmp_path / "wl.txt"
+    wl.write_text("AAAAAAAACCCCCC\n")
+    r1 = write_fastq(
+        tmp_path / "r1.fastq",
+        [("a", "AAAAAAAACCCCCCGGGG", "I" * 18), ("b", "AAAAAAAACCCCCCGGGG", "I" * 18)],
+    )
+    r2 = write_fastq(tmp_path / "r2.fastq", [("a", "ACGT", "JJJJ")])
+    from sctools_tpu.samplefastq import sample_fastq
+
+    with pytest.raises(ValueError):
+        sample_fastq(r1, r2, str(wl), "8C6C4M", str(tmp_path / "out"))
+
+
+def test_short_read_raises(tmp_path):
+    path = write_fastq(tmp_path / "r1.fastq", [("a", "AAAA", "IIII")])
+    metrics = FastQMetrics("4C2X3M")
+    with pytest.raises(ValueError, match="shorter than read structure"):
+        metrics.ingest(path)
