@@ -90,6 +90,8 @@ class FastQMetrics:
         barcodes: List[str] = []
         umis: List[str] = []
         for record in Reader(fastq_files):
+            # fixed-width code matrices require full-length reads
+            self.read_structure.validate_length(record.sequence)
             barcodes.append(self.read_structure.extract(record.sequence, "C"))
             umis.append(self.read_structure.extract(record.sequence, "M"))
             n_reads += 1

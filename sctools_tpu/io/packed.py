@@ -198,10 +198,23 @@ def frame_from_records(records: Iterable[BamRecord]) -> ReadFrame:
 
 
 def frame_from_bam(path: str, mode: Optional[str] = None) -> ReadFrame:
-    """Decode a BAM/SAM file into a ReadFrame (pure-Python decode path).
+    """Decode a BAM/SAM file into a ReadFrame.
 
-    The C++ native layer provides an accelerated drop-in for this function
-    (sctools_tpu.native) for large inputs.
+    BGZF-compressed inputs (sniffed by content, like AlignmentReader) route
+    through the native C++ decoder (sctools_tpu.native: thread-pooled BGZF
+    inflate, direct columnar extraction) when the library is available; SAM
+    inputs, environments without a toolchain, and native decode failures use
+    the pure-Python record path. ``SCTOOLS_TPU_NATIVE=0`` forces Python.
     """
+    from . import bgzf
+
+    if mode != "r" and bgzf.is_gzip(path):
+        from .. import native
+
+        if native.available():
+            try:
+                return native.frame_from_bam_native(path)
+            except RuntimeError:
+                pass  # fall back to the Python decoder (and its diagnostics)
     with AlignmentReader(path, mode) as reader:
         return frame_from_records(reader)

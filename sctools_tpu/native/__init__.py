@@ -1,0 +1,183 @@
+"""ctypes bindings for the native host layer (libsctools_native.so).
+
+The C++ decoder (bamdecode.cpp) replaces the pure-Python BAM -> ReadFrame
+path for large inputs: BGZF blocks inflate on a thread pool and records
+parse straight into packed columns — the role the reference's
+fastqpreprocessing/ binaries play for its pipeline, re-targeted at the
+device pipeline's columnar input format.
+
+The library builds on demand with make (g++/zlib only); when the toolchain
+or build is unavailable, callers fall back to the Python decoder —
+``available()`` reports which path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libsctools_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    source = os.path.join(_DIR, "bamdecode.cpp")
+    try:
+        stale = not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(source)
+        )
+        if stale:
+            subprocess.run(
+                ["make", "-s", "-C", _DIR],
+                check=True,
+                capture_output=True,
+                timeout=300,
+            )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("SCTOOLS_TPU_NATIVE", "1") == "0" or not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.scx_decode_bam.restype = ctypes.c_void_p
+        lib.scx_decode_bam.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_n_records.restype = ctypes.c_long
+        lib.scx_n_records.argtypes = [ctypes.c_void_p]
+        lib.scx_col_i32.restype = ctypes.POINTER(ctypes.c_int32)
+        lib.scx_col_i32.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_col_i8.restype = ctypes.POINTER(ctypes.c_int8)
+        lib.scx_col_i8.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_col_f32.restype = ctypes.POINTER(ctypes.c_float)
+        lib.scx_col_f32.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_vocab_size.restype = ctypes.c_long
+        lib.scx_vocab_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_vocab_bytes.restype = ctypes.POINTER(ctypes.c_char)
+        lib.scx_vocab_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.scx_vocab_offsets.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.scx_vocab_offsets.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_free.restype = None
+        lib.scx_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """Whether the native decoder can be used (builds lazily on first call)."""
+    return _load() is not None
+
+
+def _copy_array(pointer, n, dtype):
+    return np.ctypeslib.as_array(pointer, shape=(n,)).astype(dtype, copy=True)
+
+
+def _vocab(lib, handle, name: bytes) -> List[str]:
+    size = lib.scx_vocab_size(handle, name)
+    total = ctypes.c_long(0)
+    data = lib.scx_vocab_bytes(handle, name, ctypes.byref(total))
+    offsets = lib.scx_vocab_offsets(handle, name)
+    raw = ctypes.string_at(data, total.value) if total.value else b""
+    out = []
+    for i in range(size):
+        out.append(raw[offsets[i]:offsets[i + 1]].decode("ascii"))
+    return out
+
+
+def frame_from_bam_native(path: str, n_threads: Optional[int] = None):
+    """Decode a BAM file into a ReadFrame via the native library.
+
+    Raises RuntimeError when the library is unavailable or the file is
+    malformed; io.packed.frame_from_bam handles fallback.
+    """
+    from ..io.packed import ReadFrame
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native decoder unavailable")
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_decode_bam(
+        path.encode(), n_threads, errbuf, ctypes.sizeof(errbuf)
+    )
+    if not handle:
+        raise RuntimeError(
+            f"native BAM decode failed: {errbuf.value.decode(errors='replace')}"
+        )
+    try:
+        n = lib.scx_n_records(handle)
+
+        def i32(name):
+            return _copy_array(lib.scx_col_i32(handle, name), n, np.int32)
+
+        def i8(name, dtype=np.int8):
+            return _copy_array(lib.scx_col_i8(handle, name), n, dtype)
+
+        def f32(name):
+            return _copy_array(lib.scx_col_f32(handle, name), n, np.float32)
+
+        if n == 0:
+            empty_i32 = np.zeros(0, np.int32)
+            return ReadFrame(
+                cell=empty_i32, umi=empty_i32.copy(), gene=empty_i32.copy(),
+                qname=empty_i32.copy(),
+                cell_names=[], umi_names=[], gene_names=[], qname_names=[],
+                ref=empty_i32.copy(), pos=empty_i32.copy(),
+                strand=np.zeros(0, np.int8),
+                unmapped=np.zeros(0, bool), duplicate=np.zeros(0, bool),
+                spliced=np.zeros(0, bool),
+                xf=np.zeros(0, np.int8), nh=empty_i32.copy(),
+                perfect_umi=np.zeros(0, np.int8),
+                perfect_cb=np.zeros(0, np.int8),
+                umi_frac30=np.zeros(0, np.float32),
+                cb_frac30=np.zeros(0, np.float32),
+                genomic_frac30=np.zeros(0, np.float32),
+                genomic_mean=np.zeros(0, np.float32),
+            )
+
+        return ReadFrame(
+            cell=i32(b"cell"), umi=i32(b"umi"), gene=i32(b"gene"),
+            qname=i32(b"qname"),
+            cell_names=_vocab(lib, handle, b"cell"),
+            umi_names=_vocab(lib, handle, b"umi"),
+            gene_names=_vocab(lib, handle, b"gene"),
+            qname_names=_vocab(lib, handle, b"qname"),
+            ref=i32(b"ref"), pos=i32(b"pos"),
+            strand=i8(b"strand"),
+            unmapped=i8(b"unmapped").astype(bool),
+            duplicate=i8(b"duplicate").astype(bool),
+            spliced=i8(b"spliced").astype(bool),
+            xf=i8(b"xf"), nh=i32(b"nh"),
+            perfect_umi=i8(b"perfect_umi"),
+            perfect_cb=i8(b"perfect_cb"),
+            umi_frac30=f32(b"umi_frac30"),
+            cb_frac30=f32(b"cb_frac30"),
+            genomic_frac30=f32(b"genomic_frac30"),
+            genomic_mean=f32(b"genomic_mean"),
+        )
+    finally:
+        lib.scx_free(handle)

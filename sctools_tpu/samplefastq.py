@@ -58,15 +58,14 @@ def sample_fastq(
                 if fixed is None:
                     continue
                 kept += 1
-                name = r1.name[1:].split()[0] if r1.name.startswith("@") else r1.name
+                # Record names always start with '@' (the setter enforces it)
+                name = r1.name[1:].split()[0]
                 out_r1.write(
                     f"@{name}\n{barcode[:8]}{SLIDESEQ_LINKER}{barcode[8:]}"
                     f"{umi}T\n+\n"
                     f"{quality[:8]}{_LINKER_QUALITY}{quality[8:]}{umi_quality}F\n"
                 )
-                r2_name = (
-                    r2.name[1:].split()[0] if r2.name.startswith("@") else r2.name
-                )
+                r2_name = r2.name[1:].split()[0]
                 out_r2.write(
                     f"@{r2_name}\n{r2.sequence.rstrip()}\n+\n{r2.quality.rstrip()}\n"
                 )

@@ -1,0 +1,138 @@
+"""Native C++ BAM decoder parity vs the pure-Python ReadFrame path."""
+
+import random
+
+import numpy as np
+import pytest
+
+from sctools_tpu import native
+from sctools_tpu.io.packed import frame_from_records
+from sctools_tpu.io.sam import AlignmentWriter, BamRecord
+
+from helpers import make_header, make_record, write_bam
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+def _mixed_records():
+    rng = random.Random(99)
+    header = make_header()
+    records = []
+    cells = ["".join(rng.choice("ACGT") for _ in range(16)) for _ in range(8)]
+    for i in range(300):
+        cb = rng.choice(cells + [None])
+        records.append(
+            make_record(
+                name=f"q{rng.randrange(120):05d}",
+                cb=cb,
+                cr=(cb if rng.random() < 0.5 else "T" * 16) if cb else None,
+                cy="I" * 16 if rng.random() < 0.8 else None,
+                ub="".join(rng.choice("ACGTN") for _ in range(10))
+                if rng.random() < 0.9
+                else None,
+                ur="".join(rng.choice("ACGT") for _ in range(10))
+                if rng.random() < 0.5
+                else None,
+                uy="".join(chr(33 + rng.randrange(42)) for _ in range(10))
+                if rng.random() < 0.8
+                else None,
+                ge=rng.choice(["G1", "G2", "G1,G2", None]),
+                xf=rng.choice(["CODING", "INTRONIC", "UTR", "INTERGENIC", "WEIRD", None]),
+                nh=rng.choice([None, 1, 2, 300, 70000]),
+                reference_id=rng.choice([0, 1, 2]),
+                pos=rng.randrange(100000),
+                unmapped=rng.random() < 0.1,
+                reverse=rng.random() < 0.5,
+                duplicate=rng.random() < 0.2,
+                spliced=rng.random() < 0.3,
+                quality=[rng.randrange(0, 42) for _ in range(26)],
+                header=header,
+            )
+        )
+    # soft/hard clips and missing quality
+    clip = make_record(name="clipped", cb=cells[0], header=header)
+    clip.cigar = [(5, 2), (4, 3), (0, 20), (4, 3)]  # H S M S
+    records.append(clip)
+    noqual = make_record(name="noqual", cb=cells[1], header=header)
+    noqual.quality = None
+    records.append(noqual)
+    return records, header
+
+
+@pytest.fixture(scope="module")
+def bam_path(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("native")
+    records, header = _mixed_records()
+    return write_bam(tmp / "mixed.bam", records, header), records
+
+
+def test_native_matches_python(bam_path):
+    path, records = bam_path
+    python_frame = frame_from_records(iter(records))
+    native_frame = native.frame_from_bam_native(path)
+
+    assert native_frame.n_records == python_frame.n_records
+    assert native_frame.cell_names == python_frame.cell_names
+    assert native_frame.umi_names == python_frame.umi_names
+    assert native_frame.gene_names == python_frame.gene_names
+    assert native_frame.qname_names == python_frame.qname_names
+    for column in (
+        "cell", "umi", "gene", "qname", "ref", "pos", "strand", "unmapped",
+        "duplicate", "spliced", "xf", "nh", "perfect_umi", "perfect_cb",
+    ):
+        np.testing.assert_array_equal(
+            getattr(native_frame, column),
+            getattr(python_frame, column),
+            err_msg=column,
+        )
+    for column in ("umi_frac30", "cb_frac30", "genomic_frac30", "genomic_mean"):
+        np.testing.assert_allclose(
+            getattr(native_frame, column),
+            getattr(python_frame, column),
+            rtol=1e-6,
+            equal_nan=True,
+            err_msg=column,
+        )
+
+
+def test_frame_from_bam_uses_native(bam_path, monkeypatch):
+    path, records = bam_path
+    from sctools_tpu.io import packed
+
+    calls = []
+    original = native.frame_from_bam_native
+
+    def spy(p, n_threads=None):
+        calls.append(p)
+        return original(p, n_threads)
+
+    monkeypatch.setattr(native, "frame_from_bam_native", spy)
+    frame = packed.frame_from_bam(path)
+    assert calls == [path]
+    assert frame.n_records == len(records)
+
+
+def test_native_disabled_by_env(bam_path, monkeypatch, tmp_path):
+    path, records = bam_path
+    # simulate missing toolchain at the io boundary
+    from sctools_tpu.io import packed
+
+    monkeypatch.setattr(native, "available", lambda: False)
+    frame = packed.frame_from_bam(path)
+    assert frame.n_records == len(records)
+
+
+def test_native_empty_bam(tmp_path):
+    path = str(tmp_path / "empty.bam")
+    write_bam(path, [])
+    frame = native.frame_from_bam_native(path)
+    assert frame.n_records == 0
+
+
+def test_native_error_on_garbage(tmp_path):
+    path = tmp_path / "garbage.bam"
+    path.write_bytes(b"this is not a bam file at all")
+    with pytest.raises(RuntimeError, match="native BAM decode failed"):
+        native.frame_from_bam_native(str(path))
