@@ -118,46 +118,6 @@ def _common_metrics(
     }
 
 
-def _fragment_metrics(
-    key_cols: Tuple[jnp.ndarray, ...],
-    frag_cols: Tuple[jnp.ndarray, ...],
-    valid: jnp.ndarray,
-    mapped: jnp.ndarray,
-    num_segments: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """(n_fragments, single-read fragments, entity key) per aux outer segment.
-
-    The fragment histogram key is (ref, pos, strand, tags)
-    (reference aggregator.py:299-303) and only mapped reads contribute, so an
-    auxiliary sort over (tags..., ref, pos, strand) with unmapped records
-    pushed to the end provides the adjacency for run counting.
-    """
-    push_back = ~(valid & mapped)
-    sort_keys = [jnp.where(push_back, _I32_MAX, k.astype(jnp.int32)) for k in key_cols]
-    sort_keys += [jnp.where(push_back, _I32_MAX, f.astype(jnp.int32)) for f in frag_cols]
-    (sorted_keys, (sorted_ok,)) = seg.lexsort(sort_keys, [valid & mapped])
-
-    outer_starts = seg.run_starts(sorted_keys[:1])
-    outer_ids = seg.segment_ids_from_starts(outer_starts)
-    frag_starts = seg.run_starts(sorted_keys)
-    frag_ids = seg.segment_ids_from_starts(frag_starts)
-
-    n_fragments_local = seg.distinct_runs_per_outer(
-        frag_starts, outer_ids, num_segments, where=sorted_ok
-    )
-    single_local = seg.runs_with_count_per_outer(
-        frag_ids, outer_ids, num_segments, where=sorted_ok, predicate="eq1"
-    )
-    # Map from this sort's outer segments back to the primary sort's segments:
-    # both enumerate the distinct values of key_cols[0] in ascending order, but
-    # this sort collapses entities with no mapped reads onto the trailing
-    # INT32_MAX bucket. Scatter by the entity's first key value instead.
-    entity_key = seg.segment_min(
-        jnp.where(sorted_ok, sorted_keys[0], _I32_MAX), outer_ids, num_segments
-    )
-    return n_fragments_local, single_local, entity_key
-
-
 def _scatter_by_entity(
     values: jnp.ndarray,
     entity_key: jnp.ndarray,
@@ -204,15 +164,30 @@ def compute_entity_metrics(
     valid = cols["valid"]
     pad_key = lambda name: jnp.where(valid, cols[name].astype(jnp.int32), _I32_MAX)
     sort_keys = [pad_key(name) for name in key_names]
+    # ONE sort provides outer, triple, AND fragment adjacency: the key tuple
+    # extends (tags...) with (mapped-last flag, ref, pos, strand), so runs of
+    # the 3-key prefix are molecules and runs of the full tuple are fragments
+    # (reference fragment key is (ref, pos, strand, tags), aggregator.py:299-
+    # 303; only mapped reads contribute, so unmapped sort after the mapped
+    # fragments of their triple and are masked out of the run counts).
+    mapped_col = valid & ~cols["unmapped"].astype(bool)
+    sort_keys = sort_keys + [
+        jnp.where(mapped_col, 0, 1).astype(jnp.int32),
+        pad_key("ref"),
+        pad_key("pos"),
+        pad_key("strand"),
+    ]
 
     value_names = [
         "valid", "unmapped", "duplicate", "spliced", "xf", "nh",
         "perfect_umi", "perfect_cb", "umi_frac30", "cb_frac30",
-        "genomic_frac30", "genomic_mean", "ref", "pos", "strand",
-        "cell", "umi", "gene",
+        "genomic_frac30", "genomic_mean", "cell", "umi", "gene",
     ]
-    sorted_keys, sorted_values = seg.lexsort(sort_keys, [cols[n] for n in value_names])
-    s = dict(zip(value_names, sorted_values))
+    # sort keys + a permutation index, then gather the value columns — the
+    # value payload rides one gather each instead of the full sorting network
+    perm = seg.sort_permutation(sort_keys)
+    sorted_keys = [k[perm] for k in sort_keys]
+    s = {name: cols[name][perm] for name in value_names}
     s["valid"] = s["valid"].astype(bool)
     s["unmapped"] = s["unmapped"].astype(bool)
     s["duplicate"] = s["duplicate"].astype(bool)
@@ -220,31 +195,26 @@ def compute_entity_metrics(
 
     outer_starts = seg.run_starts(sorted_keys[:1])
     outer_ids = seg.segment_ids_from_starts(outer_starts)
-    triple_starts = seg.run_starts(sorted_keys)
+    triple_starts = seg.run_starts(sorted_keys[:3])
     triple_ids = seg.segment_ids_from_starts(triple_starts)
 
     out = _common_metrics(s, outer_ids, triple_starts, triple_ids, num_segments)
 
-    # --- fragments (auxiliary sort including (ref, pos, strand)) ----------
+    # --- fragments: runs of the full extended key among mapped records -----
     valid_sorted = s["valid"]
-    mapped_sorted = ~s["unmapped"]
-    n_frag_local, frag_single_local, frag_entity_key = _fragment_metrics(
-        tuple(s[n] for n in key_names),
-        (s["ref"], s["pos"], s["strand"]),
-        valid_sorted,
-        mapped_sorted,
-        num_segments,
+    mapped_sorted = valid_sorted & ~s["unmapped"]
+    frag_starts = seg.run_starts(sorted_keys)
+    frag_ids = seg.segment_ids_from_starts(frag_starts)
+    n_fragments = seg.distinct_runs_per_outer(
+        frag_starts, outer_ids, num_segments, where=mapped_sorted
+    )
+    frag_single = seg.runs_with_count_per_outer(
+        frag_ids, outer_ids, num_segments, where=mapped_sorted, predicate="eq1"
     )
     primary_entity_key = seg.segment_min(
         jnp.where(valid_sorted, s[key_names[0]].astype(jnp.int32), _I32_MAX),
         outer_ids,
         num_segments,
-    )
-    n_fragments = _scatter_by_entity(
-        n_frag_local, frag_entity_key, primary_entity_key, num_segments
-    )
-    frag_single = _scatter_by_entity(
-        frag_single_local, frag_entity_key, primary_entity_key, num_segments
     )
     f_reads = out["n_reads"].astype(jnp.float32)
     f_frag = n_fragments.astype(jnp.float32)

@@ -30,10 +30,15 @@ _load_failed = False
 
 
 def _build() -> bool:
-    source = os.path.join(_DIR, "bamdecode.cpp")
+    sources = [
+        os.path.join(_DIR, name)
+        for name in os.listdir(_DIR)
+        if name.endswith(".cpp")
+    ]
     try:
-        stale = not os.path.exists(_LIB_PATH) or (
+        stale = not os.path.exists(_LIB_PATH) or any(
             os.path.getmtime(_LIB_PATH) < os.path.getmtime(source)
+            for source in sources
         )
         if stale:
             subprocess.run(
@@ -181,3 +186,136 @@ def frame_from_bam_native(path: str, n_threads: Optional[int] = None):
         )
     finally:
         lib.scx_free(handle)
+
+
+# ---------------------------------------------------------------- attach
+
+def _load_attach(lib) -> None:
+    if getattr(lib, "_attach_bound", False):
+        return
+    lib.scx_attach_open.restype = ctypes.c_void_p
+    lib.scx_attach_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.scx_attach_next.restype = ctypes.c_long
+    lib.scx_attach_next.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.scx_attach_buf.restype = ctypes.POINTER(ctypes.c_char)
+    lib.scx_attach_buf.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.scx_attach_len.restype = ctypes.c_int
+    lib.scx_attach_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.scx_attach_write.restype = ctypes.c_long
+    lib.scx_attach_write.argtypes = [
+        ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.scx_attach_close.restype = ctypes.c_int
+    lib.scx_attach_close.argtypes = [ctypes.c_void_p]
+    lib.scx_attach_error.restype = ctypes.c_char_p
+    lib.scx_attach_error.argtypes = [ctypes.c_void_p]
+    lib.scx_attach_free.restype = None
+    lib.scx_attach_free.argtypes = [ctypes.c_void_p]
+    lib._attach_bound = True
+
+
+def _spans_array(spans):
+    flat = []
+    for start, end in spans or []:
+        flat.extend([start, end])
+    arr = (ctypes.c_int32 * len(flat))(*flat)
+    return arr, len(flat) // 2
+
+
+def attach_barcodes_native(
+    r1: str,
+    u2: str,
+    output_bam: str,
+    cb_spans,
+    umi_spans,
+    sample_spans=None,
+    i1: Optional[str] = None,
+    whitelist: Optional[str] = None,
+    batch_size: int = 1 << 16,
+) -> int:
+    """Attach barcode tags to a BAM with native IO + device correction.
+
+    The fastqprocess-equivalent pipeline: native fastq/BAM streaming and
+    BGZF writing, with whitelist correction per batch on the device kernel
+    (sctools_tpu.ops.whitelist). Spans are [start, end) slices of r1 (i1 for
+    sample); split barcodes pass several spans. Returns records written.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    _load_attach(lib)
+
+    corrector = None
+    if whitelist is not None:
+        from ..ops.whitelist import WhitelistCorrector
+
+        corrector = WhitelistCorrector.from_file(whitelist)
+
+    cb_arr, n_cb = _spans_array(cb_spans)
+    umi_arr, n_umi = _spans_array(umi_spans)
+    sample_arr, n_sample = _spans_array(sample_spans)
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_attach_open(
+        r1.encode(), (i1 or "").encode(), u2.encode(), output_bam.encode(),
+        cb_arr, n_cb, umi_arr, n_umi, sample_arr, n_sample,
+        errbuf, ctypes.sizeof(errbuf),
+    )
+    if not handle:
+        raise RuntimeError(
+            f"attach open failed: {errbuf.value.decode(errors='replace')}"
+        )
+    total_written = 0
+    try:
+        cb_len = lib.scx_attach_len(handle, b"cb")
+        if corrector is not None and cb_len != corrector.barcode_length:
+            raise RuntimeError(
+                f"whitelist barcode length {corrector.barcode_length} does "
+                f"not match the cell barcode span length {cb_len}"
+            )
+        while True:
+            n = lib.scx_attach_next(handle, batch_size)
+            if n < 0:
+                raise RuntimeError(
+                    f"attach read failed: {lib.scx_attach_error(handle).decode()}"
+                )
+            if n == 0:
+                break
+            cb_bytes = None
+            cb_mask = None
+            if corrector is not None and cb_len > 0:
+                raw = ctypes.string_at(
+                    lib.scx_attach_buf(handle, b"cr"), n * cb_len
+                )
+                queries = [
+                    raw[i * cb_len:(i + 1) * cb_len].rstrip(b"\0").decode("ascii")
+                    for i in range(n)
+                ]
+                corrected = corrector.correct(queries)
+                mask = bytearray(n)
+                fixed = bytearray(n * cb_len)
+                for i, value in enumerate(corrected):
+                    if value is not None:
+                        mask[i] = 1
+                        fixed[i * cb_len:(i + 1) * cb_len] = value.encode("ascii")
+                cb_bytes = bytes(fixed)
+                cb_mask = (ctypes.c_uint8 * n).from_buffer(mask)
+            written = lib.scx_attach_write(handle, n, cb_bytes, cb_mask)
+            if written < 0:
+                raise RuntimeError(
+                    f"attach write failed: {lib.scx_attach_error(handle).decode()}"
+                )
+            total_written += written
+            if written < n:
+                break  # u2 exhausted before the fastq (zip semantics)
+        if lib.scx_attach_close(handle) != 0:
+            raise RuntimeError("attach close failed")
+    finally:
+        lib.scx_attach_free(handle)
+    return total_written

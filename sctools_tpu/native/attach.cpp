@@ -1,0 +1,534 @@
+// Native barcode-attach pipeline: FASTQ decode + BAM tag-append + BGZF write.
+//
+// The analog of the reference's fastqprocess binary (fastqpreprocessing/src/
+// fastq_common.cpp:274-414: reader threads extract barcodes, writer threads
+// emit tagged BAM), restructured for a device-in-the-loop design: the native
+// side streams R1 (+I1) fastq records and the unaligned BAM, exports each
+// batch's raw barcode/quality bytes as fixed-width buffers, and Python runs
+// whitelist correction on the TPU (the MXU matmul kernel replacing the
+// reference's host hash map, utilities.cpp:14-53) before handing corrected
+// barcodes back for tag writing.
+//
+// Flow per batch (driven from sctools_tpu/native/__init__.py):
+//   scx_attach_next()   -> decode up to N fastq records, fill CR/CY/UR/UY/
+//                          SR/SY buffers (spans clamp to short reads;
+//                          truncated barcodes then fail correction, the
+//                          graceful-degradation contract of the Python path)
+//   scx_attach_write()  -> read N records from the u2 BAM, append tags
+//                          (+ CB where the caller corrected), BGZF-compress
+//                          into the output
+//
+// BGZF framing matches the spec: <=64KB payloads, BC extra field, CRC32,
+// trailing EOF block.
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr size_t kBgzfMaxPayload = 0xff00;  // htslib's conventional max
+
+// ------------------------------------------------------- streaming inflate
+
+// generic zlib pull-reader over a file (gzip/BGZF via window bits 15+32,
+// concatenated members handled by inflateReset)
+class InflateReader {
+ public:
+  bool open(const char* path) {
+    file_ = std::fopen(path, "rb");
+    if (!file_) return false;
+    std::memset(&strm_, 0, sizeof(strm_));
+    plain_probe();
+    if (!plain_ && inflateInit2(&strm_, 15 + 32) != Z_OK) return false;
+    return true;
+  }
+
+  // fill out with up to len bytes; returns bytes produced (0 = EOF)
+  size_t read(uint8_t* out, size_t len) {
+    if (plain_) return std::fread(out, 1, len, file_);
+    size_t produced = 0;
+    while (produced < len) {
+      if (strm_.avail_in == 0 && !feed()) break;
+      strm_.next_out = out + produced;
+      strm_.avail_out = static_cast<uInt>(len - produced);
+      int ret = inflate(&strm_, Z_NO_FLUSH);
+      produced = len - strm_.avail_out;
+      if (ret == Z_STREAM_END) {
+        // possibly another concatenated gzip member (BGZF is many members)
+        if (strm_.avail_in == 0 && !feed()) break;
+        if (inflateReset(&strm_) != Z_OK) break;
+      } else if (ret != Z_OK && ret != Z_BUF_ERROR) {
+        error_ = true;
+        break;
+      } else if (ret == Z_BUF_ERROR && strm_.avail_in == 0 && !feed()) {
+        break;
+      }
+    }
+    return produced;
+  }
+
+  bool failed() const { return error_; }
+
+  ~InflateReader() {
+    if (file_) std::fclose(file_);
+    if (!plain_) inflateEnd(&strm_);
+  }
+
+ private:
+  void plain_probe() {
+    int c0 = std::fgetc(file_);
+    int c1 = std::fgetc(file_);
+    std::rewind(file_);
+    plain_ = !(c0 == 0x1f && c1 == 0x8b);
+  }
+
+  bool feed() {
+    size_t n = std::fread(inbuf_, 1, sizeof(inbuf_), file_);
+    strm_.next_in = inbuf_;
+    strm_.avail_in = static_cast<uInt>(n);
+    return n > 0;
+  }
+
+  FILE* file_ = nullptr;
+  z_stream strm_;
+  uint8_t inbuf_[1 << 16];
+  bool plain_ = false;
+  bool error_ = false;
+};
+
+// buffered line/record access on top of InflateReader
+class ByteStream {
+ public:
+  bool open(const char* path) { return reader_.open(path); }
+
+  // read exactly n bytes into out; false at EOF/short
+  bool read_exact(uint8_t* out, size_t n) {
+    while (buffer_.size() - offset_ < n) {
+      if (!refill()) return false;
+    }
+    std::memcpy(out, buffer_.data() + offset_, n);
+    offset_ += n;
+    compact();
+    return true;
+  }
+
+  // next '\n'-terminated line (newline stripped); false at EOF
+  bool read_line(std::string& line) {
+    for (;;) {
+      const uint8_t* base = buffer_.data() + offset_;
+      size_t avail = buffer_.size() - offset_;
+      const void* nl = std::memchr(base, '\n', avail);
+      if (nl) {
+        size_t len = static_cast<const uint8_t*>(nl) - base;
+        line.assign(reinterpret_cast<const char*>(base), len);
+        offset_ += len + 1;
+        compact();
+        return true;
+      }
+      if (!refill()) {
+        if (avail == 0) return false;
+        line.assign(reinterpret_cast<const char*>(base), avail);
+        offset_ += avail;
+        return true;
+      }
+    }
+  }
+
+  bool failed() const { return reader_.failed(); }
+
+ private:
+  bool refill() {
+    uint8_t chunk[1 << 16];
+    size_t n = reader_.read(chunk, sizeof(chunk));
+    if (n == 0) return false;
+    buffer_.insert(buffer_.end(), chunk, chunk + n);
+    return true;
+  }
+
+  void compact() {
+    if (offset_ > (1 << 20)) {
+      buffer_.erase(buffer_.begin(), buffer_.begin() + offset_);
+      offset_ = 0;
+    }
+  }
+
+  InflateReader reader_;
+  std::vector<uint8_t> buffer_;
+  size_t offset_ = 0;
+};
+
+// --------------------------------------------------------- BGZF writing
+
+class BgzfWriter {
+ public:
+  bool open(const char* path) {
+    file_ = std::fopen(path, "wb");
+    return file_ != nullptr;
+  }
+
+  void write(const uint8_t* data, size_t len) {
+    while (len > 0) {
+      size_t take = std::min(len, kBgzfMaxPayload - pending_.size());
+      pending_.insert(pending_.end(), data, data + take);
+      data += take;
+      len -= take;
+      if (pending_.size() >= kBgzfMaxPayload) flush_block();
+    }
+  }
+
+  bool close() {
+    if (!file_) return true;
+    if (!pending_.empty()) flush_block();
+    // spec EOF marker block
+    static const uint8_t kEof[28] = {
+        0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff, 0x06, 0x00, 0x42,
+        0x43, 0x02, 0x00, 0x1b, 0x00, 0x03, 0, 0, 0, 0, 0, 0, 0, 0, 0};
+    std::fwrite(kEof, 1, sizeof(kEof), file_);
+    int rc = std::fclose(file_);
+    file_ = nullptr;
+    return rc == 0 && !error_;
+  }
+
+  bool failed() const { return error_; }
+
+  ~BgzfWriter() { close(); }
+
+ private:
+  void flush_block() {
+    uint8_t compressed[kBgzfMaxPayload + 1024];
+    z_stream strm;
+    std::memset(&strm, 0, sizeof(strm));
+    if (deflateInit2(&strm, 6, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) != Z_OK) {
+      error_ = true;
+      pending_.clear();
+      return;
+    }
+    strm.next_in = pending_.data();
+    strm.avail_in = static_cast<uInt>(pending_.size());
+    strm.next_out = compressed;
+    strm.avail_out = sizeof(compressed);
+    if (deflate(&strm, Z_FINISH) != Z_STREAM_END) error_ = true;
+    size_t clen = sizeof(compressed) - strm.avail_out;
+    deflateEnd(&strm);
+
+    uint32_t crc = crc32(0, pending_.data(), pending_.size());
+    uint32_t isize = static_cast<uint32_t>(pending_.size());
+    uint16_t bsize = static_cast<uint16_t>(clen + 25);  // total block - 1
+
+    uint8_t header[18] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff,
+                          0x06, 0x00, 0x42, 0x43, 0x02, 0x00,
+                          static_cast<uint8_t>(bsize & 0xff),
+                          static_cast<uint8_t>(bsize >> 8)};
+    uint8_t footer[8] = {
+        static_cast<uint8_t>(crc & 0xff), static_cast<uint8_t>(crc >> 8),
+        static_cast<uint8_t>(crc >> 16), static_cast<uint8_t>(crc >> 24),
+        static_cast<uint8_t>(isize & 0xff), static_cast<uint8_t>(isize >> 8),
+        static_cast<uint8_t>(isize >> 16), static_cast<uint8_t>(isize >> 24)};
+    if (std::fwrite(header, 1, 18, file_) != 18 ||
+        std::fwrite(compressed, 1, clen, file_) != clen ||
+        std::fwrite(footer, 1, 8, file_) != 8)
+      error_ = true;
+    pending_.clear();
+  }
+
+  FILE* file_ = nullptr;
+  std::vector<uint8_t> pending_;
+  bool error_ = false;
+};
+
+// --------------------------------------------------------------- spans
+
+struct Span {
+  int32_t start, end;
+};
+
+std::string extract_spans(const std::string& read, const std::vector<Span>& spans) {
+  std::string out;
+  for (const Span& span : spans) {
+    int32_t lo = std::min<int32_t>(span.start, read.size());
+    int32_t hi = std::min<int32_t>(span.end, read.size());
+    if (hi > lo) out.append(read, lo, hi - lo);
+  }
+  return out;
+}
+
+// --------------------------------------------------------------- handle
+
+struct AttachHandle {
+  ByteStream r1, i1, u2;
+  bool has_i1 = false;
+  BgzfWriter out;
+  std::string error;
+
+  std::vector<Span> cb_spans, umi_spans, sample_spans;
+  int cb_len = 0, umi_len = 0, sample_len = 0;
+
+  // batch buffers (fixed-width, size = n * len; short reads '\0'-padded so
+  // Python sees the truncation and correction rejects it)
+  std::vector<char> cr, cy, ur, uy, sr, sy;
+};
+
+int span_len(const std::vector<Span>& spans) {
+  int total = 0;
+  for (const Span& s : spans) total += s.end - s.start;
+  return total;
+}
+
+void fill_fixed(std::vector<char>& buffer, long index, int width,
+                const std::string& value) {
+  std::memset(buffer.data() + index * width, 0, width);
+  std::memcpy(buffer.data() + index * width, value.data(),
+              std::min<size_t>(width, value.size()));
+}
+
+// read one 4-line fastq record's sequence+quality; false at EOF
+bool next_fastq(ByteStream& stream, std::string& seq, std::string& qual) {
+  std::string name, plus;
+  if (!stream.read_line(name)) return false;
+  if (!stream.read_line(seq)) return false;
+  if (!stream.read_line(plus)) return false;
+  if (!stream.read_line(qual)) return false;
+  return true;
+}
+
+// copy the BAM header (magic..references) from u2 to out; needs the stream
+// positioned at the start
+bool copy_bam_header(AttachHandle& handle) {
+  uint8_t magic[4];
+  if (!handle.u2.read_exact(magic, 4) || std::memcmp(magic, "BAM\1", 4) != 0) {
+    handle.error = "u2 is not a BAM stream";
+    return false;
+  }
+  handle.out.write(magic, 4);
+  uint8_t len4[4];
+  auto copy_sized = [&](uint32_t n) -> bool {
+    std::vector<uint8_t> buf(n);
+    if (n && !handle.u2.read_exact(buf.data(), n)) return false;
+    handle.out.write(buf.data(), n);
+    return true;
+  };
+  auto read_u32 = [&](uint32_t& value) -> bool {
+    if (!handle.u2.read_exact(len4, 4)) return false;
+    value = len4[0] | (len4[1] << 8) | (len4[2] << 16) | (uint32_t(len4[3]) << 24);
+    handle.out.write(len4, 4);
+    return true;
+  };
+  uint32_t l_text;
+  if (!read_u32(l_text) || !copy_sized(l_text)) {
+    handle.error = "truncated BAM header";
+    return false;
+  }
+  uint32_t n_ref;
+  if (!read_u32(n_ref)) {
+    handle.error = "truncated BAM header";
+    return false;
+  }
+  for (uint32_t i = 0; i < n_ref; ++i) {
+    uint32_t l_name;
+    if (!read_u32(l_name) || !copy_sized(l_name + 4)) {  // name + l_ref
+      handle.error = "truncated BAM reference list";
+      return false;
+    }
+  }
+  return true;
+}
+
+void append_z_tag(std::vector<uint8_t>& rec, const char* tag,
+                  const char* value, size_t len) {
+  rec.push_back(tag[0]);
+  rec.push_back(tag[1]);
+  rec.push_back('Z');
+  rec.insert(rec.end(), value, value + len);
+  rec.push_back('\0');
+}
+
+}  // namespace
+
+extern "C" {
+
+void* scx_attach_open(const char* r1, const char* i1, const char* u2,
+                      const char* out_path, const int32_t* cb_spans,
+                      int n_cb_spans, const int32_t* umi_spans,
+                      int n_umi_spans, const int32_t* sample_spans,
+                      int n_sample_spans, char* errbuf, int errbuf_len) {
+  auto handle = new AttachHandle();
+  auto fail = [&](const std::string& message) -> void* {
+    if (errbuf && errbuf_len > 0)
+      std::snprintf(errbuf, errbuf_len, "%s", message.c_str());
+    delete handle;
+    return nullptr;
+  };
+  if (!handle->r1.open(r1)) return fail(std::string("cannot open ") + r1);
+  if (i1 && *i1) {
+    if (!handle->i1.open(i1)) return fail(std::string("cannot open ") + i1);
+    handle->has_i1 = true;
+  }
+  if (!handle->u2.open(u2)) return fail(std::string("cannot open ") + u2);
+  if (!handle->out.open(out_path))
+    return fail(std::string("cannot open for write ") + out_path);
+  for (int i = 0; i < n_cb_spans; ++i)
+    handle->cb_spans.push_back({cb_spans[2 * i], cb_spans[2 * i + 1]});
+  for (int i = 0; i < n_umi_spans; ++i)
+    handle->umi_spans.push_back({umi_spans[2 * i], umi_spans[2 * i + 1]});
+  for (int i = 0; i < n_sample_spans; ++i)
+    handle->sample_spans.push_back(
+        {sample_spans[2 * i], sample_spans[2 * i + 1]});
+  handle->cb_len = span_len(handle->cb_spans);
+  handle->umi_len = span_len(handle->umi_spans);
+  handle->sample_len = span_len(handle->sample_spans);
+  if (!copy_bam_header(*handle)) {
+    std::string message = handle->error;
+    delete handle;
+    if (errbuf && errbuf_len > 0)
+      std::snprintf(errbuf, errbuf_len, "%s", message.c_str());
+    return nullptr;
+  }
+  return handle;
+}
+
+long scx_attach_next(void* h, long max_batch) {
+  auto* handle = static_cast<AttachHandle*>(h);
+  handle->cr.resize(max_batch * handle->cb_len);
+  handle->cy.resize(max_batch * handle->cb_len);
+  handle->ur.resize(max_batch * handle->umi_len);
+  handle->uy.resize(max_batch * handle->umi_len);
+  handle->sr.resize(max_batch * handle->sample_len);
+  handle->sy.resize(max_batch * handle->sample_len);
+  long n = 0;
+  std::string seq, qual, iseq, iqual;
+  while (n < max_batch) {
+    if (!next_fastq(handle->r1, seq, qual)) break;
+    if (handle->cb_len) {
+      fill_fixed(handle->cr, n, handle->cb_len,
+                 extract_spans(seq, handle->cb_spans));
+      fill_fixed(handle->cy, n, handle->cb_len,
+                 extract_spans(qual, handle->cb_spans));
+    }
+    if (handle->umi_len) {
+      fill_fixed(handle->ur, n, handle->umi_len,
+                 extract_spans(seq, handle->umi_spans));
+      fill_fixed(handle->uy, n, handle->umi_len,
+                 extract_spans(qual, handle->umi_spans));
+    }
+    if (handle->has_i1 && handle->sample_len) {
+      if (!next_fastq(handle->i1, iseq, iqual)) {
+        handle->error = "i1 fastq ended before r1";
+        return -1;
+      }
+      fill_fixed(handle->sr, n, handle->sample_len,
+                 extract_spans(iseq, handle->sample_spans));
+      fill_fixed(handle->sy, n, handle->sample_len,
+                 extract_spans(iqual, handle->sample_spans));
+    }
+    ++n;
+  }
+  if (handle->r1.failed()) {
+    handle->error = "r1 decompression failed";
+    return -1;
+  }
+  return n;
+}
+
+const char* scx_attach_buf(void* h, const char* name) {
+  auto* handle = static_cast<AttachHandle*>(h);
+  std::string_view n(name);
+  if (n == "cr") return handle->cr.data();
+  if (n == "cy") return handle->cy.data();
+  if (n == "ur") return handle->ur.data();
+  if (n == "uy") return handle->uy.data();
+  if (n == "sr") return handle->sr.data();
+  if (n == "sy") return handle->sy.data();
+  return nullptr;
+}
+
+int scx_attach_len(void* h, const char* name) {
+  auto* handle = static_cast<AttachHandle*>(h);
+  std::string_view n(name);
+  if (n == "cb") return handle->cb_len;
+  if (n == "umi") return handle->umi_len;
+  if (n == "sample") return handle->sample_len;
+  return -1;
+}
+
+// tag + write `n` u2 records. cb_bytes/cb_mask: corrected barcodes (may be
+// null when no whitelist). Returns records written, or -1 on error.
+long scx_attach_write(void* h, long n, const char* cb_bytes,
+                      const uint8_t* cb_mask) {
+  auto* handle = static_cast<AttachHandle*>(h);
+  std::vector<uint8_t> rec;
+  uint8_t len4[4];
+  long written = 0;
+  for (long i = 0; i < n; ++i) {
+    if (!handle->u2.read_exact(len4, 4)) break;  // u2 exhausted: stop (zip semantics)
+    uint32_t block_size =
+        len4[0] | (len4[1] << 8) | (len4[2] << 16) | (uint32_t(len4[3]) << 24);
+    // sanity-bound before allocating: corrupt length bytes would otherwise
+    // raise bad_alloc across the C boundary and terminate the process
+    if (block_size < 32 || block_size > (1u << 28)) {
+      handle->error = "implausible u2 record size (corrupt stream?)";
+      return -1;
+    }
+    rec.resize(block_size);
+    if (block_size && !handle->u2.read_exact(rec.data(), block_size)) {
+      handle->error = "truncated u2 record";
+      return -1;
+    }
+    auto strip = [](const char* data, int width) {
+      size_t len = 0;
+      while (len < static_cast<size_t>(width) && data[len]) ++len;
+      return std::make_pair(data, len);
+    };
+    if (handle->cb_len) {
+      auto [crp, crl] = strip(handle->cr.data() + i * handle->cb_len, handle->cb_len);
+      auto [cyp, cyl] = strip(handle->cy.data() + i * handle->cb_len, handle->cb_len);
+      append_z_tag(rec, "CR", crp, crl);
+      append_z_tag(rec, "CY", cyp, cyl);
+      if (cb_bytes && cb_mask && cb_mask[i]) {
+        append_z_tag(rec, "CB", cb_bytes + i * handle->cb_len, handle->cb_len);
+      }
+    }
+    if (handle->umi_len) {
+      auto [urp, url] = strip(handle->ur.data() + i * handle->umi_len, handle->umi_len);
+      auto [uyp, uyl] = strip(handle->uy.data() + i * handle->umi_len, handle->umi_len);
+      append_z_tag(rec, "UR", urp, url);
+      append_z_tag(rec, "UY", uyp, uyl);
+    }
+    if (handle->has_i1 && handle->sample_len) {
+      auto [srp, srl] = strip(handle->sr.data() + i * handle->sample_len, handle->sample_len);
+      auto [syp, syl] = strip(handle->sy.data() + i * handle->sample_len, handle->sample_len);
+      append_z_tag(rec, "SR", srp, srl);
+      append_z_tag(rec, "SY", syp, syl);
+    }
+    uint32_t new_size = static_cast<uint32_t>(rec.size());
+    uint8_t out4[4] = {static_cast<uint8_t>(new_size & 0xff),
+                       static_cast<uint8_t>(new_size >> 8),
+                       static_cast<uint8_t>(new_size >> 16),
+                       static_cast<uint8_t>(new_size >> 24)};
+    handle->out.write(out4, 4);
+    handle->out.write(rec.data(), rec.size());
+    ++written;
+  }
+  if (handle->out.failed()) {
+    handle->error = "output write failed";
+    return -1;
+  }
+  return written;
+}
+
+int scx_attach_close(void* h) {
+  auto* handle = static_cast<AttachHandle*>(h);
+  return handle->out.close() ? 0 : -1;
+}
+
+const char* scx_attach_error(void* h) {
+  return static_cast<AttachHandle*>(h)->error.c_str();
+}
+
+void scx_attach_free(void* h) { delete static_cast<AttachHandle*>(h); }
+
+}  // extern "C"

@@ -136,3 +136,65 @@ def test_native_error_on_garbage(tmp_path):
     path.write_bytes(b"this is not a bam file at all")
     with pytest.raises(RuntimeError, match="native BAM decode failed"):
         native.frame_from_bam_native(str(path))
+
+
+def test_native_attach_matches_python(tmp_path, monkeypatch):
+    """The native attach pipeline and the Python generator path must produce
+    identical tags for every record."""
+    import random
+
+    from sctools_tpu import platform
+    from sctools_tpu.io.sam import AlignmentReader
+    from helpers import write_fastq
+
+    rng = random.Random(5)
+    whitelist = [
+        "".join(rng.choice("ACGT") for _ in range(16)) for _ in range(20)
+    ]
+    wl_path = tmp_path / "wl.txt"
+    wl_path.write_text("\n".join(whitelist) + "\n")
+
+    reads = []
+    header = make_header()
+    u2_records = []
+    for i in range(120):
+        barcode = rng.choice(whitelist)
+        kind = i % 4
+        if kind == 1:  # one substitution -> corrected
+            p = rng.randrange(16)
+            barcode = barcode[:p] + rng.choice("ACGTN".replace(barcode[p], "")) + barcode[p + 1:]
+        elif kind == 2:  # garbage -> uncorrectable
+            barcode = "".join(rng.choice("ACGT") for _ in range(16))
+        umi = "".join(rng.choice("ACGT") for _ in range(10))
+        qual = "".join(chr(33 + rng.randrange(40)) for _ in range(28))
+        reads.append((f"r{i}", barcode + umi + "AC", qual))
+        u2_records.append(make_record(name=f"r{i}", unmapped=True, header=header))
+    r1 = write_fastq(tmp_path / "r1.fastq", reads)
+    u2 = write_bam(tmp_path / "u2.bam", u2_records, header)
+
+    out_native = str(tmp_path / "native.bam")
+    rc = platform.TenXV2.attach_barcodes(
+        ["--r1", r1, "--u2", u2, "-o", out_native, "-w", str(wl_path)]
+    )
+    assert rc == 0
+
+    out_python = str(tmp_path / "python.bam")
+    monkeypatch.setattr(
+        platform.TenXV2, "_attach_with_native",
+        classmethod(lambda cls, *a, **k: False),
+    )
+    rc = platform.TenXV2.attach_barcodes(
+        ["--r1", r1, "--u2", u2, "-o", out_python, "-w", str(wl_path)]
+    )
+    assert rc == 0
+
+    with AlignmentReader(out_native) as fn, AlignmentReader(out_python) as fp:
+        native_records = list(fn)
+        python_records = list(fp)
+    assert len(native_records) == len(python_records) == 120
+    corrected = 0
+    for a, b in zip(native_records, python_records):
+        assert a.query_name == b.query_name
+        assert dict(a.tags) == dict(b.tags), a.query_name
+        corrected += a.has_tag("CB")
+    assert 0 < corrected < 120
