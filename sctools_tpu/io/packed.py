@@ -197,6 +197,136 @@ def frame_from_records(records: Iterable[BamRecord]) -> ReadFrame:
     )
 
 
+_PER_RECORD_FIELDS = (
+    "cell", "umi", "gene", "qname", "ref", "pos", "strand", "unmapped",
+    "duplicate", "spliced", "xf", "nh", "perfect_umi", "perfect_cb",
+    "umi_frac30", "cb_frac30", "genomic_frac30", "genomic_mean",
+)
+_CODED_FIELDS = ("cell", "umi", "gene", "qname")
+
+
+def slice_frame(frame: ReadFrame, start: int, stop: int) -> ReadFrame:
+    """Row-slice a frame; vocabularies are shared (codes stay valid)."""
+    kwargs = {name: getattr(frame, name)[start:stop] for name in _PER_RECORD_FIELDS}
+    for name in _CODED_FIELDS:
+        kwargs[f"{name}_names"] = getattr(frame, f"{name}_names")
+    return ReadFrame(**kwargs)
+
+
+def compact_frame(frame: ReadFrame) -> ReadFrame:
+    """Shrink each vocabulary to the names actually referenced.
+
+    Slicing shares the parent's (possibly merged) vocabularies; a carry frame
+    held across streaming batches must compact them, or the name lists would
+    accumulate the union of every batch seen so far and host memory would
+    scale with file size again. Codes are remapped onto the compacted (still
+    sorted) vocabulary.
+    """
+    kwargs = {name: getattr(frame, name) for name in _PER_RECORD_FIELDS}
+    for name in _CODED_FIELDS:
+        codes = getattr(frame, name)
+        names = getattr(frame, f"{name}_names")
+        used = np.unique(codes)
+        if len(used) == len(names):
+            kwargs[f"{name}_names"] = names
+            continue
+        remap = np.zeros(len(names), dtype=np.int32)
+        remap[used] = np.arange(len(used), dtype=np.int32)
+        kwargs[name] = remap[codes]
+        kwargs[f"{name}_names"] = [names[int(code)] for code in used]
+    return ReadFrame(**kwargs)
+
+
+def _merge_coded(codes_a, names_a, codes_b, names_b):
+    """Concatenate two dictionary-coded columns under one merged vocabulary.
+
+    Both vocabularies are sorted (np.unique order), so the union stays sorted
+    and a searchsorted gather remaps each side's codes.
+    """
+    if names_a == names_b:
+        return np.concatenate([codes_a, codes_b]).astype(np.int32), names_a
+    a = np.asarray(names_a, dtype=object)
+    b = np.asarray(names_b, dtype=object)
+    union = np.union1d(a, b)
+    remap_a = np.searchsorted(union, a).astype(np.int32)
+    remap_b = np.searchsorted(union, b).astype(np.int32)
+    codes = np.concatenate([
+        remap_a[codes_a] if len(codes_a) else codes_a,
+        remap_b[codes_b] if len(codes_b) else codes_b,
+    ]).astype(np.int32)
+    return codes, [str(value) for value in union]
+
+
+def concat_frames(a: ReadFrame, b: ReadFrame) -> ReadFrame:
+    """Concatenate two frames, merging their vocabularies.
+
+    The carry mechanism of the streaming pipeline: the incomplete trailing
+    entity of batch k is prepended to batch k+1, so record order is
+    preserved and codes are remapped into the merged (still sorted)
+    vocabularies.
+    """
+    if a.n_records == 0:
+        return b
+    if b.n_records == 0:
+        return a
+    kwargs = {}
+    for name in _CODED_FIELDS:
+        codes, names = _merge_coded(
+            getattr(a, name), getattr(a, f"{name}_names"),
+            getattr(b, name), getattr(b, f"{name}_names"),
+        )
+        kwargs[name] = codes
+        kwargs[f"{name}_names"] = names
+    for name in _PER_RECORD_FIELDS:
+        if name in _CODED_FIELDS:
+            continue
+        kwargs[name] = np.concatenate([getattr(a, name), getattr(b, name)])
+    return ReadFrame(**kwargs)
+
+
+def iter_frames_from_bam(
+    path: str,
+    batch_records: int,
+    mode: Optional[str] = None,
+    want_qname: bool = False,
+):
+    """Yield ReadFrames of <= batch_records alignments in file order.
+
+    The bounded-memory decode path (native stream when available, Python
+    AlignmentReader batching otherwise) — the TPU build's analog of the
+    reference's alignments_per_batch streaming reads (htslib_tagsort.cpp:
+    308-393). Each frame has its own (sorted) vocabularies.
+    """
+    import itertools
+
+    from . import bgzf
+
+    if mode != "r" and bgzf.is_gzip(path):
+        from .. import native
+
+        if native.available():
+            stream = native.stream_frames_native(
+                path, batch_records, want_qname=want_qname
+            )
+            try:
+                first = next(stream, None)
+            except RuntimeError:
+                first = None
+                stream = None  # fall through to the Python decoder
+            if stream is not None:
+                if first is not None:
+                    yield first
+                    yield from stream
+                return
+    with AlignmentReader(path, mode) as reader:
+        records = iter(reader)
+        while True:
+            chunk = list(itertools.islice(records, batch_records))
+            if not chunk:
+                break
+            yield frame_from_records(chunk)
+
+
 def frame_from_bam(path: str, mode: Optional[str] = None) -> ReadFrame:
     """Decode a BAM/SAM file into a ReadFrame.
 

@@ -12,17 +12,30 @@ streaming host aggregators instead (exact reference semantics, no device).
 from __future__ import annotations
 
 from contextlib import closing
-from typing import Dict, List, Set
+from typing import Dict, List, Optional, Set
 
 import numpy as np
 
 from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
-from ..io.packed import PAD_FILLS, ReadFrame, frame_from_bam
+from ..io.packed import (
+    PAD_FILLS,
+    ReadFrame,
+    compact_frame,
+    concat_frames,
+    iter_frames_from_bam,
+    slice_frame,
+)
 from ..io.sam import AlignmentReader
 from ..ops.segments import bucket_size
+from ..utils import prefetch_iterator
 from .aggregator import CellMetrics, GeneMetrics
 from .schema import CELL_COLUMNS, GENE_COLUMNS, INT_COLUMNS
 from .writer import MetricCSVWriter
+
+# Device batch size: at most this many alignments are held in host RAM and
+# processed per compiled pass. The streaming analog of the reference's
+# alignments_per_batch default (fastqpreprocessing/src/input_options.h:16).
+DEFAULT_BATCH_RECORDS = 1 << 20
 
 
 def _pad_columns(frame: ReadFrame, is_mito: np.ndarray) -> Dict[str, np.ndarray]:
@@ -81,12 +94,14 @@ class MetricGatherer:
         mitochondrial_gene_ids: Set[str] = set(),
         compress: bool = True,
         backend: str = "device",
+        batch_records: int = DEFAULT_BATCH_RECORDS,
     ):
         self._bam_file = bam_file
         self._output_stem = output_stem
         self._compress = compress
         self._mitochondrial_gene_ids = mitochondrial_gene_ids
         self._backend = backend
+        self._batch_records = batch_records
 
     @property
     def bam_file(self) -> str:
@@ -103,18 +118,55 @@ class MetricGatherer:
     # ---- device backend --------------------------------------------------
 
     def _extract_device(self, mode: str) -> None:
+        """Streaming device pass: bounded host memory for any file size.
+
+        Batches of <= batch_records alignments decode off a prefetch thread
+        (decode overlaps device compute); each batch is cut at the last
+        entity boundary and the incomplete tail entity carries into the next
+        batch — sorted input means an entity never spans two processed
+        batches, so per-batch results need no cross-batch merging. Memory is
+        one batch plus the largest single entity, the reference gatherer's
+        own model ("one molecule group in memory", metrics/gatherer.py:41-43,
+        scaled to batches).
+        """
         from . import device as device_engine  # deferred jax import
 
-        frame = frame_from_bam(self._bam_file, mode if mode != "rb" else None)
+        frames = prefetch_iterator(
+            iter_frames_from_bam(
+                self._bam_file,
+                self._batch_records,
+                mode if mode != "rb" else None,
+            )
+        )
+        with closing(MetricCSVWriter(self._output_stem, self._compress)) as out:
+            out.write_header({c: None for c in self.columns})
+            carry: Optional[ReadFrame] = None
+            for frame in frames:
+                if carry is not None:
+                    frame = concat_frames(carry, frame)
+                    carry = None
+                key = (
+                    frame.cell if self.entity_kind == "cell" else frame.gene
+                )
+                changes = np.nonzero(key[1:] != key[:-1])[0]
+                if changes.size == 0:
+                    carry = frame  # one entity so far; keep accumulating
+                    continue
+                cut = int(changes[-1]) + 1
+                self._process_device_batch(
+                    slice_frame(frame, 0, cut), device_engine, out
+                )
+                # compact, or the carried vocabularies would accumulate the
+                # union of every batch seen so far
+                carry = compact_frame(slice_frame(frame, cut, frame.n_records))
+            if carry is not None and carry.n_records:
+                self._process_device_batch(carry, device_engine, out)
+
+    def _process_device_batch(self, frame: ReadFrame, device_engine, out) -> None:
         is_mito = np.asarray(
             [name in self._mitochondrial_gene_ids for name in frame.gene_names],
             dtype=bool,
         )
-        if frame.n_records == 0:
-            with closing(MetricCSVWriter(self._output_stem, self._compress)) as out:
-                out.write_header({c: None for c in self.columns})
-            return
-
         cols = _pad_columns(frame, is_mito)
         num_segments = len(cols["valid"])
         result = device_engine.compute_entity_metrics(
@@ -123,7 +175,7 @@ class MetricGatherer:
             kind=self.entity_kind,
         )
         result = {k: np.asarray(v) for k, v in result.items()}
-        self._write_device_rows(frame, result)
+        self._write_device_rows(frame, result, out)
 
     def _entity_names(self, frame: ReadFrame) -> List[str]:
         return frame.cell_names if self.entity_kind == "cell" else frame.gene_names
@@ -132,25 +184,25 @@ class MetricGatherer:
         """Whether to emit a row for this entity (gene path drops multi-genes)."""
         return True
 
-    def _write_device_rows(self, frame: ReadFrame, result: Dict[str, np.ndarray]) -> None:
+    def _write_device_rows(
+        self, frame: ReadFrame, result: Dict[str, np.ndarray], out: MetricCSVWriter
+    ) -> None:
         names = self._entity_names(frame)
         n_entities = int(result["n_entities"])
-        with closing(MetricCSVWriter(self._output_stem, self._compress)) as out:
-            out.write_header({c: None for c in self.columns})
-            for row in range(n_entities):
-                code = int(result["entity_code"][row])
-                name = names[code]
-                if not self._row_filter(name):
-                    continue
-                index = "None" if name == "" else name
-                record = {}
-                for column in self.columns:
-                    value = result[column][row]
-                    if column in INT_COLUMNS:
-                        record[column] = int(value)
-                    else:
-                        record[column] = float(value)
-                out.write(index, record)
+        for row in range(n_entities):
+            code = int(result["entity_code"][row])
+            name = names[code]
+            if not self._row_filter(name):
+                continue
+            index = "None" if name == "" else name
+            record = {}
+            for column in self.columns:
+                value = result[column][row]
+                if column in INT_COLUMNS:
+                    record[column] = int(value)
+                else:
+                    record[column] = float(value)
+            out.write(index, record)
 
     # ---- cpu backend (exact reference streaming semantics) ---------------
 

@@ -87,6 +87,17 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.scx_vocab_offsets.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.scx_free.restype = None
         lib.scx_free.argtypes = [ctypes.c_void_p]
+        lib.scx_stream_open.restype = ctypes.c_void_p
+        lib.scx_stream_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_stream_next.restype = ctypes.c_long
+        lib.scx_stream_next.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.scx_stream_error.restype = ctypes.c_char_p
+        lib.scx_stream_error.argtypes = [ctypes.c_void_p]
+        lib.scx_stream_close.restype = None
+        lib.scx_stream_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -112,14 +123,73 @@ def _vocab(lib, handle, name: bytes) -> List[str]:
     return out
 
 
+def _empty_frame():
+    from ..io.packed import ReadFrame
+
+    empty_i32 = np.zeros(0, np.int32)
+    return ReadFrame(
+        cell=empty_i32, umi=empty_i32.copy(), gene=empty_i32.copy(),
+        qname=empty_i32.copy(),
+        cell_names=[], umi_names=[], gene_names=[], qname_names=[],
+        ref=empty_i32.copy(), pos=empty_i32.copy(),
+        strand=np.zeros(0, np.int8),
+        unmapped=np.zeros(0, bool), duplicate=np.zeros(0, bool),
+        spliced=np.zeros(0, bool),
+        xf=np.zeros(0, np.int8), nh=empty_i32.copy(),
+        perfect_umi=np.zeros(0, np.int8),
+        perfect_cb=np.zeros(0, np.int8),
+        umi_frac30=np.zeros(0, np.float32),
+        cb_frac30=np.zeros(0, np.float32),
+        genomic_frac30=np.zeros(0, np.float32),
+        genomic_mean=np.zeros(0, np.float32),
+    )
+
+
+def _frame_from_handle(lib, handle, want_qname: bool):
+    """Copy the handle's current batch out into a ReadFrame."""
+    from ..io.packed import ReadFrame
+
+    n = lib.scx_n_records(handle)
+    if n == 0:
+        return _empty_frame()
+
+    def i32(name):
+        return _copy_array(lib.scx_col_i32(handle, name), n, np.int32)
+
+    def i8(name, dtype=np.int8):
+        return _copy_array(lib.scx_col_i8(handle, name), n, dtype)
+
+    def f32(name):
+        return _copy_array(lib.scx_col_f32(handle, name), n, np.float32)
+
+    return ReadFrame(
+        cell=i32(b"cell"), umi=i32(b"umi"), gene=i32(b"gene"),
+        qname=i32(b"qname"),
+        cell_names=_vocab(lib, handle, b"cell"),
+        umi_names=_vocab(lib, handle, b"umi"),
+        gene_names=_vocab(lib, handle, b"gene"),
+        qname_names=_vocab(lib, handle, b"qname") if want_qname else [""],
+        ref=i32(b"ref"), pos=i32(b"pos"),
+        strand=i8(b"strand"),
+        unmapped=i8(b"unmapped").astype(bool),
+        duplicate=i8(b"duplicate").astype(bool),
+        spliced=i8(b"spliced").astype(bool),
+        xf=i8(b"xf"), nh=i32(b"nh"),
+        perfect_umi=i8(b"perfect_umi"),
+        perfect_cb=i8(b"perfect_cb"),
+        umi_frac30=f32(b"umi_frac30"),
+        cb_frac30=f32(b"cb_frac30"),
+        genomic_frac30=f32(b"genomic_frac30"),
+        genomic_mean=f32(b"genomic_mean"),
+    )
+
+
 def frame_from_bam_native(path: str, n_threads: Optional[int] = None):
-    """Decode a BAM file into a ReadFrame via the native library.
+    """Decode a whole BAM file into one ReadFrame via the native library.
 
     Raises RuntimeError when the library is unavailable or the file is
     malformed; io.packed.frame_from_bam handles fallback.
     """
-    from ..io.packed import ReadFrame
-
     lib = _load()
     if lib is None:
         raise RuntimeError("native decoder unavailable")
@@ -134,58 +204,54 @@ def frame_from_bam_native(path: str, n_threads: Optional[int] = None):
             f"native BAM decode failed: {errbuf.value.decode(errors='replace')}"
         )
     try:
-        n = lib.scx_n_records(handle)
-
-        def i32(name):
-            return _copy_array(lib.scx_col_i32(handle, name), n, np.int32)
-
-        def i8(name, dtype=np.int8):
-            return _copy_array(lib.scx_col_i8(handle, name), n, dtype)
-
-        def f32(name):
-            return _copy_array(lib.scx_col_f32(handle, name), n, np.float32)
-
-        if n == 0:
-            empty_i32 = np.zeros(0, np.int32)
-            return ReadFrame(
-                cell=empty_i32, umi=empty_i32.copy(), gene=empty_i32.copy(),
-                qname=empty_i32.copy(),
-                cell_names=[], umi_names=[], gene_names=[], qname_names=[],
-                ref=empty_i32.copy(), pos=empty_i32.copy(),
-                strand=np.zeros(0, np.int8),
-                unmapped=np.zeros(0, bool), duplicate=np.zeros(0, bool),
-                spliced=np.zeros(0, bool),
-                xf=np.zeros(0, np.int8), nh=empty_i32.copy(),
-                perfect_umi=np.zeros(0, np.int8),
-                perfect_cb=np.zeros(0, np.int8),
-                umi_frac30=np.zeros(0, np.float32),
-                cb_frac30=np.zeros(0, np.float32),
-                genomic_frac30=np.zeros(0, np.float32),
-                genomic_mean=np.zeros(0, np.float32),
-            )
-
-        return ReadFrame(
-            cell=i32(b"cell"), umi=i32(b"umi"), gene=i32(b"gene"),
-            qname=i32(b"qname"),
-            cell_names=_vocab(lib, handle, b"cell"),
-            umi_names=_vocab(lib, handle, b"umi"),
-            gene_names=_vocab(lib, handle, b"gene"),
-            qname_names=_vocab(lib, handle, b"qname"),
-            ref=i32(b"ref"), pos=i32(b"pos"),
-            strand=i8(b"strand"),
-            unmapped=i8(b"unmapped").astype(bool),
-            duplicate=i8(b"duplicate").astype(bool),
-            spliced=i8(b"spliced").astype(bool),
-            xf=i8(b"xf"), nh=i32(b"nh"),
-            perfect_umi=i8(b"perfect_umi"),
-            perfect_cb=i8(b"perfect_cb"),
-            umi_frac30=f32(b"umi_frac30"),
-            cb_frac30=f32(b"cb_frac30"),
-            genomic_frac30=f32(b"genomic_frac30"),
-            genomic_mean=f32(b"genomic_mean"),
-        )
+        return _frame_from_handle(lib, handle, want_qname=True)
     finally:
         lib.scx_free(handle)
+
+
+def stream_frames_native(
+    path: str,
+    batch_records: int,
+    n_threads: Optional[int] = None,
+    want_qname: bool = False,
+):
+    """Yield ReadFrames of <= batch_records alignments in file order.
+
+    Bounded host memory: the native stream (scx_stream_*) holds only the
+    current batch plus one compressed chunk — the reference's
+    alignments_per_batch memory model (input_options.h:16). With
+    ``want_qname=False`` the qname column is all zeros and its vocabulary is
+    [""], skipping the near-one-entry-per-record dictionary that metrics
+    never read.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native decoder unavailable")
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_stream_open(
+        path.encode(), n_threads, 1 if want_qname else 0,
+        errbuf, ctypes.sizeof(errbuf),
+    )
+    if not handle:
+        raise RuntimeError(
+            f"native BAM stream open failed: "
+            f"{errbuf.value.decode(errors='replace')}"
+        )
+    try:
+        while True:
+            n = lib.scx_stream_next(handle, batch_records)
+            if n < 0:
+                raise RuntimeError(
+                    "native BAM stream failed: "
+                    f"{lib.scx_stream_error(handle).decode(errors='replace')}"
+                )
+            if n == 0:
+                break
+            yield _frame_from_handle(lib, handle, want_qname)
+    finally:
+        lib.scx_stream_close(handle)
 
 
 # ---------------------------------------------------------------- attach

@@ -1,22 +1,26 @@
-// Native BAM -> packed-column decoder for the TPU pipeline.
+// Native streaming BAM -> packed-column decoder for the TPU pipeline.
 //
 // The C++ host layer of the framework: the analog of the reference's
 // fastqpreprocessing/ native code (htslib_tagsort.cpp:106-218 extracts the
-// same per-alignment fields into TSV tuples), redesigned to feed a device
-// pipeline: instead of strings and sorted text files, it emits fixed-width
-// struct-of-arrays columns (the ReadFrame schema of sctools_tpu/io/packed.py)
-// with strings dictionary-encoded against lexicographically sorted
-// vocabularies, so the arrays can be handed to jax.device_put unchanged.
+// same per-alignment fields into TSV tuples; its AlignmentReader at
+// htslib_tagsort.cpp:308-393 serializes batch reads across sort workers),
+// redesigned to feed a device pipeline: instead of strings and sorted text
+// files, it emits fixed-width struct-of-arrays columns (the ReadFrame schema
+// of sctools_tpu/io/packed.py) with strings dictionary-encoded against
+// lexicographically sorted per-batch vocabularies, so the arrays can be
+// handed to jax.device_put unchanged.
 //
-// Layout of the work:
-//   1. scan the BGZF container sequentially (header hops only) to index
-//      blocks, then inflate all blocks IN PARALLEL (blocks are independent
-//      deflate streams; this is where the bytes are and where the reference
-//      spends its reader threads, fastq_common.cpp:274-360);
-//   2. parse the decompressed BAM stream record by record, computing exactly
-//      the ReadFrame columns (tag codes, flags, quality summaries);
-//   3. sort each string vocabulary and remap codes so code order == numpy's
-//      np.unique order (byte-lexicographic; "" first).
+// The decoder is a bounded-memory STREAM: the file is read in fixed-size
+// compressed chunks, BGZF blocks inflate on a thread pool (blocks are
+// independent deflate streams), and each scx_stream_next(max_records) call
+// parses at most max_records alignments — the same memory model as the
+// reference's alignments_per_batch knob (input_options.h:16). Record parsing
+// itself is also parallel: the batch's record spans are split into contiguous
+// ranges, each worker parses into thread-local columns with thread-local
+// string interning, and the vocabularies are merged + codes remapped at the
+// end so code order == numpy's np.unique order (byte-lexicographic; ""
+// first). The legacy whole-file API (scx_decode_bam) is a stream whose
+// single batch is the entire file.
 //
 // Exposed through a minimal C API consumed by ctypes (sctools_tpu/native/
 // __init__.py); no Python.h dependency.
@@ -25,25 +29,20 @@
 
 #include <algorithm>
 #include <atomic>
+#include <climits>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
-#include <numeric>
-#include <stdexcept>
 #include <string>
+#include <string_view>
 #include <thread>
 #include <unordered_map>
 #include <vector>
 
 namespace {
 
-struct BlockInfo {
-  size_t file_offset;   // offset of the deflate payload
-  uint32_t payload_len; // compressed payload length
-  uint32_t isize;       // uncompressed size
-  size_t out_offset;    // prefix-summed output offset
-};
+constexpr size_t kCompChunk = 16u << 20;  // compressed bytes per file read
 
 // ----------------------------------------------------------------- columns
 
@@ -52,51 +51,95 @@ struct Columns {
   std::vector<int8_t> strand, xf, perfect_umi, perfect_cb;
   std::vector<uint8_t> unmapped, duplicate, spliced;
   std::vector<float> umi_frac30, cb_frac30, genomic_frac30, genomic_mean;
+
+  size_t size() const { return cell.size(); }
+
+  void clear() {
+    cell.clear(); umi.clear(); gene.clear(); qname.clear();
+    ref.clear(); pos.clear(); nh.clear();
+    strand.clear(); xf.clear(); perfect_umi.clear(); perfect_cb.clear();
+    unmapped.clear(); duplicate.clear(); spliced.clear();
+    umi_frac30.clear(); cb_frac30.clear();
+    genomic_frac30.clear(); genomic_mean.clear();
+  }
+
+  void append(Columns&& other) {
+    auto cat = [](auto& dst, auto& src) {
+      dst.insert(dst.end(), src.begin(), src.end());
+    };
+    cat(cell, other.cell); cat(umi, other.umi); cat(gene, other.gene);
+    cat(qname, other.qname); cat(ref, other.ref); cat(pos, other.pos);
+    cat(nh, other.nh); cat(strand, other.strand); cat(xf, other.xf);
+    cat(perfect_umi, other.perfect_umi); cat(perfect_cb, other.perfect_cb);
+    cat(unmapped, other.unmapped); cat(duplicate, other.duplicate);
+    cat(spliced, other.spliced); cat(umi_frac30, other.umi_frac30);
+    cat(cb_frac30, other.cb_frac30); cat(genomic_frac30, other.genomic_frac30);
+    cat(genomic_mean, other.genomic_mean);
+  }
 };
 
-struct Vocab {
-  // each unique string is stored exactly once (as the map key) until
-  // finalize(); qname vocabularies are near one-entry-per-record, so a
-  // second copy would double peak memory on large files
+// thread-local string interner: local code = insertion order. Sorted BAMs
+// repeat the same CB/UB/GE across consecutive records, so a one-entry memo
+// of the last key skips the string allocation + hash on the common path.
+struct LocalVocab {
   std::unordered_map<std::string, int32_t> map;
-  std::vector<std::string> strings;  // sorted, filled by finalize()
+  std::vector<const std::string*> order;  // local code -> key
+  const std::string* last_key = nullptr;
+  int32_t last_code = -1;
 
   int32_t code(const char* data, size_t len) {
-    return map.try_emplace(std::string(data, len),
-                           static_cast<int32_t>(map.size()))
-        .first->second;
-  }
-
-  // sort lexicographically and return old->new code remapping
-  std::vector<int32_t> finalize() {
-    std::vector<const std::pair<const std::string, int32_t>*> entries;
-    entries.reserve(map.size());
-    for (const auto& entry : map) entries.push_back(&entry);
-    std::sort(entries.begin(), entries.end(), [](auto* a, auto* b) {
-      return a->first < b->first;
-    });
-    std::vector<int32_t> remap(map.size());
-    strings.resize(map.size());
-    for (size_t rank = 0; rank < entries.size(); ++rank) {
-      remap[entries[rank]->second] = static_cast<int32_t>(rank);
-      strings[rank] = entries[rank]->first;
-    }
-    map.clear();
-    return remap;
+    if (last_key && last_key->size() == len &&
+        std::memcmp(last_key->data(), data, len) == 0)
+      return last_code;
+    auto [it, inserted] = map.try_emplace(
+        len ? std::string(data, len) : std::string(),
+        static_cast<int32_t>(map.size()));
+    if (inserted) order.push_back(&it->first);
+    last_key = &it->first;
+    last_code = it->second;
+    return it->second;
   }
 };
 
-struct Handle {
+// merge thread-local vocabularies into one sorted vocabulary and remap each
+// thread's codes in place
+void merge_vocabs(std::vector<LocalVocab>& locals,
+                  std::vector<std::vector<int32_t>*> code_columns,
+                  std::vector<std::string>& out_sorted) {
+  out_sorted.clear();
+  for (const LocalVocab& local : locals)
+    for (const std::string* s : local.order) out_sorted.push_back(*s);
+  std::sort(out_sorted.begin(), out_sorted.end());
+  out_sorted.erase(std::unique(out_sorted.begin(), out_sorted.end()),
+                   out_sorted.end());
+  std::unordered_map<std::string_view, int32_t> rank;
+  rank.reserve(out_sorted.size() * 2);
+  for (size_t i = 0; i < out_sorted.size(); ++i)
+    rank.emplace(out_sorted[i], static_cast<int32_t>(i));
+  for (size_t t = 0; t < locals.size(); ++t) {
+    std::vector<int32_t> remap(locals[t].order.size());
+    for (size_t i = 0; i < locals[t].order.size(); ++i)
+      remap[i] = rank.at(*locals[t].order[i]);
+    for (int32_t& code : *code_columns[t]) code = remap[code];
+  }
+}
+
+struct Batch {
   Columns cols;
-  Vocab cell_vocab, umi_vocab, gene_vocab, qname_vocab;
-  // flattened vocab export buffers (built lazily)
+  std::vector<std::string> cell_vocab, umi_vocab, gene_vocab, qname_vocab;
   struct Flat {
     std::string bytes;
     std::vector<int64_t> offsets;
     bool built = false;
   };
   Flat flat[4];
-  std::string error;
+
+  void clear() {
+    cols.clear();
+    cell_vocab.clear(); umi_vocab.clear();
+    gene_vocab.clear(); qname_vocab.clear();
+    for (Flat& f : flat) { f.bytes.clear(); f.offsets.clear(); f.built = false; }
+  }
 };
 
 // ----------------------------------------------------------------- BGZF
@@ -115,43 +158,203 @@ bool inflate_block(const uint8_t* src, uint32_t src_len, uint8_t* dst,
   return ret == Z_STREAM_END && strm.avail_out == 0;
 }
 
-// scan BGZF headers; returns false on malformed container
-bool index_blocks(const std::vector<uint8_t>& data,
-                  std::vector<BlockInfo>& blocks, size_t& total_out) {
-  size_t offset = 0;
-  total_out = 0;
-  while (offset + 18 <= data.size()) {
-    const uint8_t* p = data.data() + offset;
-    if (p[0] != 0x1f || p[1] != 0x8b) return false;
-    uint16_t xlen = p[10] | (p[11] << 8);
-    // find BC subfield for BSIZE
-    size_t extra = offset + 12;
-    uint32_t bsize = 0;
-    size_t extra_end = extra + xlen;
-    if (extra_end > data.size()) return false;
-    while (extra + 4 <= extra_end) {
-      uint8_t si1 = data[extra], si2 = data[extra + 1];
-      uint16_t slen = data[extra + 2] | (data[extra + 3] << 8);
-      if (si1 == 'B' && si2 == 'C' && slen == 2 && extra + 6 <= extra_end) {
-        bsize = (data[extra + 4] | (data[extra + 5] << 8)) + 1;
-      }
-      extra += 4 + slen;
-    }
-    // bsize must cover header (12+xlen) and footer (8) or payload_len
-    // would wrap below; reject instead of under/overflowing
-    if (bsize < 12u + xlen + 8u || offset + bsize > data.size()) return false;
-    size_t payload = offset + 12 + xlen;
-    uint32_t payload_len = bsize - 12 - xlen - 8;
-    uint32_t isize = data[offset + bsize - 4] | (data[offset + bsize - 3] << 8) |
-                     (data[offset + bsize - 2] << 16) |
-                     (data[offset + bsize - 1] << 24);
-    if (isize > 0) {
-      blocks.push_back({payload, payload_len, isize, total_out});
-      total_out += isize;
-    }
-    offset += bsize;
+struct BlockInfo {
+  size_t src_offset;    // offset of the deflate payload within comp buffer
+  uint32_t payload_len; // compressed payload length
+  uint32_t isize;       // uncompressed size
+  size_t out_offset;    // prefix-summed offset within the new inflated bytes
+};
+
+// ----------------------------------------------------------------- stream
+
+struct Stream {
+  FILE* f = nullptr;
+  bool plain = false;       // uncompressed "BAM\1" input (no BGZF container)
+  bool format_known = false;
+  int n_threads = 1;
+  bool want_qname = true;
+  bool file_eof = false;
+  std::string error;
+
+  std::vector<uint8_t> comp;  // compressed bytes not yet inflated
+  size_t comp_pos = 0;
+  std::vector<uint8_t> bam;   // inflated bytes not yet parsed
+  size_t bam_pos = 0;
+  bool header_done = false;
+
+  Batch batch;
+
+  ~Stream() { if (f) std::fclose(f); }
+};
+
+// Pull one compressed chunk from the file and inflate every complete BGZF
+// block in the buffer. Consumed prefixes of both buffers are compacted first,
+// so relative offsets from {comp,bam}_pos stay valid across calls. Returns
+// false when no new inflated bytes could be produced (EOF or error).
+bool refill(Stream& s) {
+  if (s.error.size()) return false;
+  // compact
+  if (s.bam_pos) {
+    s.bam.erase(s.bam.begin(), s.bam.begin() + s.bam_pos);
+    s.bam_pos = 0;
   }
-  return offset == data.size();
+  if (s.comp_pos) {
+    s.comp.erase(s.comp.begin(), s.comp.begin() + s.comp_pos);
+    s.comp_pos = 0;
+  }
+
+  size_t produced = 0;
+  while (produced == 0) {
+    if (!s.file_eof) {
+      size_t old = s.comp.size();
+      s.comp.resize(old + kCompChunk);
+      size_t got = std::fread(s.comp.data() + old, 1, kCompChunk, s.f);
+      s.comp.resize(old + got);
+      if (got < kCompChunk) s.file_eof = true;
+    }
+    if (s.comp.empty()) return false;
+
+    if (!s.format_known) {
+      // fread returns short only at EOF, so comp holds >= 4 bytes here
+      // unless the whole file is shorter than that (which cannot be a BAM)
+      if (s.comp.size() >= 4 && std::memcmp(s.comp.data(), "BAM\1", 4) == 0)
+        s.plain = true;
+      else if (s.comp.size() >= 2 && s.comp[0] == 0x1f && s.comp[1] == 0x8b)
+        s.plain = false;
+      else {
+        s.error = "not a BAM stream (bad magic)";
+        return false;
+      }
+      s.format_known = true;
+    }
+
+    if (s.plain) {
+      s.bam.insert(s.bam.end(), s.comp.begin(), s.comp.end());
+      s.comp.clear();
+      return !s.bam.empty();
+    }
+
+    // index complete BGZF blocks in comp
+    std::vector<BlockInfo> blocks;
+    size_t offset = 0;
+    size_t total_out = 0;
+    while (offset + 18 <= s.comp.size()) {
+      const uint8_t* p = s.comp.data() + offset;
+      if (p[0] != 0x1f || p[1] != 0x8b) {
+        s.error = "malformed BGZF container";
+        return false;
+      }
+      uint16_t xlen = p[10] | (p[11] << 8);
+      size_t extra = offset + 12;
+      size_t extra_end = extra + xlen;
+      if (extra_end > s.comp.size()) break;  // header spans chunk boundary
+      uint32_t bsize = 0;
+      while (extra + 4 <= extra_end) {
+        uint8_t si1 = s.comp[extra], si2 = s.comp[extra + 1];
+        uint16_t slen = s.comp[extra + 2] | (s.comp[extra + 3] << 8);
+        if (si1 == 'B' && si2 == 'C' && slen == 2 && extra + 6 <= extra_end)
+          bsize = (s.comp[extra + 4] | (s.comp[extra + 5] << 8)) + 1;
+        extra += 4 + slen;
+      }
+      if (bsize < 12u + xlen + 8u) {
+        s.error = "malformed BGZF container";
+        return false;
+      }
+      if (offset + bsize > s.comp.size()) break;  // incomplete block
+      uint32_t payload_len = bsize - 12 - xlen - 8;
+      uint32_t isize = s.comp[offset + bsize - 4] |
+                       (s.comp[offset + bsize - 3] << 8) |
+                       (s.comp[offset + bsize - 2] << 16) |
+                       (s.comp[offset + bsize - 1] << 24);
+      if (isize > 0) {
+        blocks.push_back({offset + 12 + xlen, payload_len, isize, total_out});
+        total_out += isize;
+      }
+      offset += bsize;
+    }
+    if (offset == 0 && s.file_eof) {
+      // leftover bytes that can never form a block
+      if (!s.comp.empty()) s.error = "truncated BGZF block at EOF";
+      return false;
+    }
+
+    if (total_out) {
+      size_t base = s.bam.size();
+      s.bam.resize(base + total_out);
+      std::atomic<size_t> next{0};
+      std::atomic<bool> ok{true};
+      auto worker = [&]() {
+        for (;;) {
+          size_t i = next.fetch_add(1);
+          if (i >= blocks.size()) return;
+          const BlockInfo& b = blocks[i];
+          if (!inflate_block(s.comp.data() + b.src_offset, b.payload_len,
+                             s.bam.data() + base + b.out_offset, b.isize))
+            ok.store(false);
+        }
+      };
+      int workers = std::min<int>(std::max(s.n_threads, 1),
+                                  static_cast<int>(blocks.size()));
+      std::vector<std::thread> pool;
+      for (int t = 0; t < workers; ++t) pool.emplace_back(worker);
+      for (auto& t : pool) t.join();
+      if (!ok.load()) {
+        s.error = "BGZF block failed to inflate";
+        return false;
+      }
+      produced += total_out;
+    }
+    s.comp.erase(s.comp.begin(), s.comp.begin() + offset);
+    if (s.file_eof && produced == 0) return false;
+  }
+  return true;
+}
+
+// ensure at least `need` unparsed inflated bytes are available
+bool ensure(Stream& s, size_t need) {
+  while (s.bam.size() - s.bam_pos < need)
+    if (!refill(s)) return false;
+  return true;
+}
+
+inline uint32_t read_u32(const uint8_t* q) {
+  return q[0] | (q[1] << 8) | (q[2] << 16) | (uint32_t(q[3]) << 24);
+}
+
+// skip the BAM header (text + reference list); ref ids stay numeric in the
+// frame schema so reference names are not retained
+bool read_header(Stream& s) {
+  if (!ensure(s, 12)) {
+    if (s.error.empty()) s.error = "truncated header";
+    return false;
+  }
+  if (std::memcmp(s.bam.data() + s.bam_pos, "BAM\1", 4) != 0) {
+    s.error = "not a BAM stream (bad magic)";
+    return false;
+  }
+  uint64_t l_text = read_u32(s.bam.data() + s.bam_pos + 4);
+  if (!ensure(s, 12 + l_text)) {
+    if (s.error.empty()) s.error = "truncated header";
+    return false;
+  }
+  uint64_t cursor = 8 + l_text;  // relative to bam_pos
+  uint32_t n_ref = read_u32(s.bam.data() + s.bam_pos + cursor);
+  cursor += 4;
+  for (uint32_t i = 0; i < n_ref; ++i) {
+    if (!ensure(s, cursor + 4)) {
+      if (s.error.empty()) s.error = "truncated reference list";
+      return false;
+    }
+    uint64_t l_name = read_u32(s.bam.data() + s.bam_pos + cursor);
+    if (!ensure(s, cursor + 8 + l_name)) {
+      if (s.error.empty()) s.error = "truncated reference list";
+      return false;
+    }
+    cursor += 8 + l_name;  // l_name field + name + l_ref
+  }
+  s.bam_pos += cursor;
+  s.header_done = true;
+  return true;
 }
 
 // --------------------------------------------------------------- BAM parse
@@ -253,150 +456,272 @@ int8_t xf_code(const TagView& tags) {
   return 5;
 }
 
-bool parse_bam(const std::vector<uint8_t>& bam, Handle& handle) {
-  const uint8_t* p = bam.data();
-  const uint8_t* end = p + bam.size();
-  auto read_u32 = [&](const uint8_t* q) -> uint32_t {
-    return q[0] | (q[1] << 8) | (q[2] << 16) | (uint32_t(q[3]) << 24);
-  };
-  auto read_i32 = [&](const uint8_t* q) -> int32_t {
-    return static_cast<int32_t>(read_u32(q));
-  };
+struct ThreadState {
+  Columns cols;
+  LocalVocab cell, umi, gene, qname;
+  std::string error;
+};
 
-  if (end - p < 12 || std::memcmp(p, "BAM\1", 4) != 0) {
-    handle.error = "not a BAM stream (bad magic)";
+// parse one alignment record (block_size bytes at rec) into `t`
+bool parse_record(const uint8_t* rec, uint32_t block_size, bool want_qname,
+                  ThreadState& t) {
+  int32_t ref_id = static_cast<int32_t>(read_u32(rec));
+  int32_t pos = static_cast<int32_t>(read_u32(rec + 4));
+  uint8_t l_read_name = rec[8];
+  uint16_t n_cigar = rec[12] | (rec[13] << 8);
+  uint16_t flag = rec[14] | (rec[15] << 8);
+  uint32_t l_seq = read_u32(rec + 16);
+
+  // validate field extents in 64-bit before forming any pointer: a corrupt
+  // l_seq near UINT32_MAX would otherwise wrap (l_seq+1)/2 and overflow the
+  // qual pointer arithmetic (UB) before a downstream check could reject it
+  uint64_t need = 32ull + l_read_name + 4ull * n_cigar +
+                  (static_cast<uint64_t>(l_seq) + 1) / 2 + l_seq;
+  if (need > block_size) {
+    t.error = "record fields overflow block";
     return false;
   }
-  uint32_t l_text = read_u32(p + 4);
-  p += 8 + l_text;
-  if (p + 4 > end) { handle.error = "truncated header"; return false; }
-  uint32_t n_ref = read_u32(p);
-  p += 4;
-  // reference list: the frame schema carries numeric ref ids only
-  // (ReadFrame has no reference-name column), so names are skipped
-  for (uint32_t i = 0; i < n_ref; ++i) {
-    if (p + 4 > end) { handle.error = "truncated reference list"; return false; }
-    uint32_t l_name = read_u32(p);
-    p += 4;
-    if (p + l_name + 4 > end) { handle.error = "truncated reference list"; return false; }
-    p += l_name + 4;  // name + l_ref
+
+  const char* read_name = reinterpret_cast<const char*>(rec + 32);
+  size_t name_len = l_read_name ? l_read_name - 1 : 0;
+  const uint8_t* cigar = rec + 32 + l_read_name;
+  const uint8_t* seq = cigar + 4 * n_cigar;
+  const uint8_t* qual = seq + (l_seq + 1) / 2;
+  const uint8_t* tags_start = qual + l_seq;
+
+  bool unmapped = flag & 0x4;
+  bool reverse = flag & 0x10;
+  bool duplicate = flag & 0x400;
+
+  // cigar walk: spliced (N op), soft-clip bounds (H ignored, leading and
+  // trailing S excluded) — matches BamRecord._clip_bounds
+  bool spliced = false;
+  uint32_t clip_start = 0, clip_end = l_seq;
+  int first_non_h = -1, last_non_h = -1;
+  for (uint16_t i = 0; i < n_cigar; ++i) {
+    uint32_t entry = read_u32(cigar + 4 * i);
+    uint32_t op = entry & 0xf;
+    if (op == 3) spliced = true;          // N
+    if (op != 5) {                        // not H
+      if (first_non_h < 0) first_non_h = i;
+      last_non_h = i;
+    }
+  }
+  if (first_non_h >= 0) {
+    uint32_t first_entry = read_u32(cigar + 4 * first_non_h);
+    uint32_t last_entry = read_u32(cigar + 4 * last_non_h);
+    if ((first_entry & 0xf) == 4) clip_start = first_entry >> 4;  // S
+    if (last_non_h != first_non_h && (last_entry & 0xf) == 4)
+      clip_end = l_seq - (last_entry >> 4);
   }
 
-  Columns& c = handle.cols;
-  while (p + 4 <= end) {
-    uint32_t block_size = read_u32(p);
-    p += 4;
-    if (p + block_size > end || block_size < 32) {
-      handle.error = "truncated record";
-      return false;
+  TagView tags;
+  if (!parse_tags(tags_start, rec + block_size, tags)) {
+    t.error = "malformed aux tags";
+    return false;
+  }
+
+  Columns& c = t.cols;
+  c.qname.push_back(want_qname ? t.qname.code(read_name, name_len) : 0);
+  c.cell.push_back(t.cell.code(tags.cb, tags.has_cb ? tags.cb_len : 0));
+  c.umi.push_back(t.umi.code(tags.ub, tags.has_ub ? tags.ub_len : 0));
+  c.gene.push_back(t.gene.code(tags.ge, tags.ge ? tags.ge_len : 0));
+  c.ref.push_back(ref_id);
+  c.pos.push_back(pos);
+  c.strand.push_back(reverse ? 1 : 0);
+  c.unmapped.push_back(unmapped ? 1 : 0);
+  c.duplicate.push_back(duplicate ? 1 : 0);
+  c.spliced.push_back(spliced ? 1 : 0);
+  c.xf.push_back(xf_code(tags));
+  c.nh.push_back(tags.nh);
+
+  int8_t perfect_umi = -1;
+  if (tags.ur && tags.has_ub)
+    perfect_umi = (tags.ur_len == tags.ub_len &&
+                   std::memcmp(tags.ur, tags.ub, tags.ub_len) == 0) ? 1 : 0;
+  c.perfect_umi.push_back(perfect_umi);
+  int8_t perfect_cb = -1;
+  if (tags.has_cb && tags.cr)
+    perfect_cb = (tags.cr_len == tags.cb_len &&
+                  std::memcmp(tags.cr, tags.cb, tags.cb_len) == 0) ? 1 : 0;
+  c.perfect_cb.push_back(perfect_cb);
+
+  c.umi_frac30.push_back(tags.uy ? phred_frac_above30(tags.uy, tags.uy_len) : NAN);
+  c.cb_frac30.push_back(tags.cy ? phred_frac_above30(tags.cy, tags.cy_len) : NAN);
+
+  // aligned-portion qualities; an all-0xFF fill means "absent" in BAM
+  // (BamRecord.from_bytes sets quality=None only when every byte is 0xFF)
+  bool has_qual = false;
+  for (uint32_t i = 0; i < l_seq; ++i) {
+    if (qual[i] != 0xff) { has_qual = true; break; }
+  }
+  if (has_qual && clip_end > clip_start) {
+    uint32_t n = clip_end - clip_start;
+    uint32_t above = 0;
+    uint64_t total = 0;
+    for (uint32_t i = clip_start; i < clip_end; ++i) {
+      uint8_t q = qual[i];
+      if (q > 30) ++above;
+      total += q;
     }
-    const uint8_t* rec = p;
-    p += block_size;
-
-    int32_t ref_id = read_i32(rec);
-    int32_t pos = read_i32(rec + 4);
-    uint8_t l_read_name = rec[8];
-    uint16_t n_cigar = rec[12] | (rec[13] << 8);
-    uint16_t flag = rec[14] | (rec[15] << 8);
-    uint32_t l_seq = read_u32(rec + 16);
-
-    const char* read_name = reinterpret_cast<const char*>(rec + 32);
-    size_t name_len = l_read_name ? l_read_name - 1 : 0;
-    const uint8_t* cigar = rec + 32 + l_read_name;
-    const uint8_t* seq = cigar + 4 * n_cigar;
-    const uint8_t* qual = seq + (l_seq + 1) / 2;
-    const uint8_t* tags_start = qual + l_seq;
-    if (tags_start > rec + block_size) {
-      handle.error = "record fields overflow block";
-      return false;
-    }
-
-    bool unmapped = flag & 0x4;
-    bool reverse = flag & 0x10;
-    bool duplicate = flag & 0x400;
-
-    // cigar walk: spliced (N op), soft-clip bounds (H ignored, leading and
-    // trailing S excluded) — matches BamRecord._clip_bounds
-    bool spliced = false;
-    uint32_t clip_start = 0, clip_end = l_seq;
-    int first_non_h = -1, last_non_h = -1;
-    for (uint16_t i = 0; i < n_cigar; ++i) {
-      uint32_t entry = read_u32(cigar + 4 * i);
-      uint32_t op = entry & 0xf;
-      if (op == 3) spliced = true;          // N
-      if (op != 5) {                        // not H
-        if (first_non_h < 0) first_non_h = i;
-        last_non_h = i;
-      }
-    }
-    if (first_non_h >= 0) {
-      uint32_t first_entry = read_u32(cigar + 4 * first_non_h);
-      uint32_t last_entry = read_u32(cigar + 4 * last_non_h);
-      if ((first_entry & 0xf) == 4) clip_start = first_entry >> 4;  // S
-      if (last_non_h != first_non_h && (last_entry & 0xf) == 4)
-        clip_end = l_seq - (last_entry >> 4);
-    }
-
-    TagView tags;
-    if (!parse_tags(tags_start, rec + block_size, tags)) {
-      handle.error = "malformed aux tags";
-      return false;
-    }
-
-    c.qname.push_back(handle.qname_vocab.code(read_name, name_len));
-    c.cell.push_back(handle.cell_vocab.code(tags.cb, tags.has_cb ? tags.cb_len : 0));
-    c.umi.push_back(handle.umi_vocab.code(tags.ub, tags.has_ub ? tags.ub_len : 0));
-    c.gene.push_back(handle.gene_vocab.code(tags.ge, tags.ge ? tags.ge_len : 0));
-    c.ref.push_back(ref_id);
-    c.pos.push_back(pos);
-    c.strand.push_back(reverse ? 1 : 0);
-    c.unmapped.push_back(unmapped ? 1 : 0);
-    c.duplicate.push_back(duplicate ? 1 : 0);
-    c.spliced.push_back(spliced ? 1 : 0);
-    c.xf.push_back(xf_code(tags));
-    c.nh.push_back(tags.nh);
-
-    int8_t perfect_umi = -1;
-    if (tags.ur && tags.has_ub)
-      perfect_umi = (tags.ur_len == tags.ub_len &&
-                     std::memcmp(tags.ur, tags.ub, tags.ub_len) == 0) ? 1 : 0;
-    c.perfect_umi.push_back(perfect_umi);
-    int8_t perfect_cb = -1;
-    if (tags.has_cb && tags.cr)
-      perfect_cb = (tags.cr_len == tags.cb_len &&
-                    std::memcmp(tags.cr, tags.cb, tags.cb_len) == 0) ? 1 : 0;
-    c.perfect_cb.push_back(perfect_cb);
-
-    c.umi_frac30.push_back(tags.uy ? phred_frac_above30(tags.uy, tags.uy_len) : NAN);
-    c.cb_frac30.push_back(tags.cy ? phred_frac_above30(tags.cy, tags.cy_len) : NAN);
-
-    // aligned-portion qualities; an all-0xFF fill means "absent" in BAM
-    // (BamRecord.from_bytes sets quality=None only when every byte is 0xFF)
-    bool has_qual = false;
-    for (uint32_t i = 0; i < l_seq; ++i) {
-      if (qual[i] != 0xff) { has_qual = true; break; }
-    }
-    if (has_qual && clip_end > clip_start) {
-      uint32_t n = clip_end - clip_start;
-      uint32_t above = 0;
-      uint64_t total = 0;
-      for (uint32_t i = clip_start; i < clip_end; ++i) {
-        uint8_t q = qual[i];
-        if (q > 30) ++above;
-        total += q;
-      }
-      c.genomic_frac30.push_back(static_cast<float>(above) / n);
-      c.genomic_mean.push_back(static_cast<float>(total) / n);
-    } else {
-      c.genomic_frac30.push_back(NAN);
-      c.genomic_mean.push_back(NAN);
-    }
+    c.genomic_frac30.push_back(static_cast<float>(above) / n);
+    c.genomic_mean.push_back(static_cast<float>(total) / n);
+  } else {
+    c.genomic_frac30.push_back(NAN);
+    c.genomic_mean.push_back(NAN);
   }
   return true;
 }
 
-void remap_codes(std::vector<int32_t>& codes, const std::vector<int32_t>& remap) {
-  for (auto& code : codes) code = remap[code];
+// decode up to max_records alignments into s.batch; returns count, 0 at EOF,
+// -1 on error
+long stream_next(Stream& s, long max_records) {
+  if (s.error.size()) return -1;
+  s.batch.clear();
+  if (!s.header_done) {
+    if (!ensure(s, 1)) {
+      // completely empty input is an error; empty record section is EOF
+      if (s.error.empty() && !s.format_known) s.error = "empty input";
+      return s.error.empty() ? 0 : -1;
+    }
+    if (!read_header(s)) return -1;
+  }
+
+  // collect record spans (relative to bam_pos; refill preserves them)
+  struct Span { size_t offset; uint32_t size; };
+  std::vector<Span> spans;
+  size_t cursor = 0;  // relative to bam_pos
+  while (max_records < 0 ||
+         spans.size() < static_cast<size_t>(max_records)) {
+    if (!ensure(s, cursor + 4)) {
+      if (!s.error.empty()) return -1;
+      if (s.bam.size() - s.bam_pos != cursor) {
+        s.error = "truncated record";
+        return -1;
+      }
+      break;  // clean EOF at a record boundary
+    }
+    uint32_t block_size = read_u32(s.bam.data() + s.bam_pos + cursor);
+    if (block_size < 32) {
+      s.error = "truncated record";
+      return -1;
+    }
+    if (!ensure(s, cursor + 4 + block_size)) {
+      s.error = s.error.empty() ? "truncated record" : s.error;
+      return -1;
+    }
+    spans.push_back({cursor + 4, block_size});
+    cursor += 4 + block_size;
+  }
+  if (spans.empty()) return 0;
+
+  // parallel parse: contiguous span ranges -> thread-local columns
+  int workers = std::min<int>(std::max(s.n_threads, 1),
+                              static_cast<int>(spans.size()));
+  std::vector<ThreadState> states(workers);
+  const uint8_t* base = s.bam.data() + s.bam_pos;
+  size_t per = (spans.size() + workers - 1) / workers;
+  auto work = [&](int t) {
+    // both bounds clamp: with per = ceil(n/w), trailing workers can start
+    // past the end (e.g. 17 spans / 16 workers), which must yield an empty
+    // range, not an underflowed one
+    size_t lo = std::min(spans.size(), t * per);
+    size_t hi = std::min(spans.size(), lo + per);
+    ThreadState& state = states[t];
+    state.cols.cell.reserve(hi - lo);
+    for (size_t i = lo; i < hi; ++i) {
+      if (!parse_record(base + spans[i].offset, spans[i].size, s.want_qname,
+                        state))
+        return;
+    }
+  };
+  if (workers == 1) {
+    work(0);
+  } else {
+    std::vector<std::thread> pool;
+    for (int t = 0; t < workers; ++t) pool.emplace_back(work, t);
+    for (auto& t : pool) t.join();
+  }
+  for (ThreadState& state : states) {
+    if (!state.error.empty()) {
+      s.error = state.error;
+      return -1;
+    }
+  }
+
+  // merge vocabularies, remap codes (the four columns merge concurrently),
+  // then concatenate columns in thread order
+  auto merge_one = [&](LocalVocab ThreadState::*member_vocab,
+                       std::vector<int32_t> Columns::*member_col,
+                       std::vector<std::string>& out_sorted) {
+    std::vector<LocalVocab> locals;
+    std::vector<std::vector<int32_t>*> cols;
+    locals.reserve(workers);
+    for (ThreadState& state : states) {
+      locals.push_back(std::move(state.*member_vocab));
+      cols.push_back(&(state.cols.*member_col));
+    }
+    merge_vocabs(locals, cols, out_sorted);
+  };
+  {
+    std::vector<std::thread> mergers;
+    mergers.emplace_back(merge_one, &ThreadState::cell, &Columns::cell,
+                         std::ref(s.batch.cell_vocab));
+    mergers.emplace_back(merge_one, &ThreadState::umi, &Columns::umi,
+                         std::ref(s.batch.umi_vocab));
+    mergers.emplace_back(merge_one, &ThreadState::gene, &Columns::gene,
+                         std::ref(s.batch.gene_vocab));
+    if (s.want_qname)
+      mergers.emplace_back(merge_one, &ThreadState::qname, &Columns::qname,
+                           std::ref(s.batch.qname_vocab));
+    else
+      s.batch.qname_vocab.assign(1, std::string());
+    for (auto& t : mergers) t.join();
+  }
+  for (ThreadState& state : states) s.batch.cols.append(std::move(state.cols));
+
+  s.bam_pos += cursor;
+  return static_cast<long>(s.batch.cols.size());
+}
+
+Batch::Flat* flat_vocab(Stream* s, const char* name) {
+  std::string_view n(name);
+  std::vector<std::string>* vocab = nullptr;
+  int slot = -1;
+  if (n == "cell") { vocab = &s->batch.cell_vocab; slot = 0; }
+  else if (n == "umi") { vocab = &s->batch.umi_vocab; slot = 1; }
+  else if (n == "gene") { vocab = &s->batch.gene_vocab; slot = 2; }
+  else if (n == "qname") { vocab = &s->batch.qname_vocab; slot = 3; }
+  else return nullptr;
+  Batch::Flat& flat = s->batch.flat[slot];
+  if (!flat.built) {
+    flat.offsets.push_back(0);
+    for (const std::string& str : *vocab) {
+      flat.bytes += str;
+      flat.offsets.push_back(static_cast<int64_t>(flat.bytes.size()));
+    }
+    flat.built = true;
+  }
+  return &flat;
+}
+
+Stream* open_stream(const char* path, int n_threads, bool want_qname,
+                    std::string& error) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    error = std::string("cannot open ") + path;
+    return nullptr;
+  }
+  auto* s = new Stream();
+  s->f = f;
+  s->n_threads = n_threads < 1 ? 1 : n_threads;
+  s->want_qname = want_qname;
+  return s;
+}
+
+void set_errbuf(char* errbuf, int errbuf_len, const std::string& message) {
+  if (errbuf && errbuf_len > 0)
+    std::snprintf(errbuf, errbuf_len, "%s", message.c_str());
 }
 
 }  // namespace
@@ -405,76 +730,34 @@ void remap_codes(std::vector<int32_t>& codes, const std::vector<int32_t>& remap)
 
 extern "C" {
 
-void* scx_decode_bam(const char* path, int n_threads, char* errbuf,
-                     int errbuf_len) {
-  auto fail = [&](const std::string& message) -> void* {
-    if (errbuf && errbuf_len > 0) {
-      std::snprintf(errbuf, errbuf_len, "%s", message.c_str());
-    }
-    return nullptr;
-  };
+// ---- streaming API ----
 
-  FILE* f = std::fopen(path, "rb");
-  if (!f) return fail(std::string("cannot open ") + path);
-  std::fseek(f, 0, SEEK_END);
-  long file_size = std::ftell(f);
-  std::fseek(f, 0, SEEK_SET);
-  std::vector<uint8_t> data(static_cast<size_t>(file_size));
-  if (file_size > 0 &&
-      std::fread(data.data(), 1, data.size(), f) != data.size()) {
-    std::fclose(f);
-    return fail("short read");
-  }
-  std::fclose(f);
-
-  std::vector<uint8_t> bam;
-  if (data.size() >= 4 && std::memcmp(data.data(), "BAM\1", 4) == 0) {
-    bam = std::move(data);  // uncompressed BAM stream
-  } else {
-    std::vector<BlockInfo> blocks;
-    size_t total = 0;
-    if (!index_blocks(data, blocks, total))
-      return fail("malformed BGZF container");
-    bam.resize(total);
-    if (n_threads < 1) n_threads = 1;
-    std::atomic<size_t> next{0};
-    std::atomic<bool> ok{true};
-    auto worker = [&]() {
-      for (;;) {
-        size_t i = next.fetch_add(1);
-        if (i >= blocks.size()) return;
-        const BlockInfo& b = blocks[i];
-        if (!inflate_block(data.data() + b.file_offset, b.payload_len,
-                           bam.data() + b.out_offset, b.isize))
-          ok.store(false);
-      }
-    };
-    std::vector<std::thread> pool;
-    int workers = std::min<int>(n_threads, static_cast<int>(blocks.size()));
-    for (int t = 0; t < workers; ++t) pool.emplace_back(worker);
-    for (auto& t : pool) t.join();
-    if (!ok.load()) return fail("BGZF block failed to inflate");
-  }
-
-  auto handle = new Handle();
-  if (!parse_bam(bam, *handle)) {
-    std::string message = handle->error;
-    delete handle;
-    return fail(message);
-  }
-  remap_codes(handle->cols.cell, handle->cell_vocab.finalize());
-  remap_codes(handle->cols.umi, handle->umi_vocab.finalize());
-  remap_codes(handle->cols.gene, handle->gene_vocab.finalize());
-  remap_codes(handle->cols.qname, handle->qname_vocab.finalize());
-  return handle;
+void* scx_stream_open(const char* path, int n_threads, int want_qname,
+                      char* errbuf, int errbuf_len) {
+  std::string error;
+  Stream* s = open_stream(path, n_threads, want_qname != 0, error);
+  if (!s) set_errbuf(errbuf, errbuf_len, error);
+  return s;
 }
 
+long scx_stream_next(void* h, long max_records) {
+  return stream_next(*static_cast<Stream*>(h), max_records);
+}
+
+const char* scx_stream_error(void* h) {
+  return static_cast<Stream*>(h)->error.c_str();
+}
+
+void scx_stream_close(void* h) { delete static_cast<Stream*>(h); }
+
+// ---- batch column accessors (current batch of a stream / whole-file handle)
+
 long scx_n_records(void* h) {
-  return static_cast<long>(static_cast<Handle*>(h)->cols.cell.size());
+  return static_cast<long>(static_cast<Stream*>(h)->batch.cols.size());
 }
 
 const int32_t* scx_col_i32(void* h, const char* name) {
-  Columns& c = static_cast<Handle*>(h)->cols;
+  Columns& c = static_cast<Stream*>(h)->batch.cols;
   std::string_view n(name);
   if (n == "cell") return c.cell.data();
   if (n == "umi") return c.umi.data();
@@ -487,7 +770,7 @@ const int32_t* scx_col_i32(void* h, const char* name) {
 }
 
 const int8_t* scx_col_i8(void* h, const char* name) {
-  Columns& c = static_cast<Handle*>(h)->cols;
+  Columns& c = static_cast<Stream*>(h)->batch.cols;
   std::string_view n(name);
   if (n == "strand") return c.strand.data();
   if (n == "xf") return c.xf.data();
@@ -500,7 +783,7 @@ const int8_t* scx_col_i8(void* h, const char* name) {
 }
 
 const float* scx_col_f32(void* h, const char* name) {
-  Columns& c = static_cast<Handle*>(h)->cols;
+  Columns& c = static_cast<Stream*>(h)->batch.cols;
   std::string_view n(name);
   if (n == "umi_frac30") return c.umi_frac30.data();
   if (n == "cb_frac30") return c.cb_frac30.data();
@@ -509,44 +792,42 @@ const float* scx_col_f32(void* h, const char* name) {
   return nullptr;
 }
 
-static Handle::Flat* flat_vocab(Handle* handle, const char* name) {
-  std::string_view n(name);
-  Vocab* vocab = nullptr;
-  int slot = -1;
-  if (n == "cell") { vocab = &handle->cell_vocab; slot = 0; }
-  else if (n == "umi") { vocab = &handle->umi_vocab; slot = 1; }
-  else if (n == "gene") { vocab = &handle->gene_vocab; slot = 2; }
-  else if (n == "qname") { vocab = &handle->qname_vocab; slot = 3; }
-  else return nullptr;
-  Handle::Flat& flat = handle->flat[slot];
-  if (!flat.built) {
-    flat.offsets.push_back(0);
-    for (const std::string& s : vocab->strings) {
-      flat.bytes += s;
-      flat.offsets.push_back(static_cast<int64_t>(flat.bytes.size()));
-    }
-    flat.built = true;
-  }
-  return &flat;
-}
-
 long scx_vocab_size(void* h, const char* name) {
-  Handle::Flat* flat = flat_vocab(static_cast<Handle*>(h), name);
+  Batch::Flat* flat = flat_vocab(static_cast<Stream*>(h), name);
   return flat ? static_cast<long>(flat->offsets.size()) - 1 : -1;
 }
 
 const char* scx_vocab_bytes(void* h, const char* name, long* total_len) {
-  Handle::Flat* flat = flat_vocab(static_cast<Handle*>(h), name);
+  Batch::Flat* flat = flat_vocab(static_cast<Stream*>(h), name);
   if (!flat) return nullptr;
   if (total_len) *total_len = static_cast<long>(flat->bytes.size());
   return flat->bytes.data();
 }
 
 const int64_t* scx_vocab_offsets(void* h, const char* name) {
-  Handle::Flat* flat = flat_vocab(static_cast<Handle*>(h), name);
+  Batch::Flat* flat = flat_vocab(static_cast<Stream*>(h), name);
   return flat ? flat->offsets.data() : nullptr;
 }
 
-void scx_free(void* h) { delete static_cast<Handle*>(h); }
+// ---- legacy whole-file API: a stream whose single batch is the file ----
+
+void* scx_decode_bam(const char* path, int n_threads, char* errbuf,
+                     int errbuf_len) {
+  std::string error;
+  Stream* s = open_stream(path, n_threads, /*want_qname=*/true, error);
+  if (!s) {
+    set_errbuf(errbuf, errbuf_len, error);
+    return nullptr;
+  }
+  long n = stream_next(*s, -1);
+  if (n < 0) {
+    set_errbuf(errbuf, errbuf_len, s->error);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void scx_free(void* h) { delete static_cast<Stream*>(h); }
 
 }  // extern "C"

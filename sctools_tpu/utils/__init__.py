@@ -1,5 +1,6 @@
-"""Utility helpers: synthetic workload generation, timing."""
+"""Utility helpers: synthetic workload generation, prefetching, timing."""
 
+from .prefetch import prefetch_iterator
 from .synth import make_synthetic_columns
 
-__all__ = ["make_synthetic_columns"]
+__all__ = ["make_synthetic_columns", "prefetch_iterator"]
