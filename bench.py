@@ -1,44 +1,123 @@
-"""Benchmark: cells/sec of the device cell-metrics engine vs the CPU streaming path.
+"""Benchmark: END-TO-END cells/sec of CalculateCellMetrics vs the CPU path.
 
-The north-star workload (BASELINE.md): CalculateCellMetrics. This bench times
-the compiled device pass (sort + segment reductions over packed columns,
-sctools_tpu.metrics.device) on the default JAX device — the real TPU chip when
-run by the driver — and compares against the reference-semantics CPU streaming
-aggregation (sctools_tpu.metrics.aggregator, a faithful reimplementation of
-src/sctools/metrics/aggregator.py driven the way gatherer.py:116-159 drives
-it), measured on a proportional subsample and normalized to cells/sec.
+The north-star workload (BASELINE.md): CalculateCellMetrics on a 10x-style
+cell-sorted BAM. Unlike round 1 (which timed the compiled pass on pre-packed
+device arrays only), this measures the full pipeline a user runs: native
+streaming BAM decode -> prefetch -> device sort/segment metrics -> CSV rows,
+wall clock, on the default JAX device (the real TPU chip under the driver).
 
-Both sides time aggregation only (no file decode on either side) over the same
-synthetic read distribution (~32 reads/cell). Prints ONE JSON line.
+The baseline is the reference-semantics CPU streaming path: the same BAM
+driven through this repo's faithful reimplementation of the reference's
+per-record Python aggregation (sctools_tpu.metrics.aggregator as driven by
+src/sctools/metrics/gatherer.py:116-159), measured on a cell-proportional
+subsample and normalized to cells/sec. The reference itself cannot run here
+(no pysam in the image) — BASELINE.md documents this caveat.
+
+The input BAM is written by the native synthetic generator (cached across
+runs in /tmp, keyed by shape) — ~32 reads/cell, realistic 98bp reads,
+duplicates, XF mix.
+
+Prints ONE JSON line. Flags:
+  --profile   write a jax.profiler trace to /tmp/sctools_tpu_profile
+  --breakdown include decode-only and compute-only timings in the JSON
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
-import numpy as np
-
 # device workload size
-N_RECORDS = 1 << 21  # ~2.1M reads
-N_CELLS = 1 << 16  # 65k cells (~32 reads/cell)
+N_CELLS = 1 << 16  # 65k cells
+MOLECULES_PER_CELL = 8
+READS_PER_MOLECULE = 4  # 32 reads/cell -> ~2.1M reads
 N_GENES = 1 << 12
-# cpu baseline subsample (same 32 reads/cell), kept small: the streaming
-# python path is ~4 orders of magnitude slower per read
-CPU_CELLS = 640
-CPU_MOLECULES_PER_CELL = 8
-CPU_READS_PER_MOLECULE = 4  # 8 * 4 = 32 reads/cell, matching the device side
-REPEATS = 5
+BATCH_RECORDS = 1 << 20
+# cpu baseline subsample (same shape per cell), kept small: the streaming
+# python path is ~3-4 orders of magnitude slower per read
+CPU_CELLS = 512
 
 
-def bench_device() -> float:
+# bump when synth.cpp's record generation changes, or stale cached inputs
+# would silently keep benchmarking the old generator
+SYNTH_SEED = 42
+SYNTH_VERSION = 1
+
+
+def _bench_bam_path() -> str:
+    return (
+        f"/tmp/sctools_tpu_bench_v{SYNTH_VERSION}_s{SYNTH_SEED}_{N_CELLS}x"
+        f"{MOLECULES_PER_CELL}x{READS_PER_MOLECULE}.bam"
+    )
+
+
+def ensure_bench_bam() -> str:
+    from sctools_tpu import native
+
+    path = _bench_bam_path()
+    if not os.path.exists(path):
+        n = native.synth_bam_native(
+            path + ".tmp",
+            n_cells=N_CELLS,
+            molecules_per_cell=MOLECULES_PER_CELL,
+            reads_per_molecule=READS_PER_MOLECULE,
+            n_genes=N_GENES,
+            seed=SYNTH_SEED,
+        )
+        assert n == N_CELLS * MOLECULES_PER_CELL * READS_PER_MOLECULE
+        os.rename(path + ".tmp", path)
+    return path
+
+
+def bench_end_to_end(bam_path: str, profile: bool = False) -> dict:
+    """Wall-clock the full device pipeline; returns timing dict."""
     import jax
+
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    out = "/tmp/sctools_tpu_bench_out.csv.gz"
+
+    def run() -> float:
+        start = time.perf_counter()
+        GatherCellMetrics(
+            bam_path, out, backend="device", batch_records=BATCH_RECORDS
+        ).extract_metrics()
+        return time.perf_counter() - start
+
+    warm = run()  # includes jit compilation
+    if profile:
+        with jax.profiler.trace("/tmp/sctools_tpu_profile"):
+            timed = run()
+    else:
+        timed = run()
+    return {"end_to_end_s": timed, "warm_s": warm}
+
+
+def bench_decode_only(bam_path: str) -> float:
+    """Decode + pack only (no device work): the ingest ceiling."""
+    from sctools_tpu.io.packed import iter_frames_from_bam
+
+    start = time.perf_counter()
+    total = 0
+    for frame in iter_frames_from_bam(bam_path, batch_records=BATCH_RECORDS):
+        total += frame.n_records
+    elapsed = time.perf_counter() - start
+    assert total == N_CELLS * MOLECULES_PER_CELL * READS_PER_MOLECULE
+    return elapsed
+
+
+def bench_compute_only() -> float:
+    """The compiled metrics pass on pre-packed arrays (round-1's number)."""
+    import jax
+    import numpy as np
 
     from sctools_tpu.metrics.device import compute_entity_metrics
     from sctools_tpu.utils import make_synthetic_columns
 
     cols = make_synthetic_columns(
-        N_RECORDS, n_cells=N_CELLS, n_genes=N_GENES, seed=42
+        BATCH_RECORDS, n_cells=N_CELLS, n_genes=N_GENES, seed=42
     )
     num_segments = len(cols["valid"])
     device_cols = {k: jax.device_put(v) for k, v in cols.items()}
@@ -48,108 +127,82 @@ def bench_device() -> float:
             device_cols, num_segments=num_segments, kind="cell"
         )
 
-    out = run()
-    jax.block_until_ready(out)  # compile + warm
-    n_cells = int(out["n_entities"])
-
+    jax.block_until_ready(run())  # compile + warm
     times = []
-    for _ in range(REPEATS):
+    for _ in range(3):
         start = time.perf_counter()
         jax.block_until_ready(run())
         times.append(time.perf_counter() - start)
-    return n_cells / float(np.median(times))
+    return float(np.median(times))
 
 
-def bench_cpu_baseline() -> float:
-    """Reference-semantics streaming aggregation, cells/sec."""
-    import random
+def bench_cpu_baseline(bam_path: str) -> float:
+    """Reference-semantics streaming aggregation over the same BAM, cells/sec.
 
+    Decodes the first CPU_CELLS cells' records through the same IO layer and
+    drives the host aggregator exactly as the reference gatherer does
+    (nested CB -> UB -> GE groups, src/sctools/metrics/gatherer.py:116-159).
+    """
+    from sctools_tpu.io.sam import AlignmentReader
     from sctools_tpu.metrics.aggregator import CellMetrics
 
-    rng = random.Random(7)
-    bases = "ACGT"
-
-    class Rec:
-        """Minimal stand-in exposing the attributes parse_molecule reads."""
-
-        __slots__ = (
-            "tags", "reference_id", "pos", "is_reverse", "is_unmapped",
-            "is_duplicate", "query_alignment_qualities", "_cigar",
-        )
-
-        def __init__(self):
-            self.tags = {}
-            self.reference_id = rng.randrange(4)
-            self.pos = rng.randrange(100_000)
-            self.is_reverse = rng.random() < 0.5
-            self.is_unmapped = rng.random() < 0.04
-            self.is_duplicate = rng.random() < 0.15
-            self.query_alignment_qualities = [rng.randrange(10, 41) for _ in range(26)]
-            self._cigar = [(0, 26)] if rng.random() < 0.8 else [(0, 13), (3, 100), (0, 13)]
-
-        def get_tag(self, key):
-            if key not in self.tags:
-                raise KeyError(key)
-            return self.tags[key]
-
-        def has_tag(self, key):
-            return key in self.tags
-
-        def get_cigar_stats(self):
-            counts = [0] * 9
-            for op, length in self._cigar:
-                counts[op] += length if op != 3 else 1
-            return counts, None
-
-    def barcode(length):
-        return "".join(rng.choice(bases) for _ in range(length))
-
-    # pre-build sorted groups: cell -> umi -> gene, contiguous like a
-    # CB/UB/GE-sorted BAM
-    cells = []
-    for _ in range(CPU_CELLS):
-        cb = barcode(16)
-        molecules = []
-        for _ in range(CPU_MOLECULES_PER_CELL):
-            ub = barcode(10)
-            genes = {}
-            for _ in range(CPU_READS_PER_MOLECULE):
-                ge = f"G{rng.randrange(64)}"
-                rec = Rec()
-                rec.tags = {
-                    "CB": cb, "CR": cb, "CY": "I" * 16,
-                    "UB": ub, "UR": ub, "UY": "I" * 10,
-                    "GE": ge, "NH": rng.choice([1, 1, 1, 2]),
-                    "XF": rng.choice(["CODING", "INTRONIC", "UTR", "INTERGENIC"]),
-                }
-                genes.setdefault(ge, []).append(rec)
-            molecules.append((ub, genes))
-        cells.append((cb, molecules))
+    # stream records until CPU_CELLS distinct cells have been consumed
+    groups = []  # (cb, [(ub, {ge: [records]})])
+    current_cb = None
+    molecules = None
+    with AlignmentReader(bam_path) as reader:
+        for record in reader:
+            cb = record.tags.get("CB", (None, None))[1]
+            if cb != current_cb:
+                if len(groups) == CPU_CELLS:
+                    break
+                current_cb = cb
+                molecules = {}
+                groups.append((cb, molecules))
+            ub = record.tags.get("UB", (None, None))[1]
+            ge = record.tags.get("GE", (None, None))[1]
+            molecules.setdefault(ub, {}).setdefault(ge, []).append(record)
 
     start = time.perf_counter()
-    for cb, molecules in cells:
+    n_cells = 0
+    for cb, molecules in groups:
         agg = CellMetrics()
-        for ub, genes in molecules:
+        for ub, genes in molecules.items():
             for ge, records in genes.items():
                 agg.parse_molecule(tags=(cb, ub, ge), records=iter(records))
         agg.finalize(mitochondrial_genes=set())
+        n_cells += 1
     elapsed = time.perf_counter() - start
-    return CPU_CELLS / elapsed
+    return n_cells / elapsed
 
 
 def main():
-    cpu_cells_per_sec = bench_cpu_baseline()
-    device_cells_per_sec = bench_device()
-    print(
-        json.dumps(
-            {
-                "metric": "calculate_cell_metrics_throughput",
-                "value": round(device_cells_per_sec, 2),
-                "unit": "cells/sec",
-                "vs_baseline": round(device_cells_per_sec / cpu_cells_per_sec, 2),
-            }
-        )
-    )
+    profile = "--profile" in sys.argv
+    breakdown = "--breakdown" in sys.argv or profile
+
+    bam_path = ensure_bench_bam()
+    cpu_cells_per_sec = bench_cpu_baseline(bam_path)
+    timings = bench_end_to_end(bam_path, profile=profile)
+    cells_per_sec = N_CELLS / timings["end_to_end_s"]
+
+    result = {
+        "metric": "calculate_cell_metrics_end_to_end",
+        "value": round(cells_per_sec, 2),
+        "unit": "cells/sec",
+        "vs_baseline": round(cells_per_sec / cpu_cells_per_sec, 2),
+    }
+    if breakdown:
+        decode_s = bench_decode_only(bam_path)
+        compute_s = bench_compute_only()
+        n_reads = N_CELLS * MOLECULES_PER_CELL * READS_PER_MOLECULE
+        result["breakdown"] = {
+            "end_to_end_s": round(timings["end_to_end_s"], 3),
+            "decode_only_s": round(decode_s, 3),
+            "decode_rec_per_s": round(n_reads / decode_s),
+            "compute_only_s_per_1M_batch": round(compute_s, 3),
+            "cpu_baseline_cells_per_s": round(cpu_cells_per_sec, 2),
+        }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -38,10 +38,17 @@ from .writer import MetricCSVWriter
 DEFAULT_BATCH_RECORDS = 1 << 20
 
 
-def _pad_columns(frame: ReadFrame, is_mito: np.ndarray) -> Dict[str, np.ndarray]:
-    """ReadFrame -> dict of device-ready padded columns (+ valid mask)."""
+def _pad_columns(
+    frame: ReadFrame, is_mito: np.ndarray, pad_to: int = 0
+) -> Dict[str, np.ndarray]:
+    """ReadFrame -> dict of device-ready padded columns (+ valid mask).
+
+    ``pad_to`` pins the padded size (streaming batches all share one compiled
+    shape); it is ignored when the frame is larger (e.g. a single entity that
+    outgrew the batch capacity).
+    """
     n = frame.n_records
-    padded = bucket_size(n)
+    padded = pad_to if pad_to >= n else bucket_size(n)
 
     def pad(arr, fill=0, dtype=None):
         arr = np.asarray(arr)
@@ -49,23 +56,26 @@ def _pad_columns(frame: ReadFrame, is_mito: np.ndarray) -> Dict[str, np.ndarray]
         out[:n] = arr
         return out
 
+    # narrow columns ship narrow (int8): host->device transfer is a wall-
+    # clock cost (a tunneled TPU especially) and the device pass upcasts
+    # where arithmetic needs it
     cols = {
         "cell": pad(frame.cell, 0, np.int32),
         "umi": pad(frame.umi, 0, np.int32),
         "gene": pad(frame.gene, 0, np.int32),
         "ref": pad(frame.ref, 0, np.int32),
         "pos": pad(frame.pos, 0, np.int32),
-        "strand": pad(frame.strand.astype(np.int32), 0, np.int32),
+        "strand": pad(frame.strand, 0, np.int8),
         "unmapped": pad(frame.unmapped, False),
         "duplicate": pad(frame.duplicate, False),
         "spliced": pad(frame.spliced, False),
-        "xf": pad(frame.xf.astype(np.int32), 0, np.int32),
+        "xf": pad(frame.xf, 0, np.int8),
         "nh": pad(frame.nh, PAD_FILLS["nh"], np.int32),
         "perfect_umi": pad(
-            frame.perfect_umi.astype(np.int32), PAD_FILLS["perfect_umi"], np.int32
+            frame.perfect_umi, PAD_FILLS["perfect_umi"], np.int8
         ),
         "perfect_cb": pad(
-            frame.perfect_cb.astype(np.int32), PAD_FILLS["perfect_cb"], np.int32
+            frame.perfect_cb, PAD_FILLS["perfect_cb"], np.int8
         ),
         "umi_frac30": pad(np.nan_to_num(frame.umi_frac30, nan=0.0), 0.0, np.float32),
         "cb_frac30": pad(np.nan_to_num(frame.cb_frac30, nan=0.0), 0.0, np.float32),
@@ -129,8 +139,10 @@ class MetricGatherer:
         own model ("one molecule group in memory", metrics/gatherer.py:41-43,
         scaled to batches).
         """
+        from ..utils.cache import enable_compilation_cache
         from . import device as device_engine  # deferred jax import
 
+        enable_compilation_cache()
         frames = prefetch_iterator(
             iter_frames_from_bam(
                 self._bam_file,
@@ -141,6 +153,8 @@ class MetricGatherer:
         with closing(MetricCSVWriter(self._output_stem, self._compress)) as out:
             out.write_header({c: None for c in self.columns})
             carry: Optional[ReadFrame] = None
+            pending = None  # previous batch, dispatched but not written
+            multi_batch = False
             for frame in frames:
                 if carry is not None:
                     frame = concat_frames(carry, frame)
@@ -152,30 +166,75 @@ class MetricGatherer:
                 if changes.size == 0:
                     carry = frame  # one entity so far; keep accumulating
                     continue
-                cut = int(changes[-1]) + 1
-                self._process_device_batch(
-                    slice_frame(frame, 0, cut), device_engine, out
+                # cut at the last entity boundary that fits the capacity, so
+                # every batch of a multi-batch run pads to ONE fixed shape
+                # and the device pass compiles exactly once; only an entity
+                # larger than the whole capacity overflows it (and then
+                # falls back to a bigger padded shape). A file smaller than
+                # one batch stays at its own bucket size — padding a tiny
+                # input to the full capacity would waste ~capacity/n of
+                # device compute and transfer.
+                capacity = bucket_size(self._batch_records)
+                multi_batch = multi_batch or frame.n_records >= self._batch_records
+                eligible = changes[changes < capacity]
+                cut = int((eligible if eligible.size else changes)[-1]) + 1
+                # dispatch is async: batch k+1 computes on the device while
+                # batch k's rows transfer back and write below
+                dispatched = self._dispatch_device_batch(
+                    slice_frame(frame, 0, cut),
+                    device_engine,
+                    pad_to=capacity if multi_batch else 0,
                 )
+                if pending is not None:
+                    self._finalize_device_batch(*pending, device_engine, out)
+                pending = dispatched
                 # compact, or the carried vocabularies would accumulate the
                 # union of every batch seen so far
                 carry = compact_frame(slice_frame(frame, cut, frame.n_records))
             if carry is not None and carry.n_records:
-                self._process_device_batch(carry, device_engine, out)
+                dispatched = self._dispatch_device_batch(
+                    carry,
+                    device_engine,
+                    pad_to=bucket_size(self._batch_records) if multi_batch else 0,
+                )
+                if pending is not None:
+                    self._finalize_device_batch(*pending, device_engine, out)
+                pending = dispatched
+            if pending is not None:
+                self._finalize_device_batch(*pending, device_engine, out)
 
-    def _process_device_batch(self, frame: ReadFrame, device_engine, out) -> None:
+    def _dispatch_device_batch(self, frame: ReadFrame, device_engine, pad_to: int):
         is_mito = np.asarray(
             [name in self._mitochondrial_gene_ids for name in frame.gene_names],
             dtype=bool,
         )
-        cols = _pad_columns(frame, is_mito)
+        cols = _pad_columns(frame, is_mito, pad_to=pad_to)
         num_segments = len(cols["valid"])
         result = device_engine.compute_entity_metrics(
             {k: np.asarray(v) for k, v in cols.items()},
             num_segments=num_segments,
             kind=self.entity_kind,
         )
-        result = {k: np.asarray(v) for k, v in result.items()}
-        self._write_device_rows(frame, result, out)
+        return frame, result, num_segments
+
+    def _finalize_device_batch(
+        self, frame: ReadFrame, result, num_segments: int, device_engine, out
+    ) -> None:
+        # compact device->host transfer: pull only (a bucketed bound on) the
+        # real entity rows, as two stacked arrays instead of 38 padded ones
+        n_entities = int(result["n_entities"])
+        k = min(bucket_size(n_entities, minimum=1024), num_segments)
+        int_names = ("entity_code",) + tuple(
+            c for c in self.columns if c in INT_COLUMNS
+        )
+        float_names = tuple(c for c in self.columns if c not in INT_COLUMNS)
+        ints, floats = device_engine.compact_results(
+            result, int_names, float_names, k
+        )
+        self._write_device_rows(
+            frame, n_entities, int_names, float_names,
+            np.asarray(ints), np.asarray(floats), out,
+        )
 
     def _entity_names(self, frame: ReadFrame) -> List[str]:
         return frame.cell_names if self.entity_kind == "cell" else frame.gene_names
@@ -185,23 +244,32 @@ class MetricGatherer:
         return True
 
     def _write_device_rows(
-        self, frame: ReadFrame, result: Dict[str, np.ndarray], out: MetricCSVWriter
+        self,
+        frame: ReadFrame,
+        n_entities: int,
+        int_names,
+        float_names,
+        ints: np.ndarray,
+        floats: np.ndarray,
+        out: MetricCSVWriter,
     ) -> None:
         names = self._entity_names(frame)
-        n_entities = int(result["n_entities"])
+        int_lists = {n: ints[:, i].tolist() for i, n in enumerate(int_names)}
+        float_lists = {n: floats[:, i].tolist() for i, n in enumerate(float_names)}
+        entity_codes = int_lists["entity_code"]
         for row in range(n_entities):
-            code = int(result["entity_code"][row])
-            name = names[code]
+            name = names[entity_codes[row]]
             if not self._row_filter(name):
                 continue
             index = "None" if name == "" else name
-            record = {}
-            for column in self.columns:
-                value = result[column][row]
-                if column in INT_COLUMNS:
-                    record[column] = int(value)
-                else:
-                    record[column] = float(value)
+            record = {
+                column: (
+                    int_lists[column][row]
+                    if column in int_lists
+                    else float_lists[column][row]
+                )
+                for column in self.columns
+            }
             out.write(index, record)
 
     # ---- cpu backend (exact reference streaming semantics) ---------------

@@ -98,6 +98,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.scx_stream_error.argtypes = [ctypes.c_void_p]
         lib.scx_stream_close.restype = None
         lib.scx_stream_close.argtypes = [ctypes.c_void_p]
+        lib.scx_synth_bam.restype = ctypes.c_long
+        lib.scx_synth_bam.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
         _lib = lib
         return _lib
 
@@ -254,6 +260,38 @@ def stream_frames_native(
         lib.scx_stream_close(handle)
 
 
+def synth_bam_native(
+    path: str,
+    n_cells: int,
+    molecules_per_cell: int = 8,
+    reads_per_molecule: int = 4,
+    n_genes: int = 4096,
+    seq_len: int = 98,
+    seed: int = 42,
+    compress_level: int = 1,
+) -> int:
+    """Write a cell-sorted fully tagged synthetic BAM at native speed.
+
+    Used by bench.py and large-scale streaming tests to build
+    north-star-sized inputs. Returns records written. Raises RuntimeError
+    when the native layer is unavailable (callers fall back to the Python
+    writer in tests/helpers or skip).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    errbuf = ctypes.create_string_buffer(256)
+    written = lib.scx_synth_bam(
+        path.encode(), n_cells, molecules_per_cell, reads_per_molecule,
+        n_genes, seq_len, seed, compress_level, errbuf, ctypes.sizeof(errbuf),
+    )
+    if written < 0:
+        raise RuntimeError(
+            f"synth bam failed: {errbuf.value.decode(errors='replace')}"
+        )
+    return written
+
+
 # ---------------------------------------------------------------- attach
 
 def _load_attach(lib) -> None:
@@ -338,6 +376,7 @@ def attach_barcodes_native(
             f"attach open failed: {errbuf.value.decode(errors='replace')}"
         )
     total_written = 0
+    failed = False
     try:
         cb_len = lib.scx_attach_len(handle, b"cb")
         if corrector is not None and cb_len != corrector.barcode_length:
@@ -382,6 +421,15 @@ def attach_barcodes_native(
                 break  # u2 exhausted before the fastq (zip semantics)
         if lib.scx_attach_close(handle) != 0:
             raise RuntimeError("attach close failed")
+    except BaseException:
+        failed = True
+        raise
     finally:
         lib.scx_attach_free(handle)
+        if failed:
+            # never leave a partial output that could read as complete
+            try:
+                os.remove(output_bam)
+            except OSError:
+                pass
     return total_written

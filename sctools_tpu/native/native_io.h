@@ -1,0 +1,246 @@
+// Shared native IO building blocks: streaming inflate, buffered byte/line
+// access, and BGZF block writing. Used by the attach pipeline (attach.cpp),
+// the synthetic workload writer (synth.cpp), and future native writers.
+//
+// BGZF framing matches the spec: <=64KB payloads, BC extra field, CRC32,
+// trailing EOF block (the container format of the reference's BAM IO, which
+// it gets from htslib; ours is self-contained over zlib).
+
+#ifndef SCTOOLS_NATIVE_IO_H_
+#define SCTOOLS_NATIVE_IO_H_
+
+#include <zlib.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace scx {
+
+constexpr size_t kBgzfMaxPayload = 0xff00;  // htslib's conventional max
+
+// generic zlib pull-reader over a file (gzip/BGZF via window bits 15+32,
+// concatenated members handled by inflateReset)
+class InflateReader {
+ public:
+  bool open(const char* path) {
+    file_ = std::fopen(path, "rb");
+    if (!file_) return false;
+    std::memset(&strm_, 0, sizeof(strm_));
+    plain_probe();
+    if (!plain_ && inflateInit2(&strm_, 15 + 32) != Z_OK) return false;
+    return true;
+  }
+
+  // fill out with up to len bytes; returns bytes produced (0 = EOF)
+  size_t read(uint8_t* out, size_t len) {
+    if (plain_) return std::fread(out, 1, len, file_);
+    size_t produced = 0;
+    while (produced < len) {
+      if (strm_.avail_in == 0 && !feed()) break;
+      strm_.next_out = out + produced;
+      strm_.avail_out = static_cast<uInt>(len - produced);
+      int ret = inflate(&strm_, Z_NO_FLUSH);
+      produced = len - strm_.avail_out;
+      if (ret == Z_STREAM_END) {
+        // possibly another concatenated gzip member (BGZF is many members)
+        if (strm_.avail_in == 0 && !feed()) break;
+        if (inflateReset(&strm_) != Z_OK) break;
+      } else if (ret != Z_OK && ret != Z_BUF_ERROR) {
+        error_ = true;
+        break;
+      } else if (ret == Z_BUF_ERROR && strm_.avail_in == 0 && !feed()) {
+        break;
+      }
+    }
+    return produced;
+  }
+
+  bool failed() const { return error_; }
+
+  ~InflateReader() {
+    if (file_) std::fclose(file_);
+    if (!plain_) inflateEnd(&strm_);
+  }
+
+ private:
+  void plain_probe() {
+    int c0 = std::fgetc(file_);
+    int c1 = std::fgetc(file_);
+    std::rewind(file_);
+    plain_ = !(c0 == 0x1f && c1 == 0x8b);
+  }
+
+  bool feed() {
+    size_t n = std::fread(inbuf_, 1, sizeof(inbuf_), file_);
+    strm_.next_in = inbuf_;
+    strm_.avail_in = static_cast<uInt>(n);
+    return n > 0;
+  }
+
+  FILE* file_ = nullptr;
+  z_stream strm_;
+  uint8_t inbuf_[1 << 16];
+  bool plain_ = false;
+  bool error_ = false;
+};
+
+// buffered line/record access on top of InflateReader
+class ByteStream {
+ public:
+  bool open(const char* path) { return reader_.open(path); }
+
+  // read exactly n bytes into out; false at EOF/short
+  bool read_exact(uint8_t* out, size_t n) {
+    while (buffer_.size() - offset_ < n) {
+      if (!refill()) return false;
+    }
+    std::memcpy(out, buffer_.data() + offset_, n);
+    offset_ += n;
+    compact();
+    return true;
+  }
+
+  // next '\n'-terminated line (newline stripped); false at EOF
+  bool read_line(std::string& line) {
+    for (;;) {
+      const uint8_t* base = buffer_.data() + offset_;
+      size_t avail = buffer_.size() - offset_;
+      const void* nl = std::memchr(base, '\n', avail);
+      if (nl) {
+        size_t len = static_cast<const uint8_t*>(nl) - base;
+        line.assign(reinterpret_cast<const char*>(base), len);
+        offset_ += len + 1;
+        compact();
+        return true;
+      }
+      if (!refill()) {
+        if (avail == 0) return false;
+        line.assign(reinterpret_cast<const char*>(base), avail);
+        offset_ += avail;
+        return true;
+      }
+    }
+  }
+
+  bool failed() const { return reader_.failed(); }
+
+ private:
+  bool refill() {
+    uint8_t chunk[1 << 16];
+    size_t n = reader_.read(chunk, sizeof(chunk));
+    if (n == 0) return false;
+    buffer_.insert(buffer_.end(), chunk, chunk + n);
+    return true;
+  }
+
+  void compact() {
+    if (offset_ > (1 << 20)) {
+      buffer_.erase(buffer_.begin(), buffer_.begin() + offset_);
+      offset_ = 0;
+    }
+  }
+
+  InflateReader reader_;
+  std::vector<uint8_t> buffer_;
+  size_t offset_ = 0;
+};
+
+class BgzfWriter {
+ public:
+  // level 6 matches the reference's output sizing; level 1 is ~3x faster
+  // for scratch/synthetic outputs
+  bool open(const char* path, int level = 6) {
+    file_ = std::fopen(path, "wb");
+    level_ = level;
+    return file_ != nullptr;
+  }
+
+  void write(const uint8_t* data, size_t len) {
+    while (len > 0) {
+      size_t take = std::min(len, kBgzfMaxPayload - pending_.size());
+      pending_.insert(pending_.end(), data, data + take);
+      data += take;
+      len -= take;
+      if (pending_.size() >= kBgzfMaxPayload) flush_block();
+    }
+  }
+
+  bool close() {
+    if (!file_) return true;
+    if (!pending_.empty()) flush_block();
+    // spec EOF marker block
+    static const uint8_t kEof[28] = {
+        0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff, 0x06, 0x00, 0x42,
+        0x43, 0x02, 0x00, 0x1b, 0x00, 0x03, 0, 0, 0, 0, 0, 0, 0, 0, 0};
+    std::fwrite(kEof, 1, sizeof(kEof), file_);
+    int rc = std::fclose(file_);
+    file_ = nullptr;
+    return rc == 0 && !error_;
+  }
+
+  // close WITHOUT flushing pending data or writing the EOF marker: the
+  // error path. A partial output must not end in a valid EOF block, or it
+  // would read as a complete (silently truncated) BAM downstream.
+  void abort_close() {
+    if (!file_) return;
+    std::fclose(file_);
+    file_ = nullptr;
+    pending_.clear();
+  }
+
+  bool failed() const { return error_; }
+
+  ~BgzfWriter() { close(); }
+
+ private:
+  void flush_block() {
+    uint8_t compressed[kBgzfMaxPayload + 1024];
+    z_stream strm;
+    std::memset(&strm, 0, sizeof(strm));
+    if (deflateInit2(&strm, level_, Z_DEFLATED, -15, 8,
+                     Z_DEFAULT_STRATEGY) != Z_OK) {
+      error_ = true;
+      pending_.clear();
+      return;
+    }
+    strm.next_in = pending_.data();
+    strm.avail_in = static_cast<uInt>(pending_.size());
+    strm.next_out = compressed;
+    strm.avail_out = sizeof(compressed);
+    if (deflate(&strm, Z_FINISH) != Z_STREAM_END) error_ = true;
+    size_t clen = sizeof(compressed) - strm.avail_out;
+    deflateEnd(&strm);
+
+    uint32_t crc = crc32(0, pending_.data(), pending_.size());
+    uint32_t isize = static_cast<uint32_t>(pending_.size());
+    uint16_t bsize = static_cast<uint16_t>(clen + 25);  // total block - 1
+
+    uint8_t header[18] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff,
+                          0x06, 0x00, 0x42, 0x43, 0x02, 0x00,
+                          static_cast<uint8_t>(bsize & 0xff),
+                          static_cast<uint8_t>(bsize >> 8)};
+    uint8_t footer[8] = {
+        static_cast<uint8_t>(crc & 0xff), static_cast<uint8_t>(crc >> 8),
+        static_cast<uint8_t>(crc >> 16), static_cast<uint8_t>(crc >> 24),
+        static_cast<uint8_t>(isize & 0xff), static_cast<uint8_t>(isize >> 8),
+        static_cast<uint8_t>(isize >> 16), static_cast<uint8_t>(isize >> 24)};
+    if (std::fwrite(header, 1, 18, file_) != 18 ||
+        std::fwrite(compressed, 1, clen, file_) != clen ||
+        std::fwrite(footer, 1, 8, file_) != 8)
+      error_ = true;
+    pending_.clear();
+  }
+
+  FILE* file_ = nullptr;
+  std::vector<uint8_t> pending_;
+  bool error_ = false;
+  int level_ = 6;
+};
+
+}  // namespace scx
+
+#endif  // SCTOOLS_NATIVE_IO_H_
