@@ -7,8 +7,9 @@ map used by the FASTQ attach pipeline.
 
 TPU note: :class:`ErrorsToCorrectBarcodesMap` keeps the reference's exact
 hash-map semantics for the streaming host path; the bulk device path
-(sctools_tpu.ops.correction) instead corrects packed 2-bit barcode columns with
-a hamming kernel and produces identical corrections (tested against this map).
+(sctools_tpu.ops.whitelist) instead scores one-hot barcode columns against
+the whitelist on the MXU and produces identical corrections (tested against
+this map).
 """
 
 import itertools
