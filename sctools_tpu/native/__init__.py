@@ -33,7 +33,8 @@ def _build() -> bool:
     sources = [
         os.path.join(_DIR, name)
         for name in os.listdir(_DIR)
-        if name.endswith(".cpp")
+        if name.endswith((".cpp", ".h"))  # headers too: native_io.h is
+        # included by attach/synth and must trigger rebuilds (Makefile HDRS)
     ]
     try:
         stale = not os.path.exists(_LIB_PATH) or any(

@@ -12,22 +12,36 @@
 //
 // The decoder is a bounded-memory STREAM: the file is read in fixed-size
 // compressed chunks, BGZF blocks inflate on a thread pool (blocks are
-// independent deflate streams), and each scx_stream_next(max_records) call
-// parses at most max_records alignments — the same memory model as the
-// reference's alignments_per_batch knob (input_options.h:16). Record parsing
-// itself is also parallel: the batch's record spans are split into contiguous
-// ranges, each worker parses into thread-local columns with thread-local
-// string interning, and the vocabularies are merged + codes remapped at the
-// end so code order == numpy's np.unique order (byte-lexicographic; ""
-// first). The legacy whole-file API (scx_decode_bam) is a stream whose
-// single batch is the entire file.
+// independent deflate streams; libdeflate with per-thread reusable
+// decompressors), and each scx_stream_next(max_records) call parses at most
+// max_records alignments — the same memory model as the reference's
+// alignments_per_batch knob (input_options.h:16).
+//
+// Hot-path design (the reference hashes strings per record into maps;
+// htslib_tagsort.cpp builds a TSV string per record — both are too slow for
+// a single host core feeding a TPU):
+//   * every column is preallocated per batch and written by index; worker
+//     threads own disjoint contiguous record ranges, so there is no
+//     per-record push_back, no locking, and no post-parse concatenation;
+//   * cell/molecule barcodes are packed to uint64 (3 bits/base, A=1 C=2 G=3
+//     N=4 T=5, left-aligned) whose integer order equals byte-lexicographic
+//     string order, so dictionary codes come from a run-compressed
+//     sort-unique over ints — no string hashing at all on the fast path
+//     (strings that don't pack, e.g. non-ACGTN, divert to a slow path that
+//     reproduces numpy's np.unique semantics exactly);
+//   * gene names (small vocabulary, heavily repeated) and query names keep
+//     per-thread interning with a last-key memo, merged and remapped once
+//     per batch.
 //
 // Exposed through a minimal C API consumed by ctypes (sctools_tpu/native/
 // __init__.py); no Python.h dependency.
 
-#include <zlib.h>
+#include <libdeflate.h>
+#include <sys/mman.h>
 
 #include <algorithm>
+#include <chrono>
+#include <cstdlib>
 #include <atomic>
 #include <climits>
 #include <cmath>
@@ -43,6 +57,7 @@
 namespace {
 
 constexpr size_t kCompChunk = 16u << 20;  // compressed bytes per file read
+constexpr uint64_t kIrregular = ~0ull;    // packed sentinel: see overflow
 
 // ----------------------------------------------------------------- columns
 
@@ -54,33 +69,67 @@ struct Columns {
 
   size_t size() const { return cell.size(); }
 
-  void clear() {
-    cell.clear(); umi.clear(); gene.clear(); qname.clear();
-    ref.clear(); pos.clear(); nh.clear();
-    strand.clear(); xf.clear(); perfect_umi.clear(); perfect_cb.clear();
-    unmapped.clear(); duplicate.clear(); spliced.clear();
-    umi_frac30.clear(); cb_frac30.clear();
-    genomic_frac30.clear(); genomic_mean.clear();
+  void resize(size_t n) {
+    cell.resize(n); umi.resize(n); gene.resize(n); qname.resize(n);
+    ref.resize(n); pos.resize(n); nh.resize(n);
+    strand.resize(n); xf.resize(n); perfect_umi.resize(n);
+    perfect_cb.resize(n);
+    unmapped.resize(n); duplicate.resize(n); spliced.resize(n);
+    umi_frac30.resize(n); cb_frac30.resize(n);
+    genomic_frac30.resize(n); genomic_mean.resize(n);
   }
 
-  void append(Columns&& other) {
-    auto cat = [](auto& dst, auto& src) {
-      dst.insert(dst.end(), src.begin(), src.end());
-    };
-    cat(cell, other.cell); cat(umi, other.umi); cat(gene, other.gene);
-    cat(qname, other.qname); cat(ref, other.ref); cat(pos, other.pos);
-    cat(nh, other.nh); cat(strand, other.strand); cat(xf, other.xf);
-    cat(perfect_umi, other.perfect_umi); cat(perfect_cb, other.perfect_cb);
-    cat(unmapped, other.unmapped); cat(duplicate, other.duplicate);
-    cat(spliced, other.spliced); cat(umi_frac30, other.umi_frac30);
-    cat(cb_frac30, other.cb_frac30); cat(genomic_frac30, other.genomic_frac30);
-    cat(genomic_mean, other.genomic_mean);
-  }
+  void clear() { resize(0); }
 };
 
+// --------------------------------------------------------- barcode packing
+
+// 3-bit code per base, ascending in ASCII order so packed-integer order ==
+// byte-lexicographic string order for ACGTN strings; 0 doubles as both the
+// end-of-string padding and the empty (missing-tag) barcode, which therefore
+// sorts first, matching the reference's empty-string sort default
+// (src/sctools/bam.py:660).
+constexpr int8_t kBaseCode[256] = {
+    // 'A'=65 -> 1, 'C'=67 -> 2, 'G'=71 -> 3, 'N'=78 -> 4, 'T'=84 -> 5
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 1, 0, 2, 0, 0, 0, 3, 0, 0, 0, 0, 0, 0, 4, 0,
+    0, 0, 0, 0, 5, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+};
+constexpr char kBaseLetter[6] = {'\0', 'A', 'C', 'G', 'N', 'T'};
+constexpr size_t kMaxPackedLen = 21;  // 21 bases x 3 bits = 63 bits
+
+// returns false when the string cannot pack (too long / non-ACGTN)
+inline bool pack_barcode(const char* s, size_t len, uint64_t& out) {
+  if (len > kMaxPackedLen) return false;
+  uint64_t v = 0;
+  for (size_t i = 0; i < len; ++i) {
+    uint64_t code = static_cast<uint64_t>(
+        kBaseCode[static_cast<uint8_t>(s[i])]);
+    if (code == 0) return false;
+    v |= code << (60 - 3 * i);
+  }
+  out = v;
+  return true;
+}
+
+std::string unpack_barcode(uint64_t v) {
+  std::string s;
+  for (int shift = 60; shift >= 0; shift -= 3) {
+    unsigned code = (v >> shift) & 7u;
+    if (code == 0) break;
+    s += kBaseLetter[code];
+  }
+  return s;
+}
+
+// ------------------------------------------------------- string interning
+
 // thread-local string interner: local code = insertion order. Sorted BAMs
-// repeat the same CB/UB/GE across consecutive records, so a one-entry memo
-// of the last key skips the string allocation + hash on the common path.
+// repeat the same GE across consecutive records, so a one-entry memo of the
+// last key skips the string allocation + hash on the common path.
 struct LocalVocab {
   std::unordered_map<std::string, int32_t> map;
   std::vector<const std::string*> order;  // local code -> key
@@ -101,10 +150,15 @@ struct LocalVocab {
   }
 };
 
+struct CodeRange {
+  int32_t* data;
+  size_t len;
+};
+
 // merge thread-local vocabularies into one sorted vocabulary and remap each
-// thread's codes in place
+// thread's code range in place
 void merge_vocabs(std::vector<LocalVocab>& locals,
-                  std::vector<std::vector<int32_t>*> code_columns,
+                  std::vector<CodeRange> code_ranges,
                   std::vector<std::string>& out_sorted) {
   out_sorted.clear();
   for (const LocalVocab& local : locals)
@@ -120,7 +174,8 @@ void merge_vocabs(std::vector<LocalVocab>& locals,
     std::vector<int32_t> remap(locals[t].order.size());
     for (size_t i = 0; i < locals[t].order.size(); ++i)
       remap[i] = rank.at(*locals[t].order[i]);
-    for (int32_t& code : *code_columns[t]) code = remap[code];
+    int32_t* codes = code_ranges[t].data;
+    for (size_t i = 0; i < code_ranges[t].len; ++i) codes[i] = remap[codes[i]];
   }
 }
 
@@ -142,21 +197,118 @@ struct Batch {
   }
 };
 
+// ------------------------------------------------------- code assignment
+
+// sorted-BAM-friendly dictionary coding: unique candidates come from value
+// runs (consecutive records usually share CB/UB), so the sort operates on
+// run heads, not records; codes fill per run. Ascending uint64 order ==
+// string order, so the resulting codes match np.unique(strings) exactly.
+void codes_from_packed(const std::vector<uint64_t>& packed,
+                       int32_t* codes,
+                       std::vector<uint64_t>& uniq) {
+  size_t n = packed.size();
+  uniq.clear();
+  for (size_t i = 0; i < n; ++i)
+    if (i == 0 || packed[i] != packed[i - 1]) uniq.push_back(packed[i]);
+  std::sort(uniq.begin(), uniq.end());
+  uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
+  size_t i = 0;
+  while (i < n) {
+    size_t j = i + 1;
+    while (j < n && packed[j] == packed[i]) ++j;
+    int32_t code = static_cast<int32_t>(
+        std::lower_bound(uniq.begin(), uniq.end(), packed[i]) - uniq.begin());
+    for (size_t k = i; k < j; ++k) codes[k] = code;
+    i = j;
+  }
+}
+
+// slow path: some values could not pack (non-ACGTN / >21bp). Reconstructs
+// every value as a string (overflow entries carry the original bytes) and
+// reproduces np.unique semantics with a hash map — only exercised by
+// pathological barcodes, never by real 10x data.
+void codes_from_strings(const std::vector<uint64_t>& packed,
+                        const std::vector<std::pair<size_t, std::string>>& overflow,
+                        int32_t* codes,
+                        std::vector<std::string>& vocab) {
+  size_t n = packed.size();
+  std::unordered_map<size_t, const std::string*> irregular;
+  irregular.reserve(overflow.size() * 2);
+  for (const auto& [idx, s] : overflow) irregular.emplace(idx, &s);
+  std::vector<std::string> values(n);
+  for (size_t i = 0; i < n; ++i) {
+    if (packed[i] == kIrregular)
+      values[i] = *irregular.at(i);
+    else
+      values[i] = unpack_barcode(packed[i]);
+  }
+  vocab.assign(values.begin(), values.end());
+  std::sort(vocab.begin(), vocab.end());
+  vocab.erase(std::unique(vocab.begin(), vocab.end()), vocab.end());
+  std::unordered_map<std::string_view, int32_t> rank;
+  rank.reserve(vocab.size() * 2);
+  for (size_t i = 0; i < vocab.size(); ++i)
+    rank.emplace(vocab[i], static_cast<int32_t>(i));
+  for (size_t i = 0; i < n; ++i) codes[i] = rank.at(values[i]);
+}
+
 // ----------------------------------------------------------------- BGZF
 
-bool inflate_block(const uint8_t* src, uint32_t src_len, uint8_t* dst,
-                   uint32_t dst_len) {
-  z_stream strm;
-  std::memset(&strm, 0, sizeof(strm));
-  if (inflateInit2(&strm, -15) != Z_OK) return false;
-  strm.next_in = const_cast<uint8_t*>(src);
-  strm.avail_in = src_len;
-  strm.next_out = dst;
-  strm.avail_out = dst_len;
-  int ret = inflate(&strm, Z_FINISH);
-  inflateEnd(&strm);
-  return ret == Z_STREAM_END && strm.avail_out == 0;
+// libdeflate decompressors are reusable; one per worker thread avoids both
+// zlib's per-block inflateInit cost and any locking
+bool inflate_block(libdeflate_decompressor* dec, const uint8_t* src,
+                   uint32_t src_len, uint8_t* dst, uint32_t dst_len) {
+  size_t actual = 0;
+  return libdeflate_deflate_decompress(dec, src, src_len, dst, dst_len,
+                                       &actual) == LIBDEFLATE_SUCCESS &&
+         actual == dst_len;
 }
+
+// mmap-backed byte buffer: no zero-initialization on growth, a large
+// geometric floor, and transparent hugepages, because std::vector's
+// value-initializing resize, repeated realloc-copies, and 4KB first-touch
+// faults measurably dominated inflate itself (~2x the decompression cost)
+// while a batch's inflated bytes ramped up to steady state.
+struct ByteBuf {
+  uint8_t* data = nullptr;
+  size_t size = 0, cap = 0;
+
+  ~ByteBuf() { if (data) munmap(data, cap); }
+  ByteBuf() = default;
+  ByteBuf(const ByteBuf&) = delete;
+  ByteBuf& operator=(const ByteBuf&) = delete;
+
+  bool reserve(size_t want) {
+    if (want <= cap) return true;
+    size_t newcap = cap ? cap * 2 : (64u << 20);
+    while (newcap < want) newcap *= 2;
+    void* p = mmap(nullptr, newcap, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (p == MAP_FAILED) return false;
+#ifdef MADV_HUGEPAGE
+    madvise(p, newcap, MADV_HUGEPAGE);
+#endif
+    if (size) std::memcpy(p, data, size);
+    if (data) munmap(data, cap);
+    data = static_cast<uint8_t*>(p);
+    cap = newcap;
+    return true;
+  }
+
+  // append n uninitialized bytes; returns the write pointer or null on OOM
+  uint8_t* grow(size_t n) {
+    if (!reserve(size + n)) return nullptr;
+    uint8_t* p = data + size;
+    size += n;
+    return p;
+  }
+
+  void consume_prefix(size_t n) {
+    if (!n) return;
+    std::memmove(data, data + n, size - n);
+    size -= n;
+  }
+};
 
 struct BlockInfo {
   size_t src_offset;    // offset of the deflate payload within comp buffer
@@ -176,13 +328,17 @@ struct Stream {
   bool file_eof = false;
   std::string error;
 
-  std::vector<uint8_t> comp;  // compressed bytes not yet inflated
+  ByteBuf comp;  // compressed bytes not yet inflated
   size_t comp_pos = 0;
-  std::vector<uint8_t> bam;   // inflated bytes not yet parsed
+  ByteBuf bam;   // inflated bytes not yet parsed
   size_t bam_pos = 0;
   bool header_done = false;
 
   Batch batch;
+
+  // per-batch scratch, reused across batches to avoid reallocation
+  std::vector<uint64_t> cell_packed, umi_packed;
+  std::vector<uint64_t> uniq_scratch;
 
   ~Stream() { if (f) std::fclose(f); }
 };
@@ -191,35 +347,50 @@ struct Stream {
 // block in the buffer. Consumed prefixes of both buffers are compacted first,
 // so relative offsets from {comp,bam}_pos stay valid across calls. Returns
 // false when no new inflated bytes could be produced (EOF or error).
+double g_t_fread = 0, g_t_inflate = 0, g_t_buf = 0;
+struct TicToc {
+  double* acc;
+  std::chrono::steady_clock::time_point t0 = std::chrono::steady_clock::now();
+  explicit TicToc(double* a) : acc(a) {}
+  ~TicToc() { *acc += std::chrono::duration<double>(
+      std::chrono::steady_clock::now() - t0).count(); }
+};
+
 bool refill(Stream& s) {
   if (s.error.size()) return false;
+  TicToc buf_outer(&g_t_buf);
   // compact
   if (s.bam_pos) {
-    s.bam.erase(s.bam.begin(), s.bam.begin() + s.bam_pos);
+    s.bam.consume_prefix(s.bam_pos);
     s.bam_pos = 0;
   }
   if (s.comp_pos) {
-    s.comp.erase(s.comp.begin(), s.comp.begin() + s.comp_pos);
+    s.comp.consume_prefix(s.comp_pos);
     s.comp_pos = 0;
   }
 
   size_t produced = 0;
   while (produced == 0) {
     if (!s.file_eof) {
-      size_t old = s.comp.size();
-      s.comp.resize(old + kCompChunk);
-      size_t got = std::fread(s.comp.data() + old, 1, kCompChunk, s.f);
-      s.comp.resize(old + got);
+      uint8_t* w = s.comp.grow(kCompChunk);
+      if (!w) {
+        s.error = "out of memory";
+        return false;
+      }
+      size_t got;
+      { TicToc tt(&g_t_fread); got = std::fread(w, 1, kCompChunk, s.f); }
+      s.comp.size -= kCompChunk - got;
       if (got < kCompChunk) s.file_eof = true;
     }
-    if (s.comp.empty()) return false;
+    if (s.comp.size == 0) return false;
 
     if (!s.format_known) {
       // fread returns short only at EOF, so comp holds >= 4 bytes here
       // unless the whole file is shorter than that (which cannot be a BAM)
-      if (s.comp.size() >= 4 && std::memcmp(s.comp.data(), "BAM\1", 4) == 0)
+      if (s.comp.size >= 4 && std::memcmp(s.comp.data, "BAM\1", 4) == 0)
         s.plain = true;
-      else if (s.comp.size() >= 2 && s.comp[0] == 0x1f && s.comp[1] == 0x8b)
+      else if (s.comp.size >= 2 && s.comp.data[0] == 0x1f &&
+               s.comp.data[1] == 0x8b)
         s.plain = false;
       else {
         s.error = "not a BAM stream (bad magic)";
@@ -229,17 +400,22 @@ bool refill(Stream& s) {
     }
 
     if (s.plain) {
-      s.bam.insert(s.bam.end(), s.comp.begin(), s.comp.end());
-      s.comp.clear();
-      return !s.bam.empty();
+      uint8_t* w = s.bam.grow(s.comp.size);
+      if (!w) {
+        s.error = "out of memory";
+        return false;
+      }
+      std::memcpy(w, s.comp.data, s.comp.size);
+      s.comp.size = 0;
+      return s.bam.size != 0;
     }
 
     // index complete BGZF blocks in comp
     std::vector<BlockInfo> blocks;
     size_t offset = 0;
     size_t total_out = 0;
-    while (offset + 18 <= s.comp.size()) {
-      const uint8_t* p = s.comp.data() + offset;
+    while (offset + 18 <= s.comp.size) {
+      const uint8_t* p = s.comp.data + offset;
       if (p[0] != 0x1f || p[1] != 0x8b) {
         s.error = "malformed BGZF container";
         return false;
@@ -247,25 +423,25 @@ bool refill(Stream& s) {
       uint16_t xlen = p[10] | (p[11] << 8);
       size_t extra = offset + 12;
       size_t extra_end = extra + xlen;
-      if (extra_end > s.comp.size()) break;  // header spans chunk boundary
+      if (extra_end > s.comp.size) break;  // header spans chunk boundary
       uint32_t bsize = 0;
       while (extra + 4 <= extra_end) {
-        uint8_t si1 = s.comp[extra], si2 = s.comp[extra + 1];
-        uint16_t slen = s.comp[extra + 2] | (s.comp[extra + 3] << 8);
+        uint8_t si1 = s.comp.data[extra], si2 = s.comp.data[extra + 1];
+        uint16_t slen = s.comp.data[extra + 2] | (s.comp.data[extra + 3] << 8);
         if (si1 == 'B' && si2 == 'C' && slen == 2 && extra + 6 <= extra_end)
-          bsize = (s.comp[extra + 4] | (s.comp[extra + 5] << 8)) + 1;
+          bsize = (s.comp.data[extra + 4] | (s.comp.data[extra + 5] << 8)) + 1;
         extra += 4 + slen;
       }
       if (bsize < 12u + xlen + 8u) {
         s.error = "malformed BGZF container";
         return false;
       }
-      if (offset + bsize > s.comp.size()) break;  // incomplete block
+      if (offset + bsize > s.comp.size) break;  // incomplete block
       uint32_t payload_len = bsize - 12 - xlen - 8;
-      uint32_t isize = s.comp[offset + bsize - 4] |
-                       (s.comp[offset + bsize - 3] << 8) |
-                       (s.comp[offset + bsize - 2] << 16) |
-                       (s.comp[offset + bsize - 1] << 24);
+      uint32_t isize = s.comp.data[offset + bsize - 4] |
+                       (s.comp.data[offset + bsize - 3] << 8) |
+                       (s.comp.data[offset + bsize - 2] << 16) |
+                       (s.comp.data[offset + bsize - 1] << 24);
       if (isize > 0) {
         blocks.push_back({offset + 12 + xlen, payload_len, isize, total_out});
         total_out += isize;
@@ -274,37 +450,49 @@ bool refill(Stream& s) {
     }
     if (offset == 0 && s.file_eof) {
       // leftover bytes that can never form a block
-      if (!s.comp.empty()) s.error = "truncated BGZF block at EOF";
+      if (s.comp.size) s.error = "truncated BGZF block at EOF";
       return false;
     }
 
     if (total_out) {
-      size_t base = s.bam.size();
-      s.bam.resize(base + total_out);
-      std::atomic<size_t> next{0};
+      TicToc tt(&g_t_inflate);
+      size_t base = s.bam.size;
+      if (!s.bam.grow(total_out)) {
+        s.error = "out of memory";
+        return false;
+      }
       std::atomic<bool> ok{true};
-      auto worker = [&]() {
-        for (;;) {
-          size_t i = next.fetch_add(1);
-          if (i >= blocks.size()) return;
+      auto inflate_range = [&](size_t lo, size_t hi) {
+        libdeflate_decompressor* dec = libdeflate_alloc_decompressor();
+        for (size_t i = lo; i < hi && ok.load(std::memory_order_relaxed); ++i) {
           const BlockInfo& b = blocks[i];
-          if (!inflate_block(s.comp.data() + b.src_offset, b.payload_len,
-                             s.bam.data() + base + b.out_offset, b.isize))
+          if (!inflate_block(dec, s.comp.data + b.src_offset, b.payload_len,
+                             s.bam.data + base + b.out_offset, b.isize))
             ok.store(false);
         }
+        libdeflate_free_decompressor(dec);
       };
       int workers = std::min<int>(std::max(s.n_threads, 1),
                                   static_cast<int>(blocks.size()));
-      std::vector<std::thread> pool;
-      for (int t = 0; t < workers; ++t) pool.emplace_back(worker);
-      for (auto& t : pool) t.join();
+      if (workers <= 1) {
+        inflate_range(0, blocks.size());
+      } else {
+        size_t per = (blocks.size() + workers - 1) / workers;
+        std::vector<std::thread> pool;
+        for (int t = 0; t < workers; ++t) {
+          size_t lo = std::min(blocks.size(), t * per);
+          size_t hi = std::min(blocks.size(), lo + per);
+          pool.emplace_back(inflate_range, lo, hi);
+        }
+        for (auto& t : pool) t.join();
+      }
       if (!ok.load()) {
         s.error = "BGZF block failed to inflate";
         return false;
       }
       produced += total_out;
     }
-    s.comp.erase(s.comp.begin(), s.comp.begin() + offset);
+    s.comp.consume_prefix(offset);
     if (s.file_eof && produced == 0) return false;
   }
   return true;
@@ -312,7 +500,7 @@ bool refill(Stream& s) {
 
 // ensure at least `need` unparsed inflated bytes are available
 bool ensure(Stream& s, size_t need) {
-  while (s.bam.size() - s.bam_pos < need)
+  while (s.bam.size - s.bam_pos < need)
     if (!refill(s)) return false;
   return true;
 }
@@ -328,24 +516,24 @@ bool read_header(Stream& s) {
     if (s.error.empty()) s.error = "truncated header";
     return false;
   }
-  if (std::memcmp(s.bam.data() + s.bam_pos, "BAM\1", 4) != 0) {
+  if (std::memcmp(s.bam.data + s.bam_pos, "BAM\1", 4) != 0) {
     s.error = "not a BAM stream (bad magic)";
     return false;
   }
-  uint64_t l_text = read_u32(s.bam.data() + s.bam_pos + 4);
+  uint64_t l_text = read_u32(s.bam.data + s.bam_pos + 4);
   if (!ensure(s, 12 + l_text)) {
     if (s.error.empty()) s.error = "truncated header";
     return false;
   }
   uint64_t cursor = 8 + l_text;  // relative to bam_pos
-  uint32_t n_ref = read_u32(s.bam.data() + s.bam_pos + cursor);
+  uint32_t n_ref = read_u32(s.bam.data + s.bam_pos + cursor);
   cursor += 4;
   for (uint32_t i = 0; i < n_ref; ++i) {
     if (!ensure(s, cursor + 4)) {
       if (s.error.empty()) s.error = "truncated reference list";
       return false;
     }
-    uint64_t l_name = read_u32(s.bam.data() + s.bam_pos + cursor);
+    uint64_t l_name = read_u32(s.bam.data + s.bam_pos + cursor);
     if (!ensure(s, cursor + 8 + l_name)) {
       if (s.error.empty()) s.error = "truncated reference list";
       return false;
@@ -363,7 +551,7 @@ inline float phred_frac_above30(const char* qual, size_t len) {
   if (len == 0) return NAN;
   size_t above = 0;
   for (size_t i = 0; i < len; ++i)
-    if (qual[i] - 33 > 30) ++above;
+    above += static_cast<uint8_t>(qual[i]) > 63;  // q - 33 > 30
   return static_cast<float>(above) / static_cast<float>(len);
 }
 
@@ -457,13 +645,16 @@ int8_t xf_code(const TagView& tags) {
 }
 
 struct ThreadState {
-  Columns cols;
-  LocalVocab cell, umi, gene, qname;
+  LocalVocab gene, qname;
+  std::vector<std::pair<size_t, std::string>> cell_overflow, umi_overflow;
   std::string error;
 };
 
-// parse one alignment record (block_size bytes at rec) into `t`
-bool parse_record(const uint8_t* rec, uint32_t block_size, bool want_qname,
+// parse one alignment record (block_size bytes at rec) into row i of the
+// preallocated batch columns
+bool parse_record(const uint8_t* rec, uint32_t block_size, size_t i,
+                  bool want_qname, Columns& c,
+                  uint64_t* cell_packed, uint64_t* umi_packed,
                   ThreadState& t) {
   int32_t ref_id = static_cast<int32_t>(read_u32(rec));
   int32_t pos = static_cast<int32_t>(read_u32(rec + 4));
@@ -494,25 +685,28 @@ bool parse_record(const uint8_t* rec, uint32_t block_size, bool want_qname,
   bool duplicate = flag & 0x400;
 
   // cigar walk: spliced (N op), soft-clip bounds (H ignored, leading and
-  // trailing S excluded) — matches BamRecord._clip_bounds
+  // trailing S excluded) — matches BamRecord._clip_bounds. Clamped so a
+  // corrupt trailing soft-clip longer than l_seq cannot underflow clip_end
+  // into an out-of-bounds quality scan.
   bool spliced = false;
   uint32_t clip_start = 0, clip_end = l_seq;
   int first_non_h = -1, last_non_h = -1;
-  for (uint16_t i = 0; i < n_cigar; ++i) {
-    uint32_t entry = read_u32(cigar + 4 * i);
+  for (uint16_t k = 0; k < n_cigar; ++k) {
+    uint32_t entry = read_u32(cigar + 4 * k);
     uint32_t op = entry & 0xf;
     if (op == 3) spliced = true;          // N
     if (op != 5) {                        // not H
-      if (first_non_h < 0) first_non_h = i;
-      last_non_h = i;
+      if (first_non_h < 0) first_non_h = k;
+      last_non_h = k;
     }
   }
   if (first_non_h >= 0) {
     uint32_t first_entry = read_u32(cigar + 4 * first_non_h);
     uint32_t last_entry = read_u32(cigar + 4 * last_non_h);
-    if ((first_entry & 0xf) == 4) clip_start = first_entry >> 4;  // S
+    if ((first_entry & 0xf) == 4)
+      clip_start = std::min(first_entry >> 4, l_seq);  // S
     if (last_non_h != first_non_h && (last_entry & 0xf) == 4)
-      clip_end = l_seq - (last_entry >> 4);
+      clip_end = (last_entry >> 4) > l_seq ? 0 : l_seq - (last_entry >> 4);
   }
 
   TagView tags;
@@ -521,57 +715,82 @@ bool parse_record(const uint8_t* rec, uint32_t block_size, bool want_qname,
     return false;
   }
 
-  Columns& c = t.cols;
-  c.qname.push_back(want_qname ? t.qname.code(read_name, name_len) : 0);
-  c.cell.push_back(t.cell.code(tags.cb, tags.has_cb ? tags.cb_len : 0));
-  c.umi.push_back(t.umi.code(tags.ub, tags.has_ub ? tags.ub_len : 0));
-  c.gene.push_back(t.gene.code(tags.ge, tags.ge ? tags.ge_len : 0));
-  c.ref.push_back(ref_id);
-  c.pos.push_back(pos);
-  c.strand.push_back(reverse ? 1 : 0);
-  c.unmapped.push_back(unmapped ? 1 : 0);
-  c.duplicate.push_back(duplicate ? 1 : 0);
-  c.spliced.push_back(spliced ? 1 : 0);
-  c.xf.push_back(xf_code(tags));
-  c.nh.push_back(tags.nh);
+  c.qname[i] = want_qname ? t.qname.code(read_name, name_len) : 0;
+
+  size_t cb_len = tags.has_cb ? tags.cb_len : 0;
+  if (!pack_barcode(tags.cb, cb_len, cell_packed[i])) {
+    cell_packed[i] = kIrregular;
+    t.cell_overflow.emplace_back(i, std::string(tags.cb, cb_len));
+  }
+  size_t ub_len = tags.has_ub ? tags.ub_len : 0;
+  if (!pack_barcode(tags.ub, ub_len, umi_packed[i])) {
+    umi_packed[i] = kIrregular;
+    t.umi_overflow.emplace_back(i, std::string(tags.ub, ub_len));
+  }
+  c.gene[i] = t.gene.code(tags.ge, tags.ge ? tags.ge_len : 0);
+
+  c.ref[i] = ref_id;
+  c.pos[i] = pos;
+  c.strand[i] = reverse ? 1 : 0;
+  c.unmapped[i] = unmapped ? 1 : 0;
+  c.duplicate[i] = duplicate ? 1 : 0;
+  c.spliced[i] = spliced ? 1 : 0;
+  c.xf[i] = xf_code(tags);
+  c.nh[i] = tags.nh;
 
   int8_t perfect_umi = -1;
   if (tags.ur && tags.has_ub)
     perfect_umi = (tags.ur_len == tags.ub_len &&
                    std::memcmp(tags.ur, tags.ub, tags.ub_len) == 0) ? 1 : 0;
-  c.perfect_umi.push_back(perfect_umi);
+  c.perfect_umi[i] = perfect_umi;
   int8_t perfect_cb = -1;
   if (tags.has_cb && tags.cr)
     perfect_cb = (tags.cr_len == tags.cb_len &&
                   std::memcmp(tags.cr, tags.cb, tags.cb_len) == 0) ? 1 : 0;
-  c.perfect_cb.push_back(perfect_cb);
+  c.perfect_cb[i] = perfect_cb;
 
-  c.umi_frac30.push_back(tags.uy ? phred_frac_above30(tags.uy, tags.uy_len) : NAN);
-  c.cb_frac30.push_back(tags.cy ? phred_frac_above30(tags.cy, tags.cy_len) : NAN);
+  c.umi_frac30[i] = tags.uy ? phred_frac_above30(tags.uy, tags.uy_len) : NAN;
+  c.cb_frac30[i] = tags.cy ? phred_frac_above30(tags.cy, tags.cy_len) : NAN;
 
   // aligned-portion qualities; an all-0xFF fill means "absent" in BAM
   // (BamRecord.from_bytes sets quality=None only when every byte is 0xFF)
   bool has_qual = false;
-  for (uint32_t i = 0; i < l_seq; ++i) {
-    if (qual[i] != 0xff) { has_qual = true; break; }
+  for (uint32_t k = 0; k < l_seq; ++k) {
+    if (qual[k] != 0xff) { has_qual = true; break; }
   }
   if (has_qual && clip_end > clip_start) {
     uint32_t n = clip_end - clip_start;
     uint32_t above = 0;
     uint64_t total = 0;
-    for (uint32_t i = clip_start; i < clip_end; ++i) {
-      uint8_t q = qual[i];
-      if (q > 30) ++above;
+    for (uint32_t k = clip_start; k < clip_end; ++k) {
+      uint8_t q = qual[k];
+      above += q > 30;
       total += q;
     }
-    c.genomic_frac30.push_back(static_cast<float>(above) / n);
-    c.genomic_mean.push_back(static_cast<float>(total) / n);
+    c.genomic_frac30[i] = static_cast<float>(above) / n;
+    c.genomic_mean[i] = static_cast<float>(total) / n;
   } else {
-    c.genomic_frac30.push_back(NAN);
-    c.genomic_mean.push_back(NAN);
+    c.genomic_frac30[i] = NAN;
+    c.genomic_mean[i] = NAN;
   }
   return true;
 }
+
+// SCX_TIMING=1 prints per-stage wall times to stderr (profiling aid only)
+struct StageTimer {
+  bool on = std::getenv("SCX_TIMING") != nullptr;
+  std::chrono::steady_clock::time_point t = std::chrono::steady_clock::now();
+  void mark(const char* stage) {
+    if (!on) return;
+    std::fprintf(stderr, "[scx]   fread=%.3f inflate=%.3f buf=%.3f\n",
+                 g_t_fread, g_t_inflate, g_t_buf - g_t_fread - g_t_inflate);
+    g_t_fread = g_t_inflate = g_t_buf = 0;
+    auto now = std::chrono::steady_clock::now();
+    std::fprintf(stderr, "[scx] %s %.3fs\n", stage,
+                 std::chrono::duration<double>(now - t).count());
+    t = now;
+  }
+};
 
 // decode up to max_records alignments into s.batch; returns count, 0 at EOF,
 // -1 on error
@@ -586,6 +805,12 @@ long stream_next(Stream& s, long max_records) {
     }
     if (!read_header(s)) return -1;
   }
+  StageTimer timer;
+
+  // reserve the batch's likely footprint once: growth mid-batch would
+  // realloc-copy hundreds of MB (measured ~2x the inflate cost)
+  if (max_records > 0)
+    s.bam.reserve(static_cast<size_t>(max_records) * 384);
 
   // collect record spans (relative to bam_pos; refill preserves them)
   struct Span { size_t offset; uint32_t size; };
@@ -595,13 +820,13 @@ long stream_next(Stream& s, long max_records) {
          spans.size() < static_cast<size_t>(max_records)) {
     if (!ensure(s, cursor + 4)) {
       if (!s.error.empty()) return -1;
-      if (s.bam.size() - s.bam_pos != cursor) {
+      if (s.bam.size - s.bam_pos != cursor) {
         s.error = "truncated record";
         return -1;
       }
       break;  // clean EOF at a record boundary
     }
-    uint32_t block_size = read_u32(s.bam.data() + s.bam_pos + cursor);
+    uint32_t block_size = read_u32(s.bam.data + s.bam_pos + cursor);
     if (block_size < 32) {
       s.error = "truncated record";
       return -1;
@@ -614,24 +839,27 @@ long stream_next(Stream& s, long max_records) {
     cursor += 4 + block_size;
   }
   if (spans.empty()) return 0;
+  timer.mark("spans");
 
-  // parallel parse: contiguous span ranges -> thread-local columns
-  int workers = std::min<int>(std::max(s.n_threads, 1),
-                              static_cast<int>(spans.size()));
+  // parallel parse into preallocated columns: each worker owns a contiguous
+  // record range, so every column write is by index and lock-free
+  size_t n = spans.size();
+  s.batch.cols.resize(n);
+  s.cell_packed.resize(n);
+  s.umi_packed.resize(n);
+  int workers = std::min<int>(std::max(s.n_threads, 1), static_cast<int>(n));
   std::vector<ThreadState> states(workers);
-  const uint8_t* base = s.bam.data() + s.bam_pos;
-  size_t per = (spans.size() + workers - 1) / workers;
+  std::vector<size_t> bounds(workers + 1);
+  size_t per = (n + workers - 1) / workers;
+  for (int t = 0; t <= workers; ++t)
+    bounds[t] = std::min(n, static_cast<size_t>(t) * per);
+  const uint8_t* base = s.bam.data + s.bam_pos;
   auto work = [&](int t) {
-    // both bounds clamp: with per = ceil(n/w), trailing workers can start
-    // past the end (e.g. 17 spans / 16 workers), which must yield an empty
-    // range, not an underflowed one
-    size_t lo = std::min(spans.size(), t * per);
-    size_t hi = std::min(spans.size(), lo + per);
     ThreadState& state = states[t];
-    state.cols.cell.reserve(hi - lo);
-    for (size_t i = lo; i < hi; ++i) {
-      if (!parse_record(base + spans[i].offset, spans[i].size, s.want_qname,
-                        state))
+    for (size_t i = bounds[t]; i < bounds[t + 1]; ++i) {
+      if (!parse_record(base + spans[i].offset, spans[i].size, i,
+                        s.want_qname, s.batch.cols,
+                        s.cell_packed.data(), s.umi_packed.data(), state))
         return;
     }
   };
@@ -648,40 +876,63 @@ long stream_next(Stream& s, long max_records) {
       return -1;
     }
   }
+  timer.mark("parse");
 
-  // merge vocabularies, remap codes (the four columns merge concurrently),
-  // then concatenate columns in thread order
-  auto merge_one = [&](LocalVocab ThreadState::*member_vocab,
-                       std::vector<int32_t> Columns::*member_col,
-                       std::vector<std::string>& out_sorted) {
-    std::vector<LocalVocab> locals;
-    std::vector<std::vector<int32_t>*> cols;
-    locals.reserve(workers);
+  // cell/umi codes from packed ints (fast path), or the string slow path
+  // when any value failed to pack
+  auto assign = [&](std::vector<uint64_t>& packed,
+                    std::vector<std::pair<size_t, std::string>> ThreadState::*member,
+                    std::vector<int32_t>& codes,
+                    std::vector<std::string>& vocab) {
+    std::vector<std::pair<size_t, std::string>> overflow;
     for (ThreadState& state : states) {
-      locals.push_back(std::move(state.*member_vocab));
-      cols.push_back(&(state.cols.*member_col));
+      auto& part = state.*member;
+      overflow.insert(overflow.end(),
+                      std::make_move_iterator(part.begin()),
+                      std::make_move_iterator(part.end()));
+      part.clear();
     }
-    merge_vocabs(locals, cols, out_sorted);
+    if (overflow.empty()) {
+      codes_from_packed(packed, codes.data(), s.uniq_scratch);
+      vocab.resize(s.uniq_scratch.size());
+      for (size_t i = 0; i < s.uniq_scratch.size(); ++i)
+        vocab[i] = unpack_barcode(s.uniq_scratch[i]);
+    } else {
+      codes_from_strings(packed, overflow, codes.data(), vocab);
+    }
+  };
+  assign(s.cell_packed, &ThreadState::cell_overflow, s.batch.cols.cell,
+         s.batch.cell_vocab);
+  assign(s.umi_packed, &ThreadState::umi_overflow, s.batch.cols.umi,
+         s.batch.umi_vocab);
+  timer.mark("codes");
+
+  // gene/qname vocabularies: merge thread-local interners, remap each
+  // thread's contiguous code range
+  auto ranges_for = [&](std::vector<int32_t>& col) {
+    std::vector<CodeRange> ranges;
+    for (int t = 0; t < workers; ++t)
+      ranges.push_back({col.data() + bounds[t], bounds[t + 1] - bounds[t]});
+    return ranges;
   };
   {
-    std::vector<std::thread> mergers;
-    mergers.emplace_back(merge_one, &ThreadState::cell, &Columns::cell,
-                         std::ref(s.batch.cell_vocab));
-    mergers.emplace_back(merge_one, &ThreadState::umi, &Columns::umi,
-                         std::ref(s.batch.umi_vocab));
-    mergers.emplace_back(merge_one, &ThreadState::gene, &Columns::gene,
-                         std::ref(s.batch.gene_vocab));
-    if (s.want_qname)
-      mergers.emplace_back(merge_one, &ThreadState::qname, &Columns::qname,
-                           std::ref(s.batch.qname_vocab));
-    else
-      s.batch.qname_vocab.assign(1, std::string());
-    for (auto& t : mergers) t.join();
+    std::vector<LocalVocab> locals;
+    locals.reserve(workers);
+    for (ThreadState& state : states) locals.push_back(std::move(state.gene));
+    merge_vocabs(locals, ranges_for(s.batch.cols.gene), s.batch.gene_vocab);
   }
-  for (ThreadState& state : states) s.batch.cols.append(std::move(state.cols));
+  if (s.want_qname) {
+    std::vector<LocalVocab> locals;
+    locals.reserve(workers);
+    for (ThreadState& state : states) locals.push_back(std::move(state.qname));
+    merge_vocabs(locals, ranges_for(s.batch.cols.qname), s.batch.qname_vocab);
+  } else {
+    s.batch.qname_vocab.assign(1, std::string());
+  }
 
+  timer.mark("vocab_merge");
   s.bam_pos += cursor;
-  return static_cast<long>(s.batch.cols.size());
+  return static_cast<long>(n);
 }
 
 Batch::Flat* flat_vocab(Stream* s, const char* name) {
