@@ -34,7 +34,11 @@ N_CELLS = 1 << 16  # 65k cells
 MOLECULES_PER_CELL = 8
 READS_PER_MOLECULE = 4  # 32 reads/cell -> ~2.1M reads
 N_GENES = 1 << 12
-BATCH_RECORDS = 1 << 20
+# 512k records/batch: finer pipeline granularity halves each upload's
+# footprint on the (bandwidth-variable) tunneled link and overlaps decode
+# with device work better than 1M batches in measurement; the gatherer
+# compiles once either way
+BATCH_RECORDS = 1 << 19
 # cpu baseline subsample (same shape per cell), kept small: the streaming
 # python path is ~3-4 orders of magnitude slower per read
 CPU_CELLS = 512
@@ -43,7 +47,11 @@ CPU_CELLS = 512
 # bump when synth.cpp's record generation changes, or stale cached inputs
 # would silently keep benchmarking the old generator
 SYNTH_SEED = 42
-SYNTH_VERSION = 1
+# v2: BGZF blocks compressed at level 6, the htslib default real BAMs are
+# written with (level 1 produced an unrealistically literal-heavy stream
+# that inflates slower per output byte than production data)
+SYNTH_VERSION = 2
+SYNTH_COMPRESS_LEVEL = 6
 
 
 def _bench_bam_path() -> str:
@@ -65,6 +73,7 @@ def ensure_bench_bam() -> str:
             reads_per_molecule=READS_PER_MOLECULE,
             n_genes=N_GENES,
             seed=SYNTH_SEED,
+            compress_level=SYNTH_COMPRESS_LEVEL,
         )
         assert n == N_CELLS * MOLECULES_PER_CELL * READS_PER_MOLECULE
         os.rename(path + ".tmp", path)
@@ -123,15 +132,18 @@ def bench_compute_only() -> float:
     device_cols = {k: jax.device_put(v) for k, v in cols.items()}
 
     def run():
-        return compute_entity_metrics(
+        result = compute_entity_metrics(
             device_cols, num_segments=num_segments, kind="cell"
         )
+        # pull a scalar: block_until_ready alone under-reports on tunneled
+        # backends (readiness can be acknowledged before remote completion)
+        return int(np.asarray(result["n_entities"]))
 
-    jax.block_until_ready(run())  # compile + warm
+    run()  # compile + warm
     times = []
     for _ in range(3):
         start = time.perf_counter()
-        jax.block_until_ready(run())
+        run()
         times.append(time.perf_counter() - start)
     return float(np.median(times))
 

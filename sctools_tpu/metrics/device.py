@@ -5,21 +5,27 @@ The TPU-native reformulation of the reference's streaming aggregators
 492-530 cell extras, 580-595 gene extras). One jit-compiled pass over a padded
 record batch:
 
-1. lexicographic device sort by the tag-key triple (the reference instead
-   pre-sorts the BAM file and walks it with nested iterators,
-   metrics/gatherer.py:134-153);
-2. run detection over the sorted keys realizes the group structure;
-3. every per-group quantity becomes a segment reduction:
-   Counters -> run counting, Welford -> two-pass segment moments,
-   histogram ``.keys()``/value predicates -> run-start flags and run-length
-   predicates.
+1. group structure comes from *runs* of equal tag keys. The gatherer's input
+   is already sorted by the tag triple (the documented precondition the
+   reference imposes on its own input files, metrics/gatherer.py:91-95), so
+   with ``presorted=True`` no primary device sort happens at all — run
+   detection works directly in record order. ``presorted=False`` first
+   applies one 3-key sort permutation (for resharded/synthetic batches);
+2. every per-group quantity becomes a segment reduction: Counters -> run
+   counting, Welford -> two-pass segment moments, histogram ``.keys()`` /
+   value predicates -> run-start flags and run-length predicates;
+3. the two orderings the primary order cannot express — fragment adjacency
+   over (tags, ref, pos, strand) and the cell path's (cell, gene) histogram —
+   use *key-only* auxiliary sorts: the payload never rides the sort network,
+   each sorted row is decoded from its own key bits.
 
-Fragment statistics need adjacency over (tags, ref, pos, strand), and the cell
-path's gene histogram needs adjacency over (cell, gene); both get auxiliary
-device sorts rather than hash maps.
+Record flags travel bit-packed in one int16 ``flags`` column (see
+``io.packed.pack_flags``): a 1M-record batch ships ~7 fewer byte-wide
+columns over PCIe/tunnel links, and the sort-free fast path cuts the
+compiled program to a fraction of a full-sort design.
 
-All shapes are static: callers pad records to a bucket size with key columns
-set to INT32_MAX (sorting after all real data) and valid=False.
+All shapes are static: callers pad records to a bucket size with valid=False
+(key columns are masked to INT32_MAX internally so padding sorts last).
 """
 
 from __future__ import annotations
@@ -32,56 +38,90 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import consts
+from ..io.packed import (
+    FLAG_DUPLICATE,
+    FLAG_MITO,
+    FLAG_SPLICED,
+    FLAG_STRAND,
+    FLAG_UNMAPPED,
+    FLAG_NH1_SHIFT,
+    FLAG_PCB_SHIFT,
+    FLAG_PUMI_SHIFT,
+    FLAG_XF_SHIFT,
+)
 from ..ops import segments as seg
 from ..ops.stats import segment_mean_and_variance
 
 _I32_MAX = np.iinfo(np.int32).max
 
 
+def _unpack_flags(flags: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Decode the packed int16 flag column into boolean/int fields."""
+    f = flags.astype(jnp.int32)
+    return {
+        "strand": f & FLAG_STRAND,
+        "unmapped": (f & FLAG_UNMAPPED) != 0,
+        "duplicate": (f & FLAG_DUPLICATE) != 0,
+        "spliced": (f & FLAG_SPLICED) != 0,
+        "xf": (f >> FLAG_XF_SHIFT) & 7,
+        "perfect_umi": ((f >> FLAG_PUMI_SHIFT) & 3) == 2,  # stored value+1
+        "perfect_cb": ((f >> FLAG_PCB_SHIFT) & 3) == 2,
+        "nh1": ((f >> FLAG_NH1_SHIFT) & 1) != 0,  # NH tag == 1
+        "is_mito": (f & FLAG_MITO) != 0,
+    }
+
+
 def _common_metrics(
-    sorted_cols: Dict[str, jnp.ndarray],
+    cols: Dict[str, jnp.ndarray],
+    bits: Dict[str, jnp.ndarray],
+    valid: jnp.ndarray,
     outer_ids: jnp.ndarray,
+    num_segments: int,
+    s_valid: jnp.ndarray,
+    s_outer_ids: jnp.ndarray,
     triple_starts: jnp.ndarray,
     triple_ids: jnp.ndarray,
-    num_segments: int,
 ) -> Dict[str, jnp.ndarray]:
-    """The 24 shared metrics, reduced over the outer (entity) segment."""
-    valid = sorted_cols["valid"]
-    mapped = valid & ~sorted_cols["unmapped"]
+    """The 24 shared metrics, reduced over the outer (entity) segment.
+
+    Per-record reductions operate in record order (no gather); the molecule
+    histogram operates on the key-only sorted side (``s_*``/``triple_*``),
+    whose outer segment numbering matches record order.
+    """
+    mapped = valid & ~bits["unmapped"]
 
     def count_where(mask):
         return seg.segment_count(outer_ids, num_segments, where=mask)
 
     n_reads = count_where(valid)
-    perfect_molecule_barcodes = count_where(valid & (sorted_cols["perfect_umi"] == 1))
+    perfect_molecule_barcodes = count_where(valid & bits["perfect_umi"])
 
-    xf = sorted_cols["xf"]
+    xf = bits["xf"]
     reads_mapped_exonic = count_where(mapped & (xf == consts.XF_CODING))
     reads_mapped_intronic = count_where(mapped & (xf == consts.XF_INTRONIC))
     reads_mapped_utr = count_where(mapped & (xf == consts.XF_UTR))
 
-    nh = sorted_cols["nh"]
-    reads_mapped_uniquely = count_where(mapped & (nh == 1))
-    reads_mapped_multiple = count_where(mapped & (nh != 1))
-    duplicate_reads = count_where(mapped & sorted_cols["duplicate"])
-    spliced_reads = count_where(mapped & sorted_cols["spliced"])
+    reads_mapped_uniquely = count_where(mapped & bits["nh1"])
+    reads_mapped_multiple = count_where(mapped & ~bits["nh1"])
+    duplicate_reads = count_where(mapped & bits["duplicate"])
+    spliced_reads = count_where(mapped & bits["spliced"])
 
     umi_mean, umi_var, _ = segment_mean_and_variance(
-        sorted_cols["umi_frac30"], outer_ids, num_segments, where=valid
+        cols["umi_frac30"], outer_ids, num_segments, where=valid
     )
     gf_mean, gf_var, _ = segment_mean_and_variance(
-        sorted_cols["genomic_frac30"], outer_ids, num_segments, where=valid
+        cols["genomic_frac30"], outer_ids, num_segments, where=valid
     )
     gq_mean, gq_var, _ = segment_mean_and_variance(
-        sorted_cols["genomic_mean"], outer_ids, num_segments, where=valid
+        cols["genomic_mean"], outer_ids, num_segments, where=valid
     )
 
     # molecule histogram: distinct tag triples / triples observed once
     n_molecules = seg.distinct_runs_per_outer(
-        triple_starts, outer_ids, num_segments, where=valid
+        triple_starts, s_outer_ids, num_segments, where=s_valid
     )
     molecules_single = seg.runs_with_count_per_outer(
-        triple_ids, outer_ids, num_segments, where=valid, predicate="eq1"
+        triple_ids, s_outer_ids, num_segments, where=s_valid, predicate="eq1"
     )
 
     zeros = jnp.zeros_like(n_reads)
@@ -107,7 +147,7 @@ def _common_metrics(
         "genomic_read_quality_mean": gq_mean,
         "genomic_read_quality_variance": gq_var,
         "n_molecules": n_molecules,
-        "n_fragments": zeros,  # filled by _fragment_metrics
+        "n_fragments": zeros,  # filled by the fragment pass
         "reads_per_molecule": jnp.where(
             n_molecules > 0, f_reads / jnp.maximum(f_molecules, 1), jnp.nan
         ),
@@ -137,9 +177,14 @@ def _scatter_by_entity(
     return jnp.where(found, gathered, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("num_segments", "kind"))
+@functools.partial(
+    jax.jit, static_argnames=("num_segments", "kind", "presorted")
+)
 def compute_entity_metrics(
-    cols: Dict[str, jnp.ndarray], num_segments: int, kind: str = "cell"
+    cols: Dict[str, jnp.ndarray],
+    num_segments: int,
+    kind: str = "cell",
+    presorted: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """All metrics for one entity axis in a single compiled pass.
 
@@ -148,8 +193,26 @@ def compute_entity_metrics(
     metrics/gatherer.py:91-95). ``kind='gene'``: outer key = gene, triple =
     (gene, cell, umi) (gatherer.py:164-168).
 
-    ``cols`` must contain the ReadFrame columns plus ``valid``; shapes are
-    uniform [N] with padding sorted to the end. ``num_segments`` == N.
+    ``presorted=True`` asserts records already arrive *grouped by the outer
+    entity key, groups in ascending code order*, with padding at the end —
+    the gatherer's streaming batches, which inherit the order of the
+    entity-sorted input BAM (vocabulary codes preserve string order, so
+    ascending holds by construction). Grouped-but-unordered input would
+    misattribute the sorted-side metrics: record-order segments number
+    groups by appearance while the key-only sorted side numbers them
+    ascending, and the two numberings must coincide. That contract is
+    exactly the reference gatherer's own input requirement, and no more:
+    its shipped "cell-sorted" files are sorted by CB only, with (UB, GE)
+    free to interleave inside a cell (hash-based Counters absorb that,
+    aggregator.py:95/128). Outer reductions therefore run with no sort at
+    all, and molecule/fragment structure comes from one *key-only* device
+    sort whose payload never moves. With ``presorted=False`` a 3-key sort
+    permutation reorders the payload first, so any record order is accepted
+    (resharded batches, synthetic workloads).
+
+    ``cols`` holds int32 ``cell``/``umi``/``gene``/``ref``/``pos``, packed
+    int16 ``flags`` (io.packed.pack_flags), boolean ``valid``, and the four
+    float32 quality columns; shapes are uniform [N]. ``num_segments`` == N.
     Returns per-segment metric arrays plus:
       - ``entity_code``: the entity's vocabulary code per segment
       - ``segment_valid``: which segments are real
@@ -161,60 +224,76 @@ def compute_entity_metrics(
     else:
         raise ValueError(f"kind must be 'cell' or 'gene', got {kind!r}")
 
-    valid = cols["valid"]
-    pad_key = lambda name: jnp.where(valid, cols[name].astype(jnp.int32), _I32_MAX)
-    sort_keys = [pad_key(name) for name in key_names]
-    # ONE sort provides outer, triple, AND fragment adjacency: the key tuple
-    # extends (tags...) with (mapped-last flag, ref, pos, strand), so runs of
-    # the 3-key prefix are molecules and runs of the full tuple are fragments
-    # (reference fragment key is (ref, pos, strand, tags), aggregator.py:299-
-    # 303; only mapped reads contribute, so unmapped sort after the mapped
-    # fragments of their triple and are masked out of the run counts).
-    mapped_col = valid & ~cols["unmapped"].astype(bool)
-    sort_keys = sort_keys + [
-        jnp.where(mapped_col, 0, 1).astype(jnp.int32),
-        pad_key("ref"),
-        pad_key("pos"),
-        pad_key("strand"),
-    ]
+    valid = cols["valid"].astype(bool)
+    if not presorted:
+        sort_keys = [
+            jnp.where(valid, cols[name].astype(jnp.int32), _I32_MAX)
+            for name in key_names
+        ]
+        perm = seg.sort_permutation(sort_keys)
+        cols = {name: value[perm] for name, value in cols.items()}
+        valid = cols["valid"].astype(bool)
 
-    value_names = [
-        "valid", "unmapped", "duplicate", "spliced", "xf", "nh",
-        "perfect_umi", "perfect_cb", "umi_frac30", "cb_frac30",
-        "genomic_frac30", "genomic_mean", "cell", "umi", "gene",
-    ]
-    # sort keys + a permutation index, then gather the value columns — the
-    # value payload rides one gather each instead of the full sorting network
-    perm = seg.sort_permutation(sort_keys)
-    sorted_keys = [k[perm] for k in sort_keys]
-    s = {name: cols[name][perm] for name in value_names}
-    s["valid"] = s["valid"].astype(bool)
-    s["unmapped"] = s["unmapped"].astype(bool)
-    s["duplicate"] = s["duplicate"].astype(bool)
-    s["spliced"] = s["spliced"].astype(bool)
+    bits = _unpack_flags(cols["flags"])
+    pad_key = lambda name: jnp.where(
+        valid, cols[name].astype(jnp.int32), _I32_MAX
+    )
+    k1, k2, k3 = (pad_key(name) for name in key_names)
 
-    outer_starts = seg.run_starts(sorted_keys[:1])
+    # outer segments exist directly in record order (outer-grouped input)
+    outer_starts = seg.run_starts([k1])
     outer_ids = seg.segment_ids_from_starts(outer_starts)
+
+    # --- molecule + fragment structure from ONE key-only sort --------------
+    # (umi, gene) interleave freely inside an entity, so triples/fragments
+    # need sorted adjacency; sorting only the key tuple (tags..., mapped-
+    # last, ref, pos, strand) realizes both without moving any payload.
+    # Outer segment NUMBERING is identical on both sides: the same distinct
+    # k1 values ascend in record order and in sorted order, so per-outer
+    # sums computed on sorted rows land on the right record-order segments.
+    # (reference fragment key: (ref, pos, strand, tags), aggregator.py:299-
+    # 303; molecule key: the tag triple, aggregator.py:95)
+    mapped = valid & ~bits["unmapped"]
+    sorted_keys = jax.lax.sort(
+        [
+            k1,
+            k2,
+            k3,
+            jnp.where(mapped, 0, 1).astype(jnp.int32),
+            pad_key("ref"),
+            pad_key("pos"),
+            jnp.where(valid, bits["strand"], _I32_MAX),
+        ],
+        num_keys=7,
+    )
+    s_valid = sorted_keys[0] != _I32_MAX
+    s_mapped = s_valid & (sorted_keys[3] == 0)
+    s_outer_ids = seg.segment_ids_from_starts(seg.run_starts(sorted_keys[:1]))
     triple_starts = seg.run_starts(sorted_keys[:3])
     triple_ids = seg.segment_ids_from_starts(triple_starts)
 
-    out = _common_metrics(s, outer_ids, triple_starts, triple_ids, num_segments)
+    out = _common_metrics(
+        cols,
+        bits,
+        valid,
+        outer_ids,
+        num_segments,
+        s_valid,
+        s_outer_ids,
+        triple_starts,
+        triple_ids,
+    )
 
-    # --- fragments: runs of the full extended key among mapped records -----
-    valid_sorted = s["valid"]
-    mapped_sorted = valid_sorted & ~s["unmapped"]
     frag_starts = seg.run_starts(sorted_keys)
     frag_ids = seg.segment_ids_from_starts(frag_starts)
     n_fragments = seg.distinct_runs_per_outer(
-        frag_starts, outer_ids, num_segments, where=mapped_sorted
+        frag_starts, s_outer_ids, num_segments, where=s_mapped
     )
     frag_single = seg.runs_with_count_per_outer(
-        frag_ids, outer_ids, num_segments, where=mapped_sorted, predicate="eq1"
+        frag_ids, s_outer_ids, num_segments, where=s_mapped, predicate="eq1"
     )
     primary_entity_key = seg.segment_min(
-        jnp.where(valid_sorted, s[key_names[0]].astype(jnp.int32), _I32_MAX),
-        outer_ids,
-        num_segments,
+        jnp.where(valid, k1, _I32_MAX), outer_ids, num_segments
     )
     f_reads = out["n_reads"].astype(jnp.float32)
     f_frag = n_fragments.astype(jnp.float32)
@@ -230,21 +309,30 @@ def compute_entity_metrics(
 
     if kind == "cell":
         out.update(
-            _cell_extras(cols, s, outer_ids, primary_entity_key, num_segments)
+            _cell_extras(
+                cols, bits, valid, outer_ids, primary_entity_key, num_segments
+            )
         )
     else:
-        out.update(_gene_extras(s, sorted_keys, outer_ids, num_segments))
+        out.update(
+            _gene_extras(sorted_keys, s_valid, s_outer_ids, num_segments)
+        )
 
-    n_entities = jnp.sum(jnp.where(valid_sorted, outer_starts, False).astype(jnp.int32))
+    n_entities = jnp.sum(
+        jnp.where(valid, outer_starts, False).astype(jnp.int32)
+    )
     out["entity_code"] = primary_entity_key
-    out["segment_valid"] = jnp.arange(num_segments, dtype=jnp.int32) < n_entities
+    out["segment_valid"] = (
+        jnp.arange(num_segments, dtype=jnp.int32) < n_entities
+    )
     out["n_entities"] = n_entities
     return out
 
 
 def _cell_extras(
     cols: Dict[str, jnp.ndarray],
-    s: Dict[str, jnp.ndarray],
+    bits: Dict[str, jnp.ndarray],
+    valid: jnp.ndarray,
     outer_ids: jnp.ndarray,
     primary_entity_key: jnp.ndarray,
     num_segments: int,
@@ -252,39 +340,43 @@ def _cell_extras(
     """The 11 cell-specific metrics (reference aggregator.py:437-530).
 
     The genes histogram needs (cell, gene) adjacency, which the primary
-    (cell, umi, gene) sort does not provide — an auxiliary sort supplies it.
-    ``is_mito`` is a per-record flag gathered host-side from the gene
-    vocabulary (reference resolves mito genes from GTF names at
-    platform.py:302-307 and checks membership at aggregator.py:476-482).
+    (cell, umi, gene) order does not provide — a key-only auxiliary sort
+    supplies it, with the per-gene mito flag riding in the low bit of the
+    gene key (constant within a (cell, gene) run, so run structure is
+    unchanged). ``is_mito`` originates host-side from the gene vocabulary
+    (reference resolves mito genes from GTF names at platform.py:302-307 and
+    checks membership at aggregator.py:476-482).
     """
-    valid = s["valid"]
 
     def count_where(mask):
         return seg.segment_count(outer_ids, num_segments, where=mask)
 
-    perfect_cell_barcodes = count_where(valid & (s["perfect_cb"] == 1))
+    perfect_cell_barcodes = count_where(valid & bits["perfect_cb"])
     # XF checks in cell extras ignore mapped state (aggregator.py:522-527):
     # INTERGENIC counts any read carrying that tag value; a missing XF counts
     # toward reads_unmapped.
-    reads_mapped_intergenic = count_where(valid & (s["xf"] == consts.XF_INTERGENIC))
-    reads_unmapped = count_where(valid & (s["xf"] == consts.XF_MISSING))
+    xf = bits["xf"]
+    reads_mapped_intergenic = count_where(valid & (xf == consts.XF_INTERGENIC))
+    reads_unmapped = count_where(valid & (xf == consts.XF_MISSING))
 
     cb_mean, cb_var, _ = segment_mean_and_variance(
-        s["cb_frac30"], outer_ids, num_segments, where=valid
+        cols["cb_frac30"], outer_ids, num_segments, where=valid
     )
 
-    # --- genes histogram via (cell, gene) auxiliary sort ------------------
-    pad = ~cols["valid"]
-    cell_key = jnp.where(pad, _I32_MAX, cols["cell"].astype(jnp.int32))
-    gene_key = jnp.where(pad, _I32_MAX, cols["gene"].astype(jnp.int32))
-    (gk_sorted, (g_valid, g_is_mito)) = seg.lexsort(
-        [cell_key, gene_key], [cols["valid"], cols["is_mito"]]
+    # --- genes histogram via key-only (cell, gene<<1|mito) aux sort ---------
+    cell_key = jnp.where(valid, cols["cell"].astype(jnp.int32), _I32_MAX)
+    gene_mito_key = jnp.where(
+        valid,
+        (cols["gene"].astype(jnp.int32) << 1)
+        | bits["is_mito"].astype(jnp.int32),
+        _I32_MAX,
     )
-    g_valid = g_valid.astype(bool)
-    g_is_mito = g_is_mito.astype(bool)
-    g_outer_starts = seg.run_starts(gk_sorted[:1])
+    gk_cell, gk_gene = jax.lax.sort([cell_key, gene_mito_key], num_keys=2)
+    g_valid = gk_cell != _I32_MAX
+    g_is_mito = g_valid & ((gk_gene & 1) == 1)
+    g_outer_starts = seg.run_starts([gk_cell])
     g_outer_ids = seg.segment_ids_from_starts(g_outer_starts)
-    g_pair_starts = seg.run_starts(gk_sorted)
+    g_pair_starts = seg.run_starts([gk_cell, gk_gene])
     g_pair_ids = seg.segment_ids_from_starts(g_pair_starts)
 
     n_genes_local = seg.distinct_runs_per_outer(
@@ -294,12 +386,14 @@ def _cell_extras(
         g_pair_ids, g_outer_ids, num_segments, where=g_valid, predicate="gt1"
     )
     mito_genes_local = seg.distinct_runs_per_outer(
-        g_pair_starts, g_outer_ids, num_segments, where=g_valid & g_is_mito
+        g_pair_starts, g_outer_ids, num_segments, where=g_is_mito
     )
-    mito_reads_local = seg.segment_count(g_outer_ids, num_segments, where=g_valid & g_is_mito)
+    mito_reads_local = seg.segment_count(
+        g_outer_ids, num_segments, where=g_is_mito
+    )
 
     g_entity_key = seg.segment_min(
-        jnp.where(g_valid, gk_sorted[0], _I32_MAX), g_outer_ids, num_segments
+        jnp.where(g_valid, gk_cell, _I32_MAX), g_outer_ids, num_segments
     )
     realign = lambda v: _scatter_by_entity(
         v, g_entity_key, primary_entity_key, num_segments
@@ -347,35 +441,39 @@ def compact_results(
     full-length arrays per batch is transfer-bound (especially over a
     tunneled TPU); two stacked [k x columns] pulls replace them. ``k`` is a
     bucketed bound >= n_entities so the compiled slice program is reused.
+
+    Stacks are int32/float32 — the dtypes the engine actually computes in —
+    so the pull moves half the bytes of a 64-bit stack and test/production
+    behavior cannot diverge on precision (counts fit int32 by construction:
+    they are bounded by the per-batch record count).
     """
     ints = jnp.stack(
-        [result[name][:k].astype(jnp.int64) for name in int_names], axis=1
+        [result[name][:k].astype(jnp.int32) for name in int_names], axis=1
     )
     floats = jnp.stack(
-        [result[name][:k].astype(jnp.float64) for name in float_names], axis=1
+        [result[name][:k].astype(jnp.float32) for name in float_names], axis=1
     )
     return ints, floats
 
 
 def _gene_extras(
-    s: Dict[str, jnp.ndarray],
     sorted_keys,
-    outer_ids: jnp.ndarray,
+    s_valid: jnp.ndarray,
+    s_outer_ids: jnp.ndarray,
     num_segments: int,
 ) -> Dict[str, jnp.ndarray]:
     """The 2 gene-specific metrics (reference aggregator.py:561-595).
 
-    The primary (gene, cell, umi) sort already provides (gene, cell)
-    adjacency, so the cells histogram falls out of run counting directly.
+    The key-only sorted side already provides (gene, cell) adjacency, so the
+    cells histogram falls out of run counting on its first two keys.
     """
-    valid = s["valid"]
     pair_starts = seg.run_starts(sorted_keys[:2])
     pair_ids = seg.segment_ids_from_starts(pair_starts)
     number_cells_expressing = seg.distinct_runs_per_outer(
-        pair_starts, outer_ids, num_segments, where=valid
+        pair_starts, s_outer_ids, num_segments, where=s_valid
     )
     number_cells_detected_multiple = seg.runs_with_count_per_outer(
-        pair_ids, outer_ids, num_segments, where=valid, predicate="gt1"
+        pair_ids, s_outer_ids, num_segments, where=s_valid, predicate="gt1"
     )
     return {
         "number_cells_detected_multiple": number_cells_detected_multiple,

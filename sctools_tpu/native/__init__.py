@@ -29,6 +29,22 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
+def _host_fingerprint() -> str:
+    """CPU identity the compiled library is specific to (-march=native)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    import hashlib
+
+                    return hashlib.sha256(line.encode()).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
 def _build() -> bool:
     sources = [
         os.path.join(_DIR, name)
@@ -36,11 +52,22 @@ def _build() -> bool:
         if name.endswith((".cpp", ".h"))  # headers too: native_io.h is
         # included by attach/synth and must trigger rebuilds (Makefile HDRS)
     ]
+    marker = _LIB_PATH + ".buildhost"
+    fingerprint = _host_fingerprint()
     try:
         stale = not os.path.exists(_LIB_PATH) or any(
             os.path.getmtime(_LIB_PATH) < os.path.getmtime(source)
             for source in sources
         )
+        # the library is built -march=native: an up-to-date .so from another
+        # machine (shared filesystem, container image) could carry illegal
+        # instructions for this CPU — rebuild when the host changed
+        if not stale:
+            try:
+                with open(marker) as f:
+                    stale = f.read().strip() != fingerprint
+            except OSError:
+                stale = True
         if stale:
             subprocess.run(
                 ["make", "-s", "-C", _DIR],
@@ -48,6 +75,8 @@ def _build() -> bool:
                 capture_output=True,
                 timeout=300,
             )
+            with open(marker, "w") as f:
+                f.write(fingerprint)
         return True
     except (OSError, subprocess.SubprocessError):
         return False

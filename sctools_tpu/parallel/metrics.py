@@ -64,9 +64,12 @@ def reshard_by_key(
 
     ``capacity`` is the per-(src, dst) bucket cap. The default S is always
     sufficient; callers with host visibility of the data should pass the
-    tight value from ``required_reshard_capacity`` (records beyond capacity
-    would be silently dropped — validate host-side, this function cannot
-    raise under jit).
+    tight value from ``required_reshard_capacity``.
+
+    Returns ``(cols, n_dropped)``: records beyond an undersized capacity are
+    dropped from the exchange, and ``n_dropped`` (a per-shard device scalar)
+    counts them so callers can surface the loss after the jit boundary —
+    this function itself cannot raise under jit.
     """
     local_size = cols[key].shape[0]
     if capacity is None:
@@ -84,7 +87,11 @@ def reshard_by_key(
     col_in_bucket = iota - first[run_ids]
 
     ok = (sorted_dest < n_shards) & (col_in_bucket < capacity)
-    # out-of-bounds rows are dropped by scatter mode='drop'
+    # out-of-bounds rows are dropped by scatter mode='drop'; count them so
+    # the loss is observable (silent truncation would corrupt metrics)
+    n_dropped = jnp.sum(
+        ((sorted_dest < n_shards) & ~ok).astype(jnp.int32)
+    )
     row = jnp.where(ok, sorted_dest, n_shards)
 
     # scatter each column into its send buffer, grouped by dtype
@@ -108,7 +115,7 @@ def reshard_by_key(
         )
         for i, name in enumerate(group):
             out[name] = received[i].reshape(n_shards * capacity)
-    return out
+    return out, n_dropped
 
 
 def required_reshard_capacity(
@@ -199,29 +206,39 @@ def distributed_metrics_step(
     ``__graft_entry__.dryrun_multichip`` compiles over an N-device mesh.
 
     ``capacity`` (per-(src,dst) reshard bucket) is computed tight from the
-    concrete input when possible, validated when given, and falls back to the
-    always-sufficient full shard size when the input is a tracer.
+    concrete input when omitted, and falls back to the always-sufficient full
+    shard size when the input is a tracer. An explicit capacity is *checked
+    on device*: the reshard counts every record an undersized bucket would
+    drop, and this function raises after the step instead of silently losing
+    records (the round-robin file binning it replaces cannot overflow,
+    src/sctools/bam.py:442-448 — neither may the collective).
     """
     n_shards, shard_size = stacked_cols["cell"].shape
     _check_shard_count(n_shards, mesh, axis_name)
     concrete = not isinstance(stacked_cols["gene"], jax.core.Tracer)
-    if concrete:
+    if capacity is not None:
+        cap = capacity
+    elif concrete:
         required = required_reshard_capacity(stacked_cols, "gene", n_shards)
-        if capacity is None:
-            cap = seg.bucket_size(required, minimum=8)
-        elif capacity < required:
-            raise ValueError(
-                f"reshard capacity={capacity} too small: a (src,dst) shard "
-                f"pair exchanges up to {required} records"
-            )
-        else:
-            cap = capacity
+        cap = seg.bucket_size(required, minimum=8)
     else:
-        cap = capacity if capacity is not None else shard_size
+        cap = shard_size
 
-    return _build_distributed_step(mesh, axis_name, n_shards, shard_size, cap)(
-        stacked_cols
-    )
+    cell_out, gene_out, dropped = _build_distributed_step(
+        mesh, axis_name, n_shards, shard_size, cap
+    )(stacked_cols)
+    if not isinstance(dropped, jax.core.Tracer):
+        # eager call: surface any overflow loss immediately. Under an outer
+        # jit the counter is a tracer and cannot be read here — such callers
+        # compose reshard_by_key directly and own the check.
+        n_dropped = int(np.sum(np.asarray(dropped)))
+        if n_dropped:
+            raise RuntimeError(
+                f"reshard capacity={cap} too small: {n_dropped} records "
+                "were dropped in the all_to_all rekey; rerun with a larger "
+                "capacity (see required_reshard_capacity)"
+            )
+    return cell_out, gene_out
 
 
 @functools.lru_cache(maxsize=64)
@@ -234,7 +251,7 @@ def _build_distributed_step(
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(axis_name),),
-        out_specs=(P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P(axis_name), P(axis_name)),
         check_vma=False,
     )
     def step(local):
@@ -242,11 +259,13 @@ def _build_distributed_step(
         cell_out = compute_entity_metrics(
             local, num_segments=shard_size, kind="cell"
         )
-        regene = reshard_by_key(local, "gene", axis_name, n_shards, capacity=cap)
+        regene, dropped = reshard_by_key(
+            local, "gene", axis_name, n_shards, capacity=cap
+        )
         gene_out = compute_entity_metrics(
             regene, num_segments=n_shards * cap, kind="gene"
         )
-        return _expand_local(cell_out), _expand_local(gene_out)
+        return _expand_local(cell_out), _expand_local(gene_out), dropped[None]
 
     return jax.jit(step)
 

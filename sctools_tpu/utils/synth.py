@@ -15,6 +15,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..io.packed import pack_flags
 from ..ops.segments import bucket_size
 
 
@@ -26,11 +27,13 @@ def make_synthetic_columns(
     seed: int = 0,
     pad: bool = True,
 ) -> Dict[str, np.ndarray]:
-    """Random padded columns with the full metric-engine schema.
+    """Random padded columns with the packed metric-engine schema.
 
     Codes are drawn uniformly; ``gene`` code 0 plays the "no GE tag" role
-    (like the empty string sorting first in a vocabulary). Returns a dict
-    ready for metrics.device.compute_entity_metrics / parallel.partition_columns.
+    (like the empty string sorting first in a vocabulary). Narrow per-record
+    fields are packed into the int16 ``flags`` column exactly as
+    metrics.gatherer._pad_columns packs them. Returns a dict ready for
+    metrics.device.compute_entity_metrics / parallel.partition_columns.
     """
     rng = np.random.default_rng(seed)
     n_umis = n_umis if n_umis is not None else max(n_records // 4, 4)
@@ -51,25 +54,6 @@ def make_synthetic_columns(
         "gene": column(rng.integers(0, n_genes, n_records), np.int32),
         "ref": column(np.where(unmapped, -1, rng.integers(0, 4, n_records)), np.int32),
         "pos": column(np.where(unmapped, -1, rng.integers(0, 100_000, n_records)), np.int32),
-        "strand": column(rng.integers(0, 2, n_records), np.int32),
-        "unmapped": column(unmapped, bool),
-        "duplicate": column(rng.random(n_records) < 0.15, bool),
-        "spliced": column(rng.random(n_records) < 0.2, bool),
-        # XF codes 0..5 (consts.XF_*): mostly CODING/INTRONIC/UTR, some
-        # INTERGENIC and missing
-        "xf": column(
-            rng.choice([0, 1, 2, 3, 4], size=n_records, p=[0.05, 0.6, 0.15, 0.1, 0.1]),
-            np.int32,
-        ),
-        "nh": column(
-            rng.choice([1, 1, 1, 2, 4], size=n_records), np.int32, fill=-1
-        ),
-        "perfect_umi": column(
-            rng.choice([1, 1, 1, 0], size=n_records), np.int32, fill=-1
-        ),
-        "perfect_cb": column(
-            rng.choice([1, 1, 0, -1], size=n_records), np.int32, fill=-1
-        ),
         "umi_frac30": column(
             rng.random(n_records).astype(np.float32), np.float32
         ),
@@ -84,9 +68,24 @@ def make_synthetic_columns(
         ),
         "valid": valid,
     }
+    gene_codes = cols["gene"][:n_records]
     # a fixed slice of genes is "mitochondrial"
     is_mito_gene = np.zeros(max(n_genes, 1), dtype=bool)
     is_mito_gene[: max(n_genes // 16, 1)] = True
-    cols["is_mito"] = np.zeros(size, dtype=bool)
-    cols["is_mito"][:n_records] = is_mito_gene[cols["gene"][:n_records]]
+    flags = pack_flags(
+        strand=rng.integers(0, 2, n_records),
+        unmapped=unmapped,
+        duplicate=rng.random(n_records) < 0.15,
+        spliced=rng.random(n_records) < 0.2,
+        # XF codes 0..5 (consts.XF_*): mostly CODING/INTRONIC/UTR, some
+        # INTERGENIC and missing
+        xf=rng.choice(
+            [0, 1, 2, 3, 4], size=n_records, p=[0.05, 0.6, 0.15, 0.1, 0.1]
+        ),
+        perfect_umi=rng.choice([1, 1, 1, 0], size=n_records),
+        perfect_cb=rng.choice([1, 1, 0, -1], size=n_records),
+        nh=rng.choice([1, 1, 1, 2, 4], size=n_records),
+        is_mito=is_mito_gene[gene_codes],
+    )
+    cols["flags"] = column(flags, np.int16)
     return cols

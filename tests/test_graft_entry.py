@@ -1,6 +1,7 @@
 """The driver contract: entry() compiles and runs; dryrun_multichip executes."""
 
 import jax
+import numpy as np
 
 
 def test_entry_jits_and_runs():
@@ -24,11 +25,13 @@ def test_synthetic_columns_schema():
 
     cols = make_synthetic_columns(100, n_cells=8, n_genes=4, seed=1)
     assert cols["valid"].sum() == 100
+    # the packed device schema: narrow per-record fields ride the int16
+    # flags column (io.packed.pack_flags)
     required = {
-        "cell", "umi", "gene", "ref", "pos", "strand", "unmapped", "duplicate",
-        "spliced", "xf", "nh", "perfect_umi", "perfect_cb", "umi_frac30",
-        "cb_frac30", "genomic_frac30", "genomic_mean", "valid", "is_mito",
+        "cell", "umi", "gene", "ref", "pos", "flags", "umi_frac30",
+        "cb_frac30", "genomic_frac30", "genomic_mean", "valid",
     }
     assert required <= set(cols)
+    assert cols["flags"].dtype == np.int16
     n = len(cols["valid"])
     assert all(len(v) == n for v in cols.values())

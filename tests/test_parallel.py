@@ -138,8 +138,14 @@ def test_shard_count_mesh_mismatch_raises(padded_cols, mesh):
 
 
 def test_distributed_step_capacity_too_small_raises(padded_cols, mesh):
+    """An undersized reshard bucket raises via the on-device drop counter.
+
+    The capacity check cannot be a host-side assert (reshard_by_key runs
+    under jit on tracers); the counter travels out of the collective and the
+    step surfaces the loss instead of silently dropping records.
+    """
     stacked = partition_columns(padded_cols, N_DEVICES, key="cell")
-    with pytest.raises(ValueError, match="too small"):
+    with pytest.raises(RuntimeError, match="too small"):
         distributed_metrics_step(stacked, mesh, capacity=1)
 
 
