@@ -35,6 +35,189 @@ _DEFAULT_TAGS = (
     consts.GENE_NAME_TAG_KEY,
 )
 
+# alignments decoded per streaming batch (the reference's
+# alignments_per_batch memory knob, fastqpreprocessing/src/input_options.h:16)
+DEFAULT_BATCH_RECORDS = 1 << 19
+
+
+class _MoleculeAccumulator:
+    """Accumulates per-batch unique molecules; dedups across batches.
+
+    Each batch's device kernel emits the batch-local unique (cell, umi,
+    gene) triples. Codes are batch-local, so triples accumulate in a
+    batch-independent form: barcodes as order-preserving packed uint64
+    (io.packed.pack_barcode_u64 — the native decoder's own integer coding),
+    genes as global column indices, plus the global first-observation record
+    index. ~24 bytes per molecule — the reference's own memory model for
+    this stage (count.py:20-21: "48 bytes per molecule").
+
+    Barcodes that cannot pack (non-ACGTN, > 21 bases) get synthetic ids
+    above 2**63 from a side table; they dedup and order exactly like any
+    other value.
+    """
+
+    def __init__(self, gene_name_to_index: Dict[str, int]):
+        self._gene_name_to_index = gene_name_to_index
+        self._cells: List[np.ndarray] = []
+        self._umis: List[np.ndarray] = []
+        self._genes: List[np.ndarray] = []
+        self._firsts: List[np.ndarray] = []
+        self._irregular: Dict[str, int] = {}
+        self._irregular_names: List[str] = []
+
+    def _pack_names(self, names: List[str]) -> np.ndarray:
+        from .io.packed import IRREGULAR_BARCODE_BASE, pack_barcode_u64
+
+        out = np.empty(len(names), dtype=np.uint64)
+        for i, name in enumerate(names):
+            packed = pack_barcode_u64(name)
+            if packed is None:
+                code = self._irregular.get(name)
+                if code is None:
+                    code = int(IRREGULAR_BARCODE_BASE) + len(self._irregular_names)
+                    self._irregular[name] = code
+                    self._irregular_names.append(name)
+                packed = code
+            out[i] = packed
+        return out
+
+    def _name_of(self, packed: int) -> str:
+        from .io.packed import IRREGULAR_BARCODE_BASE, unpack_barcode_u64
+
+        if packed >= int(IRREGULAR_BARCODE_BASE):
+            return self._irregular_names[packed - int(IRREGULAR_BARCODE_BASE)]
+        return unpack_barcode_u64(packed)
+
+    def add_batch(self, frame, offset: int, pad_to: int = 0) -> None:
+        from .ops.counting import count_molecules
+
+        n = frame.n_records
+        if n == 0:
+            return
+        cols = device_count_columns(frame, pad_to=pad_to)
+        out = count_molecules(cols, num_segments=len(cols["valid"]))
+        is_molecule = np.asarray(out["is_molecule"])
+        cells = np.asarray(out["cell"])[is_molecule]
+        umis = np.asarray(out["umi"])[is_molecule]
+        genes = np.asarray(out["gene"])[is_molecule]
+        first = np.asarray(out["first_index"])[is_molecule].astype(np.int64)
+
+        gene_vocab_cols = np.asarray(
+            [
+                self._gene_name_to_index.get(name, -1)
+                for name in frame.gene_names
+            ],
+            dtype=np.int64,
+        )
+        gene_cols = gene_vocab_cols[genes]
+        if np.any(gene_cols < 0):
+            missing = {
+                frame.gene_names[g] for g in np.unique(genes[gene_cols < 0])
+            }
+            raise KeyError(
+                f"gene names not present in gene_name_to_index: "
+                f"{sorted(missing)[:5]}"
+            )
+        self._cells.append(self._pack_names(frame.cell_names)[cells])
+        self._umis.append(self._pack_names(frame.umi_names)[umis])
+        self._genes.append(gene_cols)
+        self._firsts.append(first + offset)
+
+    def assemble(self):
+        """Global dedup + matrix assembly (vectorized, one pass)."""
+        n_genes = len(self._gene_name_to_index)
+        if not self._cells:
+            return (
+                sp.csr_matrix((0, n_genes), dtype=np.uint32),
+                np.asarray([], dtype=str),
+            )
+        cells = np.concatenate(self._cells)
+        umis = np.concatenate(self._umis)
+        genes = np.concatenate(self._genes)
+        firsts = np.concatenate(self._firsts)
+
+        # cross-batch dedup: a triple seen in several batches (same cell and
+        # umi re-observed later in the file) counts once, with the earliest
+        # first-observation index (reference dedup set, count.py:297-306)
+        order = np.lexsort((firsts, umis, genes, cells))
+        cells, umis, genes, firsts = (
+            cells[order], umis[order], genes[order], firsts[order]
+        )
+        new = np.ones(len(cells), dtype=bool)
+        if len(cells) > 1:
+            new[1:] = (
+                (cells[1:] != cells[:-1])
+                | (genes[1:] != genes[:-1])
+                | (umis[1:] != umis[:-1])
+            )
+        cells, genes, firsts = cells[new], genes[new], firsts[new]
+
+        # row order = first observation in file order (reference
+        # count.py:319-329 assigns cell indices as cells appear):
+        # per-cell min first index, cells ordered by that minimum
+        unique_cells, inverse = np.unique(cells, return_inverse=True)
+        cell_min_first = np.full(len(unique_cells), np.iinfo(np.int64).max)
+        np.minimum.at(cell_min_first, inverse, firsts)
+        order = np.argsort(cell_min_first, kind="stable")
+        ordered_codes = unique_cells[order]
+        rank = np.empty(len(unique_cells), dtype=np.int64)
+        rank[order] = np.arange(len(unique_cells))
+        cell_rows = rank[inverse]
+
+        coordinate_matrix = sp.coo_matrix(
+            (np.ones(len(cell_rows), dtype=np.uint32), (cell_rows, genes)),
+            shape=(len(ordered_codes), n_genes),
+            dtype=np.uint32,
+        )
+        row_index = np.asarray(
+            [self._name_of(int(code)) for code in ordered_codes]
+        )
+        return coordinate_matrix.tocsr(), row_index
+
+
+def device_count_columns(frame, pad_to: int = 0) -> Dict[str, np.ndarray]:
+    """ReadFrame -> padded columns for ops.counting.count_molecules.
+
+    Host-side eligibility per alignment (reference count.py:264-268,
+    276-284): GE tag present, XF present and != INTERGENIC, gene name not a
+    multi-gene "a,b" string; plus CB/UB presence flags read from the
+    vocabulary (code of "" == missing tag).
+    """
+    from .ops.segments import bucket_size
+
+    n = frame.n_records
+    gene_names = np.asarray(frame.gene_names, dtype=object)
+    has_ge = gene_names != ""
+    multi_gene = np.asarray([("," in g) for g in frame.gene_names], dtype=bool)
+    xf = frame.xf.astype(np.int32)
+    eligible = (
+        (xf != consts.XF_MISSING)
+        & (xf != consts.XF_INTERGENIC)
+        & has_ge[frame.gene]
+        & ~multi_gene[frame.gene]
+    )
+    cb_ok = np.asarray(frame.cell_names, dtype=object)[frame.cell] != ""
+    ub_ok = np.asarray(frame.umi_names, dtype=object)[frame.umi] != ""
+
+    size = pad_to if pad_to >= n else bucket_size(n)
+
+    def pad(arr, fill=0):
+        arr = np.asarray(arr)
+        out = np.full(size, fill, dtype=arr.dtype)
+        out[:n] = arr
+        return out
+
+    return {
+        "qname": pad(frame.qname),
+        "cell": pad(frame.cell),
+        "umi": pad(frame.umi),
+        "gene": pad(frame.gene),
+        "eligible": pad(eligible, False),
+        "cb_ok": pad(cb_ok, False),
+        "ub_ok": pad(ub_ok, False),
+        "valid": np.arange(size) < n,
+    }
+
 
 class CountMatrix:
     def __init__(self, matrix: sp.csr_matrix, row_index: np.ndarray, col_index: np.ndarray):
@@ -66,6 +249,7 @@ class CountMatrix:
         gene_name_tag: str = consts.GENE_NAME_TAG_KEY,
         open_mode: str = "rb",
         backend: str = "device",
+        batch_records: int = DEFAULT_BATCH_RECORDS,
     ) -> "CountMatrix":
         """Count unique (cell, molecule, gene) triples from a tagged BAM.
 
@@ -74,22 +258,29 @@ class CountMatrix:
         exactly one eligible gene (GE present, XF present and != INTERGENIC,
         single-gene name), then count the (CB, UB, gene) triple once.
 
-        Unlike the reference — which requires (but does not check) a
-        queryname-sorted input and silently miscounts otherwise
-        (count.py:149-153) — the device backend groups by query name itself,
-        so any record order gives correct counts; the cpu backend keeps the
-        reference's adjacency requirement.
+        The device backend STREAMS: batches of ``batch_records`` alignments
+        decode into bounded host memory, each batch is cut at a query-name
+        boundary (the incomplete tail group carries into the next batch),
+        and the per-batch device kernel's unique triples accumulate as
+        packed integers that a final vectorized pass deduplicates across
+        batches — so a BAM of any size counts in O(batch + molecules)
+        memory, the reference's own memory model (count.py:20-21: ~48 bytes
+        per molecule). Custom tag keys stream through the Python decoder.
+
+        Input-order requirement: like the reference (count.py:149-153,
+        unchecked there too), a multi-batch input must keep all alignments
+        of one query ADJACENT (queryname-grouped) — the batch cut can only
+        respect adjacent groups, and a query split across batches would be
+        resolved per fragment. Inputs no larger than one batch need no
+        particular order (the kernel groups by query name itself).
         """
         if backend == "device":
-            # the packed decode reads the fixed 10x tag vocabulary; custom
-            # tag keys only work on the cpu backend for now
-            if (cell_barcode_tag, molecule_barcode_tag, gene_name_tag) != _DEFAULT_TAGS:
-                raise ValueError(
-                    "backend='device' supports only the default CB/UB/GE tag "
-                    "keys; use backend='cpu' for custom tags"
-                )
             return cls._from_bam_device(
-                bam_file, gene_name_to_index, open_mode=open_mode
+                bam_file,
+                gene_name_to_index,
+                open_mode=open_mode,
+                tag_keys=(cell_barcode_tag, molecule_barcode_tag, gene_name_tag),
+                batch_records=batch_records,
             )
         if backend == "cpu":
             return cls._from_bam_cpu(
@@ -104,94 +295,63 @@ class CountMatrix:
 
     @classmethod
     def _from_bam_device(
-        cls, bam_file: str, gene_name_to_index: Dict[str, int], open_mode: str = "rb"
+        cls,
+        bam_file: str,
+        gene_name_to_index: Dict[str, int],
+        open_mode: str = "rb",
+        tag_keys=_DEFAULT_TAGS,
+        batch_records: int = DEFAULT_BATCH_RECORDS,
     ) -> "CountMatrix":
-        from .io.packed import frame_from_bam
-        from .ops.counting import count_molecules
+        from .io.packed import (
+            compact_frame,
+            concat_frames,
+            iter_frames_from_bam,
+            slice_frame,
+        )
         from .ops.segments import bucket_size
 
-        frame = frame_from_bam(bam_file, open_mode if open_mode != "rb" else None)
-        n = frame.n_records
-        n_genes = len(gene_name_to_index)
-        if n == 0:
-            matrix = sp.csr_matrix((0, n_genes), dtype=np.uint32)
-            col_index = _col_index_from_map(gene_name_to_index)
-            return cls(matrix, np.asarray([], dtype=str), col_index)
-
-        gene_names = np.asarray(frame.gene_names, dtype=object)
-        has_ge = gene_names != ""
-        multi_gene = np.asarray([("," in g) for g in frame.gene_names], dtype=bool)
-        xf = frame.xf.astype(np.int32)
-        eligible = (
-            (xf != consts.XF_MISSING)
-            & (xf != consts.XF_INTERGENIC)
-            & has_ge[frame.gene]
-            & ~multi_gene[frame.gene]
+        accumulator = _MoleculeAccumulator(gene_name_to_index)
+        frames = iter_frames_from_bam(
+            bam_file,
+            batch_records,
+            open_mode if open_mode != "rb" else None,
+            want_qname=True,
+            tag_keys=tag_keys,
         )
-        cb_ok = np.asarray(frame.cell_names, dtype=object)[frame.cell] != ""
-        ub_ok = np.asarray(frame.umi_names, dtype=object)[frame.umi] != ""
-
-        size = bucket_size(n)
-
-        def pad(arr, fill=0):
-            arr = np.asarray(arr)
-            out = np.full(size, fill, dtype=arr.dtype)
-            out[:n] = arr
-            return out
-
-        cols = {
-            "qname": pad(frame.qname),
-            "cell": pad(frame.cell),
-            "umi": pad(frame.umi),
-            "gene": pad(frame.gene),
-            "eligible": pad(eligible, False),
-            "cb_ok": pad(cb_ok, False),
-            "ub_ok": pad(ub_ok, False),
-            "valid": np.arange(size) < n,
-        }
-        out = count_molecules(cols, num_segments=size)
-        is_molecule = np.asarray(out["is_molecule"])
-        cells = np.asarray(out["cell"])[is_molecule]
-        genes = np.asarray(out["gene"])[is_molecule]
-        first = np.asarray(out["first_index"])[is_molecule]
-
-        # row order = first observation in file order (reference
-        # count.py:319-329 assigns cell indices as cells appear), vectorized:
-        # per-cell min first_index, then cells ordered by that minimum
-        unique_cells, inverse = np.unique(cells, return_inverse=True)
-        cell_min_first = np.full(len(unique_cells), np.iinfo(np.int64).max)
-        np.minimum.at(cell_min_first, inverse, first.astype(np.int64))
-        order = np.argsort(cell_min_first, kind="stable")
-        ordered_codes = unique_cells[order]
-        # row of each molecule: rank of its cell in the ordered list
-        rank = np.empty(len(unique_cells), dtype=np.int64)
-        rank[order] = np.arange(len(unique_cells))
-        cell_rows = rank[inverse]
-
-        gene_vocab_cols = np.asarray(
-            [
-                gene_name_to_index[name] if name in gene_name_to_index else -1
-                for name in frame.gene_names
-            ],
-            dtype=np.int64,
-        )
-        gene_cols = gene_vocab_cols[genes]
-        if np.any(gene_cols < 0):
-            missing = {frame.gene_names[g] for g in np.unique(genes[gene_cols < 0])}
-            raise KeyError(
-                f"gene names not present in gene_name_to_index: {sorted(missing)[:5]}"
+        carry = None
+        offset = 0
+        multi_batch = False
+        for frame in frames:
+            if carry is not None:
+                frame = concat_frames(carry, frame)
+                carry = None
+            changes = np.nonzero(frame.qname[1:] != frame.qname[:-1])[0]
+            if changes.size == 0:
+                carry = frame  # one query group so far; keep accumulating
+                continue
+            # cut at the last query boundary inside the fixed capacity so
+            # alignments of one query never split across processed batches
+            # (the multi-gene resolution spans a whole query group) and the
+            # kernel compiles for one shape
+            capacity = bucket_size(batch_records)
+            multi_batch = multi_batch or frame.n_records >= batch_records
+            eligible = changes[changes < capacity]
+            cut = int((eligible if eligible.size else changes)[-1]) + 1
+            accumulator.add_batch(
+                slice_frame(frame, 0, cut),
+                offset,
+                pad_to=capacity if multi_batch else 0,
             )
-        coordinate_matrix = sp.coo_matrix(
-            (np.ones(len(cell_rows), dtype=np.uint32), (cell_rows, gene_cols)),
-            shape=(len(ordered_codes), n_genes),
-            dtype=np.uint32,
-        )
-        row_index = np.asarray([frame.cell_names[c] for c in ordered_codes])
-        return cls(
-            coordinate_matrix.tocsr(),
-            row_index,
-            _col_index_from_map(gene_name_to_index),
-        )
+            offset += cut
+            carry = compact_frame(slice_frame(frame, cut, frame.n_records))
+        if carry is not None and carry.n_records:
+            accumulator.add_batch(
+                carry,
+                offset,
+                pad_to=bucket_size(batch_records) if multi_batch else 0,
+            )
+        matrix, row_index = accumulator.assemble()
+        return cls(matrix, row_index, _col_index_from_map(gene_name_to_index))
 
     @classmethod
     def _from_bam_cpu(

@@ -54,6 +54,48 @@ FLAG_NH1_SHIFT = 11  # 1 bit: NH tag present and == 1
 FLAG_MITO = 1 << 12  # gene is mitochondrial (host vocabulary lookup)
 
 
+# 3-bit-per-base packed barcodes (the native decoder's scheme,
+# native/bamdecode.cpp kBaseCode): A=1 C=2 G=3 N=4 T=5, left-aligned in a
+# uint64, so integer order == byte-lexicographic string order and ""
+# (missing tag) packs to 0, sorting first. Strings that cannot pack
+# (non-ACGTN or > 21 bases) have no u64 form — callers assign synthetic ids
+# above 2**63 (all regular packings are < 5<<60 < 2**63).
+_BASE_CODE = {"A": 1, "C": 2, "G": 3, "N": 4, "T": 5}
+_CODE_BASE = {v: k for k, v in _BASE_CODE.items()}
+BARCODE_U64_MAX_LEN = 21
+IRREGULAR_BARCODE_BASE = np.uint64(1) << np.uint64(63)
+
+
+def pack_barcode_u64(value: str):
+    """Pack an ACGTN string (<= 21 bases) to its order-preserving uint64.
+
+    Returns None when the string cannot pack (caller assigns a synthetic
+    irregular id).
+    """
+    if len(value) > BARCODE_U64_MAX_LEN:
+        return None
+    packed = 0
+    shift = 60
+    for ch in value:
+        code = _BASE_CODE.get(ch)
+        if code is None:
+            return None
+        packed |= code << shift
+        shift -= 3
+    return packed
+
+
+def unpack_barcode_u64(packed: int) -> str:
+    """Inverse of pack_barcode_u64 for regular (non-synthetic) values."""
+    out = []
+    for shift in range(60, -1, -3):
+        code = (int(packed) >> shift) & 7
+        if code == 0:
+            break
+        out.append(_CODE_BASE[code])
+    return "".join(out)
+
+
 def pack_flags(
     strand: np.ndarray,
     unmapped: np.ndarray,
@@ -141,8 +183,21 @@ def _encode_column(values: List[str]):
     return codes.astype(np.int32), [str(v) for v in vocabulary]
 
 
-def frame_from_records(records: Iterable[BamRecord]) -> ReadFrame:
-    """Pack an iterable of BamRecords into a ReadFrame."""
+DEFAULT_TAG_KEYS = ("CB", "UB", "GE")
+
+
+def frame_from_records(
+    records: Iterable[BamRecord],
+    tag_keys: tuple = DEFAULT_TAG_KEYS,
+) -> ReadFrame:
+    """Pack an iterable of BamRecords into a ReadFrame.
+
+    ``tag_keys`` = (cell, molecule, gene) tag names; non-default keys feed
+    the cell/umi/gene columns from those tags instead (the reference's
+    --cell-barcode-tag/--molecule-barcode-tag/--gene-name-tag flags,
+    src/sctools/count.py:134-153). Perfect-barcode comparisons stay defined
+    against the 10x raw-tag pairs (CR/UR), which have no custom variants.
+    """
     cells: List[str] = []
     umis: List[str] = []
     genes: List[str] = []
@@ -162,13 +217,14 @@ def frame_from_records(records: Iterable[BamRecord]) -> ReadFrame:
     genomic_frac30: List[float] = []
     genomic_mean: List[float] = []
 
+    cb_key, ub_key, ge_key = tag_keys
     for record in records:
         tags = record.tags
-        cb = tags.get("CB", (None, ""))[1]
+        cb = tags.get(cb_key, (None, ""))[1]
         cr = tags.get("CR", (None, None))[1]
-        ub = tags.get("UB", (None, ""))[1]
+        ub = tags.get(ub_key, (None, ""))[1]
         ur = tags.get("UR", (None, None))[1]
-        ge = tags.get("GE", (None, ""))[1]
+        ge = tags.get(ge_key, (None, ""))[1]
         uy = tags.get("UY", (None, None))[1]
         cy = tags.get("CY", (None, None))[1]
         xf_value = tags.get("XF", (None, None))[1]
@@ -329,13 +385,16 @@ def iter_frames_from_bam(
     batch_records: int,
     mode: Optional[str] = None,
     want_qname: bool = False,
+    tag_keys: tuple = DEFAULT_TAG_KEYS,
 ):
     """Yield ReadFrames of <= batch_records alignments in file order.
 
     The bounded-memory decode path (native stream when available, Python
     AlignmentReader batching otherwise) — the TPU build's analog of the
     reference's alignments_per_batch streaming reads (htslib_tagsort.cpp:
-    308-393). Each frame has its own (sorted) vocabularies.
+    308-393). Each frame has its own (sorted) vocabularies. Non-default
+    ``tag_keys`` route through the Python decoder (the native parser reads
+    the fixed 10x tag set).
     """
     import itertools
 
@@ -343,6 +402,15 @@ def iter_frames_from_bam(
         # both backends would otherwise read 0 as clean EOF and yield an
         # empty-but-valid result for what is always a caller bug
         raise ValueError(f"batch_records must be >= 1, got {batch_records}")
+    if tuple(tag_keys) != DEFAULT_TAG_KEYS:
+        with AlignmentReader(path, mode) as reader:
+            records = iter(reader)
+            while True:
+                chunk = list(itertools.islice(records, batch_records))
+                if not chunk:
+                    break
+                yield frame_from_records(chunk, tag_keys=tuple(tag_keys))
+        return
 
     from . import bgzf
 

@@ -61,16 +61,18 @@ def _build() -> bool:
         )
         # the library is built -march=native: an up-to-date .so from another
         # machine (shared filesystem, container image) could carry illegal
-        # instructions for this CPU — rebuild when the host changed
+        # instructions for this CPU — force a rebuild when the host changed
+        # (make alone would see the foreign .so as fresh and do nothing)
+        force = False
         if not stale:
             try:
                 with open(marker) as f:
-                    stale = f.read().strip() != fingerprint
+                    force = f.read().strip() != fingerprint
             except OSError:
-                stale = True
-        if stale:
+                force = True
+        if stale or force:
             subprocess.run(
-                ["make", "-s", "-C", _DIR],
+                ["make", "-s", "-C", _DIR] + (["-B"] if force else []),
                 check=True,
                 capture_output=True,
                 timeout=300,

@@ -43,7 +43,8 @@ def count_molecules(cols: Dict[str, jnp.ndarray], num_segments: int):
 
     Returns [num_segments] arrays:
       - ``is_molecule``: marks entries; one per unique counted triple
-      - ``cell``, ``gene``: codes of the triple
+      - ``cell``, ``umi``, ``gene``: codes of the triple (umi lets streaming
+        callers re-deduplicate across batch boundaries)
       - ``first_index``: smallest original record index of any query group
         that yields the triple (reproduces the reference's
         first-observation cell ordering, count.py:319-329)
@@ -90,7 +91,7 @@ def count_molecules(cols: Dict[str, jnp.ndarray], num_segments: int):
     (d_keys, (d_first, d_keep)) = seg.lexsort(
         [mcell, mgene, mumi], [first_idx, keep]
     )
-    d_cell, d_gene, _ = d_keys
+    d_cell, d_gene, d_umi = d_keys
     triple_starts = seg.run_starts(list(d_keys))
     triple_ids = seg.segment_ids_from_starts(triple_starts)
     triple_first = seg.segment_min(
@@ -101,6 +102,7 @@ def count_molecules(cols: Dict[str, jnp.ndarray], num_segments: int):
     return {
         "is_molecule": is_molecule,
         "cell": d_cell,
+        "umi": d_umi,
         "gene": d_gene,
         "first_index": triple_first[triple_ids],
     }

@@ -11,6 +11,7 @@ metric passes run under ``shard_map``, and re-keying between entity axes is an
 
 from .mesh import make_mesh
 from .shard import partition_columns, shard_assignment
+from .count import sharded_count_molecules
 from .metrics import (
     collect_sharded_rows,
     distributed_metrics_step,
@@ -23,6 +24,7 @@ __all__ = [
     "make_mesh",
     "partition_columns",
     "shard_assignment",
+    "sharded_count_molecules",
     "sharded_entity_metrics",
     "reshard_by_key",
     "distributed_metrics_step",
