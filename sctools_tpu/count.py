@@ -321,22 +321,36 @@ class CountMatrix:
         carry = None
         offset = 0
         multi_batch = False
-        for frame in frames:
+        iterator = iter(frames)
+        frame = next(iterator, None)
+        while frame is not None:
             if carry is not None:
                 frame = concat_frames(carry, frame)
                 carry = None
+            following = next(iterator, None)
+            capacity = bucket_size(batch_records)
+            multi_batch = multi_batch or frame.n_records >= batch_records
+            if following is None:
+                # the FINAL frame processes whole: cutting it would split a
+                # non-adjacent query's alignments across kernel calls, and
+                # within one kernel call record order is free
+                accumulator.add_batch(
+                    frame, offset, pad_to=capacity if multi_batch else 0
+                )
+                break
             changes = np.nonzero(frame.qname[1:] != frame.qname[:-1])[0]
             if changes.size == 0:
                 carry = frame  # one query group so far; keep accumulating
+                frame = following
                 continue
             # cut at the last query boundary inside the fixed capacity so
             # alignments of one query never split across processed batches
             # (the multi-gene resolution spans a whole query group) and the
-            # kernel compiles for one shape
-            capacity = bucket_size(batch_records)
-            multi_batch = multi_batch or frame.n_records >= batch_records
+            # kernel compiles for one shape; when even the first group
+            # overflows capacity, cut right after it — the smallest batch
+            # that keeps the group intact
             eligible = changes[changes < capacity]
-            cut = int((eligible if eligible.size else changes)[-1]) + 1
+            cut = int(eligible[-1] if eligible.size else changes[0]) + 1
             accumulator.add_batch(
                 slice_frame(frame, 0, cut),
                 offset,
@@ -344,12 +358,7 @@ class CountMatrix:
             )
             offset += cut
             carry = compact_frame(slice_frame(frame, cut, frame.n_records))
-        if carry is not None and carry.n_records:
-            accumulator.add_batch(
-                carry,
-                offset,
-                pad_to=bucket_size(batch_records) if multi_batch else 0,
-            )
+            frame = following
         matrix, row_index = accumulator.assemble()
         return cls(matrix, row_index, _col_index_from_map(gene_name_to_index))
 

@@ -184,7 +184,10 @@ class MetricGatherer:
             capacity = bucket_size(self._batch_records)
             multi_batch = multi_batch or frame.n_records >= self._batch_records
             eligible = changes[changes < capacity]
-            cut = int((eligible if eligible.size else changes)[-1]) + 1
+            # when even the first entity overflows capacity, cut right after
+            # it — the smallest oversized batch that keeps it intact, rather
+            # than the whole accumulated frame
+            cut = int(eligible[-1] if eligible.size else changes[0]) + 1
             # dispatch is async: batch k+1 computes on the device while
             # batch k's rows transfer back and write below
             dispatched = self._dispatch_device_batch(

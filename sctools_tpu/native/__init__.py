@@ -136,6 +136,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_int,
         ]
+        lib.scx_tagsort.restype = ctypes.c_long
+        lib.scx_tagsort.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
         _lib = lib
         return _lib
 
@@ -322,6 +328,39 @@ def synth_bam_native(
             f"synth bam failed: {errbuf.value.decode(errors='replace')}"
         )
     return written
+
+
+def tagsort_native(
+    input_bam: str,
+    output_bam: str,
+    tag_keys,
+    batch_records: int = 500_000,
+    compress_level: int = 6,
+) -> int:
+    """Out-of-core tag sort in C++ (scx_tagsort). Returns records written.
+
+    Sorts by exactly three tag keys then query name — the reference
+    TagSort's key shape (htslib_tagsort.cpp TagOrder). Raises RuntimeError
+    when the native layer is unavailable or the key count differs (callers
+    fall back to the Python path).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    keys = list(tag_keys)
+    if len(keys) != 3 or any(len(k) != 2 for k in keys):
+        raise RuntimeError("native tagsort requires exactly three 2-char tags")
+    errbuf = ctypes.create_string_buffer(512)
+    n = lib.scx_tagsort(
+        input_bam.encode(), output_bam.encode(),
+        keys[0].encode(), keys[1].encode(), keys[2].encode(),
+        batch_records, compress_level, errbuf, ctypes.sizeof(errbuf),
+    )
+    if n < 0:
+        raise RuntimeError(
+            f"native tagsort failed: {errbuf.value.decode(errors='replace')}"
+        )
+    return n
 
 
 # ---------------------------------------------------------------- attach

@@ -52,6 +52,7 @@ def tag_sort_bam_out_of_core(
     output_bam: str,
     tag_keys: Sequence[str],
     records_per_chunk: int = DEFAULT_RECORDS_PER_CHUNK,
+    compress_level: int = 1,
 ) -> int:
     """Sort ``input_bam`` by tags then query name with bounded memory.
 
@@ -59,8 +60,40 @@ def tag_sort_bam_out_of_core(
     alignments_per_batch knob, input_options.h:16) plus one record per
     partial during the merge. Returns the number of records written.
     Single-chunk inputs skip the partial-file round trip entirely.
+
+    BAM inputs keyed on a permutation of the barcode/umi/gene string tags —
+    the reference TagSort's entire key domain (htslib_tagsort.cpp TagOrder's
+    six permutations) — sort through the native C++ path: raw record bytes,
+    no record objects, at native speed. Anything else (SAM input, other tag
+    keys — which may hold integer values whose Python ordering is numeric,
+    not lexicographic — or no toolchain) uses the Python chunked sort + heap
+    merge below; note the Python writer uses its own default compression,
+    so ``compress_level`` only shapes the native path's output.
     """
     tag_keys = list(tag_keys)
+    string_tags = {"CB", "CR", "UB", "UR", "GE", "SR"}
+    if (
+        len(tag_keys) == 3
+        and set(tag_keys) <= string_tags
+        and not input_bam.endswith(".sam")
+    ):
+        from . import native
+        from .io import bgzf
+
+        if bgzf.is_gzip(input_bam) and native.available():
+            try:
+                # level 1 default: a tag-sorted BAM is pipeline-intermediate
+                # (feeds metrics/counting); compression would otherwise
+                # dominate single-core wall time
+                return native.tagsort_native(
+                    input_bam,
+                    output_bam,
+                    tag_keys,
+                    batch_records=records_per_chunk,
+                    compress_level=compress_level,
+                )
+            except RuntimeError:
+                pass  # fall through to the Python path
     with tempfile.TemporaryDirectory(
         prefix="tagsort_", dir=os.path.dirname(os.path.abspath(output_bam)) or "."
     ) as tmpdir:
