@@ -333,7 +333,28 @@ class CountMatrix:
             if following is None:
                 # the FINAL frame processes whole: cutting it would split a
                 # non-adjacent query's alignments across kernel calls, and
-                # within one kernel call record order is free
+                # within one kernel call record order is free. If carry
+                # pile-up pushed it past the compiled capacity, cut at query
+                # boundaries first (adjacent in a multi-batch input by the
+                # documented requirement) so the one-kernel-shape invariant
+                # holds; only a single oversized group still overflows.
+                while frame.n_records > capacity:
+                    changes = np.nonzero(
+                        frame.qname[1:] != frame.qname[:-1]
+                    )[0]
+                    eligible = changes[changes < capacity]
+                    if not eligible.size:
+                        break
+                    cut = int(eligible[-1]) + 1
+                    accumulator.add_batch(
+                        slice_frame(frame, 0, cut),
+                        offset,
+                        pad_to=capacity if multi_batch else 0,
+                    )
+                    offset += cut
+                    frame = compact_frame(
+                        slice_frame(frame, cut, frame.n_records)
+                    )
                 accumulator.add_batch(
                     frame, offset, pad_to=capacity if multi_batch else 0
                 )

@@ -363,6 +363,180 @@ def tagsort_native(
     return n
 
 
+# ----------------------------------------------------------- fastqprocess
+
+def _load_fqp(lib) -> None:
+    if getattr(lib, "_fqp_bound", False):
+        return
+    lib.scx_fqp_open.restype = ctypes.c_void_p
+    lib.scx_fqp_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.scx_fqp_next.restype = ctypes.c_long
+    lib.scx_fqp_next.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.scx_fqp_buf.restype = ctypes.POINTER(ctypes.c_char)
+    lib.scx_fqp_buf.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.scx_fqp_len.restype = ctypes.c_int
+    lib.scx_fqp_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.scx_fqp_write.restype = ctypes.c_long
+    lib.scx_fqp_write.argtypes = [
+        ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.scx_fqp_stats.restype = None
+    lib.scx_fqp_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_long)]
+    lib.scx_fqp_close.restype = ctypes.c_int
+    lib.scx_fqp_close.argtypes = [ctypes.c_void_p]
+    lib.scx_fqp_error.restype = ctypes.c_char_p
+    lib.scx_fqp_error.argtypes = [ctypes.c_void_p]
+    lib.scx_fqp_free.restype = None
+    lib.scx_fqp_free.argtypes = [ctypes.c_void_p]
+    lib._fqp_bound = True
+
+
+def fastqprocess_native(
+    r1_files,
+    r2_files,
+    output_prefix: str,
+    cb_spans,
+    umi_spans,
+    sample_spans=None,
+    i1_files=None,
+    whitelist: Optional[str] = None,
+    n_shards: int = 1,
+    output_format: str = "BAM",
+    sample_id: str = "",
+    batch_size: int = 1 << 16,
+    compress_level: int = 6,
+) -> dict:
+    """The fastqprocess scatter: FASTQ triplets -> disjoint-barcode shards.
+
+    Native IO with device whitelist correction per batch (the reference
+    fastqprocess pipeline, fastq_common.cpp:362-414). Returns the
+    correction counter dict and prints the summary line the reference
+    prints at reader exit (fastq_common.cpp:356-359).
+    """
+    import sys as _sys
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    _load_fqp(lib)
+
+    corrector = None
+    if whitelist is not None:
+        from ..ops.whitelist import WhitelistCorrector
+
+        corrector = WhitelistCorrector.from_file(whitelist)
+
+    fmt = {"BAM": 0, "FASTQ": 1}.get(output_format.upper())
+    if fmt is None:
+        raise ValueError("output_format must be BAM or FASTQ")
+    cb_arr, n_cb = _spans_array(cb_spans)
+    umi_arr, n_umi = _spans_array(umi_spans)
+    sample_arr, n_sample = _spans_array(sample_spans)
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_fqp_open(
+        "\n".join(r1_files).encode(),
+        "\n".join(i1_files or []).encode(),
+        "\n".join(r2_files).encode(),
+        output_prefix.encode(), n_shards, fmt, sample_id.encode(),
+        cb_arr, n_cb, umi_arr, n_umi, sample_arr, n_sample,
+        compress_level, errbuf, ctypes.sizeof(errbuf),
+    )
+    if not handle:
+        raise RuntimeError(
+            f"fastqprocess open failed: {errbuf.value.decode(errors='replace')}"
+        )
+    failed = False
+    try:
+        cb_len = lib.scx_fqp_len(handle, b"cb")
+        if corrector is not None and cb_len != corrector.barcode_length:
+            raise RuntimeError(
+                f"whitelist barcode length {corrector.barcode_length} does "
+                f"not match the cell barcode span length {cb_len}"
+            )
+        while True:
+            n = lib.scx_fqp_next(handle, batch_size)
+            if n < 0:
+                raise RuntimeError(
+                    f"fastqprocess read failed: {lib.scx_fqp_error(handle).decode()}"
+                )
+            if n == 0:
+                break
+            cb_bytes = None
+            cb_mask = None
+            if corrector is not None and cb_len > 0:
+                raw = ctypes.string_at(lib.scx_fqp_buf(handle, b"cr"), n * cb_len)
+                queries = [
+                    raw[i * cb_len:(i + 1) * cb_len].rstrip(b"\0").decode("ascii")
+                    for i in range(n)
+                ]
+                corrected = corrector.correct(queries)
+                mask = bytearray(n)
+                fixed = bytearray(n * cb_len)
+                for i, value in enumerate(corrected):
+                    if value is not None:
+                        mask[i] = 1
+                        fixed[i * cb_len:(i + 1) * cb_len] = value.encode("ascii")
+                cb_bytes = bytes(fixed)
+                cb_mask = (ctypes.c_uint8 * n).from_buffer(mask)
+            written = lib.scx_fqp_write(handle, n, cb_bytes, cb_mask)
+            if written < 0:
+                raise RuntimeError(
+                    f"fastqprocess write failed: {lib.scx_fqp_error(handle).decode()}"
+                )
+        if lib.scx_fqp_close(handle) != 0:
+            raise RuntimeError("fastqprocess close failed")
+        stats_arr = (ctypes.c_long * 4)()
+        lib.scx_fqp_stats(handle, stats_arr)
+        stats = {
+            "total_reads": stats_arr[0],
+            "correct": stats_arr[1],
+            "corrected": stats_arr[2],
+            "uncorrectable": stats_arr[3],
+        }
+        if corrector is not None and stats["total_reads"]:
+            # the reference's reader-exit summary (fastq_common.cpp:356-359)
+            pct = stats["uncorrectable"] / stats["total_reads"] * 100.0
+            print(
+                f"Total barcodes:{stats['total_reads']}\n"
+                f" correct:{stats['correct']}\n"
+                f"corrected:{stats['corrected']}\n"
+                f"uncorrectible:{stats['uncorrectable']}\n"
+                f"uncorrected:{pct:f}",
+                file=_sys.stderr,
+            )
+        return stats
+    except BaseException:
+        failed = True
+        raise
+    finally:
+        lib.scx_fqp_free(handle)
+        if failed:
+            # never leave partial shard outputs that could read as complete;
+            # delete exactly the files this run creates (a glob could take
+            # unrelated files sharing the prefix with it)
+            if fmt == 1:
+                paths = [
+                    f"{output_prefix}_{r}_{i}.fastq.gz"
+                    for i in range(n_shards)
+                    for r in ("R1", "R2")
+                ]
+            else:
+                paths = [f"{output_prefix}_{i}.bam" for i in range(n_shards)]
+            for path in paths:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+
 # ---------------------------------------------------------------- attach
 
 def _load_attach(lib) -> None:

@@ -34,22 +34,12 @@ namespace {
 
 using scx::BgzfWriter;
 using scx::ByteStream;
-
-// --------------------------------------------------------------- spans
-
-struct Span {
-  int32_t start, end;
-};
-
-std::string extract_spans(const std::string& read, const std::vector<Span>& spans) {
-  std::string out;
-  for (const Span& span : spans) {
-    int32_t lo = std::min<int32_t>(span.start, read.size());
-    int32_t hi = std::min<int32_t>(span.end, read.size());
-    if (hi > lo) out.append(read, lo, hi - lo);
-  }
-  return out;
-}
+using scx::FastqRecord;
+using scx::Span;
+using scx::append_z_tag;
+using scx::extract_spans;
+using scx::fill_fixed;
+using scx::span_len;
 
 // --------------------------------------------------------------- handle
 
@@ -67,26 +57,12 @@ struct AttachHandle {
   std::vector<char> cr, cy, ur, uy, sr, sy;
 };
 
-int span_len(const std::vector<Span>& spans) {
-  int total = 0;
-  for (const Span& s : spans) total += s.end - s.start;
-  return total;
-}
-
-void fill_fixed(std::vector<char>& buffer, long index, int width,
-                const std::string& value) {
-  std::memset(buffer.data() + index * width, 0, width);
-  std::memcpy(buffer.data() + index * width, value.data(),
-              std::min<size_t>(width, value.size()));
-}
-
 // read one 4-line fastq record's sequence+quality; false at EOF
 bool next_fastq(ByteStream& stream, std::string& seq, std::string& qual) {
-  std::string name, plus;
-  if (!stream.read_line(name)) return false;
-  if (!stream.read_line(seq)) return false;
-  if (!stream.read_line(plus)) return false;
-  if (!stream.read_line(qual)) return false;
+  FastqRecord rec;
+  if (!scx::next_fastq(stream, rec)) return false;
+  seq = std::move(rec.seq);
+  qual = std::move(rec.qual);
   return true;
 }
 
@@ -130,15 +106,6 @@ bool copy_bam_header(AttachHandle& handle) {
     }
   }
   return true;
-}
-
-void append_z_tag(std::vector<uint8_t>& rec, const char* tag,
-                  const char* value, size_t len) {
-  rec.push_back(tag[0]);
-  rec.push_back(tag[1]);
-  rec.push_back('Z');
-  rec.insert(rec.end(), value, value + len);
-  rec.push_back('\0');
 }
 
 }  // namespace

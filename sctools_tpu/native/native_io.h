@@ -365,6 +365,73 @@ class BgzfWriter {
   libdeflate_compressor* compressor_ = nullptr;
 };
 
+// ---------------------------------------------------------- shared helpers
+// (used by attach.cpp, fastqprocess.cpp, synth.cpp — one definition so a
+// fix in one pipeline cannot silently miss the others)
+
+struct Span {
+  int32_t start, end;
+};
+
+inline std::string extract_spans(const std::string& read,
+                                 const std::vector<Span>& spans) {
+  std::string out;
+  for (const Span& span : spans) {
+    int32_t lo = std::min<int32_t>(span.start, read.size());
+    int32_t hi = std::min<int32_t>(span.end, read.size());
+    if (hi > lo) out.append(read, lo, hi - lo);
+  }
+  return out;
+}
+
+inline int span_len(const std::vector<Span>& spans) {
+  int total = 0;
+  for (const Span& s : spans) total += s.end - s.start;
+  return total;
+}
+
+inline void fill_fixed(std::vector<char>& buffer, long index, int width,
+                       const std::string& value) {
+  std::memset(buffer.data() + index * width, 0, width);
+  std::memcpy(buffer.data() + index * width, value.data(),
+              std::min<size_t>(width, value.size()));
+}
+
+inline void append_z_tag(std::vector<uint8_t>& rec, const char* tag,
+                         const char* value, size_t len) {
+  rec.push_back(tag[0]);
+  rec.push_back(tag[1]);
+  rec.push_back('Z');
+  rec.insert(rec.end(), value, value + len);
+  rec.push_back('\0');
+}
+
+inline void put_u32(std::vector<uint8_t>& out, uint32_t v) {
+  out.push_back(v & 0xff);
+  out.push_back((v >> 8) & 0xff);
+  out.push_back((v >> 16) & 0xff);
+  out.push_back((v >> 24) & 0xff);
+}
+
+struct FastqRecord {
+  std::string name, seq, qual;
+};
+
+// one 4-line record; name stripped of '@' and anything after a space
+template <class Stream>
+bool next_fastq(Stream& stream, FastqRecord& rec) {
+  std::string plus, name_line;
+  if (!stream.read_line(name_line)) return false;
+  if (!stream.read_line(rec.seq)) return false;
+  if (!stream.read_line(plus)) return false;
+  if (!stream.read_line(rec.qual)) return false;
+  size_t start = name_line.empty() ? 0 : (name_line[0] == '@' ? 1 : 0);
+  size_t space = name_line.find(' ', start);
+  rec.name = name_line.substr(
+      start, space == std::string::npos ? std::string::npos : space - start);
+  return true;
+}
+
 }  // namespace scx
 
 #endif  // SCTOOLS_NATIVE_IO_H_

@@ -211,7 +211,12 @@ struct RecordStream {
   // next record (4-byte size prefix INCLUDED in out); false at EOF
   bool next(std::vector<uint8_t>& out) {
     uint8_t size_buf[4];
-    if (!in.read_exact(size_buf, 4)) return false;
+    if (!in.read_exact(size_buf, 4)) {
+      // distinguish clean EOF from a mid-stream failure: the merge must
+      // not treat a corrupt partial as exhausted (silent truncation)
+      if (in.failed()) error = "truncated record";
+      return false;
+    }
     uint32_t block_size = read_u32(size_buf);
     if (block_size < 32) {
       error = "truncated record";
@@ -327,6 +332,8 @@ long scx_tagsort(const char* input, const char* output, const char* tag1,
   std::vector<uint8_t> arena;
   std::vector<Span> spans;
   std::vector<uint8_t> record;
+  std::vector<uint8_t> pending;  // one-record lookahead across batches
+  bool have_pending = false;
   std::string error;
   long total = 0;
   bool eof = false;
@@ -338,6 +345,12 @@ long scx_tagsort(const char* input, const char* output, const char* tag1,
   while (!eof) {
     arena.clear();
     spans.clear();
+    if (have_pending) {
+      spans.push_back({0, static_cast<uint32_t>(pending.size())});
+      arena = pending;
+      pending.clear();
+      have_pending = false;
+    }
     while (spans.size() < static_cast<size_t>(batch_records)) {
       long r = in.next_into(arena);
       if (r < 0) {
@@ -350,6 +363,19 @@ long scx_tagsort(const char* input, const char* output, const char* tag1,
       }
       spans.push_back({arena.size() - static_cast<size_t>(r),
                        static_cast<uint32_t>(r)});
+    }
+    if (!eof && spans.size() == static_cast<size_t>(batch_records)) {
+      // peek one record so an input of exactly N batches still takes the
+      // no-partials fast path instead of a 1-cursor merge round trip
+      long r = in.next_into(pending);
+      if (r < 0) {
+        cleanup();
+        return fail(in.error);
+      }
+      if (r == 0)
+        eof = true;
+      else
+        have_pending = true;
     }
     if (spans.empty()) break;
     if (!sort_batch(arena, spans, want, error)) {
