@@ -1,0 +1,26 @@
+# One-command CI gate (the role of the reference's CircleCI pipeline,
+# .circleci/config.yml:42-63: lint + pytest): native build, a compile-all
+# lint floor (ruff when installed — not part of this image), and the test
+# suite. `make ci` green == mergeable.
+
+PY ?= python
+
+.PHONY: ci native lint test clean
+
+ci: native lint test
+
+native:
+	$(MAKE) -C sctools_tpu/native
+
+lint:
+	@if $(PY) -c "import ruff" 2>/dev/null; then \
+		$(PY) -m ruff check sctools_tpu tests bench.py __graft_entry__.py; \
+	else \
+		$(PY) -m compileall -q sctools_tpu tests bench.py __graft_entry__.py; \
+	fi
+
+test:
+	$(PY) -m pytest tests/ -q
+
+clean:
+	$(MAKE) -C sctools_tpu/native clean

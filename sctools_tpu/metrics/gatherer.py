@@ -159,10 +159,21 @@ class MetricGatherer:
             raise
 
     def _stream_device_batches(self, frames, device_engine, out) -> None:
+        import sys
+
         carry: Optional[ReadFrame] = None
         pending = None  # previous batch, dispatched but not written
         multi_batch = False
+        processed = 0
+        next_progress = 10_000_000  # reference cadence (fastq_common.cpp:340)
         for frame in frames:
+            processed += frame.n_records
+            if processed >= next_progress:
+                print(
+                    f"[{type(self).__name__}] {processed} records decoded",
+                    file=sys.stderr,
+                )
+                next_progress += 10_000_000
             if carry is not None:
                 frame = concat_frames(carry, frame)
                 carry = None

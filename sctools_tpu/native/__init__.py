@@ -621,6 +621,8 @@ def attach_barcodes_native(
             f"attach open failed: {errbuf.value.decode(errors='replace')}"
         )
     total_written = 0
+    n_correct = n_corrected = n_uncorrectable = 0
+    next_progress = 10_000_000  # the reference's cadence (fastq_common.cpp:340)
     failed = False
     try:
         cb_len = lib.scx_attach_len(handle, b"cb")
@@ -654,6 +656,12 @@ def attach_barcodes_native(
                     if value is not None:
                         mask[i] = 1
                         fixed[i * cb_len:(i + 1) * cb_len] = value.encode("ascii")
+                        if value == queries[i]:
+                            n_correct += 1
+                        else:
+                            n_corrected += 1
+                    else:
+                        n_uncorrectable += 1
                 cb_bytes = bytes(fixed)
                 cb_mask = (ctypes.c_uint8 * n).from_buffer(mask)
             written = lib.scx_attach_write(handle, n, cb_bytes, cb_mask)
@@ -662,10 +670,29 @@ def attach_barcodes_native(
                     f"attach write failed: {lib.scx_attach_error(handle).decode()}"
                 )
             total_written += written
+            if total_written >= next_progress:
+                import sys as _sys
+
+                print(
+                    f"[attach] {total_written} reads processed",
+                    file=_sys.stderr,
+                )
+                next_progress += 10_000_000
             if written < n:
                 break  # u2 exhausted before the fastq (zip semantics)
         if lib.scx_attach_close(handle) != 0:
             raise RuntimeError("attach close failed")
+        if corrector is not None and total_written:
+            # the reference's reader-exit summary (fastq_common.cpp:356-359)
+            import sys as _sys
+
+            pct = n_uncorrectable / total_written * 100.0
+            print(
+                f"Total barcodes:{total_written}\n correct:{n_correct}\n"
+                f"corrected:{n_corrected}\nuncorrectible:{n_uncorrectable}\n"
+                f"uncorrected:{pct:f}",
+                file=_sys.stderr,
+            )
     except BaseException:
         failed = True
         raise

@@ -36,11 +36,13 @@ import jax.numpy as jnp
 import numpy as np
 
 _BASE_TO_COL = {"A": 0, "C": 1, "G": 2, "T": 3}
-# byte value -> one-hot column (A=0 C=1 G=2 T=3); 4 = no column (N / other)
+# byte value -> one-hot column (A=0 C=1 G=2 T=3); 4 = no column. Uppercase
+# ACGT only: the reference's mutation map is case-sensitive (barcode.py:
+# 310-335 enumerates uppercase substitutions), so a soft-masked 'acgt' base
+# must behave like N (zero row, cannot match), not like its uppercase base.
 _COL_LUT = np.full(256, 4, dtype=np.uint8)
 for _base, _col in _BASE_TO_COL.items():
     _COL_LUT[ord(_base)] = _col
-    _COL_LUT[ord(_base.lower())] = _col
 
 
 def onehot_barcodes(barcodes: Sequence[str], length: int) -> np.ndarray:
@@ -166,6 +168,12 @@ class WhitelistCorrector:
         self._whitelist = whitelist
         if use_pallas is None:
             use_pallas = jax.default_backend() == "tpu"
+        if self._length < 2:
+            # the Pallas path pads the whitelist with zero rows, which score
+            # 0 — below the L-1 threshold only when L >= 2. For L == 1 every
+            # pair is trivially within hamming distance 1 anyway; the
+            # unpadded jnp path computes that correctly.
+            use_pallas = False
         self._use_pallas = use_pallas
         self._interpret = interpret
         # padded once: the whitelist matrix is invariant across batches, and

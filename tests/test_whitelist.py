@@ -113,3 +113,26 @@ def test_length_mismatched_queries_never_correct(corrector, whitelist):
         None,
         whitelist[0],
     ]
+
+
+def test_lowercase_query_is_case_sensitive():
+    """Soft-masked bases act like N (the reference map is case-sensitive).
+
+    'acgt' differs from whitelist 'ACGT' at every position under byte
+    comparison; with all four rows zeroed it cannot be within distance 1.
+    A single soft-masked base behaves like a single N: correctable.
+    """
+    corrector = WhitelistCorrector(["ACGTA", "TTTTT"], use_pallas=False)
+    assert corrector.correct(["acgta"]) == [None]
+    assert corrector.correct(["aCGTA"]) == ["ACGTA"]  # one masked base == one N
+
+
+def test_length_one_whitelist_uses_unpadded_path():
+    """L == 1: every barcode is trivially within hamming distance 1; the
+    padded-row Pallas shortcut would be wrong, so it must not engage."""
+    corrector = WhitelistCorrector(["A", "C"], use_pallas=True)
+    assert corrector._use_pallas is False
+    # last whitelist entry within distance wins, even over an exact match —
+    # the reference dict overwrite semantics (host oracle agrees: 'A' -> 'C')
+    assert corrector.correct(["G"]) == ["C"]
+    assert corrector.correct(["A"]) == ["C"]
