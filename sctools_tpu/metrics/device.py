@@ -178,13 +178,15 @@ def _scatter_by_entity(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_segments", "kind", "presorted")
+    jax.jit,
+    static_argnames=("num_segments", "kind", "presorted", "compact_codes"),
 )
 def compute_entity_metrics(
     cols: Dict[str, jnp.ndarray],
     num_segments: int,
     kind: str = "cell",
     presorted: bool = False,
+    compact_codes: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """All metrics for one entity axis in a single compiled pass.
 
@@ -253,23 +255,60 @@ def compute_entity_metrics(
     # sums computed on sorted rows land on the right record-order segments.
     # (reference fragment key: (ref, pos, strand, tags), aggregator.py:299-
     # 303; molecule key: the tag triple, aggregator.py:95)
+    #
+    # ``compact_codes=True`` (gatherer batches: per-batch vocabularies, so
+    # every code < num_segments <= 2^20, and the caller host-checked
+    # ref < 2^30-1 and pos < 2^31-1) packs the 7 comparator operands into
+    # 4: hi = k1|k2-high, lo = k2-low|k3 (order-preserving), m_ref =
+    # mapped-last|ref+1, ps = pos<<1|strand (injective; the sort only needs
+    # ADJACENCY of equal fragment keys, not a particular order among
+    # different ones). XLA's O(n log^2 n) sort cost scales with operand
+    # count, so this trims the dominant device cost.
     mapped = valid & ~bits["unmapped"]
-    sorted_keys = jax.lax.sort(
-        [
-            k1,
-            k2,
-            k3,
-            jnp.where(mapped, 0, 1).astype(jnp.int32),
-            pad_key("ref"),
-            pad_key("pos"),
-            jnp.where(valid, bits["strand"], _I32_MAX),
-        ],
-        num_keys=7,
+    if compact_codes:
+        k1r = cols[key_names[0]].astype(jnp.int32)
+        k2r = cols[key_names[1]].astype(jnp.int32)
+        k3r = cols[key_names[2]].astype(jnp.int32)
+        hi = jnp.where(valid, (k1r << 10) | (k2r >> 10), _I32_MAX)
+        lo = jnp.where(valid, ((k2r & 0x3FF) << 20) | k3r, _I32_MAX)
+        m_ref = jnp.where(
+            valid,
+            jnp.where(mapped, 0, 1 << 30) + (cols["ref"].astype(jnp.int32) + 1),
+            _I32_MAX,
+        )
+        ps = jnp.where(
+            valid,
+            (cols["pos"].astype(jnp.int32) << 1) | bits["strand"],
+            _I32_MAX,
+        )
+        sorted_keys = jax.lax.sort([hi, lo, m_ref, ps], num_keys=4)
+        s_hi, s_lo, s_mref = sorted_keys[0], sorted_keys[1], sorted_keys[2]
+        s_valid = s_hi != _I32_MAX
+        s_mapped = s_valid & ((s_mref >> 30) == 0)
+        outer_sorted_keys = [s_hi >> 10]
+        triple_starts = seg.run_starts([s_hi, s_lo])
+        pair_starts = seg.run_starts([s_hi, s_lo >> 20])  # (k1, k2) runs
+    else:
+        sorted_keys = jax.lax.sort(
+            [
+                k1,
+                k2,
+                k3,
+                jnp.where(mapped, 0, 1).astype(jnp.int32),
+                pad_key("ref"),
+                pad_key("pos"),
+                jnp.where(valid, bits["strand"], _I32_MAX),
+            ],
+            num_keys=7,
+        )
+        s_valid = sorted_keys[0] != _I32_MAX
+        s_mapped = s_valid & (sorted_keys[3] == 0)
+        outer_sorted_keys = sorted_keys[:1]
+        triple_starts = seg.run_starts(sorted_keys[:3])
+        pair_starts = seg.run_starts(sorted_keys[:2])
+    s_outer_ids = seg.segment_ids_from_starts(
+        seg.run_starts(outer_sorted_keys)
     )
-    s_valid = sorted_keys[0] != _I32_MAX
-    s_mapped = s_valid & (sorted_keys[3] == 0)
-    s_outer_ids = seg.segment_ids_from_starts(seg.run_starts(sorted_keys[:1]))
-    triple_starts = seg.run_starts(sorted_keys[:3])
     triple_ids = seg.segment_ids_from_starts(triple_starts)
 
     out = _common_metrics(
@@ -315,7 +354,7 @@ def compute_entity_metrics(
         )
     else:
         out.update(
-            _gene_extras(sorted_keys, s_valid, s_outer_ids, num_segments)
+            _gene_extras(pair_starts, s_valid, s_outer_ids, num_segments)
         )
 
     n_entities = jnp.sum(
@@ -457,17 +496,16 @@ def compact_results(
 
 
 def _gene_extras(
-    sorted_keys,
+    pair_starts: jnp.ndarray,
     s_valid: jnp.ndarray,
     s_outer_ids: jnp.ndarray,
     num_segments: int,
 ) -> Dict[str, jnp.ndarray]:
     """The 2 gene-specific metrics (reference aggregator.py:561-595).
 
-    The key-only sorted side already provides (gene, cell) adjacency, so the
-    cells histogram falls out of run counting on its first two keys.
+    The key-only sorted side already provides (gene, cell) adjacency;
+    ``pair_starts`` marks its (k1, k2) run boundaries.
     """
-    pair_starts = seg.run_starts(sorted_keys[:2])
     pair_ids = seg.segment_ids_from_starts(pair_starts)
     number_cells_expressing = seg.distinct_runs_per_outer(
         pair_starts, s_outer_ids, num_segments, where=s_valid

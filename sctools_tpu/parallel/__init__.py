@@ -9,12 +9,13 @@ metric passes run under ``shard_map``, and re-keying between entity axes is an
 ``all_to_all`` collective over ICI instead of a new pass over files.
 """
 
-from .mesh import make_mesh
+from .mesh import make_hybrid_mesh, make_mesh
 from .shard import partition_columns, shard_assignment
 from .count import sharded_count_molecules
 from .metrics import (
     collect_sharded_rows,
     distributed_metrics_step,
+    hybrid_metrics_step,
     required_reshard_capacity,
     reshard_by_key,
     sharded_entity_metrics,
@@ -22,6 +23,8 @@ from .metrics import (
 
 __all__ = [
     "make_mesh",
+    "make_hybrid_mesh",
+    "hybrid_metrics_step",
     "partition_columns",
     "shard_assignment",
     "sharded_count_molecules",

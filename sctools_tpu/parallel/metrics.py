@@ -176,17 +176,21 @@ def _build_sharded_metrics(mesh, axis_name: str, shard_size: int, kind: str):
     return jax.jit(run)
 
 
-def _check_shard_count(n_shards: int, mesh: jax.sharding.Mesh, axis_name: str):
+def _check_shard_count(n_shards: int, mesh: jax.sharding.Mesh, axis_name):
     """A stacked batch must carry exactly one shard per mesh device.
 
     With a mismatch, shard_map would hand each device a [k>1, S] block whose
     trailing shards ``_squeeze_local`` silently discards — records would
-    vanish from the metrics with no error.
+    vanish from the metrics with no error. ``axis_name`` may be a tuple of
+    axes (hybrid meshes); the shard count must match their size product.
     """
-    mesh_size = mesh.shape[axis_name]
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    mesh_size = 1
+    for axis in axes:
+        mesh_size *= mesh.shape[axis]
     if n_shards != mesh_size:
         raise ValueError(
-            f"batch has {n_shards} shards but mesh axis {axis_name!r} has "
+            f"batch has {n_shards} shards but mesh axes {axes!r} hold "
             f"{mesh_size} devices; repartition with n_shards={mesh_size}"
         )
 
@@ -194,7 +198,7 @@ def _check_shard_count(n_shards: int, mesh: jax.sharding.Mesh, axis_name: str):
 def distributed_metrics_step(
     stacked_cols: Dict[str, np.ndarray],
     mesh: jax.sharding.Mesh,
-    axis_name: str = DEFAULT_AXIS,
+    axis_name=DEFAULT_AXIS,
     capacity: Optional[int] = None,
 ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
     """The full distributed pipeline step: cell AND gene metrics in one jit.
@@ -204,6 +208,12 @@ def distributed_metrics_step(
     on the gene-disjoint layout. This one function exercises every collective
     the framework's scatter-gather story needs and is what
     ``__graft_entry__.dryrun_multichip`` compiles over an N-device mesh.
+
+    ``axis_name`` may be one mesh axis or a TUPLE of axes: on a 2-D
+    (dcn, ici) mesh (make_hybrid_mesh) the step shards cells over the
+    flattened device grid and the gene rekey's all_to_all runs over both
+    axes jointly — XLA routes the intra-slice fraction over ICI and only
+    cross-slice records over DCN.
 
     ``capacity`` (per-(src,dst) reshard bucket) is computed tight from the
     concrete input when omitted, and falls back to the always-sufficient full
@@ -224,8 +234,9 @@ def distributed_metrics_step(
     else:
         cap = shard_size
 
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     cell_out, gene_out, dropped = _build_distributed_step(
-        mesh, axis_name, n_shards, shard_size, cap
+        mesh, axes, n_shards, shard_size, cap
     )(stacked_cols)
     if not isinstance(dropped, jax.core.Tracer):
         # eager call: surface any overflow loss immediately. Under an outer
@@ -243,15 +254,17 @@ def distributed_metrics_step(
 
 @functools.lru_cache(maxsize=64)
 def _build_distributed_step(
-    mesh, axis_name: str, n_shards: int, shard_size: int, cap: int
+    mesh, axes: tuple, n_shards: int, shard_size: int, cap: int
 ):
     """Compiled full pipeline step, cached per (mesh, shapes, capacity)."""
+    spec = P(axes if len(axes) > 1 else axes[0])
+    collective_axes = axes if len(axes) > 1 else axes[0]
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(axis_name),),
-        out_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        in_specs=(spec,),
+        out_specs=(spec, spec, spec),
         check_vma=False,
     )
     def step(local):
@@ -260,7 +273,7 @@ def _build_distributed_step(
             local, num_segments=shard_size, kind="cell"
         )
         regene, dropped = reshard_by_key(
-            local, "gene", axis_name, n_shards, capacity=cap
+            local, "gene", collective_axes, n_shards, capacity=cap
         )
         gene_out = compute_entity_metrics(
             regene, num_segments=n_shards * cap, kind="gene"
@@ -268,6 +281,27 @@ def _build_distributed_step(
         return _expand_local(cell_out), _expand_local(gene_out), dropped[None]
 
     return jax.jit(step)
+
+
+def hybrid_metrics_step(
+    stacked_cols: Dict[str, np.ndarray],
+    mesh: jax.sharding.Mesh,
+    capacity: Optional[int] = None,
+) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """The distributed step on a 2-D (dcn, ici) mesh (parallel.make_hybrid_mesh).
+
+    Cells shard over the FLATTENED (dcn, ici) device grid — per-device cell
+    metrics need no communication at all, the multi-slice scaling property
+    the reference gets from file-level scatter (SplitBam chunks across VMs).
+    A thin wrapper over ``distributed_metrics_step`` with the tuple axis:
+    the gene rekey's all_to_all runs over both axes jointly, so XLA routes
+    the intra-slice fraction over ICI and only cross-slice records over DCN.
+    Input layout: [n_slices * per_slice, S] columns, cell-partitioned with
+    parallel.shard.partition_columns(n_shards = total devices).
+    """
+    return distributed_metrics_step(
+        stacked_cols, mesh, axis_name=tuple(mesh.axis_names), capacity=capacity
+    )
 
 
 def collect_sharded_rows(

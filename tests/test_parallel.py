@@ -149,6 +149,29 @@ def test_distributed_step_capacity_too_small_raises(padded_cols, mesh):
         distributed_metrics_step(stacked, mesh, capacity=1)
 
 
+def test_hybrid_mesh_step_matches_single_device(padded_cols):
+    """The 2-D (dcn x ici) multi-slice layout reproduces single-device rows.
+
+    2 virtual slices x 4 chips: cell metrics stay communication-free on the
+    flattened grid; the gene rekey's all_to_all crosses both axes (the DCN
+    hop for cross-slice records). Ground truth is the 1-device engine.
+    """
+    from sctools_tpu.parallel import hybrid_metrics_step, make_hybrid_mesh
+
+    hybrid = make_hybrid_mesh(n_slices=2, devices_per_slice=4)
+    assert hybrid.axis_names == ("dcn", "shard")
+    stacked = partition_columns(padded_cols, 8, key="cell")
+    cell_result, gene_result = hybrid_metrics_step(stacked, hybrid)
+    got_cell = collect_sharded_rows(
+        {k: np.asarray(v) for k, v in cell_result.items()}
+    )
+    got_gene = collect_sharded_rows(
+        {k: np.asarray(v) for k, v in gene_result.items()}
+    )
+    _assert_rows_equal(got_cell, _single_device_rows(padded_cols, "cell"))
+    _assert_rows_equal(got_gene, _single_device_rows(padded_cols, "gene"))
+
+
 def test_sharded_count_matches_single_device(mesh):
     """Cell-sharded counting == single-device kernel on the same records.
 
