@@ -158,11 +158,17 @@ class MetricGatherer:
                 pass
             raise
 
+    # batches in flight on the device before the oldest result is pulled.
+    # Depth 2 lets the main thread prep + dispatch batch k+2 while k's pull
+    # waits behind k+1's upload on a shared (tunneled) host<->device link.
+    _PIPELINE_DEPTH = 2
+
     def _stream_device_batches(self, frames, device_engine, out) -> None:
         import sys
+        from collections import deque
 
         carry: Optional[ReadFrame] = None
-        pending = None  # previous batch, dispatched but not written
+        pending = deque()  # dispatched but not yet written
         multi_batch = False
         processed = 0
         next_progress = 10_000_000  # reference cadence (fastq_common.cpp:340)
@@ -199,30 +205,32 @@ class MetricGatherer:
             # it — the smallest oversized batch that keeps it intact, rather
             # than the whole accumulated frame
             cut = int(eligible[-1] if eligible.size else changes[0]) + 1
-            # dispatch is async: batch k+1 computes on the device while
-            # batch k's rows transfer back and write below
-            dispatched = self._dispatch_device_batch(
-                slice_frame(frame, 0, cut),
-                device_engine,
-                pad_to=capacity if multi_batch else 0,
+            # dispatch is async: later batches compute on the device while
+            # earlier rows transfer back and write below
+            pending.append(
+                self._dispatch_device_batch(
+                    slice_frame(frame, 0, cut),
+                    device_engine,
+                    pad_to=capacity if multi_batch else 0,
+                )
             )
-            if pending is not None:
-                self._finalize_device_batch(*pending, device_engine, out)
-            pending = dispatched
+            if len(pending) > self._PIPELINE_DEPTH:
+                self._finalize_device_batch(
+                    *pending.popleft(), device_engine, out
+                )
             # compact, or the carried vocabularies would accumulate the
             # union of every batch seen so far
             carry = compact_frame(slice_frame(frame, cut, frame.n_records))
         if carry is not None and carry.n_records:
-            dispatched = self._dispatch_device_batch(
-                carry,
-                device_engine,
-                pad_to=bucket_size(self._batch_records) if multi_batch else 0,
+            pending.append(
+                self._dispatch_device_batch(
+                    carry,
+                    device_engine,
+                    pad_to=bucket_size(self._batch_records) if multi_batch else 0,
+                )
             )
-            if pending is not None:
-                self._finalize_device_batch(*pending, device_engine, out)
-            pending = dispatched
-        if pending is not None:
-            self._finalize_device_batch(*pending, device_engine, out)
+        while pending:
+            self._finalize_device_batch(*pending.popleft(), device_engine, out)
 
     def _dispatch_device_batch(self, frame: ReadFrame, device_engine, pad_to: int):
         is_mito = np.asarray(
@@ -254,10 +262,12 @@ class MetricGatherer:
             presorted=True,
             compact_codes=compact,
         )
-        return frame, result, num_segments
+        # keep only what finalize reads: pinning the whole frame would hold
+        # ~40 MB of record arrays per in-flight batch for no reason
+        return self._entity_names(frame), result, num_segments
 
     def _finalize_device_batch(
-        self, frame: ReadFrame, result, num_segments: int, device_engine, out
+        self, entity_names, result, num_segments: int, device_engine, out
     ) -> None:
         # compact device->host transfer: pull only (a bucketed bound on) the
         # real entity rows, as two stacked arrays instead of 38 padded ones
@@ -271,7 +281,7 @@ class MetricGatherer:
             result, int_names, float_names, k
         )
         self._write_device_rows(
-            frame, n_entities, int_names, float_names,
+            entity_names, n_entities, int_names, float_names,
             np.asarray(ints), np.asarray(floats), out,
         )
 
@@ -284,7 +294,7 @@ class MetricGatherer:
 
     def _write_device_rows(
         self,
-        frame: ReadFrame,
+        entity_names,
         n_entities: int,
         int_names,
         float_names,
@@ -302,7 +312,7 @@ class MetricGatherer:
         """
         import pyarrow as pa
 
-        names = np.asarray(self._entity_names(frame), dtype=object)
+        names = np.asarray(entity_names, dtype=object)
         int_of = {n: i for i, n in enumerate(int_names)}
         float_of = {n: i for i, n in enumerate(float_names)}
         codes = ints[:n_entities, int_of["entity_code"]].astype(np.int64)
