@@ -166,3 +166,35 @@ def test_cli_entry_point(tmp_path):
     with AlignmentReader(prefix + "_0.bam") as reader:
         records = list(reader)
     assert len(records) == len(truth)
+
+
+def test_cli_read_structure(tmp_path):
+    """--read-structure drives split-span extraction (slide-seq DSL)."""
+    rng = random.Random(5)
+    wl = ["".join(rng.choice("ACGT") for _ in range(8)) for _ in range(4)]
+    wl_path = tmp_path / "wl.txt"
+    wl_path.write_text("\n".join(wl) + "\n")
+    r1, r2 = [], []
+    for i in range(30):
+        cb = rng.choice(wl)
+        umi = "".join(rng.choice("ACGT") for _ in range(6))
+        # layout 4C2X4C6M: cb split around a 2-base skip
+        seq = cb[:4] + "NN" + cb[4:] + umi
+        r1.append((f"s{i}", seq, "I" * len(seq)))
+        r2.append((f"s{i}", "ACGT" * 10, "F" * 40))
+    p1, p2 = tmp_path / "r1.fastq", tmp_path / "r2.fastq"
+    _write_fastq(p1, r1)
+    _write_fastq(p2, r2)
+    prefix = str(tmp_path / "rs")
+    rc = TenXV2.fastq_process([
+        "--r1", str(p1), "--r2", str(p2), "-w", str(wl_path),
+        "-o", prefix, "--read-structure", "4C2X4C6M",
+    ])
+    assert rc == 0
+    with AlignmentReader(prefix + "_0.bam") as reader:
+        records = list(reader)
+    assert len(records) == 30
+    for rec in records:
+        tags = {k: v for k, (_, v) in rec.tags.items()}
+        assert tags["CB"] in wl  # split spans reassembled + corrected exactly
+        assert len(tags["UR"]) == 6
