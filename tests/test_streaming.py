@@ -141,3 +141,27 @@ def test_slice_and_concat_roundtrip():
     np.testing.assert_array_equal(
         gene_names[frame.gene], merged_names[merged.gene]
     )
+
+
+def test_failed_device_run_removes_partial_csv(tmp_path, monkeypatch):
+    """A mid-stream failure must not leave a valid-looking partial CSV."""
+    import sctools_tpu.metrics.device as device_engine
+
+    records = []
+    for i in range(10):
+        records.append(
+            make_record(
+                name=f"e{i}", cb="AAAA" if i < 5 else "TTTT", ub="CCCC",
+                ur="CCCC", uy="IIII", ge="G1", xf="CODING", nh=1, pos=i,
+            )
+        )
+    bam = write_bam(str(tmp_path / "fail.bam"), records)
+    out = tmp_path / "partial.csv.gz"
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("injected device failure")
+
+    monkeypatch.setattr(device_engine, "compute_entity_metrics", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        GatherCellMetrics(bam, str(out), backend="device").extract_metrics()
+    assert not out.exists()
