@@ -81,6 +81,18 @@ class _MoleculeAccumulator:
             out[i] = packed
         return out
 
+    def _pack_used(self, codes: np.ndarray, names) -> np.ndarray:
+        """Pack only the vocabulary entries ``codes`` actually reference.
+
+        Per-batch vocabularies approach batch size (every distinct UMI);
+        molecules are ~4x fewer and their unique barcodes fewer still, so
+        packing at used-code cardinality keeps the per-character Python
+        loop off the streaming hot path.
+        """
+        unique = np.unique(codes)
+        packed = self._pack_names([names[int(code)] for code in unique])
+        return packed[np.searchsorted(unique, codes)]
+
     def _name_of(self, packed: int) -> str:
         from .io.packed import IRREGULAR_BARCODE_BASE, unpack_barcode_u64
 
@@ -118,8 +130,8 @@ class _MoleculeAccumulator:
                 f"gene names not present in gene_name_to_index: "
                 f"{sorted(missing)[:5]}"
             )
-        self._cells.append(self._pack_names(frame.cell_names)[cells])
-        self._umis.append(self._pack_names(frame.umi_names)[umis])
+        self._cells.append(self._pack_used(cells, frame.cell_names))
+        self._umis.append(self._pack_used(umis, frame.umi_names))
         self._genes.append(gene_cols)
         self._firsts.append(first + offset)
 

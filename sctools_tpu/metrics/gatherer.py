@@ -206,12 +206,17 @@ class MetricGatherer:
             # than the whole accumulated frame
             cut = int(eligible[-1] if eligible.size else changes[0]) + 1
             # dispatch is async: later batches compute on the device while
-            # earlier rows transfer back and write below
+            # earlier rows transfer back and write below. Ascending entity
+            # order is the presorted contract; grouped-but-unsorted input
+            # (e.g. samtools collate) falls back to the device-sorted path
+            # for the batch instead of mis-attributing sorted-side metrics.
+            ascending = bool(np.all(key[1:cut] >= key[: cut - 1]))
             pending.append(
                 self._dispatch_device_batch(
                     slice_frame(frame, 0, cut),
                     device_engine,
                     pad_to=capacity if multi_batch else 0,
+                    presorted=ascending,
                 )
             )
             if len(pending) > self._PIPELINE_DEPTH:
@@ -222,17 +227,23 @@ class MetricGatherer:
             # union of every batch seen so far
             carry = compact_frame(slice_frame(frame, cut, frame.n_records))
         if carry is not None and carry.n_records:
+            tail_key = (
+                carry.cell if self.entity_kind == "cell" else carry.gene
+            )
             pending.append(
                 self._dispatch_device_batch(
                     carry,
                     device_engine,
                     pad_to=bucket_size(self._batch_records) if multi_batch else 0,
+                    presorted=bool(np.all(tail_key[1:] >= tail_key[:-1])),
                 )
             )
         while pending:
             self._finalize_device_batch(*pending.popleft(), device_engine, out)
 
-    def _dispatch_device_batch(self, frame: ReadFrame, device_engine, pad_to: int):
+    def _dispatch_device_batch(
+        self, frame: ReadFrame, device_engine, pad_to: int, presorted: bool = True
+    ):
         is_mito = np.asarray(
             [name in self._mitochondrial_gene_ids for name in frame.gene_names],
             dtype=bool,
@@ -242,11 +253,13 @@ class MetricGatherer:
         # the input BAM is sorted by the entity tag triple (the documented
         # precondition, reference gatherer.py:91-95) and vocabulary codes
         # preserve string order, so batches are presorted: the device pass
-        # skips its primary sort entirely. When every code and coordinate
-        # fits the packed-key bit budget the sort runs on 4 packed operands
-        # instead of 7. The code maxima are checked EXPLICITLY: a dispatched
-        # slice shares its parent's concat-merged vocabulary, which can
-        # exceed the slice's own record count, so record count is no bound.
+        # skips its primary sort entirely; the caller verifies ascending
+        # entity order per batch and passes presorted=False otherwise. When
+        # every code and coordinate fits the packed-key bit budget the sort
+        # runs on 4 packed operands instead of 7. The code maxima are
+        # checked EXPLICITLY: a dispatched slice shares its parent's
+        # concat-merged vocabulary, which can exceed the slice's own record
+        # count, so record count is no bound.
         code_cap = 1 << 20
         compact = frame.n_records > 0 and (
             int(frame.cell.max(initial=0)) < code_cap
@@ -259,7 +272,7 @@ class MetricGatherer:
             {k: np.asarray(v) for k, v in cols.items()},
             num_segments=num_segments,
             kind=self.entity_kind,
-            presorted=True,
+            presorted=presorted,
             compact_codes=compact,
         )
         # keep only what finalize reads: pinning the whole frame would hold
@@ -288,9 +301,9 @@ class MetricGatherer:
     def _entity_names(self, frame: ReadFrame) -> List[str]:
         return frame.cell_names if self.entity_kind == "cell" else frame.gene_names
 
-    def _row_filter(self, name: str) -> bool:
-        """Whether to emit a row for this entity (gene path drops multi-genes)."""
-        return True
+    def _filter_rows(self, names: np.ndarray):
+        """Vectorized row mask (None = keep all); gene path drops multi-genes."""
+        return None
 
     def _write_device_rows(
         self,
@@ -317,9 +330,9 @@ class MetricGatherer:
         float_of = {n: i for i, n in enumerate(float_names)}
         codes = ints[:n_entities, int_of["entity_code"]].astype(np.int64)
         row_names = names[codes]
-        keep = np.asarray(
-            [self._row_filter(name) for name in row_names], dtype=bool
-        )
+        keep = self._filter_rows(row_names)
+        if keep is None:
+            keep = slice(None)
         index = np.where(row_names == "", "None", row_names)[keep]
         arrays = [pa.array(index.astype(str))]
         for column in self.columns:
@@ -379,10 +392,10 @@ class GatherGeneMetrics(MetricGatherer):
     entity_kind = "gene"
     columns = GENE_COLUMNS
 
-    def _row_filter(self, name: str) -> bool:
-        # multi-gene groups are skipped entirely, like the counting stage
-        # (reference gatherer.py:211-212)
-        return not (name and len(name.split(",")) > 1)
+    def _filter_rows(self, names: np.ndarray):
+        # multi-gene "a,b" groups are skipped entirely, like the counting
+        # stage (reference gatherer.py:211-212); vectorized comma scan
+        return np.char.find(names.astype(str), ",") < 0
 
     def _extract_cpu(self, mode: str = "rb") -> None:
         with AlignmentReader(self._bam_file, mode if mode != "rb" else None) as bam_iterator, closing(

@@ -363,6 +363,29 @@ def tagsort_native(
     return n
 
 
+def _correct_batch(corrector, raw: bytes, n: int, cb_len: int):
+    """Run device whitelist correction over one fixed-width barcode buffer.
+
+    Returns (queries, corrected, cb_bytes, cb_mask): the decoded raw
+    barcodes, the per-row corrected values (None = uncorrectable), and the
+    fixed-width byte buffer + mask handed back to the native writer.
+    Shared by the attach and fastqprocess pipelines so the batch-correction
+    logic cannot drift between them.
+    """
+    queries = [
+        raw[i * cb_len:(i + 1) * cb_len].rstrip(b"\0").decode("ascii")
+        for i in range(n)
+    ]
+    corrected = corrector.correct(queries)
+    mask = bytearray(n)
+    fixed = bytearray(n * cb_len)
+    for i, value in enumerate(corrected):
+        if value is not None:
+            mask[i] = 1
+            fixed[i * cb_len:(i + 1) * cb_len] = value.encode("ascii")
+    return queries, corrected, bytes(fixed), (ctypes.c_uint8 * n).from_buffer(mask)
+
+
 # ----------------------------------------------------------- fastqprocess
 
 def _load_fqp(lib) -> None:
@@ -473,19 +496,9 @@ def fastqprocess_native(
             cb_mask = None
             if corrector is not None and cb_len > 0:
                 raw = ctypes.string_at(lib.scx_fqp_buf(handle, b"cr"), n * cb_len)
-                queries = [
-                    raw[i * cb_len:(i + 1) * cb_len].rstrip(b"\0").decode("ascii")
-                    for i in range(n)
-                ]
-                corrected = corrector.correct(queries)
-                mask = bytearray(n)
-                fixed = bytearray(n * cb_len)
-                for i, value in enumerate(corrected):
-                    if value is not None:
-                        mask[i] = 1
-                        fixed[i * cb_len:(i + 1) * cb_len] = value.encode("ascii")
-                cb_bytes = bytes(fixed)
-                cb_mask = (ctypes.c_uint8 * n).from_buffer(mask)
+                _, _, cb_bytes, cb_mask = _correct_batch(
+                    corrector, raw, n, cb_len
+                )
             written = lib.scx_fqp_write(handle, n, cb_bytes, cb_mask)
             if written < 0:
                 raise RuntimeError(
@@ -641,34 +654,31 @@ def attach_barcodes_native(
                 break
             cb_bytes = None
             cb_mask = None
+            queries = corrected = None
             if corrector is not None and cb_len > 0:
                 raw = ctypes.string_at(
                     lib.scx_attach_buf(handle, b"cr"), n * cb_len
                 )
-                queries = [
-                    raw[i * cb_len:(i + 1) * cb_len].rstrip(b"\0").decode("ascii")
-                    for i in range(n)
-                ]
-                corrected = corrector.correct(queries)
-                mask = bytearray(n)
-                fixed = bytearray(n * cb_len)
-                for i, value in enumerate(corrected):
-                    if value is not None:
-                        mask[i] = 1
-                        fixed[i * cb_len:(i + 1) * cb_len] = value.encode("ascii")
-                        if value == queries[i]:
-                            n_correct += 1
-                        else:
-                            n_corrected += 1
-                    else:
-                        n_uncorrectable += 1
-                cb_bytes = bytes(fixed)
-                cb_mask = (ctypes.c_uint8 * n).from_buffer(mask)
+                queries, corrected, cb_bytes, cb_mask = _correct_batch(
+                    corrector, raw, n, cb_len
+                )
             written = lib.scx_attach_write(handle, n, cb_bytes, cb_mask)
             if written < 0:
                 raise RuntimeError(
                     f"attach write failed: {lib.scx_attach_error(handle).decode()}"
                 )
+            if corrected is not None:
+                # count only the records actually written: the final batch
+                # can truncate when u2 runs out before the fastq (zip
+                # semantics), and the summary must stay consistent with
+                # Total barcodes
+                for value, query in zip(corrected[:written], queries[:written]):
+                    if value is None:
+                        n_uncorrectable += 1
+                    elif value == query:
+                        n_correct += 1
+                    else:
+                        n_corrected += 1
             total_written += written
             if total_written >= next_progress:
                 import sys as _sys

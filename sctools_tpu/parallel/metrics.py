@@ -226,13 +226,22 @@ def distributed_metrics_step(
     n_shards, shard_size = stacked_cols["cell"].shape
     _check_shard_count(n_shards, mesh, axis_name)
     concrete = not isinstance(stacked_cols["gene"], jax.core.Tracer)
-    if capacity is not None:
-        cap = capacity
-    elif concrete:
+    if concrete:
+        # cheap host-side pre-flight: an undersized explicit capacity fails
+        # BEFORE the device pass runs (the on-device drop counter still
+        # backstops tracer inputs, where this check cannot see the data)
         required = required_reshard_capacity(stacked_cols, "gene", n_shards)
-        cap = seg.bucket_size(required, minimum=8)
+        if capacity is None:
+            cap = seg.bucket_size(required, minimum=8)
+        elif capacity < required:
+            raise ValueError(
+                f"reshard capacity={capacity} too small: a (src,dst) shard "
+                f"pair exchanges up to {required} records"
+            )
+        else:
+            cap = capacity
     else:
-        cap = shard_size
+        cap = capacity if capacity is not None else shard_size
 
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     cell_out, gene_out, dropped = _build_distributed_step(

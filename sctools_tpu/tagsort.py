@@ -81,19 +81,20 @@ def tag_sort_bam_out_of_core(
         from .io import bgzf
 
         if bgzf.is_gzip(input_bam) and native.available():
-            try:
-                # level 1 default: a tag-sorted BAM is pipeline-intermediate
-                # (feeds metrics/counting); compression would otherwise
-                # dominate single-core wall time
-                return native.tagsort_native(
-                    input_bam,
-                    output_bam,
-                    tag_keys,
-                    batch_records=records_per_chunk,
-                    compress_level=compress_level,
-                )
-            except RuntimeError:
-                pass  # fall through to the Python path
+            # level 1 default: a tag-sorted BAM is pipeline-intermediate
+            # (feeds metrics/counting); compression would otherwise dominate
+            # single-core wall time. Native errors PROPAGATE: the input gate
+            # above already covers every fall-back-able condition, and a
+            # real failure (malformed tags, truncated input, disk full)
+            # would only fail again — slower and less specifically — on the
+            # Python path.
+            return native.tagsort_native(
+                input_bam,
+                output_bam,
+                tag_keys,
+                batch_records=records_per_chunk,
+                compress_level=compress_level,
+            )
     with tempfile.TemporaryDirectory(
         prefix="tagsort_", dir=os.path.dirname(os.path.abspath(output_bam)) or "."
     ) as tmpdir:

@@ -138,15 +138,48 @@ def test_shard_count_mesh_mismatch_raises(padded_cols, mesh):
 
 
 def test_distributed_step_capacity_too_small_raises(padded_cols, mesh):
-    """An undersized reshard bucket raises via the on-device drop counter.
-
-    The capacity check cannot be a host-side assert (reshard_by_key runs
-    under jit on tracers); the counter travels out of the collective and the
-    step surfaces the loss instead of silently dropping records.
-    """
+    """Concrete input: an undersized capacity fails in the pre-flight check
+    before any device work runs."""
     stacked = partition_columns(padded_cols, N_DEVICES, key="cell")
-    with pytest.raises(RuntimeError, match="too small"):
+    with pytest.raises(ValueError, match="too small"):
         distributed_metrics_step(stacked, mesh, capacity=1)
+
+
+def test_reshard_overflow_counter_counts_drops(padded_cols, mesh):
+    """Under jit (tracers), the on-device drop counter is the backstop: it
+    must report exactly the records an undersized bucket loses."""
+    import functools
+
+    import jax
+    from sctools_tpu.parallel import reshard_by_key
+    from sctools_tpu.parallel.metrics import P
+
+    stacked = partition_columns(padded_cols, N_DEVICES, key="cell")
+    for capacity in (1, None):
+
+        @functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P("shard"),),
+            out_specs=(P("shard"), P("shard")),
+            check_vma=False,
+        )
+        def run(local):
+            local = {k: v[0] for k, v in local.items()}
+            out, dropped = reshard_by_key(
+                local, "gene", "shard", N_DEVICES, capacity=capacity
+            )
+            return {"valid": out["valid"][None]}, dropped[None]
+
+        out, dropped = jax.jit(run)(stacked)
+        n_in = int(np.sum(stacked["valid"]))
+        n_out = int(np.sum(np.asarray(out["valid"])))
+        n_dropped = int(np.sum(np.asarray(dropped)))
+        assert n_out + n_dropped == n_in
+        if capacity == 1:
+            assert n_dropped > 0
+        else:
+            assert n_dropped == 0
 
 
 def test_hybrid_mesh_step_matches_single_device(padded_cols):

@@ -165,3 +165,28 @@ def test_failed_device_run_removes_partial_csv(tmp_path, monkeypatch):
     with pytest.raises(RuntimeError, match="injected"):
         GatherCellMetrics(bam, str(out), backend="device").extract_metrics()
     assert not out.exists()
+
+
+def test_grouped_but_descending_input_matches_cpu(tmp_path):
+    """Grouped-but-unsorted (descending) entities fall back to the device
+    sort instead of mis-attributing the sorted-side metrics."""
+    records = []
+    for cb in ("TTTT", "GGGG", "AAAA"):  # descending group order
+        for i in range(6):
+            records.append(
+                make_record(
+                    name=f"{cb}_{i}", cb=cb, cr=cb, cy="IIII",
+                    ub=f"CC{'AG'[i % 2]}C", ur=f"CC{'AG'[i % 2]}C",
+                    uy="IIII", ge="G1", xf="CODING", nh=1, pos=100 + i,
+                )
+            )
+    bam = write_bam(str(tmp_path / "desc.bam"), records)
+    dev = tmp_path / "dev.csv.gz"
+    cpu = tmp_path / "cpu.csv.gz"
+    GatherCellMetrics(bam, str(dev), backend="device").extract_metrics()
+    GatherCellMetrics(bam, str(cpu), backend="cpu").extract_metrics()
+    import pandas as pd
+
+    d = pd.read_csv(dev, index_col=0).sort_index()
+    c = pd.read_csv(cpu, index_col=0).sort_index()
+    pd.testing.assert_frame_equal(d, c, rtol=1e-5, atol=1e-6, check_dtype=False)
