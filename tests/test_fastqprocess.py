@@ -198,3 +198,28 @@ def test_cli_read_structure(tmp_path):
         tags = {k: v for k, (_, v) in rec.tags.items()}
         assert tags["CB"] in wl  # split spans reassembled + corrected exactly
         assert len(tags["UR"]) == 6
+
+
+def test_check_barcode_partition_cli(tmp_path):
+    """The partition validator passes on scatter output and fails on overlap."""
+    from sctools_tpu.platform import GenericPlatform
+
+    r1s, r2s, i1s, whitelist, truth = _make_inputs(tmp_path, n_triplets=1)
+    prefix = str(tmp_path / "part")
+    native.fastqprocess_native(
+        r1_files=r1s, r2_files=r2s, output_prefix=prefix,
+        cb_spans=[(0, CB_LEN)], umi_spans=[(CB_LEN, CB_LEN + UMI_LEN)],
+        whitelist=whitelist, n_shards=3, output_format="BAM",
+    )
+    shards = [f"{prefix}_{s}.bam" for s in range(3)]
+    assert GenericPlatform.check_barcode_partition(["-b", *shards]) == 0
+    # the same file twice => every barcode spans "two" files
+    assert (
+        GenericPlatform.check_barcode_partition(["-b", shards[0], shards[0]])
+        == 0  # identical path is the same file, not a violation
+    )
+    import shutil
+
+    dup = str(tmp_path / "dup.bam")
+    shutil.copy(shards[0], dup)
+    assert GenericPlatform.check_barcode_partition(["-b", shards[0], dup]) == 1
