@@ -180,17 +180,23 @@ def bench_cpu_baseline(bam_path: str) -> float:
             ge = record.tags.get("GE", (None, None))[1]
             molecules.setdefault(ub, {}).setdefault(ge, []).append(record)
 
-    start = time.perf_counter()
-    n_cells = 0
-    for cb, molecules in groups:
-        agg = CellMetrics()
-        for ub, genes in molecules.items():
-            for ge, records in genes.items():
-                agg.parse_molecule(tags=(cb, ub, ge), records=iter(records))
-        agg.finalize(mitochondrial_genes=set())
-        n_cells += 1
-    elapsed = time.perf_counter() - start
-    return n_cells / elapsed
+    import statistics
+
+    def one_run() -> float:
+        start = time.perf_counter()
+        n_cells = 0
+        for cb, molecules in groups:
+            agg = CellMetrics()
+            for ub, genes in molecules.items():
+                for ge, records in genes.items():
+                    agg.parse_molecule(tags=(cb, ub, ge), records=iter(records))
+            agg.finalize(mitochondrial_genes=set())
+            n_cells += 1
+        return n_cells / (time.perf_counter() - start)
+
+    # median of 3: the shared 1-core VM's load swings the Python loop too,
+    # and baseline noise moves the reported ratio directly
+    return statistics.median(one_run() for _ in range(3))
 
 
 def main():
