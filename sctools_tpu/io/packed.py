@@ -53,6 +53,20 @@ FLAG_PCB_SHIFT = 9  # 2 bits: same encoding
 FLAG_NH1_SHIFT = 11  # 1 bit: NH tag present and == 1
 FLAG_MITO = 1 << 12  # gene is mitochondrial (host vocabulary lookup)
 
+# Packed device-sort key layout, shared by the host packer
+# (metrics.gatherer._pad_columns) and the device unpacker
+# (metrics.device.compute_entity_metrics, prepacked=True) so the two sides
+# cannot drift: three codes < 2^KEY_CODE_BITS ride two i32 operands as
+#   key_hi = k1 << KEY_HI_SHIFT | k2 >> KEY_HI_SHIFT
+#   key_lo = (k2 & KEY_LO_MASK) << KEY_CODE_BITS | k3
+# plus m_ref = mapped-last << KEY_UNMAPPED_SHIFT | (ref+1) and
+# ps = pos << 1 | strand (injective for the host-checked ranges).
+KEY_CODE_BITS = 20
+KEY_HI_SHIFT = 10
+KEY_LO_MASK = (1 << KEY_HI_SHIFT) - 1
+KEY_CODE_MASK = (1 << KEY_CODE_BITS) - 1
+KEY_UNMAPPED_SHIFT = 30
+
 
 # 3-bit-per-base packed barcodes (the native decoder's scheme,
 # native/bamdecode.cpp kBaseCode): A=1 C=2 G=3 N=4 T=5, left-aligned in a

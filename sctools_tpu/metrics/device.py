@@ -48,6 +48,11 @@ from ..io.packed import (
     FLAG_PCB_SHIFT,
     FLAG_PUMI_SHIFT,
     FLAG_XF_SHIFT,
+    KEY_CODE_BITS,
+    KEY_CODE_MASK,
+    KEY_HI_SHIFT,
+    KEY_LO_MASK,
+    KEY_UNMAPPED_SHIFT,
 )
 from ..ops import segments as seg
 from ..ops.stats import segment_mean_and_variance
@@ -179,14 +184,14 @@ def _scatter_by_entity(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_segments", "kind", "presorted", "compact_codes"),
+    static_argnames=("num_segments", "kind", "presorted", "prepacked"),
 )
 def compute_entity_metrics(
     cols: Dict[str, jnp.ndarray],
     num_segments: int,
     kind: str = "cell",
     presorted: bool = False,
-    compact_codes: bool = False,
+    prepacked: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """All metrics for one entity axis in a single compiled pass.
 
@@ -215,6 +220,11 @@ def compute_entity_metrics(
     ``cols`` holds int32 ``cell``/``umi``/``gene``/``ref``/``pos``, packed
     int16 ``flags`` (io.packed.pack_flags), boolean ``valid``, and the four
     float32 quality columns; shapes are uniform [N]. ``num_segments`` == N.
+    With ``prepacked=True`` the key columns are replaced by the four packed
+    sort operands ``key_hi``/``key_lo``/``m_ref``/``ps`` (io.packed KEY_*
+    layout, pads pre-masked to INT32_MAX) plus a [1] int32 ``n_valid``
+    count standing in for the boolean mask — the schema
+    metrics.gatherer._pad_columns emits with ``prepacked_keys``.
     Returns per-segment metric arrays plus:
       - ``entity_code``: the entity's vocabulary code per segment
       - ``segment_valid``: which segments are real
@@ -225,16 +235,33 @@ def compute_entity_metrics(
         key_names = ("gene", "cell", "umi")
     else:
         raise ValueError(f"kind must be 'cell' or 'gene', got {kind!r}")
+    if prepacked and not presorted:
+        raise ValueError("prepacked batches must also be presorted")
 
-    valid = cols["valid"].astype(bool)
-    if not presorted:
-        sort_keys = [
-            jnp.where(valid, cols[name].astype(jnp.int32), _I32_MAX)
-            for name in key_names
-        ]
-        perm = seg.sort_permutation(sort_keys)
-        cols = {name: value[perm] for name, value in cols.items()}
+    if prepacked:
+        # host shipped the four packed sort operands (metrics.gatherer
+        # _pad_columns prepacked_keys) plus a scalar valid count — derive
+        # the code columns by shifts, no per-record key columns uploaded
+        n_valid = cols["n_valid"][0]
+        valid = jnp.arange(num_segments, dtype=jnp.int32) < n_valid
+        hi, lo = cols["key_hi"], cols["key_lo"]  # pads pre-masked to MAX
+        derived = dict(cols)
+        derived[key_names[0]] = hi >> KEY_HI_SHIFT
+        derived[key_names[1]] = (
+            (hi & KEY_LO_MASK) << KEY_HI_SHIFT
+        ) | (lo >> KEY_CODE_BITS)
+        derived[key_names[2]] = lo & KEY_CODE_MASK
+        cols = derived
+    else:
         valid = cols["valid"].astype(bool)
+        if not presorted:
+            sort_keys = [
+                jnp.where(valid, cols[name].astype(jnp.int32), _I32_MAX)
+                for name in key_names
+            ]
+            perm = seg.sort_permutation(sort_keys)
+            cols = {name: value[perm] for name, value in cols.items()}
+            valid = cols["valid"].astype(bool)
 
     bits = _unpack_flags(cols["flags"])
     pad_key = lambda name: jnp.where(
@@ -256,38 +283,28 @@ def compute_entity_metrics(
     # (reference fragment key: (ref, pos, strand, tags), aggregator.py:299-
     # 303; molecule key: the tag triple, aggregator.py:95)
     #
-    # ``compact_codes=True`` (gatherer batches: per-batch vocabularies, so
-    # every code < num_segments <= 2^20, and the caller host-checked
-    # ref < 2^30-1 and pos < 2^31-1) packs the 7 comparator operands into
-    # 4: hi = k1|k2-high, lo = k2-low|k3 (order-preserving), m_ref =
-    # mapped-last|ref+1, ps = pos<<1|strand (injective; the sort only needs
-    # ADJACENCY of equal fragment keys, not a particular order among
-    # different ones). XLA's O(n log^2 n) sort cost scales with operand
-    # count, so this trims the dominant device cost.
+    # ``prepacked=True`` batches carry the 7 comparator operands packed
+    # into 4 from the host: hi = k1|k2-high, lo = k2-low|k3
+    # (order-preserving for codes < 2^20), m_ref = mapped-last|ref+1, ps =
+    # pos<<1|strand (injective; the sort only needs ADJACENCY of equal
+    # fragment keys, not a particular order among different ones). XLA's
+    # O(n log^2 n) sort cost scales with operand count, so this trims the
+    # dominant device cost — and the batch uploads 4 key columns instead
+    # of 5 plus a bool mask.
     mapped = valid & ~bits["unmapped"]
-    if compact_codes:
-        k1r = cols[key_names[0]].astype(jnp.int32)
-        k2r = cols[key_names[1]].astype(jnp.int32)
-        k3r = cols[key_names[2]].astype(jnp.int32)
-        hi = jnp.where(valid, (k1r << 10) | (k2r >> 10), _I32_MAX)
-        lo = jnp.where(valid, ((k2r & 0x3FF) << 20) | k3r, _I32_MAX)
-        m_ref = jnp.where(
-            valid,
-            jnp.where(mapped, 0, 1 << 30) + (cols["ref"].astype(jnp.int32) + 1),
-            _I32_MAX,
+    if prepacked:
+        sorted_keys = jax.lax.sort(
+            [cols["key_hi"], cols["key_lo"], cols["m_ref"], cols["ps"]],
+            num_keys=4,
         )
-        ps = jnp.where(
-            valid,
-            (cols["pos"].astype(jnp.int32) << 1) | bits["strand"],
-            _I32_MAX,
-        )
-        sorted_keys = jax.lax.sort([hi, lo, m_ref, ps], num_keys=4)
         s_hi, s_lo, s_mref = sorted_keys[0], sorted_keys[1], sorted_keys[2]
         s_valid = s_hi != _I32_MAX
-        s_mapped = s_valid & ((s_mref >> 30) == 0)
-        outer_sorted_keys = [s_hi >> 10]
+        s_mapped = s_valid & ((s_mref >> KEY_UNMAPPED_SHIFT) == 0)
+        outer_sorted_keys = [s_hi >> KEY_HI_SHIFT]
         triple_starts = seg.run_starts([s_hi, s_lo])
-        pair_starts = seg.run_starts([s_hi, s_lo >> 20])  # (k1, k2) runs
+        pair_starts = seg.run_starts(
+            [s_hi, s_lo >> KEY_CODE_BITS]
+        )  # (k1, k2) runs
     else:
         sorted_keys = jax.lax.sort(
             [

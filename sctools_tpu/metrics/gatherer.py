@@ -19,6 +19,10 @@ import numpy as np
 
 from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
 from ..io.packed import (
+    KEY_CODE_BITS,
+    KEY_HI_SHIFT,
+    KEY_LO_MASK,
+    KEY_UNMAPPED_SHIFT,
     ReadFrame,
     compact_frame,
     concat_frames,
@@ -39,8 +43,14 @@ from .writer import MetricCSVWriter
 DEFAULT_BATCH_RECORDS = 1 << 20
 
 
+_I32_MAX = np.iinfo(np.int32).max
+
+
 def _pad_columns(
-    frame: ReadFrame, is_mito: np.ndarray, pad_to: int = 0
+    frame: ReadFrame,
+    is_mito: np.ndarray,
+    pad_to: int = 0,
+    prepacked_keys: tuple = None,
 ) -> Dict[str, np.ndarray]:
     """ReadFrame -> dict of device-ready padded columns (+ valid mask).
 
@@ -50,6 +60,13 @@ def _pad_columns(
     single int16 ``flags`` column (io.packed.pack_flags): host->device
     transfer is a wall-clock cost (a tunneled TPU especially), so each batch
     ships 6 int32/float32 columns, one int16 and one bool — ~39 bytes/record.
+
+    ``prepacked_keys`` = the (k1, k2, k3) key column names in entity order:
+    when the caller verified codes/coordinates fit the packed bit budget
+    (metrics.device compact-key docs), the batch ships the device sort's
+    FOUR packed operands plus a scalar valid count instead of
+    cell/umi/gene/ref/pos/valid — ~34 bytes/record, and the device does no
+    key packing at all.
     """
     n = frame.n_records
     padded = pad_to if pad_to >= n else bucket_size(n)
@@ -66,11 +83,6 @@ def _pad_columns(
         is_mito[frame.gene],
     )
     cols = {
-        "cell": pad(frame.cell, 0, np.int32),
-        "umi": pad(frame.umi, 0, np.int32),
-        "gene": pad(frame.gene, 0, np.int32),
-        "ref": pad(frame.ref, 0, np.int32),
-        "pos": pad(frame.pos, 0, np.int32),
         "flags": pad(flags, 0, np.int16),
         "umi_frac30": pad(np.nan_to_num(frame.umi_frac30, nan=0.0), 0.0, np.float32),
         "cb_frac30": pad(np.nan_to_num(frame.cb_frac30, nan=0.0), 0.0, np.float32),
@@ -80,8 +92,37 @@ def _pad_columns(
         "genomic_mean": pad(
             np.nan_to_num(frame.genomic_mean, nan=0.0), 0.0, np.float32
         ),
-        "valid": np.arange(padded) < n,
     }
+    if prepacked_keys is None:
+        cols.update(
+            cell=pad(frame.cell, 0, np.int32),
+            umi=pad(frame.umi, 0, np.int32),
+            gene=pad(frame.gene, 0, np.int32),
+            ref=pad(frame.ref, 0, np.int32),
+            pos=pad(frame.pos, 0, np.int32),
+            valid=np.arange(padded) < n,
+        )
+        return cols
+    k1, k2, k3 = (
+        getattr(frame, name).astype(np.int32) for name in prepacked_keys
+    )
+    mapped = ~np.asarray(frame.unmapped, dtype=bool)
+    cols.update(
+        key_hi=pad((k1 << KEY_HI_SHIFT) | (k2 >> KEY_HI_SHIFT), _I32_MAX, np.int32),
+        key_lo=pad(((k2 & KEY_LO_MASK) << KEY_CODE_BITS) | k3, _I32_MAX, np.int32),
+        m_ref=pad(
+            np.where(mapped, 0, 1 << KEY_UNMAPPED_SHIFT)
+            + (frame.ref.astype(np.int32) + 1),
+            _I32_MAX,
+            np.int32,
+        ),
+        ps=pad(
+            (frame.pos.astype(np.int32) << 1) | frame.strand.astype(np.int32),
+            _I32_MAX,
+            np.int32,
+        ),
+        n_valid=np.asarray([n], dtype=np.int32),
+    )
     return cols
 
 
@@ -248,32 +289,45 @@ class MetricGatherer:
             [name in self._mitochondrial_gene_ids for name in frame.gene_names],
             dtype=bool,
         )
-        cols = _pad_columns(frame, is_mito, pad_to=pad_to)
-        num_segments = len(cols["valid"])
         # the input BAM is sorted by the entity tag triple (the documented
         # precondition, reference gatherer.py:91-95) and vocabulary codes
         # preserve string order, so batches are presorted: the device pass
         # skips its primary sort entirely; the caller verifies ascending
         # entity order per batch and passes presorted=False otherwise. When
-        # every code and coordinate fits the packed-key bit budget the sort
-        # runs on 4 packed operands instead of 7. The code maxima are
-        # checked EXPLICITLY: a dispatched slice shares its parent's
-        # concat-merged vocabulary, which can exceed the slice's own record
-        # count, so record count is no bound.
-        code_cap = 1 << 20
-        compact = frame.n_records > 0 and (
-            int(frame.cell.max(initial=0)) < code_cap
+        # every code and coordinate also fits the packed-key bit budget,
+        # the host ships the FOUR packed sort operands directly (~34 B per
+        # record instead of ~39, and no device-side key packing). The code
+        # maxima are checked EXPLICITLY: a dispatched slice shares its
+        # parent's concat-merged vocabulary, which can exceed the slice's
+        # own record count, so record count is no bound.
+        code_cap = 1 << KEY_CODE_BITS
+        prepacked = (
+            presorted
+            and frame.n_records > 0
+            and int(frame.cell.max(initial=0)) < code_cap
             and int(frame.umi.max(initial=0)) < code_cap
             and int(frame.gene.max(initial=0)) < code_cap
-            and int(frame.ref.max(initial=0)) < (1 << 30) - 1
+            and int(frame.ref.max(initial=0)) < (1 << KEY_UNMAPPED_SHIFT) - 1
             and int(frame.pos.max(initial=0)) < 0x7FFFFFFF
         )
+        key_order = (
+            ("cell", "umi", "gene")
+            if self.entity_kind == "cell"
+            else ("gene", "cell", "umi")
+        )
+        cols = _pad_columns(
+            frame,
+            is_mito,
+            pad_to=pad_to,
+            prepacked_keys=key_order if prepacked else None,
+        )
+        num_segments = len(cols["flags"])
         result = device_engine.compute_entity_metrics(
             {k: np.asarray(v) for k, v in cols.items()},
             num_segments=num_segments,
             kind=self.entity_kind,
             presorted=presorted,
-            compact_codes=compact,
+            prepacked=prepacked,
         )
         # keep only what finalize reads: pinning the whole frame would hold
         # ~40 MB of record arrays per in-flight batch for no reason
