@@ -190,3 +190,55 @@ def test_grouped_but_descending_input_matches_cpu(tmp_path):
     d = pd.read_csv(dev, index_col=0).sort_index()
     c = pd.read_csv(cpu, index_col=0).sort_index()
     pd.testing.assert_frame_equal(d, c, rtol=1e-5, atol=1e-6, check_dtype=False)
+
+
+def test_prepacked_schema_matches_plain(tmp_path):
+    """The host-packed 4-operand schema == the plain schema, metric for metric."""
+    import random as _random
+
+    import sctools_tpu.metrics.device as device_engine
+    from sctools_tpu.io.packed import frame_from_bam
+    from sctools_tpu.metrics.gatherer import _pad_columns
+
+    rng = _random.Random(21)
+    cells = sorted(
+        "".join(rng.choice("ACGT") for _ in range(8)) for _ in range(12)
+    )
+    records = []
+    for cb in cells:  # ascending groups, unsorted within: the real contract
+        for i in range(10):
+            records.append(
+                make_record(
+                    name=f"{cb}{i}", cb=cb, cr=cb, cy="IIII",
+                    ub="".join(rng.choice("ACGT") for _ in range(4)),
+                    ur="ACGT", uy="IIII",
+                    ge=rng.choice(["G1", "G2", None]),
+                    xf=rng.choice(["CODING", "INTERGENIC", None]),
+                    nh=rng.choice([1, 2]), pos=rng.randrange(1000),
+                    unmapped=rng.random() < 0.1,
+                    reference_id=rng.choice([0, 1]),
+                )
+            )
+    bam = write_bam(str(tmp_path / "pp.bam"), records)
+    frame = frame_from_bam(bam)
+    is_mito = np.zeros(len(frame.gene_names), dtype=bool)
+
+    plain = _pad_columns(frame, is_mito)
+    packed = _pad_columns(
+        frame, is_mito, prepacked_keys=("cell", "umi", "gene")
+    )
+    n = len(plain["flags"])
+    a = device_engine.compute_entity_metrics(
+        {k: np.asarray(v) for k, v in plain.items()},
+        num_segments=n, kind="cell", presorted=True,
+    )
+    b = device_engine.compute_entity_metrics(
+        {k: np.asarray(v) for k, v in packed.items()},
+        num_segments=n, kind="cell", presorted=True, prepacked=True,
+    )
+    assert int(a["n_entities"]) == int(b["n_entities"]) == len(cells)
+    for key in a:
+        np.testing.assert_allclose(
+            np.asarray(a[key]), np.asarray(b[key]),
+            rtol=1e-6, atol=0, equal_nan=True, err_msg=key,
+        )
