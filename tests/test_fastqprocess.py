@@ -223,3 +223,24 @@ def test_check_barcode_partition_cli(tmp_path):
     dup = str(tmp_path / "dup.bam")
     shutil.copy(shards[0], dup)
     assert GenericPlatform.check_barcode_partition(["-b", shards[0], dup]) == 1
+
+
+def test_truncated_r1_is_an_error(tmp_path):
+    """R1 ending before R2 must fail loudly, not silently drop R2's tail."""
+    _write_fastq(tmp_path / "r1.fastq", [("a", "ACGT" * 7, "I" * 28)])
+    _write_fastq(
+        tmp_path / "r2.fastq",
+        [("a", "ACGT" * 10, "F" * 40), ("b", "ACGT" * 10, "F" * 40)],
+    )
+    with pytest.raises(RuntimeError, match="r1 fastq ended before r2"):
+        native.fastqprocess_native(
+            r1_files=[str(tmp_path / "r1.fastq")],
+            r2_files=[str(tmp_path / "r2.fastq")],
+            output_prefix=str(tmp_path / "t"),
+            cb_spans=[(0, 16)], umi_spans=[(16, 26)],
+            n_shards=2, output_format="BAM",
+        )
+    # failure cleanup removed the shard files
+    import glob
+
+    assert not glob.glob(str(tmp_path / "t_*"))
