@@ -142,6 +142,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_int,
         ]
+        lib.scx_format_csv_block.restype = ctypes.c_long
+        lib.scx_format_csv_block.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_char_p, ctypes.c_long,
+        ]
         _lib = lib
         return _lib
 
@@ -361,6 +369,69 @@ def tagsort_native(
             f"native tagsort failed: {errbuf.value.decode(errors='replace')}"
         )
     return n
+
+
+def format_csv_block(index, columns) -> Optional[bytes]:
+    """Render one batch of metric rows to CSV bytes (scx_format_csv_block).
+
+    ``index`` is a sequence of entity-name strings; ``columns`` is a list of
+    equal-length 1-D numpy arrays in header order — int64 and float64 render
+    exactly; other dtypes are cast to one of the two first (callers wanting
+    fallback-identical bytes must pre-cast, as MetricCSVWriter.write_block
+    does). The native formatter reproduces Python's per-value ``str()``
+    rendering of those canonical dtypes byte-for-byte (the reference
+    writer's contract, src/sctools/metrics/writer.py:84-103). Returns None
+    when the native library is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    if hasattr(index, "tolist"):
+        index = index.tolist()
+    n = len(index)
+    if n == 0:
+        return b""
+    encoded = [str(s).encode() for s in index]
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    blob = b"".join(encoded)
+    is_float = np.asarray(
+        [np.issubdtype(np.asarray(c).dtype, np.floating) for c in columns],
+        dtype=np.int8,
+    )
+    col_src = np.zeros(len(columns), np.int32)
+    int_cols, float_cols = [], []
+    for i, column in enumerate(columns):
+        column = np.asarray(column)
+        if len(column) != n:
+            # a silent mismatch would read out-of-bounds in C
+            raise ValueError(
+                f"column {i} has {len(column)} rows, index has {n}"
+            )
+        group = float_cols if is_float[i] else int_cols
+        col_src[i] = len(group)
+        group.append(column)
+    ints = np.ascontiguousarray(
+        np.column_stack(int_cols) if int_cols else np.zeros((n, 0)), np.int64
+    )
+    floats = np.ascontiguousarray(
+        np.column_stack(float_cols) if float_cols else np.zeros((n, 0)),
+        np.float64,
+    )
+    capacity = len(blob) + n * (33 * len(columns) + 1) + 64
+    out = ctypes.create_string_buffer(capacity)
+    written = lib.scx_format_csv_block(
+        blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        ints.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), ints.shape[1],
+        floats.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), floats.shape[1],
+        is_float.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        col_src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(columns), out, capacity,
+    )
+    if written < 0:
+        raise RuntimeError("csv block formatting overflowed its buffer")
+    # copy only the written prefix (.raw would materialize all of capacity)
+    return ctypes.string_at(out, written)
 
 
 def _correct_batch(corrector, raw: bytes, n: int, cb_len: int):

@@ -390,7 +390,10 @@ long scx_tagsort(const char* input, const char* output, const char* tag1,
       if (!out.open(output, compress_level))
         return fail(std::string("cannot open ") + output);
       write_batch(out, in.header, arena, spans);
-      if (!out.close()) return fail("write failed");
+      if (!out.close()) {
+        std::remove(output);  // never leave a complete-looking output
+        return fail("write failed");
+      }
       return total;
     }
     std::string path = std::string(output) + ".tagsort_partial_" +
@@ -415,7 +418,10 @@ long scx_tagsort(const char* input, const char* output, const char* tag1,
       return fail(std::string("cannot open ") + output);
     out.write(reinterpret_cast<const uint8_t*>(in.header.data()),
               in.header.size());
-    if (!out.close()) return fail("write failed");
+    if (!out.close()) {
+      std::remove(output);
+      return fail("write failed");
+    }
     return 0;
   }
 
@@ -456,13 +462,17 @@ long scx_tagsort(const char* input, const char* output, const char* tag1,
     out.write(cursors[i].record.data(), cursors[i].record.size());
     if (!cursors[i].advance(want, error)) {
       out.abort_close();
+      std::remove(output);  // partial output must not survive a failed merge
       cleanup();
       return fail(error);
     }
     if (!cursors[i].done) heap.push(i);
   }
   cleanup();
-  if (!out.close()) return fail("write failed");
+  if (!out.close()) {
+    std::remove(output);
+    return fail("write failed");
+  }
   return total;
 }
 

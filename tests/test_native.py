@@ -198,3 +198,58 @@ def test_native_attach_matches_python(tmp_path, monkeypatch):
         assert dict(a.tags) == dict(b.tags), a.query_name
         corrected += a.has_tag("CB")
     assert 0 < corrected < 120
+
+
+class TestFormatCsvBlock:
+    """Native CSV block formatter == per-value Python str() (the writer's
+    fallback path and the reference writer's contract)."""
+
+    def _expect(self, index, columns):
+        lines = []
+        for i, name in enumerate(index):
+            lines.append(str(name) + "," + ",".join(str(c[i]) for c in columns))
+        return ("\n".join(lines) + "\n").encode() if lines else b""
+
+    def test_tricky_float_values(self):
+        from sctools_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        values = [
+            0.0, -0.0, 1.0, -1.0, 2.0, 100.0, -0.5, 0.25,
+            float("nan"), float("inf"), float("-inf"),
+            1e15, 1e16, 1e17, -1e16, 1.5e16,
+            1e-4, 1e-5, -1e-5, 1.2345e-4,
+            1234567890123456.0, 12345678901234567.0,
+            1 / 3, 2 / 3, 0.1, 0.30000001192092896,
+        ]
+        # every float32 value a metric column can produce upcasts exactly
+        f32 = np.random.default_rng(7).random(4096, dtype=np.float32)
+        col = np.asarray(values + list(f32.astype(np.float64)), np.float64)
+        index = [f"CELL{i}" for i in range(len(col))]
+        got = native.format_csv_block(index, [col])
+        assert got == self._expect(index, [col])
+
+    def test_int_and_mixed_columns(self):
+        from sctools_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        n = 1000
+        rng = np.random.default_rng(3)
+        ints = rng.integers(-(2**62), 2**62, size=n, dtype=np.int64)
+        ints[:4] = [0, -1, np.iinfo(np.int64).max, np.iinfo(np.int64).min]
+        floats = rng.standard_normal(n) * 10.0 ** rng.integers(-8, 8, size=n)
+        floats[:2] = [7.0, float("nan")]
+        small = rng.integers(0, 100, size=n, dtype=np.int64)
+        index = [f"G{i}" for i in range(n)]
+        cols = [ints, floats, small, floats * -1.0]
+        got = native.format_csv_block(index, cols)
+        assert got == self._expect(index, cols)
+
+    def test_empty_block(self):
+        from sctools_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        assert native.format_csv_block([], []) == b""
