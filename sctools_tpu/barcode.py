@@ -14,13 +14,24 @@ this map).
 
 import itertools
 from collections import Counter
-from typing import Iterable, Iterator, List, Mapping, Tuple
+from typing import Iterable, Iterator, Mapping
 
 import numpy as np
 
 from . import consts
 from .encodings import TwoBit
 from .stats import base4_entropy
+
+_SUBSTITUTION_ALPHABET = "ACGTN"  # N enumerated as a 5th letter, like the map
+# the reference builds (barcode.py:330-334, fastqpreprocessing utilities.cpp)
+
+_HAMMING_SUMMARY_KEYS = (
+    "minimum",
+    "25th percentile",
+    "median",
+    "75th percentile",
+    "maximum",
+)
 
 
 class Barcodes:
@@ -29,57 +40,60 @@ class Barcodes:
     def __init__(self, barcodes: Mapping[str, int], barcode_length: int):
         if not isinstance(barcodes, Mapping):
             raise TypeError(
-                'The argument "barcodes" must be a dict-like object mapping barcodes to counts'
+                "barcodes must be a dict-like object mapping each (2-bit "
+                "encoded) barcode to its observation count"
             )
-        self._mapping: Mapping[str, int] = barcodes
+        # quirk inherited from the reference (barcode.py:57-59): the length
+        # check only fires for a non-int that compares > 0 — a non-positive
+        # int passes silently
+        if not (isinstance(barcode_length, int) or barcode_length <= 0):
+            raise ValueError("barcode_length must be a positive integer")
+        self._counts: Mapping[str, int] = barcodes
+        self._length: int = barcode_length
 
-        if not isinstance(barcode_length, int) and barcode_length > 0:
-            raise ValueError('The argument "barcode_length" must be a positive integer')
-        self._barcode_length: int = barcode_length
-
-    def __contains__(self, item) -> bool:
-        return item in self._mapping
+    def __contains__(self, barcode) -> bool:
+        return barcode in self._counts
 
     def __iter__(self) -> Iterator[str]:
-        return iter(self._mapping)
+        return iter(self._counts)
 
     def __len__(self) -> int:
-        return len(self._mapping)
+        return len(self._counts)
 
-    def __getitem__(self, item) -> int:
-        return self._mapping[item]
+    def __getitem__(self, barcode) -> int:
+        return self._counts[barcode]
 
     def summarize_hamming_distances(self) -> Mapping[str, float]:
         """min/quartiles/max/mean hamming distance over all barcode pairs."""
-        distances: List = []
-        for a, b in itertools.combinations(self, 2):
-            distances.append(TwoBit.hamming_distance(a, b))
-
-        keys: Tuple = (
-            "minimum", "25th percentile", "median", "75th percentile", "maximum",
-            "average",
+        pairwise = [
+            TwoBit.hamming_distance(a, b)
+            for a, b in itertools.combinations(self, 2)
+        ]
+        summary = dict(
+            zip(
+                _HAMMING_SUMMARY_KEYS,
+                np.percentile(pairwise, (0, 25, 50, 75, 100)),
+            )
         )
-        values: List = list(np.percentile(distances, [0, 25, 50, 75, 100]))
-        values.append(np.mean(distances))
-        return dict(zip(keys, values))
+        summary["average"] = np.mean(pairwise)
+        return summary
 
     def base_frequency(self, weighted=False) -> np.ndarray:
-        """(barcode_length, 4) counts of each 2-bit base code by position."""
-        base_counts_by_position: np.ndarray = np.zeros(
-            (self._barcode_length, 4), dtype=np.uint64
-        )
-        keys: np.ndarray = np.fromiter(self._mapping.keys(), dtype=np.uint64)
+        """(barcode_length, 4) counts of each 2-bit base code by position.
 
-        for i in reversed(range(self._barcode_length)):
-            binary_base_representations, counts = np.unique(
-                keys & np.uint64(3), return_counts=True
-            )
-            if weighted:
-                raise NotImplementedError
-            base_counts_by_position[i, binary_base_representations] = counts
-            keys = keys >> np.uint64(2)
-
-        return base_counts_by_position
+        Position 0 is the barcode's first (highest-order) base. ``weighted``
+        is unimplemented — a reference todo preserved deliberately
+        (barcode.py:105-147).
+        """
+        if weighted:
+            raise NotImplementedError
+        codes = np.fromiter(self._counts.keys(), dtype=np.uint64)
+        frequency = np.zeros((self._length, 4), dtype=np.uint64)
+        for position in range(self._length):
+            shift = np.uint64(2 * (self._length - 1 - position))
+            bases = (codes >> shift) & np.uint64(3)
+            frequency[position] = np.bincount(bases.astype(np.int64), minlength=4)
+        return frequency
 
     def effective_diversity(self, weighted=False) -> np.ndarray:
         """Per-position base-4 entropy of the set; 1.0 == perfect 25% split."""
@@ -88,25 +102,26 @@ class Barcodes:
     @classmethod
     def from_whitelist(cls, file_: str, barcode_length: int):
         """One barcode per line, plain text; each gets count 1."""
-        tbe = TwoBit(barcode_length)
-        with open(file_, "rb") as f:
-            return cls(Counter(tbe.encode(barcode[:-1]) for barcode in f), barcode_length)
+        encoder = TwoBit(barcode_length)
+        with open(file_, "rb") as lines:
+            counts = Counter(encoder.encode(line[:-1]) for line in lines)
+        return cls(counts, barcode_length)
 
     @classmethod
     def from_iterable_encoded(cls, iterable: Iterable[int], barcode_length: int):
-        return cls(Counter(iterable), barcode_length=barcode_length)
+        return cls(Counter(iterable), barcode_length)
 
     @classmethod
     def from_iterable_strings(cls, iterable: Iterable[str], barcode_length: int):
-        tbe: TwoBit = TwoBit(barcode_length)
+        encoder = TwoBit(barcode_length)
         return cls(
-            Counter(tbe.encode(b.encode()) for b in iterable), barcode_length=barcode_length
+            Counter(encoder.encode(b.encode()) for b in iterable), barcode_length
         )
 
     @classmethod
     def from_iterable_bytes(cls, iterable: Iterable[bytes], barcode_length: int):
-        tbe: TwoBit = TwoBit(barcode_length)
-        return cls(Counter(tbe.encode(b) for b in iterable), barcode_length=barcode_length)
+        encoder = TwoBit(barcode_length)
+        return cls(Counter(encoder.encode(b) for b in iterable), barcode_length)
 
 
 class ErrorsToCorrectBarcodesMap:
@@ -115,32 +130,39 @@ class ErrorsToCorrectBarcodesMap:
     def __init__(self, errors_to_barcodes: Mapping[str, str]):
         if not isinstance(errors_to_barcodes, Mapping):
             raise TypeError(
-                f'The argument "errors_to_barcodes" must be a mapping of erroneous barcodes '
-                f"to correct barcodes, not {type(errors_to_barcodes)}"
+                "errors_to_barcodes must map erroneous barcodes to their "
+                f"whitelisted corrections, got {type(errors_to_barcodes)}"
             )
-        self._map = errors_to_barcodes
+        self._corrections = errors_to_barcodes
 
     def get_corrected_barcode(self, barcode: str) -> str:
         """The whitelisted barcode for ``barcode``; KeyError if distance > 1."""
-        return self._map[barcode]
+        return self._corrections[barcode]
 
     @staticmethod
-    def _prepare_single_base_error_hash_table(barcodes: Iterable[str]) -> Mapping[str, str]:
-        """whitelist barcode + all its single-base substitutions (ACGTN) -> barcode"""
-        error_map = {}
-        for barcode in barcodes:
-            error_map[barcode] = barcode
-            for i, nucleotide in enumerate(barcode):
-                errors = set("ACGTN")
-                errors.discard(nucleotide)
-                for e in errors:
-                    error_map[barcode[:i] + e + barcode[i + 1 :]] = barcode
-        return error_map
+    def _prepare_single_base_error_hash_table(
+        barcodes: Iterable[str],
+    ) -> Mapping[str, str]:
+        """Each whitelist barcode, plus its 1-substitution neighborhood over
+        ACGTN, mapped to itself. Whitelist order decides collisions
+        (last writer wins) — the invariant the device corrector's ambiguity
+        tests pin against this oracle."""
+        corrections = {}
+        for true_barcode in barcodes:
+            corrections[true_barcode] = true_barcode
+            for position, original in enumerate(true_barcode):
+                head = true_barcode[:position]
+                tail = true_barcode[position + 1:]
+                for substitute in _SUBSTITUTION_ALPHABET:
+                    if substitute != original:
+                        corrections[head + substitute + tail] = true_barcode
+        return corrections
 
     @classmethod
     def single_hamming_errors_from_whitelist(cls, whitelist_file: str):
-        with open(whitelist_file, "r") as f:
-            return cls(cls._prepare_single_base_error_hash_table(line[:-1] for line in f))
+        with open(whitelist_file, "r") as lines:
+            stripped = (line[:-1] for line in lines)
+            return cls(cls._prepare_single_base_error_hash_table(stripped))
 
     def correct_bam(self, bam_file: str, output_bam_file: str) -> None:
         """Add corrected CB tags to every record of a bam, given raw CR tags.
@@ -149,14 +171,18 @@ class ErrorsToCorrectBarcodesMap:
         """
         from .io.sam import AlignmentFile  # deferred: keep barcode import-light
 
-        with AlignmentFile(bam_file, "rb") as fin:
-            with AlignmentFile(output_bam_file, "wb", template=fin) as fout:
-                for alignment in fin:
-                    try:
-                        tag = self.get_corrected_barcode(alignment.get_tag("CR"))
-                    except KeyError:
-                        tag = alignment.get_tag(consts.RAW_CELL_BARCODE_TAG_KEY)
-                    alignment.set_tag(
-                        tag=consts.CELL_BARCODE_TAG_KEY, value=tag, value_type="Z"
-                    )
-                    fout.write(alignment)
+        with AlignmentFile(bam_file, "rb") as source, AlignmentFile(
+            output_bam_file, "wb", template=source
+        ) as sink:
+            for alignment in source:
+                raw = alignment.get_tag(consts.RAW_CELL_BARCODE_TAG_KEY)
+                try:
+                    corrected = self.get_corrected_barcode(raw)
+                except KeyError:
+                    corrected = raw
+                alignment.set_tag(
+                    tag=consts.CELL_BARCODE_TAG_KEY,
+                    value=corrected,
+                    value_type="Z",
+                )
+                sink.write(alignment)

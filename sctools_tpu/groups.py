@@ -84,18 +84,15 @@ def write_aggregated_picard_metrics_by_row(file_names, output_name) -> None:
         class_name = parsed["metrics"]["class"].split(".")[2]
         contents = parsed["metrics"]["contents"]
         if class_name == "AlignmentSummaryMetrics":
-            if isinstance(contents, dict):
-                contents = [contents]
+            # unpaired runs yield one dict; paired runs one entry per
+            # CATEGORY (PAIR/R1/R2), flattened here into suffixed keys
+            category_rows = contents if isinstance(contents, list) else [contents]
             rows = {}
-            for m in contents:
-                cat = m["CATEGORY"]
-                rows.update(
-                    {
-                        f"{k}.{cat}": v
-                        for k, v in m.items()
-                        if k not in _DROP_KEYS
-                    }
-                )
+            for row in category_rows:
+                suffix = "." + row["CATEGORY"]
+                for key, value in row.items():
+                    if key not in _DROP_KEYS:
+                        rows[key + suffix] = value
         elif class_name == "InsertSizeMetrics":
             rows = contents[0] if isinstance(contents, list) else contents
         else:
@@ -142,19 +139,20 @@ def parse_hisat2_log(file_names, output_name) -> None:
     metrics: Dict[str, Dict] = {}
     tag = "NONE"
     for file_name in file_names:
+        base = os.path.basename(file_name)
         if "_qc" in file_name:
-            cell_id = os.path.basename(file_name).split("_qc")[0]
-            tag = "HISAT2G"
+            cell_id, tag = base.split("_qc")[0], "HISAT2G"
         elif "_rsem" in file_name:
-            cell_id = os.path.basename(file_name).split("_rsem")[0]
-            tag = "HISAT2T"
+            cell_id, tag = base.split("_rsem")[0], "HISAT2T"
         else:
-            cell_id = os.path.basename(file_name)
+            cell_id = base
         with open(file_name) as fileobj:
-            lines = [x.strip().split(":") for x in fileobj.readlines()]
-        lines.pop(0)  # drop the section's first row
+            sections = [x.strip().split(":") for x in fileobj]
+        del sections[0]  # the section's first row is a header
         metrics[cell_id] = {
-            x[0]: x[1].strip().split(" ")[0] for x in lines if len(x) > 1
+            parts[0]: parts[1].strip().split(" ")[0]
+            for parts in sections
+            if len(parts) > 1
         }
     df = pd.DataFrame.from_dict(metrics, orient="columns")
     df.insert(0, "Class", tag)
@@ -163,24 +161,27 @@ def parse_hisat2_log(file_names, output_name) -> None:
 
 def parse_rsem_cnt(file_names, output_name) -> None:
     """Aggregate RSEM .cnt statistics per cell (reference groups.py:155-195)."""
+    # row labels in output order; .cnt line 1 = alignability counts,
+    # line 2 = multimapping counts, line 3 = hit total + strandedness
+    row_labels = (
+        "unalignable reads", "alignable reads", "filtered reads",
+        "total reads", "unique aligned", "multiple mapped",
+        "total alignments", "strand", "uncertain reads",
+    )
     metrics: Dict[str, Dict] = {}
     for file_name in file_names:
         cell_id = os.path.basename(file_name).split("_rsem")[0]
         with open(file_name) as fileobj:
-            n0, n1, n2, n_tot = fileobj.readline().strip().split(" ")
-            n_unique, n_multi, n_uncertain = fileobj.readline().strip().split(" ")
-            n_hits, read_type = fileobj.readline().strip().split(" ")
-        metrics[cell_id] = {
-            "unalignable reads": n0,
-            "alignable reads": n1,
-            "filtered reads": n2,
-            "total reads": n_tot,
-            "unique aligned": n_unique,
-            "multiple mapped": n_multi,
-            "total alignments": n_hits,
-            "strand": read_type,
-            "uncertain reads": n_uncertain,
-        }
+            n0, n1, n2, n_tot = fileobj.readline().split()
+            n_unique, n_multi, n_uncertain = fileobj.readline().split()
+            n_hits, read_type = fileobj.readline().split()
+        metrics[cell_id] = dict(
+            zip(
+                row_labels,
+                (n0, n1, n2, n_tot, n_unique, n_multi, n_hits, read_type,
+                 n_uncertain),
+            )
+        )
     df = pd.DataFrame.from_dict(metrics, orient="columns")
     df.insert(0, "Class", "RSEM")
     df.T.to_csv(output_name + ".csv")
