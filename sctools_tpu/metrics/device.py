@@ -11,18 +11,28 @@ record batch:
    with ``presorted=True`` no primary device sort happens at all — run
    detection works directly in record order. ``presorted=False`` first
    applies one 3-key sort permutation (for resharded/synthetic batches);
-2. every per-group quantity becomes a segment reduction: Counters -> run
-   counting, Welford -> two-pass segment moments, histogram ``.keys()`` /
-   value predicates -> run-start flags and run-length predicates;
-3. the two orderings the primary order cannot express — fragment adjacency
-   over (tags, ref, pos, strand) and the cell path's (cell, gene) histogram —
-   use *key-only* auxiliary sorts: the payload never rides the sort network,
-   each sorted row is decoded from its own key bits.
+2. ONE key-only auxiliary sort realizes every histogram at once. Its key
+   order is (outer, pair, inner): (cell, gene|mito, umi) for the cell axis,
+   (gene, cell, umi) for the gene axis, then (mapped, ref, pos, strand).
+   Equal tuples are adjacent whatever the component order, so molecule
+   runs, fragment runs AND the (outer, pair) histogram all fall out of one
+   sorted view — the cell path's former second sort (cell, gene) is gone;
+3. per-group quantities then avoid TPU scatters entirely (measured ~5 ms
+   per 512k-record ``segment_sum`` — the old engine's dominant cost, an
+   order of magnitude above the sorts it was blamed on):
+   - count metrics: 0/1 columns stacked [N, C] through one segmented scan
+     (ops.segments.RunBounds) — integer, run-local, exact;
+   - ``count == 1`` / ``count > 1`` histogram predicates: two shifted
+     run-start flag vectors (ops.segments.run_is_singleton/plural) — no
+     per-run reduction at all;
+   - only the float quality moments keep a (stacked) record-order
+     ``segment_sum``: scan trees re-associate f32 additions, which would
+     make output bytes depend on batch offsets; the scatter accumulates in
+     record order, keeping CSV bytes identical across batch splits.
 
 Record flags travel bit-packed in one int16 ``flags`` column (see
 ``io.packed.pack_flags``): a 1M-record batch ships ~7 fewer byte-wide
-columns over PCIe/tunnel links, and the sort-free fast path cuts the
-compiled program to a fraction of a full-sort design.
+columns over PCIe/tunnel links.
 
 All shapes are static: callers pad records to a bucket size with valid=False
 (key columns are masked to INT32_MAX internally so padding sorts last).
@@ -49,13 +59,10 @@ from ..io.packed import (
     FLAG_PUMI_SHIFT,
     FLAG_XF_SHIFT,
     KEY_CODE_BITS,
-    KEY_CODE_MASK,
     KEY_HI_SHIFT,
-    KEY_LO_MASK,
     KEY_UNMAPPED_SHIFT,
 )
 from ..ops import segments as seg
-from ..ops.stats import segment_mean_and_variance
 
 _I32_MAX = np.iinfo(np.int32).max
 
@@ -76,110 +83,38 @@ def _unpack_flags(flags: jnp.ndarray) -> Dict[str, jnp.ndarray]:
     }
 
 
-def _common_metrics(
-    cols: Dict[str, jnp.ndarray],
-    bits: Dict[str, jnp.ndarray],
-    valid: jnp.ndarray,
-    outer_ids: jnp.ndarray,
-    num_segments: int,
-    s_valid: jnp.ndarray,
-    s_outer_ids: jnp.ndarray,
-    triple_starts: jnp.ndarray,
-    triple_ids: jnp.ndarray,
-) -> Dict[str, jnp.ndarray]:
-    """The 24 shared metrics, reduced over the outer (entity) segment.
+def _stacked_moments(
+    columns, valid: jnp.ndarray, outer_ids: jnp.ndarray, num_segments: int,
+    count: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-segment (means, sample variances) of stacked float columns.
 
-    Per-record reductions operate in record order (no gather); the molecule
-    histogram operates on the key-only sorted side (``s_*``/``triple_*``),
-    whose outer segment numbering matches record order.
+    Two-pass centered moments (as stable as Welford, embarrassingly
+    parallel; the variance convention matches the Python reference: sample
+    variance, nan below two observations — stats.py:94-99, deliberately not
+    the C++ sum-of-squares variant, SURVEY.md section 5 quirk 2). The two
+    reductions are record-order scatters on purpose — see the module
+    docstring — but stacked, so the pass costs 2 scatters total instead of
+    2 per metric.
     """
-    mapped = valid & ~bits["unmapped"]
-
-    def count_where(mask):
-        return seg.segment_count(outer_ids, num_segments, where=mask)
-
-    n_reads = count_where(valid)
-    perfect_molecule_barcodes = count_where(valid & bits["perfect_umi"])
-
-    xf = bits["xf"]
-    reads_mapped_exonic = count_where(mapped & (xf == consts.XF_CODING))
-    reads_mapped_intronic = count_where(mapped & (xf == consts.XF_INTRONIC))
-    reads_mapped_utr = count_where(mapped & (xf == consts.XF_UTR))
-
-    reads_mapped_uniquely = count_where(mapped & bits["nh1"])
-    reads_mapped_multiple = count_where(mapped & ~bits["nh1"])
-    duplicate_reads = count_where(mapped & bits["duplicate"])
-    spliced_reads = count_where(mapped & bits["spliced"])
-
-    umi_mean, umi_var, _ = segment_mean_and_variance(
-        cols["umi_frac30"], outer_ids, num_segments, where=valid
+    stacked = jnp.stack(columns, axis=1)
+    masked = jnp.where(valid[:, None], stacked, 0.0)
+    totals = jax.ops.segment_sum(
+        masked, outer_ids, num_segments=num_segments, indices_are_sorted=True
     )
-    gf_mean, gf_var, _ = segment_mean_and_variance(
-        cols["genomic_frac30"], outer_ids, num_segments, where=valid
+    safe_count = jnp.maximum(count, 1).astype(stacked.dtype)[:, None]
+    means = jnp.where(count[:, None] > 0, totals / safe_count, 0.0)
+    centered = stacked - means[outer_ids]
+    sq = jnp.where(valid[:, None], centered * centered, 0.0)
+    m2 = jax.ops.segment_sum(
+        sq, outer_ids, num_segments=num_segments, indices_are_sorted=True
     )
-    gq_mean, gq_var, _ = segment_mean_and_variance(
-        cols["genomic_mean"], outer_ids, num_segments, where=valid
+    variances = jnp.where(
+        count[:, None] >= 2,
+        m2 / jnp.maximum(count - 1, 1).astype(stacked.dtype)[:, None],
+        jnp.nan,
     )
-
-    # molecule histogram: distinct tag triples / triples observed once
-    n_molecules = seg.distinct_runs_per_outer(
-        triple_starts, s_outer_ids, num_segments, where=s_valid
-    )
-    molecules_single = seg.runs_with_count_per_outer(
-        triple_ids, s_outer_ids, num_segments, where=s_valid, predicate="eq1"
-    )
-
-    zeros = jnp.zeros_like(n_reads)
-    f_reads = n_reads.astype(jnp.float32)
-    f_molecules = n_molecules.astype(jnp.float32)
-
-    return {
-        "n_reads": n_reads,
-        "noise_reads": zeros,  # NotImplemented in the reference; always 0
-        "perfect_molecule_barcodes": perfect_molecule_barcodes,
-        "reads_mapped_exonic": reads_mapped_exonic,
-        "reads_mapped_intronic": reads_mapped_intronic,
-        "reads_mapped_utr": reads_mapped_utr,
-        "reads_mapped_uniquely": reads_mapped_uniquely,
-        "reads_mapped_multiple": reads_mapped_multiple,
-        "duplicate_reads": duplicate_reads,
-        "spliced_reads": spliced_reads,
-        "antisense_reads": zeros,  # never incremented in the reference
-        "molecule_barcode_fraction_bases_above_30_mean": umi_mean,
-        "molecule_barcode_fraction_bases_above_30_variance": umi_var,
-        "genomic_reads_fraction_bases_quality_above_30_mean": gf_mean,
-        "genomic_reads_fraction_bases_quality_above_30_variance": gf_var,
-        "genomic_read_quality_mean": gq_mean,
-        "genomic_read_quality_variance": gq_var,
-        "n_molecules": n_molecules,
-        "n_fragments": zeros,  # filled by the fragment pass
-        "reads_per_molecule": jnp.where(
-            n_molecules > 0, f_reads / jnp.maximum(f_molecules, 1), jnp.nan
-        ),
-        "reads_per_fragment": zeros.astype(jnp.float32),  # filled later
-        "fragments_per_molecule": zeros.astype(jnp.float32),  # filled later
-        "fragments_with_single_read_evidence": zeros,
-        "molecules_with_single_read_evidence": molecules_single,
-    }
-
-
-def _scatter_by_entity(
-    values: jnp.ndarray,
-    entity_key: jnp.ndarray,
-    primary_entity_key: jnp.ndarray,
-    num_segments: int,
-) -> jnp.ndarray:
-    """Re-align per-entity values from an auxiliary sort onto primary segments.
-
-    ``entity_key[j]`` is the key value of auxiliary segment j (INT32_MAX when
-    unused); ``primary_entity_key[s]`` is the key value of primary segment s.
-    Keys ascend in both, so a searchsorted gather realigns them.
-    """
-    idx = jnp.searchsorted(entity_key, primary_entity_key)
-    idx = jnp.clip(idx, 0, num_segments - 1)
-    gathered = values[idx]
-    found = entity_key[idx] == primary_entity_key
-    return jnp.where(found, gathered, 0)
+    return means, variances
 
 
 @functools.partial(
@@ -195,10 +130,7 @@ def compute_entity_metrics(
 ) -> Dict[str, jnp.ndarray]:
     """All metrics for one entity axis in a single compiled pass.
 
-    ``kind='cell'``: outer key = cell, triple = (cell, umi, gene) — the sort
-    order GatherCellMetrics requires of its input file (reference
-    metrics/gatherer.py:91-95). ``kind='gene'``: outer key = gene, triple =
-    (gene, cell, umi) (gatherer.py:164-168).
+    ``kind='cell'``: outer key = cell; ``kind='gene'``: outer key = gene.
 
     ``presorted=True`` asserts records already arrive *grouped by the outer
     entity key, groups in ascending code order*, with padding at the end —
@@ -211,87 +143,69 @@ def compute_entity_metrics(
     exactly the reference gatherer's own input requirement, and no more:
     its shipped "cell-sorted" files are sorted by CB only, with (UB, GE)
     free to interleave inside a cell (hash-based Counters absorb that,
-    aggregator.py:95/128). Outer reductions therefore run with no sort at
-    all, and molecule/fragment structure comes from one *key-only* device
-    sort whose payload never moves. With ``presorted=False`` a 3-key sort
-    permutation reorders the payload first, so any record order is accepted
-    (resharded batches, synthetic workloads).
+    aggregator.py:95/128). With ``presorted=False`` a 3-key sort
+    permutation reorders the payload first, so any record order is
+    accepted (resharded batches, synthetic workloads).
 
     ``cols`` holds int32 ``cell``/``umi``/``gene``/``ref``/``pos``, packed
     int16 ``flags`` (io.packed.pack_flags), boolean ``valid``, and the four
     float32 quality columns; shapes are uniform [N]. ``num_segments`` == N.
     With ``prepacked=True`` the key columns are replaced by the four packed
     sort operands ``key_hi``/``key_lo``/``m_ref``/``ps`` (io.packed KEY_*
-    layout, pads pre-masked to INT32_MAX) plus a [1] int32 ``n_valid``
+    layout with the *pair* code in the k2 slot — gene<<1|mito for the cell
+    axis — and pads pre-masked to INT32_MAX) plus a [1] int32 ``n_valid``
     count standing in for the boolean mask — the schema
     metrics.gatherer._pad_columns emits with ``prepacked_keys``.
     Returns per-segment metric arrays plus:
       - ``entity_code``: the entity's vocabulary code per segment
       - ``segment_valid``: which segments are real
     """
-    if kind == "cell":
-        key_names = ("cell", "umi", "gene")
-    elif kind == "gene":
-        key_names = ("gene", "cell", "umi")
-    else:
+    if kind not in ("cell", "gene"):
         raise ValueError(f"kind must be 'cell' or 'gene', got {kind!r}")
     if prepacked and not presorted:
         raise ValueError("prepacked batches must also be presorted")
 
     if prepacked:
-        # host shipped the four packed sort operands (metrics.gatherer
-        # _pad_columns prepacked_keys) plus a scalar valid count — derive
-        # the code columns by shifts, no per-record key columns uploaded
+        # host shipped the four packed sort operands plus a scalar valid
+        # count; only the outer code column is ever derived back
         n_valid = cols["n_valid"][0]
         valid = jnp.arange(num_segments, dtype=jnp.int32) < n_valid
-        hi, lo = cols["key_hi"], cols["key_lo"]  # pads pre-masked to MAX
-        derived = dict(cols)
-        derived[key_names[0]] = hi >> KEY_HI_SHIFT
-        derived[key_names[1]] = (
-            (hi & KEY_LO_MASK) << KEY_HI_SHIFT
-        ) | (lo >> KEY_CODE_BITS)
-        derived[key_names[2]] = lo & KEY_CODE_MASK
-        cols = derived
+        k1 = jnp.where(valid, cols["key_hi"] >> KEY_HI_SHIFT, _I32_MAX)
     else:
         valid = cols["valid"].astype(bool)
+        bits_pre = _unpack_flags(cols["flags"])
+        if kind == "cell":
+            # the pair slot carries gene<<1|mito: one sorted view then
+            # yields the (cell, gene) histogram with its mito split
+            key_cols = (
+                cols["cell"],
+                (cols["gene"].astype(jnp.int32) << 1)
+                | bits_pre["is_mito"].astype(jnp.int32),
+                cols["umi"],
+            )
+        else:
+            key_cols = (cols["gene"], cols["cell"], cols["umi"])
+        keys = [
+            jnp.where(valid, c.astype(jnp.int32), _I32_MAX) for c in key_cols
+        ]
         if not presorted:
-            sort_keys = [
-                jnp.where(valid, cols[name].astype(jnp.int32), _I32_MAX)
-                for name in key_names
-            ]
-            perm = seg.sort_permutation(sort_keys)
+            perm = seg.sort_permutation(keys)
             cols = {name: value[perm] for name, value in cols.items()}
             valid = cols["valid"].astype(bool)
+            keys = [k[perm] for k in keys]
+        k1 = keys[0]
 
     bits = _unpack_flags(cols["flags"])
-    pad_key = lambda name: jnp.where(
-        valid, cols[name].astype(jnp.int32), _I32_MAX
-    )
-    k1, k2, k3 = (pad_key(name) for name in key_names)
-
-    # outer segments exist directly in record order (outer-grouped input)
-    outer_starts = seg.run_starts([k1])
-    outer_ids = seg.segment_ids_from_starts(outer_starts)
-
-    # --- molecule + fragment structure from ONE key-only sort --------------
-    # (umi, gene) interleave freely inside an entity, so triples/fragments
-    # need sorted adjacency; sorting only the key tuple (tags..., mapped-
-    # last, ref, pos, strand) realizes both without moving any payload.
-    # Outer segment NUMBERING is identical on both sides: the same distinct
-    # k1 values ascend in record order and in sorted order, so per-outer
-    # sums computed on sorted rows land on the right record-order segments.
-    # (reference fragment key: (ref, pos, strand, tags), aggregator.py:299-
-    # 303; molecule key: the tag triple, aggregator.py:95)
-    #
-    # ``prepacked=True`` batches carry the 7 comparator operands packed
-    # into 4 from the host: hi = k1|k2-high, lo = k2-low|k3
-    # (order-preserving for codes < 2^20), m_ref = mapped-last|ref+1, ps =
-    # pos<<1|strand (injective; the sort only needs ADJACENCY of equal
-    # fragment keys, not a particular order among different ones). XLA's
-    # O(n log^2 n) sort cost scales with operand count, so this trims the
-    # dominant device cost — and the batch uploads 4 key columns instead
-    # of 5 plus a bool mask.
     mapped = valid & ~bits["unmapped"]
+
+    # ---- the ONE key-only sort: (outer, pair, inner, mapped, ref, pos,
+    # strand). Molecule runs = distinct (k1,k2,k3); fragment runs = distinct
+    # full tuples among mapped rows (reference fragment key (ref, pos,
+    # strand, tags), aggregator.py:299-303); pair runs = distinct (k1,k2) =
+    # the genes/cells histograms. Outer segment NUMBERING is identical on
+    # both sides: the same distinct k1 values ascend in record order and in
+    # sorted order, so per-outer sums computed on sorted rows land on the
+    # right record-order segments.
     if prepacked:
         sorted_keys = jax.lax.sort(
             [cols["key_hi"], cols["key_lo"], cols["m_ref"], cols["ps"]],
@@ -301,19 +215,16 @@ def compute_entity_metrics(
         s_valid = s_hi != _I32_MAX
         s_mapped = s_valid & ((s_mref >> KEY_UNMAPPED_SHIFT) == 0)
         outer_sorted_keys = [s_hi >> KEY_HI_SHIFT]
-        triple_starts = seg.run_starts([s_hi, s_lo])
-        pair_starts = seg.run_starts(
-            [s_hi, s_lo >> KEY_CODE_BITS]
-        )  # (k1, k2) runs
+        pair_keys = [s_hi, s_lo >> KEY_CODE_BITS]
+        triple_keys = [s_hi, s_lo]
+        s_pair_low_bit = (s_lo >> KEY_CODE_BITS) & 1
     else:
         sorted_keys = jax.lax.sort(
-            [
-                k1,
-                k2,
-                k3,
+            keys
+            + [
                 jnp.where(mapped, 0, 1).astype(jnp.int32),
-                pad_key("ref"),
-                pad_key("pos"),
+                jnp.where(valid, cols["ref"].astype(jnp.int32), _I32_MAX),
+                jnp.where(valid, cols["pos"].astype(jnp.int32), _I32_MAX),
                 jnp.where(valid, bits["strand"], _I32_MAX),
             ],
             num_keys=7,
@@ -321,166 +232,175 @@ def compute_entity_metrics(
         s_valid = sorted_keys[0] != _I32_MAX
         s_mapped = s_valid & (sorted_keys[3] == 0)
         outer_sorted_keys = sorted_keys[:1]
-        triple_starts = seg.run_starts(sorted_keys[:3])
-        pair_starts = seg.run_starts(sorted_keys[:2])
-    s_outer_ids = seg.segment_ids_from_starts(
-        seg.run_starts(outer_sorted_keys)
-    )
-    triple_ids = seg.segment_ids_from_starts(triple_starts)
+        pair_keys = sorted_keys[:2]
+        triple_keys = sorted_keys[:3]
+        s_pair_low_bit = sorted_keys[1] & 1
 
-    out = _common_metrics(
-        cols,
-        bits,
+    outer_starts = seg.run_starts([k1])  # record order
+    outer_bounds = seg.RunBounds(outer_starts)
+    s_outer_starts = seg.run_starts(outer_sorted_keys)
+    s_outer_bounds = seg.RunBounds(s_outer_starts)
+
+    triple_starts = seg.run_starts(triple_keys)
+    pair_starts = seg.run_starts(pair_keys)
+    frag_starts = seg.run_starts(sorted_keys)
+
+    # ---- record-order counters: one stacked segmented scan ---------------
+    xf = bits["xf"]
+    int_cols = [
+        valid,                                      # n_reads
+        valid & bits["perfect_umi"],                # perfect_molecule_barcodes
+        mapped & (xf == consts.XF_CODING),          # reads_mapped_exonic
+        mapped & (xf == consts.XF_INTRONIC),        # reads_mapped_intronic
+        mapped & (xf == consts.XF_UTR),             # reads_mapped_utr
+        mapped & bits["nh1"],                       # reads_mapped_uniquely
+        mapped & ~bits["nh1"],                      # reads_mapped_multiple
+        mapped & bits["duplicate"],                 # duplicate_reads
+        mapped & bits["spliced"],                   # spliced_reads
+    ]
+    if kind == "cell":
+        # XF checks in cell extras ignore mapped state (aggregator.py:
+        # 522-527): INTERGENIC counts any read carrying that tag value; a
+        # missing XF counts toward reads_unmapped.
+        int_cols += [
+            valid & bits["perfect_cb"],             # perfect_cell_barcodes
+            valid & (xf == consts.XF_INTERGENIC),   # reads_mapped_intergenic
+            valid & (xf == consts.XF_MISSING),      # reads_unmapped
+        ]
+    record_sums = outer_bounds.sum(
+        jnp.stack(int_cols, axis=1).astype(jnp.int32)
+    )
+    (
+        n_reads,
+        perfect_molecule_barcodes,
+        reads_mapped_exonic,
+        reads_mapped_intronic,
+        reads_mapped_utr,
+        reads_mapped_uniquely,
+        reads_mapped_multiple,
+        duplicate_reads,
+        spliced_reads,
+    ) = (record_sums[:, i] for i in range(9))
+
+    # ---- sorted-side histograms: one stacked segmented scan --------------
+    # singleton/plural run predicates are shifted-flag ANDs; the per-outer
+    # sums of their start flags realize len(histogram) and the count
+    # predicates of the reference's Counters.
+    s_cols = [
+        triple_starts & s_valid,                        # n_molecules
+        seg.run_is_singleton(triple_starts) & s_valid,  # molecules single
+        frag_starts & s_mapped,                         # n_fragments
+        seg.run_is_singleton(frag_starts) & s_mapped,   # fragments single
+        pair_starts & s_valid,                          # pair histogram size
+        seg.run_is_plural(pair_starts) & s_valid,       # pairs seen > once
+    ]
+    if kind == "cell":
+        s_mito = s_valid & (s_pair_low_bit == 1)
+        s_cols += [
+            pair_starts & s_mito,                       # n_mitochondrial_genes
+            s_mito,                                     # mito reads
+        ]
+    sorted_sums = s_outer_bounds.sum(
+        jnp.stack(s_cols, axis=1).astype(jnp.int32)
+    )
+    n_molecules = sorted_sums[:, 0]
+    molecules_single = sorted_sums[:, 1]
+    n_fragments = sorted_sums[:, 2]
+    frag_single = sorted_sums[:, 3]
+
+    # ---- float quality moments: two stacked record-order scatters --------
+    float_names = ["umi_frac30", "genomic_frac30", "genomic_mean"]
+    if kind == "cell":
+        float_names.append("cb_frac30")
+    outer_ids = seg.segment_ids_from_starts(outer_starts)
+    means, variances = _stacked_moments(
+        [cols[name] for name in float_names],
         valid,
         outer_ids,
         num_segments,
-        s_valid,
-        s_outer_ids,
-        triple_starts,
-        triple_ids,
+        n_reads,
     )
 
-    frag_starts = seg.run_starts(sorted_keys)
-    frag_ids = seg.segment_ids_from_starts(frag_starts)
-    n_fragments = seg.distinct_runs_per_outer(
-        frag_starts, s_outer_ids, num_segments, where=s_mapped
-    )
-    frag_single = seg.runs_with_count_per_outer(
-        frag_ids, s_outer_ids, num_segments, where=s_mapped, predicate="eq1"
-    )
-    primary_entity_key = seg.segment_min(
-        jnp.where(valid, k1, _I32_MAX), outer_ids, num_segments
-    )
-    f_reads = out["n_reads"].astype(jnp.float32)
-    f_frag = n_fragments.astype(jnp.float32)
-    f_mol = out["n_molecules"].astype(jnp.float32)
-    out["n_fragments"] = n_fragments
-    out["fragments_with_single_read_evidence"] = frag_single
-    out["reads_per_fragment"] = jnp.where(
-        n_fragments > 0, f_reads / jnp.maximum(f_frag, 1), jnp.nan
-    )
-    out["fragments_per_molecule"] = jnp.where(
-        f_mol > 0, f_frag / jnp.maximum(f_mol, 1), jnp.nan
-    )
+    zeros = jnp.zeros_like(n_reads)
+    f_reads = n_reads.astype(jnp.float32)
+    f_molecules = n_molecules.astype(jnp.float32)
+    f_fragments = n_fragments.astype(jnp.float32)
+
+    out = {
+        "n_reads": n_reads,
+        "noise_reads": zeros,  # NotImplemented in the reference; always 0
+        "perfect_molecule_barcodes": perfect_molecule_barcodes,
+        "reads_mapped_exonic": reads_mapped_exonic,
+        "reads_mapped_intronic": reads_mapped_intronic,
+        "reads_mapped_utr": reads_mapped_utr,
+        "reads_mapped_uniquely": reads_mapped_uniquely,
+        "reads_mapped_multiple": reads_mapped_multiple,
+        "duplicate_reads": duplicate_reads,
+        "spliced_reads": spliced_reads,
+        "antisense_reads": zeros,  # never incremented in the reference
+        "molecule_barcode_fraction_bases_above_30_mean": means[:, 0],
+        "molecule_barcode_fraction_bases_above_30_variance": variances[:, 0],
+        "genomic_reads_fraction_bases_quality_above_30_mean": means[:, 1],
+        "genomic_reads_fraction_bases_quality_above_30_variance": variances[:, 1],
+        "genomic_read_quality_mean": means[:, 2],
+        "genomic_read_quality_variance": variances[:, 2],
+        "n_molecules": n_molecules,
+        "n_fragments": n_fragments,
+        "reads_per_molecule": jnp.where(
+            n_molecules > 0, f_reads / jnp.maximum(f_molecules, 1), jnp.nan
+        ),
+        "reads_per_fragment": jnp.where(
+            n_fragments > 0, f_reads / jnp.maximum(f_fragments, 1), jnp.nan
+        ),
+        "fragments_per_molecule": jnp.where(
+            n_molecules > 0, f_fragments / jnp.maximum(f_molecules, 1), jnp.nan
+        ),
+        "fragments_with_single_read_evidence": frag_single,
+        "molecules_with_single_read_evidence": molecules_single,
+    }
 
     if kind == "cell":
+        n_genes = sorted_sums[:, 4]
+        n_mito_molecules = sorted_sums[:, 7]
         out.update(
-            _cell_extras(
-                cols, bits, valid, outer_ids, primary_entity_key, num_segments
-            )
+            {
+                "perfect_cell_barcodes": record_sums[:, 9],
+                "reads_mapped_intergenic": record_sums[:, 10],
+                "reads_unmapped": record_sums[:, 11],
+                "reads_mapped_too_many_loci": zeros,
+                "cell_barcode_fraction_bases_above_30_variance": variances[:, 3],
+                "cell_barcode_fraction_bases_above_30_mean": means[:, 3],
+                "n_genes": n_genes,
+                "genes_detected_multiple_observations": sorted_sums[:, 5],
+                "n_mitochondrial_genes": sorted_sums[:, 6],
+                "n_mitochondrial_molecules": n_mito_molecules,
+                # read-weighted percentage (reference aggregator.py:463-490)
+                "pct_mitochondrial_molecules": jnp.where(
+                    n_mito_molecules > 0,
+                    n_mito_molecules.astype(jnp.float32)
+                    / jnp.maximum(n_reads, 1).astype(jnp.float32)
+                    * 100.0,
+                    0.0,
+                ),
+            }
         )
     else:
         out.update(
-            _gene_extras(pair_starts, s_valid, s_outer_ids, num_segments)
+            {
+                "number_cells_detected_multiple": sorted_sums[:, 5],
+                "number_cells_expressing": sorted_sums[:, 4],
+            }
         )
 
     n_entities = jnp.sum(
         jnp.where(valid, outer_starts, False).astype(jnp.int32)
     )
-    out["entity_code"] = primary_entity_key
+    out["entity_code"] = outer_bounds.first(k1, _I32_MAX)
     out["segment_valid"] = (
         jnp.arange(num_segments, dtype=jnp.int32) < n_entities
     )
     out["n_entities"] = n_entities
     return out
-
-
-def _cell_extras(
-    cols: Dict[str, jnp.ndarray],
-    bits: Dict[str, jnp.ndarray],
-    valid: jnp.ndarray,
-    outer_ids: jnp.ndarray,
-    primary_entity_key: jnp.ndarray,
-    num_segments: int,
-) -> Dict[str, jnp.ndarray]:
-    """The 11 cell-specific metrics (reference aggregator.py:437-530).
-
-    The genes histogram needs (cell, gene) adjacency, which the primary
-    (cell, umi, gene) order does not provide — a key-only auxiliary sort
-    supplies it, with the per-gene mito flag riding in the low bit of the
-    gene key (constant within a (cell, gene) run, so run structure is
-    unchanged). ``is_mito`` originates host-side from the gene vocabulary
-    (reference resolves mito genes from GTF names at platform.py:302-307 and
-    checks membership at aggregator.py:476-482).
-    """
-
-    def count_where(mask):
-        return seg.segment_count(outer_ids, num_segments, where=mask)
-
-    perfect_cell_barcodes = count_where(valid & bits["perfect_cb"])
-    # XF checks in cell extras ignore mapped state (aggregator.py:522-527):
-    # INTERGENIC counts any read carrying that tag value; a missing XF counts
-    # toward reads_unmapped.
-    xf = bits["xf"]
-    reads_mapped_intergenic = count_where(valid & (xf == consts.XF_INTERGENIC))
-    reads_unmapped = count_where(valid & (xf == consts.XF_MISSING))
-
-    cb_mean, cb_var, _ = segment_mean_and_variance(
-        cols["cb_frac30"], outer_ids, num_segments, where=valid
-    )
-
-    # --- genes histogram via key-only (cell, gene<<1|mito) aux sort ---------
-    cell_key = jnp.where(valid, cols["cell"].astype(jnp.int32), _I32_MAX)
-    gene_mito_key = jnp.where(
-        valid,
-        (cols["gene"].astype(jnp.int32) << 1)
-        | bits["is_mito"].astype(jnp.int32),
-        _I32_MAX,
-    )
-    gk_cell, gk_gene = jax.lax.sort([cell_key, gene_mito_key], num_keys=2)
-    g_valid = gk_cell != _I32_MAX
-    g_is_mito = g_valid & ((gk_gene & 1) == 1)
-    g_outer_starts = seg.run_starts([gk_cell])
-    g_outer_ids = seg.segment_ids_from_starts(g_outer_starts)
-    g_pair_starts = seg.run_starts([gk_cell, gk_gene])
-    g_pair_ids = seg.segment_ids_from_starts(g_pair_starts)
-
-    n_genes_local = seg.distinct_runs_per_outer(
-        g_pair_starts, g_outer_ids, num_segments, where=g_valid
-    )
-    genes_multiple_local = seg.runs_with_count_per_outer(
-        g_pair_ids, g_outer_ids, num_segments, where=g_valid, predicate="gt1"
-    )
-    mito_genes_local = seg.distinct_runs_per_outer(
-        g_pair_starts, g_outer_ids, num_segments, where=g_is_mito
-    )
-    mito_reads_local = seg.segment_count(
-        g_outer_ids, num_segments, where=g_is_mito
-    )
-
-    g_entity_key = seg.segment_min(
-        jnp.where(g_valid, gk_cell, _I32_MAX), g_outer_ids, num_segments
-    )
-    realign = lambda v: _scatter_by_entity(
-        v, g_entity_key, primary_entity_key, num_segments
-    )
-    n_genes = realign(n_genes_local)
-    genes_detected_multiple_observations = realign(genes_multiple_local)
-    n_mitochondrial_genes = realign(mito_genes_local)
-    n_mitochondrial_molecules = realign(mito_reads_local)
-
-    total_reads = seg.segment_count(outer_ids, num_segments, where=valid)
-    pct = jnp.where(
-        n_mitochondrial_molecules > 0,
-        n_mitochondrial_molecules.astype(jnp.float32)
-        / jnp.maximum(total_reads, 1).astype(jnp.float32)
-        * 100.0,
-        0.0,
-    )
-
-    return {
-        "perfect_cell_barcodes": perfect_cell_barcodes,
-        "reads_mapped_intergenic": reads_mapped_intergenic,
-        "reads_unmapped": reads_unmapped,
-        "reads_mapped_too_many_loci": jnp.zeros_like(perfect_cell_barcodes),
-        "cell_barcode_fraction_bases_above_30_variance": cb_var,
-        "cell_barcode_fraction_bases_above_30_mean": cb_mean,
-        "n_genes": n_genes,
-        "genes_detected_multiple_observations": genes_detected_multiple_observations,
-        "n_mitochondrial_genes": n_mitochondrial_genes,
-        "n_mitochondrial_molecules": n_mitochondrial_molecules,
-        "pct_mitochondrial_molecules": pct,
-    }
 
 
 @functools.partial(jax.jit, static_argnames=("int_names", "float_names", "k"))
@@ -510,27 +430,3 @@ def compact_results(
         [result[name][:k].astype(jnp.float32) for name in float_names], axis=1
     )
     return ints, floats
-
-
-def _gene_extras(
-    pair_starts: jnp.ndarray,
-    s_valid: jnp.ndarray,
-    s_outer_ids: jnp.ndarray,
-    num_segments: int,
-) -> Dict[str, jnp.ndarray]:
-    """The 2 gene-specific metrics (reference aggregator.py:561-595).
-
-    The key-only sorted side already provides (gene, cell) adjacency;
-    ``pair_starts`` marks its (k1, k2) run boundaries.
-    """
-    pair_ids = seg.segment_ids_from_starts(pair_starts)
-    number_cells_expressing = seg.distinct_runs_per_outer(
-        pair_starts, s_outer_ids, num_segments, where=s_valid
-    )
-    number_cells_detected_multiple = seg.runs_with_count_per_outer(
-        pair_ids, s_outer_ids, num_segments, where=s_valid, predicate="gt1"
-    )
-    return {
-        "number_cells_detected_multiple": number_cells_detected_multiple,
-        "number_cells_expressing": number_cells_expressing,
-    }

@@ -51,6 +51,7 @@ def _pad_columns(
     is_mito: np.ndarray,
     pad_to: int = 0,
     prepacked_keys: tuple = None,
+    pair_mito: bool = False,
 ) -> Dict[str, np.ndarray]:
     """ReadFrame -> dict of device-ready padded columns (+ valid mask).
 
@@ -66,7 +67,9 @@ def _pad_columns(
     (metrics.device compact-key docs), the batch ships the device sort's
     FOUR packed operands plus a scalar valid count instead of
     cell/umi/gene/ref/pos/valid — ~34 bytes/record, and the device does no
-    key packing at all.
+    key packing at all. With ``pair_mito`` the k2 (pair) slot carries
+    ``code << 1 | is_mito`` — the cell axis' (cell, gene) histogram and its
+    mito split then ride the device's single sorted view.
     """
     n = frame.n_records
     padded = pad_to if pad_to >= n else bucket_size(n)
@@ -106,6 +109,8 @@ def _pad_columns(
     k1, k2, k3 = (
         getattr(frame, name).astype(np.int32) for name in prepacked_keys
     )
+    if pair_mito:
+        k2 = (k2 << 1) | is_mito[frame.gene].astype(np.int32)
     mapped = ~np.asarray(frame.unmapped, dtype=bool)
     cols.update(
         key_hi=pad((k1 << KEY_HI_SHIFT) | (k2 >> KEY_HI_SHIFT), _I32_MAX, np.int32),
@@ -301,12 +306,15 @@ class MetricGatherer:
         # parent's concat-merged vocabulary, which can exceed the slice's
         # own record count, so record count is no bound.
         code_cap = 1 << KEY_CODE_BITS
+        # the cell axis packs gene<<1|mito into the pair slot, so the gene
+        # code loses one bit of budget there
+        gene_cap = code_cap >> 1 if self.entity_kind == "cell" else code_cap
         prepacked = (
             presorted
             and frame.n_records > 0
             and int(frame.cell.max(initial=0)) < code_cap
             and int(frame.umi.max(initial=0)) < code_cap
-            and int(frame.gene.max(initial=0)) < code_cap
+            and int(frame.gene.max(initial=0)) < gene_cap
             and int(frame.ref.max(initial=0)) < (1 << KEY_UNMAPPED_SHIFT) - 1
             # pos shifts left by 1 into ps: bound it so the packed int32
             # cannot wrap and the key stays order-preserving, not merely
@@ -314,7 +322,7 @@ class MetricGatherer:
             and int(frame.pos.max(initial=0)) < (1 << 30)
         )
         key_order = (
-            ("cell", "umi", "gene")
+            ("cell", "gene", "umi")
             if self.entity_kind == "cell"
             else ("gene", "cell", "umi")
         )
@@ -323,6 +331,7 @@ class MetricGatherer:
             is_mito,
             pad_to=pad_to,
             prepacked_keys=key_order if prepacked else None,
+            pair_mito=self.entity_kind == "cell",
         )
         num_segments = len(cols["flags"])
         result = device_engine.compute_entity_metrics(

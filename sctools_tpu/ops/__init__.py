@@ -7,6 +7,6 @@ These are the TPU-native replacements for the reference's Python Counters and
 hash maps (SURVEY.md section 7 design stance).
 """
 
-from . import segments, stats  # noqa: F401
+from . import segments  # noqa: F401
 
-__all__ = ["segments", "stats", "correction", "encodings"]
+__all__ = ["segments", "correction", "encodings"]

@@ -225,7 +225,7 @@ def test_prepacked_schema_matches_plain(tmp_path):
 
     plain = _pad_columns(frame, is_mito)
     packed = _pad_columns(
-        frame, is_mito, prepacked_keys=("cell", "umi", "gene")
+        frame, is_mito, prepacked_keys=("cell", "gene", "umi"), pair_mito=True
     )
     n = len(plain["flags"])
     a = device_engine.compute_entity_metrics(
