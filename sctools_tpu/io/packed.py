@@ -162,11 +162,14 @@ class ReadFrame:
     perfect_umi: np.ndarray  # int8: 1 match / 0 mismatch / -1 not computable
     perfect_cb: np.ndarray  # int8: same convention, gated on CB presence
 
-    # quality summaries (float32)
-    umi_frac30: np.ndarray  # fraction of UY qualities > 30
-    cb_frac30: np.ndarray  # fraction of CY qualities > 30
-    genomic_frac30: np.ndarray  # fraction of aligned-portion qualities > 30
-    genomic_mean: np.ndarray  # mean aligned-portion quality
+    # quality summaries, exact integer form: the wire cost of four float32
+    # columns (16 B/record) collapses to 6 B and the device recovers the
+    # float32 values by one f32 division each (identical where the backend
+    # divides correctly-rounded, within ~1 ulp otherwise)
+    umi_qual: np.ndarray  # uint16: above30<<8 | len(UY); 0 == tag missing
+    cb_qual: np.ndarray  # uint16: above30<<8 | len(CY); 0 == tag missing
+    genomic_qual: np.ndarray  # uint32: above30<<16 | aligned len; 0 == none
+    genomic_total: np.ndarray  # uint32: sum of aligned phred scores
 
     extras: Dict[str, np.ndarray] = field(default_factory=dict)
 
@@ -177,17 +180,60 @@ class ReadFrame:
     def n_records(self) -> int:
         return len(self.cell)
 
+    # ---- derived float views (compat: parallel/synth paths, tests) -------
 
-def _frac_above(qualities: Sequence[int], threshold: int = _QUAL_THRESHOLD) -> float:
-    if not qualities:
-        return float("nan")
-    return sum(1 for q in qualities if q > threshold) / len(qualities)
+    @property
+    def umi_frac30(self) -> np.ndarray:
+        """float32 fraction of UY qualities > 30 (nan when tag missing)."""
+        return _qual_frac(self.umi_qual, 8)
+
+    @property
+    def cb_frac30(self) -> np.ndarray:
+        """float32 fraction of CY qualities > 30 (nan when tag missing)."""
+        return _qual_frac(self.cb_qual, 8)
+
+    @property
+    def genomic_frac30(self) -> np.ndarray:
+        """float32 fraction of aligned qualities > 30 (nan when absent)."""
+        return _qual_frac(self.genomic_qual, 16)
+
+    @property
+    def genomic_mean(self) -> np.ndarray:
+        """float32 mean aligned quality (nan when absent)."""
+        length = (self.genomic_qual & 0xFFFF).astype(np.float32)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            out = self.genomic_total.astype(np.float32) / length
+        return np.where(length > 0, out, np.float32(np.nan)).astype(np.float32)
 
 
-def _string_qual_frac_above(qual: Optional[str], threshold: int = _QUAL_THRESHOLD) -> float:
-    if not qual:
-        return float("nan")
-    return sum(1 for c in qual if ord(c) - 33 > threshold) / len(qual)
+def _qual_frac(packed: np.ndarray, shift: int) -> np.ndarray:
+    mask = (1 << shift) - 1
+    length = (packed & mask).astype(np.float32)
+    above = (packed >> shift).astype(np.float32)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        out = above / length
+    return np.where(length > 0, out, np.float32(np.nan)).astype(np.float32)
+
+
+def _pack_string_qual(qual: Optional[str], threshold: int = _QUAL_THRESHOLD) -> int:
+    """above30<<8 | len for a string-encoded quality tag (0 == missing).
+
+    Lengths above 255 cannot be represented and degrade to "missing" — no
+    sequencing barcode approaches that (the packed-barcode cap is 21 bases).
+    """
+    if not qual or len(qual) > 0xFF:
+        return 0
+    above = sum(1 for c in qual if ord(c) - 33 > threshold)
+    return (above << 8) | len(qual)
+
+
+def _pack_aligned_qual(qualities: Sequence[int], threshold: int = _QUAL_THRESHOLD):
+    """(above30<<16 | len, total) for aligned phred scores (0, 0 == absent)."""
+    n = len(qualities)
+    if not n or n > 0xFFFF:
+        return 0, 0
+    above = sum(1 for q in qualities if q > threshold)
+    return (above << 16) | n, sum(qualities)
 
 
 def _encode_column(values: List[str]):
@@ -226,10 +272,10 @@ def frame_from_records(
     nh: List[int] = []
     perfect_umi: List[int] = []
     perfect_cb: List[int] = []
-    umi_frac30: List[float] = []
-    cb_frac30: List[float] = []
-    genomic_frac30: List[float] = []
-    genomic_mean: List[float] = []
+    umi_qual: List[int] = []
+    cb_qual: List[int] = []
+    genomic_qual: List[int] = []
+    genomic_total: List[int] = []
 
     cb_key, ub_key, ge_key = tag_keys
     for record in records:
@@ -268,13 +314,11 @@ def frame_from_records(
             perfect_cb.append(1 if cr == cb else 0)
         else:
             perfect_cb.append(-1)
-        umi_frac30.append(_string_qual_frac_above(uy))
-        cb_frac30.append(_string_qual_frac_above(cy))
-        aligned_quals = record.query_alignment_qualities or []
-        genomic_frac30.append(_frac_above(aligned_quals))
-        genomic_mean.append(
-            float(np.mean(aligned_quals)) if aligned_quals else float("nan")
-        )
+        umi_qual.append(_pack_string_qual(uy))
+        cb_qual.append(_pack_string_qual(cy))
+        gq, gt = _pack_aligned_qual(record.query_alignment_qualities or [])
+        genomic_qual.append(gq)
+        genomic_total.append(gt)
 
     cell_codes, cell_names = _encode_column(cells)
     umi_codes, umi_names = _encode_column(umis)
@@ -300,17 +344,17 @@ def frame_from_records(
         nh=np.asarray(nh, dtype=np.int32),
         perfect_umi=np.asarray(perfect_umi, dtype=np.int8),
         perfect_cb=np.asarray(perfect_cb, dtype=np.int8),
-        umi_frac30=np.asarray(umi_frac30, dtype=np.float32),
-        cb_frac30=np.asarray(cb_frac30, dtype=np.float32),
-        genomic_frac30=np.asarray(genomic_frac30, dtype=np.float32),
-        genomic_mean=np.asarray(genomic_mean, dtype=np.float32),
+        umi_qual=np.asarray(umi_qual, dtype=np.uint16),
+        cb_qual=np.asarray(cb_qual, dtype=np.uint16),
+        genomic_qual=np.asarray(genomic_qual, dtype=np.uint32),
+        genomic_total=np.asarray(genomic_total, dtype=np.uint32),
     )
 
 
 _PER_RECORD_FIELDS = (
     "cell", "umi", "gene", "qname", "ref", "pos", "strand", "unmapped",
     "duplicate", "spliced", "xf", "nh", "perfect_umi", "perfect_cb",
-    "umi_frac30", "cb_frac30", "genomic_frac30", "genomic_mean",
+    "umi_qual", "cb_qual", "genomic_qual", "genomic_total",
 )
 _CODED_FIELDS = ("cell", "umi", "gene", "qname")
 

@@ -83,6 +83,23 @@ def _unpack_flags(flags: jnp.ndarray) -> Dict[str, jnp.ndarray]:
     }
 
 
+def _unpack_frac(packed: jnp.ndarray, shift: int) -> jnp.ndarray:
+    """above/len as float32 from an integer quality summary (0 len -> 0.0).
+
+    Unsigned shifts keep the u32 wide form exact; the single f32 division
+    reproduces the float the decoder used to ship before quality columns
+    went integer (exactly where the backend divides correctly-rounded;
+    within ~1 ulp on backends that lower to reciprocal-multiply).
+    """
+    length = (packed & ((1 << shift) - 1)).astype(jnp.int32)
+    above = (packed >> shift).astype(jnp.int32)
+    return jnp.where(
+        length > 0,
+        above.astype(jnp.float32) / jnp.maximum(length, 1).astype(jnp.float32),
+        0.0,
+    )
+
+
 def _stacked_moments(
     columns, valid: jnp.ndarray, outer_ids: jnp.ndarray, num_segments: int,
     count: jnp.ndarray,
@@ -119,7 +136,10 @@ def _stacked_moments(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_segments", "kind", "presorted", "prepacked"),
+    static_argnames=(
+        "num_segments", "kind", "presorted", "prepacked", "wide_genomic",
+        "small_ref",
+    ),
 )
 def compute_entity_metrics(
     cols: Dict[str, jnp.ndarray],
@@ -127,6 +147,8 @@ def compute_entity_metrics(
     kind: str = "cell",
     presorted: bool = False,
     prepacked: bool = False,
+    wide_genomic: bool = False,
+    small_ref: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """All metrics for one entity axis in a single compiled pass.
 
@@ -155,7 +177,13 @@ def compute_entity_metrics(
     layout with the *pair* code in the k2 slot — gene<<1|mito for the cell
     axis — and pads pre-masked to INT32_MAX) plus a [1] int32 ``n_valid``
     count standing in for the boolean mask — the schema
-    metrics.gatherer._pad_columns emits with ``prepacked_keys``.
+    metrics.gatherer._pad_columns emits with ``prepacked_keys``. Prepacked
+    quality columns are exact integer summaries (``umi_qual``/``cb_qual``
+    u16 = above30<<8|len; ``genomic_qual``/``genomic_total`` u16 when
+    ``wide_genomic`` is False, else u32 = above30<<16|len + raw total):
+    one f32 division per column recovers the old float schema's values
+    (exact up to the backend's division rounding) at ~1/3 the wire bytes. ``small_ref``
+    marks ``m_ref`` as u8 (unmapped<<7 | ref+1), reconstructed on device.
     Returns per-segment metric arrays plus:
       - ``entity_code``: the entity's vocabulary code per segment
       - ``segment_valid``: which segments are real
@@ -171,6 +199,15 @@ def compute_entity_metrics(
         n_valid = cols["n_valid"][0]
         valid = jnp.arange(num_segments, dtype=jnp.int32) < n_valid
         k1 = jnp.where(valid, cols["key_hi"] >> KEY_HI_SHIFT, _I32_MAX)
+        if small_ref:
+            m8 = cols["m_ref"].astype(jnp.int32)
+            m_ref = jnp.where(
+                valid,
+                ((m8 >> 7) << KEY_UNMAPPED_SHIFT) | (m8 & 0x7F),
+                _I32_MAX,
+            )
+        else:
+            m_ref = cols["m_ref"]
     else:
         valid = cols["valid"].astype(bool)
         bits_pre = _unpack_flags(cols["flags"])
@@ -208,7 +245,7 @@ def compute_entity_metrics(
     # right record-order segments.
     if prepacked:
         sorted_keys = jax.lax.sort(
-            [cols["key_hi"], cols["key_lo"], cols["m_ref"], cols["ps"]],
+            [cols["key_hi"], cols["key_lo"], m_ref, cols["ps"]],
             num_keys=4,
         )
         s_hi, s_lo, s_mref = sorted_keys[0], sorted_keys[1], sorted_keys[2]
@@ -309,12 +346,32 @@ def compute_entity_metrics(
     frag_single = sorted_sums[:, 3]
 
     # ---- float quality moments: two stacked record-order scatters --------
-    float_names = ["umi_frac30", "genomic_frac30", "genomic_mean"]
-    if kind == "cell":
-        float_names.append("cb_frac30")
+    if prepacked:
+        gshift = 16 if wide_genomic else 8
+        glen = (
+            cols["genomic_qual"] & ((1 << gshift) - 1)
+        ).astype(jnp.int32)
+        quality_cols = [
+            _unpack_frac(cols["umi_qual"], 8),
+            _unpack_frac(cols["genomic_qual"], gshift),
+            jnp.where(
+                glen > 0,
+                cols["genomic_total"].astype(jnp.float32)
+                / jnp.maximum(glen, 1).astype(jnp.float32),
+                0.0,
+            ),
+        ]
+        if kind == "cell":
+            quality_cols.append(_unpack_frac(cols["cb_qual"], 8))
+    else:
+        quality_cols = [
+            cols["umi_frac30"], cols["genomic_frac30"], cols["genomic_mean"]
+        ]
+        if kind == "cell":
+            quality_cols.append(cols["cb_frac30"])
     outer_ids = seg.segment_ids_from_starts(outer_starts)
     means, variances = _stacked_moments(
-        [cols[name] for name in float_names],
+        quality_cols,
         valid,
         outer_ids,
         num_segments,

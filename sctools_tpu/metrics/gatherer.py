@@ -52,8 +52,9 @@ def _pad_columns(
     pad_to: int = 0,
     prepacked_keys: tuple = None,
     pair_mito: bool = False,
-) -> Dict[str, np.ndarray]:
-    """ReadFrame -> dict of device-ready padded columns (+ valid mask).
+    small_ref: bool = False,
+):
+    """ReadFrame -> (device-ready padded columns, static engine flags).
 
     ``pad_to`` pins the padded size (streaming batches all share one compiled
     shape); it is ignored when the frame is larger (e.g. a single entity that
@@ -85,19 +86,23 @@ def _pad_columns(
         frame.xf, frame.perfect_umi, frame.perfect_cb, frame.nh,
         is_mito[frame.gene],
     )
-    cols = {
-        "flags": pad(flags, 0, np.int16),
-        "umi_frac30": pad(np.nan_to_num(frame.umi_frac30, nan=0.0), 0.0, np.float32),
-        "cb_frac30": pad(np.nan_to_num(frame.cb_frac30, nan=0.0), 0.0, np.float32),
-        "genomic_frac30": pad(
-            np.nan_to_num(frame.genomic_frac30, nan=0.0), 0.0, np.float32
-        ),
-        "genomic_mean": pad(
-            np.nan_to_num(frame.genomic_mean, nan=0.0), 0.0, np.float32
-        ),
-    }
+    cols = {"flags": pad(flags, 0, np.int16)}
     if prepacked_keys is None:
+        # plain schema ships the derived float32 views (the compat
+        # properties recover exactly the floats the old decoder shipped)
         cols.update(
+            umi_frac30=pad(
+                np.nan_to_num(frame.umi_frac30, nan=0.0), 0.0, np.float32
+            ),
+            cb_frac30=pad(
+                np.nan_to_num(frame.cb_frac30, nan=0.0), 0.0, np.float32
+            ),
+            genomic_frac30=pad(
+                np.nan_to_num(frame.genomic_frac30, nan=0.0), 0.0, np.float32
+            ),
+            genomic_mean=pad(
+                np.nan_to_num(frame.genomic_mean, nan=0.0), 0.0, np.float32
+            ),
             cell=pad(frame.cell, 0, np.int32),
             umi=pad(frame.umi, 0, np.int32),
             gene=pad(frame.gene, 0, np.int32),
@@ -105,22 +110,50 @@ def _pad_columns(
             pos=pad(frame.pos, 0, np.int32),
             valid=np.arange(padded) < n,
         )
-        return cols
+        return cols, {}
+    # prepacked schema v2: quality columns travel as exact integer
+    # summaries (one device-side f32 division each recovers the old float
+    # schema's values) and m_ref narrows to u8 when the
+    # reference count allows — ~23 B/record on the wire vs 34 with the
+    # float columns
     k1, k2, k3 = (
         getattr(frame, name).astype(np.int32) for name in prepacked_keys
     )
     if pair_mito:
         k2 = (k2 << 1) | is_mito[frame.gene].astype(np.int32)
     mapped = ~np.asarray(frame.unmapped, dtype=bool)
-    cols.update(
-        key_hi=pad((k1 << KEY_HI_SHIFT) | (k2 >> KEY_HI_SHIFT), _I32_MAX, np.int32),
-        key_lo=pad(((k2 & KEY_LO_MASK) << KEY_CODE_BITS) | k3, _I32_MAX, np.int32),
-        m_ref=pad(
-            np.where(mapped, 0, 1 << KEY_UNMAPPED_SHIFT)
-            + (frame.ref.astype(np.int32) + 1),
+    genomic_len = frame.genomic_qual & np.uint32(0xFFFF)
+    narrow_genomic = bool(genomic_len.max(initial=0) <= 0xFF)
+    if narrow_genomic:
+        gq = ((frame.genomic_qual >> np.uint32(16)) << np.uint32(8)) | genomic_len
+        cols.update(
+            genomic_qual=pad(gq.astype(np.uint16), 0, np.uint16),
+            genomic_total=pad(frame.genomic_total.astype(np.uint16), 0, np.uint16),
+        )
+    else:
+        cols.update(
+            genomic_qual=pad(frame.genomic_qual, 0, np.uint32),
+            genomic_total=pad(frame.genomic_total, 0, np.uint32),
+        )
+    ref_plus_1 = frame.ref.astype(np.int32) + 1
+    if small_ref:
+        m_ref = pad(
+            (np.where(mapped, 0, 0x80) | ref_plus_1).astype(np.uint8),
+            0xFF,
+            np.uint8,
+        )
+    else:
+        m_ref = pad(
+            np.where(mapped, 0, 1 << KEY_UNMAPPED_SHIFT) + ref_plus_1,
             _I32_MAX,
             np.int32,
-        ),
+        )
+    cols.update(
+        umi_qual=pad(frame.umi_qual, 0, np.uint16),
+        cb_qual=pad(frame.cb_qual, 0, np.uint16),
+        key_hi=pad((k1 << KEY_HI_SHIFT) | (k2 >> KEY_HI_SHIFT), _I32_MAX, np.int32),
+        key_lo=pad(((k2 & KEY_LO_MASK) << KEY_CODE_BITS) | k3, _I32_MAX, np.int32),
+        m_ref=m_ref,
         ps=pad(
             (frame.pos.astype(np.int32) << 1) | frame.strand.astype(np.int32),
             _I32_MAX,
@@ -128,7 +161,7 @@ def _pad_columns(
         ),
         n_valid=np.asarray([n], dtype=np.int32),
     )
-    return cols
+    return cols, {"wide_genomic": not narrow_genomic, "small_ref": small_ref}
 
 
 class MetricGatherer:
@@ -183,6 +216,15 @@ class MetricGatherer:
         from . import device as device_engine  # deferred jax import
 
         enable_compilation_cache()
+        # wire-schema decisions that must not flip mid-stream: the u8 m_ref
+        # column is chosen from the header's reference count (fixed for the
+        # whole file), and wide_genomic ratchets one-way in the dispatch
+        # loop — at most one recompile per run, never schema flapping
+        with AlignmentReader(
+            self._bam_file, mode if mode != "rb" else None
+        ) as header_probe:
+            self._small_ref = len(header_probe.header.references) <= 0x7F
+        self._wide_genomic = False
         frames = prefetch_iterator(
             iter_frames_from_bam(
                 self._bam_file,
@@ -326,13 +368,21 @@ class MetricGatherer:
             if self.entity_kind == "cell"
             else ("gene", "cell", "umi")
         )
-        cols = _pad_columns(
+        cols, static_flags = _pad_columns(
             frame,
             is_mito,
             pad_to=pad_to,
             prepacked_keys=key_order if prepacked else None,
             pair_mito=self.entity_kind == "cell",
+            small_ref=self._small_ref,
         )
+        if static_flags.get("wide_genomic"):
+            # one-way ratchet: once any batch needs the wide genomic
+            # columns, later batches stay wide (at most one extra compile
+            # per run instead of flapping between schemas)
+            self._wide_genomic = True
+        if self._wide_genomic:
+            static_flags["wide_genomic"] = True
         num_segments = len(cols["flags"])
         result = device_engine.compute_entity_metrics(
             {k: np.asarray(v) for k, v in cols.items()},
@@ -340,6 +390,7 @@ class MetricGatherer:
             kind=self.entity_kind,
             presorted=presorted,
             prepacked=prepacked,
+            **static_flags,
         )
         # keep only what finalize reads: pinning the whole frame would hold
         # ~40 MB of record arrays per in-flight batch for no reason

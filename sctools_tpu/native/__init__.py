@@ -107,8 +107,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.scx_col_i32.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.scx_col_i8.restype = ctypes.POINTER(ctypes.c_int8)
         lib.scx_col_i8.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.scx_col_f32.restype = ctypes.POINTER(ctypes.c_float)
-        lib.scx_col_f32.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_col_u16.restype = ctypes.POINTER(ctypes.c_uint16)
+        lib.scx_col_u16.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_col_u32.restype = ctypes.POINTER(ctypes.c_uint32)
+        lib.scx_col_u32.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.scx_vocab_size.restype = ctypes.c_long
         lib.scx_vocab_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.scx_vocab_bytes.restype = ctypes.POINTER(ctypes.c_char)
@@ -190,10 +192,10 @@ def _empty_frame():
         xf=np.zeros(0, np.int8), nh=empty_i32.copy(),
         perfect_umi=np.zeros(0, np.int8),
         perfect_cb=np.zeros(0, np.int8),
-        umi_frac30=np.zeros(0, np.float32),
-        cb_frac30=np.zeros(0, np.float32),
-        genomic_frac30=np.zeros(0, np.float32),
-        genomic_mean=np.zeros(0, np.float32),
+        umi_qual=np.zeros(0, np.uint16),
+        cb_qual=np.zeros(0, np.uint16),
+        genomic_qual=np.zeros(0, np.uint32),
+        genomic_total=np.zeros(0, np.uint32),
     )
 
 
@@ -211,8 +213,11 @@ def _frame_from_handle(lib, handle, want_qname: bool):
     def i8(name, dtype=np.int8):
         return _copy_array(lib.scx_col_i8(handle, name), n, dtype)
 
-    def f32(name):
-        return _copy_array(lib.scx_col_f32(handle, name), n, np.float32)
+    def u16(name):
+        return _copy_array(lib.scx_col_u16(handle, name), n, np.uint16)
+
+    def u32(name):
+        return _copy_array(lib.scx_col_u32(handle, name), n, np.uint32)
 
     return ReadFrame(
         cell=i32(b"cell"), umi=i32(b"umi"), gene=i32(b"gene"),
@@ -229,10 +234,10 @@ def _frame_from_handle(lib, handle, want_qname: bool):
         xf=i8(b"xf"), nh=i32(b"nh"),
         perfect_umi=i8(b"perfect_umi"),
         perfect_cb=i8(b"perfect_cb"),
-        umi_frac30=f32(b"umi_frac30"),
-        cb_frac30=f32(b"cb_frac30"),
-        genomic_frac30=f32(b"genomic_frac30"),
-        genomic_mean=f32(b"genomic_mean"),
+        umi_qual=u16(b"umi_qual"),
+        cb_qual=u16(b"cb_qual"),
+        genomic_qual=u32(b"genomic_qual"),
+        genomic_total=u32(b"genomic_total"),
     )
 
 

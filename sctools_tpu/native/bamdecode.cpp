@@ -65,7 +65,9 @@ struct Columns {
   std::vector<int32_t> cell, umi, gene, qname, ref, pos, nh;
   std::vector<int8_t> strand, xf, perfect_umi, perfect_cb;
   std::vector<uint8_t> unmapped, duplicate, spliced;
-  std::vector<float> umi_frac30, cb_frac30, genomic_frac30, genomic_mean;
+  std::vector<uint16_t> umi_qual, cb_qual;     // above30<<8 | len, 0=missing
+  std::vector<uint32_t> genomic_qual;          // above30<<16 | aligned len
+  std::vector<uint32_t> genomic_total;         // sum of aligned phreds
 
   size_t size() const { return cell.size(); }
 
@@ -75,8 +77,8 @@ struct Columns {
     strand.resize(n); xf.resize(n); perfect_umi.resize(n);
     perfect_cb.resize(n);
     unmapped.resize(n); duplicate.resize(n); spliced.resize(n);
-    umi_frac30.resize(n); cb_frac30.resize(n);
-    genomic_frac30.resize(n); genomic_mean.resize(n);
+    umi_qual.resize(n); cb_qual.resize(n);
+    genomic_qual.resize(n); genomic_total.resize(n);
   }
 
   void clear() { resize(0); }
@@ -547,12 +549,14 @@ bool read_header(Stream& s) {
 
 // --------------------------------------------------------------- BAM parse
 
-inline float phred_frac_above30(const char* qual, size_t len) {
-  if (len == 0) return NAN;
-  size_t above = 0;
+// above30<<8 | len for a string-encoded quality tag; 0 means missing.
+// Lengths above 255 degrade to missing (no real barcode approaches that).
+inline uint16_t pack_string_qual(const char* qual, size_t len) {
+  if (len == 0 || len > 0xFF) return 0;
+  uint32_t above = 0;
   for (size_t i = 0; i < len; ++i)
     above += static_cast<uint8_t>(qual[i]) > 63;  // q - 33 > 30
-  return static_cast<float>(above) / static_cast<float>(len);
+  return static_cast<uint16_t>((above << 8) | len);
 }
 
 struct TagView {
@@ -749,8 +753,8 @@ bool parse_record(const uint8_t* rec, uint32_t block_size, size_t i,
                   std::memcmp(tags.cr, tags.cb, tags.cb_len) == 0) ? 1 : 0;
   c.perfect_cb[i] = perfect_cb;
 
-  c.umi_frac30[i] = tags.uy ? phred_frac_above30(tags.uy, tags.uy_len) : NAN;
-  c.cb_frac30[i] = tags.cy ? phred_frac_above30(tags.cy, tags.cy_len) : NAN;
+  c.umi_qual[i] = tags.uy ? pack_string_qual(tags.uy, tags.uy_len) : 0;
+  c.cb_qual[i] = tags.cy ? pack_string_qual(tags.cy, tags.cy_len) : 0;
 
   // aligned-portion qualities; an all-0xFF fill means "absent" in BAM
   // (BamRecord.from_bytes sets quality=None only when every byte is 0xFF)
@@ -758,20 +762,22 @@ bool parse_record(const uint8_t* rec, uint32_t block_size, size_t i,
   for (uint32_t k = 0; k < l_seq; ++k) {
     if (qual[k] != 0xff) { has_qual = true; break; }
   }
-  if (has_qual && clip_end > clip_start) {
-    uint32_t n = clip_end - clip_start;
+  uint32_t n_aligned = clip_end > clip_start ? clip_end - clip_start : 0;
+  if (has_qual && n_aligned > 0 && n_aligned <= 0xFFFF) {
     uint32_t above = 0;
-    uint64_t total = 0;
+    uint32_t total = 0;  // <= 255 * 65535 < 2^24
     for (uint32_t k = clip_start; k < clip_end; ++k) {
       uint8_t q = qual[k];
       above += q > 30;
       total += q;
     }
-    c.genomic_frac30[i] = static_cast<float>(above) / n;
-    c.genomic_mean[i] = static_cast<float>(total) / n;
+    c.genomic_qual[i] = (above << 16) | n_aligned;
+    c.genomic_total[i] = total;
   } else {
-    c.genomic_frac30[i] = NAN;
-    c.genomic_mean[i] = NAN;
+    // absent qualities, or an aligned window beyond 65535 bases (outside
+    // the short-read domain) degrade to "absent"
+    c.genomic_qual[i] = 0;
+    c.genomic_total[i] = 0;
   }
   return true;
 }
@@ -1033,13 +1039,19 @@ const int8_t* scx_col_i8(void* h, const char* name) {
   return nullptr;
 }
 
-const float* scx_col_f32(void* h, const char* name) {
+const uint16_t* scx_col_u16(void* h, const char* name) {
   Columns& c = static_cast<Stream*>(h)->batch.cols;
   std::string_view n(name);
-  if (n == "umi_frac30") return c.umi_frac30.data();
-  if (n == "cb_frac30") return c.cb_frac30.data();
-  if (n == "genomic_frac30") return c.genomic_frac30.data();
-  if (n == "genomic_mean") return c.genomic_mean.data();
+  if (n == "umi_qual") return c.umi_qual.data();
+  if (n == "cb_qual") return c.cb_qual.data();
+  return nullptr;
+}
+
+const uint32_t* scx_col_u32(void* h, const char* name) {
+  Columns& c = static_cast<Stream*>(h)->batch.cols;
+  std::string_view n(name);
+  if (n == "genomic_qual") return c.genomic_qual.data();
+  if (n == "genomic_total") return c.genomic_total.data();
   return nullptr;
 }
 

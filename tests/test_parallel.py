@@ -66,7 +66,7 @@ def _random_records(n_cells=24, n_genes=12, seed=7):
 def padded_cols():
     frame = frame_from_records(_random_records())
     is_mito = np.zeros(len(frame.gene_names), dtype=bool)
-    return _pad_columns(frame, is_mito)
+    return _pad_columns(frame, is_mito)[0]
 
 
 @pytest.fixture(scope="module")

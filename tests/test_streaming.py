@@ -13,7 +13,7 @@ import gzip
 import numpy as np
 import pytest
 
-from helpers import make_record, write_bam
+from helpers import make_header, make_record, write_bam
 from sctools_tpu.io.packed import (
     concat_frames,
     frame_from_bam,
@@ -223,9 +223,10 @@ def test_prepacked_schema_matches_plain(tmp_path):
     frame = frame_from_bam(bam)
     is_mito = np.zeros(len(frame.gene_names), dtype=bool)
 
-    plain = _pad_columns(frame, is_mito)
-    packed = _pad_columns(
-        frame, is_mito, prepacked_keys=("cell", "gene", "umi"), pair_mito=True
+    plain, _ = _pad_columns(frame, is_mito)
+    packed, static_flags = _pad_columns(
+        frame, is_mito, prepacked_keys=("cell", "gene", "umi"),
+        pair_mito=True, small_ref=True,
     )
     n = len(plain["flags"])
     a = device_engine.compute_entity_metrics(
@@ -235,9 +236,70 @@ def test_prepacked_schema_matches_plain(tmp_path):
     b = device_engine.compute_entity_metrics(
         {k: np.asarray(v) for k, v in packed.items()},
         num_segments=n, kind="cell", presorted=True, prepacked=True,
+        **static_flags,
     )
     assert int(a["n_entities"]) == int(b["n_entities"]) == len(cells)
     for key in a:
+        np.testing.assert_allclose(
+            np.asarray(a[key]), np.asarray(b[key]),
+            rtol=1e-6, atol=0, equal_nan=True, err_msg=key,
+        )
+
+
+def test_prepacked_wide_fallbacks_match_plain(tmp_path):
+    """Long aligned windows (>255 bases) and reference counts beyond the u8
+    m_ref budget take the wide prepacked columns; results must not change."""
+    import random as _random
+
+    import sctools_tpu.metrics.device as device_engine
+    from sctools_tpu.io.packed import frame_from_bam
+    from sctools_tpu.metrics.gatherer import _pad_columns
+
+    rng = _random.Random(5)
+    header = make_header(references=[(f"chr{i}", 10_000_000) for i in range(200)])
+    cells = sorted(
+        "".join(rng.choice("ACGT") for _ in range(8)) for _ in range(6)
+    )
+    records = []
+    for cb in cells:
+        for i in range(6):
+            records.append(
+                make_record(
+                    name=f"{cb}{i}", cb=cb, cr=cb, cy="IIII",
+                    ub="".join(rng.choice("ACGT") for _ in range(4)),
+                    ur="ACGT", uy="IIII",
+                    ge=rng.choice(["G1", "G2"]), xf="CODING", nh=1,
+                    pos=rng.randrange(1000),
+                    reference_id=rng.randrange(200),  # > 127: wide m_ref
+                    sequence="ACGT" * 80,  # 320 aligned bases: wide genomic
+                    header=header,
+                )
+            )
+    bam = write_bam(str(tmp_path / "wide.bam"), records, header)
+    frame = frame_from_bam(bam)
+    assert int((frame.genomic_qual & 0xFFFF).max()) > 0xFF
+    assert int(frame.ref.max()) >= 0x7F
+    is_mito = np.zeros(len(frame.gene_names), dtype=bool)
+    plain, _ = _pad_columns(frame, is_mito)
+    packed, static_flags = _pad_columns(
+        frame, is_mito, prepacked_keys=("cell", "gene", "umi"), pair_mito=True
+    )
+    assert static_flags == {"wide_genomic": True, "small_ref": False}
+    n = len(plain["flags"])
+    a = device_engine.compute_entity_metrics(
+        {k: np.asarray(v) for k, v in plain.items()},
+        num_segments=n, kind="cell", presorted=True,
+    )
+    b = device_engine.compute_entity_metrics(
+        {k: np.asarray(v) for k, v in packed.items()},
+        num_segments=n, kind="cell", presorted=True, prepacked=True,
+        **static_flags,
+    )
+    assert int(a["n_entities"]) == int(b["n_entities"]) == len(cells)
+    for key in a:
+        # float columns: the prepacked path divides above/len on device,
+        # which some backends lower to reciprocal-multiply (~1 ulp, not
+        # correctly rounded) — tolerance, not bit equality
         np.testing.assert_allclose(
             np.asarray(a[key]), np.asarray(b[key]),
             rtol=1e-6, atol=0, equal_nan=True, err_msg=key,
