@@ -178,13 +178,21 @@ class MetricGatherer:
         compress: bool = True,
         backend: str = "device",
         batch_records: int = DEFAULT_BATCH_RECORDS,
+        frame_source=None,
     ):
+        """``frame_source``: optional zero-arg callable yielding sorted
+        ReadFrames in place of decoding ``bam_file`` (the fused tag-sort
+        path streams the merge straight in here via
+        native.tagsort_stream_frames). ``bam_file`` still names the
+        unsorted input: the device backend reads its header for wire-schema
+        decisions; the cpu backend does not support frame sources."""
         self._bam_file = bam_file
         self._output_stem = output_stem
         self._compress = compress
         self._mitochondrial_gene_ids = mitochondrial_gene_ids
         self._backend = backend
         self._batch_records = batch_records
+        self._frame_source = frame_source
 
     @property
     def bam_file(self) -> str:
@@ -194,6 +202,8 @@ class MetricGatherer:
         if self._backend == "device":
             self._extract_device(mode)
         elif self._backend == "cpu":
+            if self._frame_source is not None:
+                raise ValueError("frame_source requires the device backend")
             self._extract_cpu(mode)
         else:
             raise ValueError(f"unknown backend {self._backend!r}")
@@ -225,13 +235,16 @@ class MetricGatherer:
         ) as header_probe:
             self._small_ref = len(header_probe.header.references) <= 0x7F
         self._wide_genomic = False
-        frames = prefetch_iterator(
-            iter_frames_from_bam(
-                self._bam_file,
-                self._batch_records,
-                mode if mode != "rb" else None,
+        if self._frame_source is not None:
+            frames = prefetch_iterator(self._frame_source())
+        else:
+            frames = prefetch_iterator(
+                iter_frames_from_bam(
+                    self._bam_file,
+                    self._batch_records,
+                    mode if mode != "rb" else None,
+                )
             )
-        )
         out = MetricCSVWriter(self._output_stem, self._compress)
         try:
             with closing(out):

@@ -144,6 +144,20 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_int,
         ]
+        lib.scx_tagsort_pipe_open.restype = ctypes.c_void_p
+        lib.scx_tagsort_pipe_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_tagsort_pipe_fd.restype = ctypes.c_int
+        lib.scx_tagsort_pipe_fd.argtypes = [ctypes.c_void_p]
+        lib.scx_tagsort_pipe_finish.restype = ctypes.c_long
+        lib.scx_tagsort_pipe_finish.argtypes = [ctypes.c_void_p]
+        lib.scx_tagsort_pipe_error.restype = ctypes.c_char_p
+        lib.scx_tagsort_pipe_error.argtypes = [ctypes.c_void_p]
+        lib.scx_tagsort_pipe_free.restype = None
+        lib.scx_tagsort_pipe_free.argtypes = [ctypes.c_void_p]
         lib.scx_format_csv_block.restype = ctypes.c_long
         lib.scx_format_csv_block.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
@@ -437,6 +451,102 @@ def format_csv_block(index, columns) -> Optional[bytes]:
         raise RuntimeError("csv block formatting overflowed its buffer")
     # copy only the written prefix (.raw would materialize all of capacity)
     return ctypes.string_at(out, written)
+
+
+def tagsort_stream_frames(
+    input_bam: str,
+    tag_keys,
+    batch_records: int = 1 << 20,
+    sort_batch_records: int = 500_000,
+    bam_output: Optional[str] = None,
+    bam_compress_level: int = 1,
+    scratch_prefix: Optional[str] = None,
+    n_threads: Optional[int] = None,
+    want_qname: bool = False,
+):
+    """Yield sorted ReadFrames streamed straight out of the tag-sort merge.
+
+    The fused one-pass path (the reference computes metrics DURING its
+    k-way merge, fastqpreprocessing/src/tagsort.cpp:185-196): a worker
+    thread runs the out-of-core sort and streams the merged records as
+    plain BAM through a pipe; the parallel column decoder reads the other
+    end. No sorted BAM is written, compressed, or re-read — unless
+    ``bam_output`` is given, in which case the same merge pass tees the
+    compressed sorted BAM to disk.
+
+    Raises RuntimeError on sort or decode failure; on early abandonment of
+    the generator the worker is unblocked by closing the pipe ends.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    keys = list(tag_keys)
+    if len(keys) != 3 or any(len(k) != 2 for k in keys):
+        raise RuntimeError("native tagsort requires exactly three 2-char tags")
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    if scratch_prefix is None:
+        # next to the teed output when there is one, else the temp dir —
+        # never beside the input (which may be on a read-only mount)
+        import tempfile
+
+        base = bam_output or os.path.join(
+            tempfile.gettempdir(), os.path.basename(input_bam)
+        )
+        scratch_prefix = base + ".tagsort_partial"
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_tagsort_pipe_open(
+        input_bam.encode(), keys[0].encode(), keys[1].encode(),
+        keys[2].encode(), sort_batch_records,
+        (bam_output or "").encode(), bam_compress_level,
+        scratch_prefix.encode(), errbuf, ctypes.sizeof(errbuf),
+    )
+    if not handle:
+        raise RuntimeError(
+            f"tagsort pipe open failed: {errbuf.value.decode(errors='replace')}"
+        )
+    stream = None
+    try:
+        read_fd = lib.scx_tagsort_pipe_fd(handle)
+        stream = lib.scx_stream_open(
+            f"/proc/self/fd/{read_fd}".encode(), n_threads,
+            1 if want_qname else 0, errbuf, ctypes.sizeof(errbuf),
+        )
+        if not stream:
+            raise RuntimeError(
+                "tagsort stream open failed: "
+                f"{errbuf.value.decode(errors='replace')}"
+            )
+        total = 0
+        while True:
+            n = lib.scx_stream_next(stream, batch_records)
+            if n < 0:
+                raise RuntimeError(
+                    "tagsort stream failed: "
+                    f"{lib.scx_stream_error(stream).decode(errors='replace')}"
+                )
+            if n == 0:
+                break
+            total += n
+            yield _frame_from_handle(lib, stream, want_qname)
+        # close OUR read descriptors before joining the worker, so a
+        # failed/blocked writer cannot deadlock the join
+        lib.scx_stream_close(stream)
+        stream = None
+        merged = lib.scx_tagsort_pipe_finish(handle)
+        if merged < 0:
+            raise RuntimeError(
+                "tagsort merge failed: "
+                f"{lib.scx_tagsort_pipe_error(handle).decode(errors='replace')}"
+            )
+        if merged != total:
+            raise RuntimeError(
+                f"tagsort stream truncated: decoded {total} of {merged} records"
+            )
+    finally:
+        if stream is not None:
+            lib.scx_stream_close(stream)
+        lib.scx_tagsort_pipe_free(handle)
 
 
 def _correct_batch(corrector, raw: bytes, n: int, cb_len: int):

@@ -32,7 +32,10 @@ class InflateReader {
     if (!file_) return false;
     std::memset(&strm_, 0, sizeof(strm_));
     plain_probe();
-    if (!plain_ && inflateInit2(&strm_, 15 + 32) != Z_OK) return false;
+    if (!plain_) {
+      if (inflateInit2(&strm_, 15 + 32) != Z_OK) return false;
+      inited_ = true;
+    }
     return true;
   }
 
@@ -64,7 +67,10 @@ class InflateReader {
 
   ~InflateReader() {
     if (file_) std::fclose(file_);
-    if (!plain_) inflateEnd(&strm_);
+    // only after a successful inflateInit2: this reader is a member of
+    // BgzfInflateReader and may never have been opened at all (BGZF/plain
+    // inputs) — inflateEnd on an uninitialized z_stream reads garbage
+    if (inited_) inflateEnd(&strm_);
   }
 
  private:
@@ -87,6 +93,7 @@ class InflateReader {
   uint8_t inbuf_[1 << 16];
   bool plain_ = false;
   bool error_ = false;
+  bool inited_ = false;
 };
 
 // BGZF-aware reader: libdeflate per block (~3-4x zlib), falling back to

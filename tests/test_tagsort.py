@@ -105,3 +105,111 @@ def test_native_merge_path_matches_python(tmp_path):
             ]
 
     assert decoded(native_out) == decoded(python_out)
+
+
+class TestFusedMetrics:
+    """Metrics computed DURING the native merge must equal the two-pass
+    sort-then-gather result (the reference fuses the same way,
+    fastqpreprocessing/src/tagsort.cpp:185-196)."""
+
+    def _messy_bam(self, tmp_path, n=4000, seed=9):
+        rng = random.Random(seed)
+        header = make_header()
+        cells = ["".join(rng.choice("ACGT") for _ in range(8)) for _ in range(40)]
+        records = []
+        for i in range(n):
+            unmapped = rng.random() < 0.1
+            records.append(
+                make_record(
+                    name=f"q{rng.randrange(100000):06d}",
+                    cb=rng.choice(cells), cr=rng.choice(cells), cy="IIII",
+                    ub="".join(rng.choice("ACGT") for _ in range(6)),
+                    ur="ACGT", uy="IIII",
+                    ge=rng.choice(["G1", "G2", "mt-X", None]),
+                    xf=None if unmapped else rng.choice(
+                        ["CODING", "INTRONIC", "UTR", "INTERGENIC"]
+                    ),
+                    nh=None if unmapped else rng.choice([1, 2]),
+                    pos=rng.randrange(100000), unmapped=unmapped,
+                    duplicate=rng.random() < 0.2,
+                    spliced=rng.random() < 0.3,
+                    reverse=rng.random() < 0.5,
+                    header=header,
+                )
+            )
+        return write_bam(str(tmp_path / "messy.bam"), records, header)
+
+    @pytest.mark.parametrize(
+        "kind,tags,flag",
+        [
+            ("cell", ["CB", "UB", "GE"], "--cell-metrics-output"),
+            ("gene", ["GE", "CB", "UB"], "--gene-metrics-output"),
+        ],
+    )
+    def test_fused_equals_two_pass(self, tmp_path, kind, tags, flag):
+        import gzip
+
+        bam_path = self._messy_bam(tmp_path)
+        # two-pass: sort to a file, then gather
+        sorted_path = str(tmp_path / "sorted.bam")
+        rc = platform.GenericPlatform.tag_sort_bam(
+            ["-i", bam_path, "-o", sorted_path, "-t", *tags,
+             "--records-per-chunk", "1000"]
+        )
+        assert rc == 0
+        from sctools_tpu.metrics.gatherer import (
+            GatherCellMetrics,
+            GatherGeneMetrics,
+        )
+
+        gatherer_cls = GatherCellMetrics if kind == "cell" else GatherGeneMetrics
+        gatherer_cls(sorted_path, str(tmp_path / "two_pass")).extract_metrics()
+
+        # fused: metrics straight off the merge, teeing the sorted bam too
+        fused_bam = str(tmp_path / "fused_sorted.bam")
+        rc = platform.GenericPlatform.tag_sort_bam(
+            ["-i", bam_path, "-o", fused_bam, "-t", *tags, flag,
+             str(tmp_path / "fused"), "--records-per-chunk", "1000"]
+        )
+        assert rc == 0
+        two = gzip.open(tmp_path / "two_pass.csv.gz").read()
+        fused = gzip.open(tmp_path / "fused.csv.gz").read()
+        assert fused == two
+        # the teed sorted bam equals the two-pass sorted bam record for record
+        with AlignmentReader(sorted_path) as a, AlignmentReader(fused_bam) as b:
+            for ra, rb in zip(a, b, strict=True):
+                assert ra.query_name == rb.query_name
+                assert dict(ra.tags) == dict(rb.tags)
+
+    def test_fused_without_bam_output(self, tmp_path):
+        import gzip
+
+        bam_path = self._messy_bam(tmp_path, n=1000, seed=4)
+        rc = platform.GenericPlatform.tag_sort_bam(
+            ["-i", bam_path, "-t", "CB", "UB", "GE",
+             "--cell-metrics-output", str(tmp_path / "only_metrics")]
+        )
+        assert rc == 0
+        rows = gzip.open(tmp_path / "only_metrics.csv.gz").read().decode()
+        assert len(rows.strip().splitlines()) > 1
+        assert not (tmp_path / "only_metrics.bam").exists()
+
+    def test_tag_order_is_validated(self, tmp_path):
+        bam_path = self._messy_bam(tmp_path, n=100, seed=5)
+        with pytest.raises(SystemExit):
+            platform.GenericPlatform.tag_sort_bam(
+                ["-i", bam_path, "-t", "GE", "CB", "UB",
+                 "--cell-metrics-output", str(tmp_path / "x")]
+            )
+
+    def test_fused_failure_leaves_no_csv(self, tmp_path):
+        truncated = tmp_path / "bad.bam"
+        good = self._messy_bam(tmp_path, n=500, seed=6)
+        data = open(good, "rb").read()
+        truncated.write_bytes(data[: len(data) // 2])  # mid-block cut
+        with pytest.raises(RuntimeError):
+            platform.GenericPlatform.tag_sort_bam(
+                ["-i", str(truncated), "-t", "CB", "UB", "GE",
+                 "--cell-metrics-output", str(tmp_path / "broken")]
+            )
+        assert not (tmp_path / "broken.csv.gz").exists()
