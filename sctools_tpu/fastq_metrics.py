@@ -128,15 +128,32 @@ def compute_fastq_metrics(
     fastq_files: List[str],
     read_structure: str,
     output_prefix: str,
-) -> FastQMetrics:
-    """Scan shards into one accumulator and write the outputs.
+) -> Optional[FastQMetrics]:
+    """Scan shards and write the four outputs; native scan when available.
 
-    The reference builds one shard object per file and folds them at the end
-    (fastq_metrics.cpp:174-209) because its shards run on parallel threads;
-    sequential ingestion accumulates directly — ``+=`` remains for callers
-    that do process shards concurrently.
+    The native layer runs the reference's per-shard thread fan-out
+    (fastq_metrics.cpp:174-209) with byte-identical outputs (this module's
+    Python accumulator is the pinned oracle, tests/test_fastq_metrics.py);
+    without it, shards ingest sequentially here. Returns the Python
+    accumulator on the fallback path, None on the native path.
     """
-    total = FastQMetrics(read_structure)
+    if isinstance(fastq_files, str):
+        fastq_files = [fastq_files]
+    structure = ReadStructure(read_structure)
+    from . import native
+
+    if native.available():
+        # raises ValueError on short reads (structural -2 code) and
+        # RuntimeError on IO failures, matching the oracle's contract
+        native.fastq_metrics_native(
+            fastq_files,
+            structure.spans("C"),
+            structure.spans("M"),
+            structure.length,
+            output_prefix,
+        )
+        return None
+    total = FastQMetrics(structure)
     total.ingest(fastq_files)
     total.write(output_prefix)
     return total

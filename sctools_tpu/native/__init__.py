@@ -158,6 +158,35 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.scx_tagsort_pipe_error.argtypes = [ctypes.c_void_p]
         lib.scx_tagsort_pipe_free.restype = None
         lib.scx_tagsort_pipe_free.argtypes = [ctypes.c_void_p]
+        lib.scx_fqm.restype = ctypes.c_long
+        lib.scx_fqm.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_sfq_open.restype = ctypes.c_void_p
+        lib.scx_sfq_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_sfq_next.restype = ctypes.c_long
+        lib.scx_sfq_next.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.scx_sfq_buf.restype = ctypes.POINTER(ctypes.c_char)
+        lib.scx_sfq_buf.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_sfq_len.restype = ctypes.c_int
+        lib.scx_sfq_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_sfq_write.restype = ctypes.c_long
+        lib.scx_sfq_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.scx_sfq_close.restype = ctypes.c_int
+        lib.scx_sfq_close.argtypes = [ctypes.c_void_p]
+        lib.scx_sfq_error.restype = ctypes.c_char_p
+        lib.scx_sfq_error.argtypes = [ctypes.c_void_p]
+        lib.scx_sfq_free.restype = None
+        lib.scx_sfq_free.argtypes = [ctypes.c_void_p]
         lib.scx_format_csv_block.restype = ctypes.c_long
         lib.scx_format_csv_block.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
@@ -547,6 +576,123 @@ def tagsort_stream_frames(
         if stream is not None:
             lib.scx_stream_close(stream)
         lib.scx_tagsort_pipe_free(handle)
+
+
+def fastq_metrics_native(
+    fastq_files,
+    cb_spans,
+    umi_spans,
+    min_length: int,
+    output_prefix: str,
+    n_threads: Optional[int] = None,
+) -> int:
+    """Native per-shard parallel fastq_metrics scan (scx_fqm).
+
+    Writes the reference's four output files with bytes identical to the
+    Python FastQMetrics oracle. Returns reads processed; raises
+    RuntimeError when the native layer is unavailable or a shard fails.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    cb_arr, n_cb = _spans_array(cb_spans)
+    umi_arr, n_umi = _spans_array(umi_spans)
+    errbuf = ctypes.create_string_buffer(512)
+    n = lib.scx_fqm(
+        "\n".join(fastq_files).encode(), cb_arr, n_cb, umi_arr, n_umi,
+        min_length, output_prefix.encode(), n_threads,
+        errbuf, ctypes.sizeof(errbuf),
+    )
+    if n == -2:  # validation failure: the Python oracle's ValueError
+        raise ValueError(errbuf.value.decode(errors="replace"))
+    if n < 0:
+        raise RuntimeError(
+            f"fastq metrics failed: {errbuf.value.decode(errors='replace')}"
+        )
+    return n
+
+
+def sample_fastq_native(
+    r1_files,
+    r2_files,
+    whitelist: str,
+    cb_spans,
+    umi_spans,
+    output_prefix: str,
+    batch_size: int = 1 << 16,
+):
+    """Native samplefastq: C++ IO loop + device whitelist correction.
+
+    Mirrors the reference pipeline (samplefastq.cpp:85-103) the way
+    fastqprocess does: batches of R1/R2 reads stream through native IO,
+    each batch's cell barcodes correct on the device kernel, and kept
+    reads re-emit with the fixed slide-seq R1 rewrite. Returns
+    (kept, total); output bytes are identical to the Python oracle.
+    """
+    from ..ops.whitelist import WhitelistCorrector
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    corrector = WhitelistCorrector.from_file(whitelist)
+    cb_arr, n_cb = _spans_array(cb_spans)
+    umi_arr, n_umi = _spans_array(umi_spans)
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_sfq_open(
+        "\n".join(r1_files).encode(), "\n".join(r2_files).encode(),
+        cb_arr, n_cb, umi_arr, n_umi, output_prefix.encode(),
+        errbuf, ctypes.sizeof(errbuf),
+    )
+    if not handle:
+        raise RuntimeError(
+            f"samplefastq open failed: {errbuf.value.decode(errors='replace')}"
+        )
+    kept = total = 0
+    failed = False
+    try:
+        cb_len = lib.scx_sfq_len(handle, b"cr")
+        if cb_len != corrector.barcode_length:
+            raise RuntimeError(
+                f"whitelist barcode length {corrector.barcode_length} does "
+                f"not match the cell barcode span length {cb_len}"
+            )
+        while True:
+            n = lib.scx_sfq_next(handle, batch_size)
+            if n == -2:  # strict-zip mismatch: the oracle's ValueError
+                raise ValueError(lib.scx_sfq_error(handle).decode())
+            if n < 0:
+                raise RuntimeError(
+                    f"samplefastq read failed: {lib.scx_sfq_error(handle).decode()}"
+                )
+            if n == 0:
+                break
+            total += n
+            raw = ctypes.string_at(lib.scx_sfq_buf(handle, b"cr"), n * cb_len)
+            # shared batch-correction helper: the keep mask is exactly its
+            # corrected-vs-None mask (attach/fastqprocess use the same one)
+            _, _, _, keep_mask = _correct_batch(corrector, raw, n, cb_len)
+            written = lib.scx_sfq_write(handle, n, keep_mask)
+            if written < 0:
+                raise RuntimeError(
+                    f"samplefastq write failed: {lib.scx_sfq_error(handle).decode()}"
+                )
+            kept += written
+        if lib.scx_sfq_close(handle) != 0:
+            raise RuntimeError("samplefastq close failed")
+        return kept, total
+    except BaseException:
+        failed = True
+        raise
+    finally:
+        lib.scx_sfq_free(handle)
+        if failed:
+            for suffix in (".R1", ".R2"):
+                try:
+                    os.remove(output_prefix + suffix)
+                except OSError:
+                    pass
 
 
 def _correct_batch(corrector, raw: bytes, n: int, cb_len: int):

@@ -40,6 +40,19 @@ def sample_fastq(
     samplefastq.cpp:91-97).
     """
     structure = ReadStructure(read_structure)
+    if isinstance(r1_files, str):
+        r1_files = [r1_files]
+    if isinstance(r2_files, str):
+        r2_files = [r2_files]
+    from . import native
+
+    if native.available():
+        # native IO loop + device correction (byte-identical to the Python
+        # loop below, which is the pinned oracle — tests/test_fastq_metrics)
+        return native.sample_fastq_native(
+            r1_files, r2_files, whitelist_file,
+            structure.spans("C"), structure.spans("M"), output_prefix,
+        )
     corrector = WhitelistCorrector.from_file(whitelist_file)
 
     kept = 0
