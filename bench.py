@@ -153,6 +153,46 @@ def bench_compute_only() -> float:
     return float(np.median(times))
 
 
+def bench_link_bandwidth() -> dict:
+    """Measured host<->device bandwidth, MB/s (median of 3 x 25MB probes).
+
+    On this driver's tunneled TPU the link swings ~3-170 MB/s across a day
+    and moves the end-to-end headline directly (BASELINE.md caveats);
+    reporting the bandwidth next to the headline keeps the number honest —
+    a reader can tell link weather from code changes.
+    """
+    import statistics
+
+    import jax
+    import numpy as np
+
+    buf = np.random.default_rng(0).random(25 * 1024 * 1024 // 4).astype(
+        np.float32
+    )
+    mb = buf.nbytes / 1e6
+
+    def up() -> float:
+        start = time.perf_counter()
+        device = jax.device_put(buf)
+        # pull one scalar: block_until_ready alone under-reports on
+        # tunneled backends
+        float(device[0])
+        return mb / (time.perf_counter() - start)
+
+    def down() -> float:
+        device = jax.device_put(buf)
+        float(device[0])
+        start = time.perf_counter()
+        np.asarray(device)
+        return mb / (time.perf_counter() - start)
+
+    up()  # first transfer can include backend setup
+    return {
+        "h2d_MBps": round(statistics.median(up() for _ in range(3)), 1),
+        "d2h_MBps": round(statistics.median(down() for _ in range(3)), 1),
+    }
+
+
 def bench_cpu_baseline(bam_path: str) -> float:
     """Reference-semantics streaming aggregation over the same BAM, cells/sec.
 
@@ -213,6 +253,8 @@ def main():
         "value": round(cells_per_sec, 2),
         "unit": "cells/sec",
         "vs_baseline": round(cells_per_sec / cpu_cells_per_sec, 2),
+        # measured link weather: the headline's dominant environmental term
+        "link_MBps": bench_link_bandwidth(),
     }
     if breakdown:
         decode_s = bench_decode_only(bam_path)
