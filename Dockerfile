@@ -1,0 +1,43 @@
+# Container build for sctools_tpu (the role of the reference's Dockerfile,
+# which compiles libStatGen/htslib/gzstream and the fastqpreprocessing
+# binaries, /root/reference/Dockerfile:14-28). This image needs far less:
+# the native layer is one shared library over zlib + libdeflate, and the
+# compute path is JAX (CPU wheel by default; swap the extra for a TPU
+# release to target real chips).
+#
+#   docker build -t sctools-tpu .
+#   docker run --rm sctools-tpu CalculateCellMetrics --help
+#
+# The build runs the full CI gate (native build + lint floor + test suite
+# on an 8-device virtual CPU mesh), so an image that builds is an image
+# whose pipeline works.
+#
+# The native library compiles -march=native; when the image later runs on
+# a different CPU, the ctypes loader's build-host fingerprint check
+# (sctools_tpu/native/__init__.py) rebuilds it on first use — g++ stays in
+# the image for exactly that reason.
+
+FROM python:3.12-slim-bookworm
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make zlib1g-dev libdeflate-dev \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/sctools_tpu
+
+# dependency layer first: code edits don't reinstall jax
+COPY pyproject.toml ./
+RUN pip install --no-cache-dir jax numpy scipy pandas pytest
+
+COPY Makefile bench.py __graft_entry__.py ./
+COPY sctools_tpu ./sctools_tpu
+COPY tests ./tests
+COPY docs ./docs
+
+# native library + lint floor + full suite == the merge gate
+RUN make ci
+
+RUN pip install --no-cache-dir .
+
+ENTRYPOINT []
+CMD ["CalculateCellMetrics", "--help"]
