@@ -25,9 +25,11 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 
 WORKDIR /opt/sctools_tpu
 
-# dependency layer first: code edits don't reinstall jax
+# dependency layer first (derived from pyproject so it cannot drift from
+# the package metadata): code edits don't reinstall jax
 COPY pyproject.toml ./
-RUN pip install --no-cache-dir jax numpy scipy pandas pytest
+RUN pip install --no-cache-dir pytest $(python -c "import tomllib; \
+    print(' '.join(tomllib.load(open('pyproject.toml','rb'))['project']['dependencies']))")
 
 COPY Makefile bench.py __graft_entry__.py ./
 COPY sctools_tpu ./sctools_tpu

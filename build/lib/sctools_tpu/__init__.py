@@ -1,0 +1,53 @@
+"""sctools_tpu — a TPU-native single-cell sequence-processing framework.
+
+A from-scratch rebuild of the capabilities of ``fredlas/sctools`` (FASTQ barcode
+extraction + whitelist correction, BAM tagging/splitting/tag-sorting, per-cell and
+per-gene QC metrics, UMI-deduplicated cell x gene count matrices, chunk merging)
+designed TPU-first on JAX/XLA/Pallas:
+
+- Records become fixed-width packed integer tensors (struct-of-arrays), not streams
+  of Python objects (reference streams pysam records: src/sctools/bam.py).
+- Histograms / Counters become sort + segment reductions on device
+  (reference: collections.Counter in src/sctools/metrics/aggregator.py:132-189).
+- Hamming<=1 whitelist correction is a device kernel over 2-bit packed barcodes
+  (reference builds a 5*L*|whitelist| hash map: src/sctools/barcode.py:310-335).
+- Scatter-gather over cell barcodes (reference: file-level SplitBam -> Calculate ->
+  Merge, src/sctools/bam.py:361-488) becomes sharding over a jax.sharding.Mesh with
+  collective merges over ICI/DCN.
+
+Host I/O (BGZF/BAM/FASTQ/GTF decode) has a pure-Python implementation plus a
+multithreaded C++ native layer (sctools_tpu/native) that feeds packed arrays to the
+device, mirroring the reference's ``fastqpreprocessing/`` C++ layer.
+"""
+
+__version__ = "0.1.0"
+
+import importlib
+
+from . import consts  # noqa: F401
+
+# submodules resolved lazily so `import sctools_tpu` stays light (no jax import)
+__all__ = [
+    "bam",
+    "barcode",
+    "consts",
+    "count",
+    "encodings",
+    "fastq",
+    "groups",
+    "gtf",
+    "io",
+    "metrics",
+    "ops",
+    "parallel",
+    "platform",
+    "reader",
+    "stats",
+    "utils",
+]
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

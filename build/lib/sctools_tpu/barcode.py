@@ -1,0 +1,188 @@
+"""Barcode set analysis and hamming<=1 whitelist correction (host API).
+
+Behavior-compatible with the reference barcode layer (src/sctools/barcode.py:
+30-379): a 2-bit-encoded barcode population with hamming summaries, per-position
+base frequencies and effective diversity, plus the error->barcode correction
+map used by the FASTQ attach pipeline.
+
+TPU note: :class:`ErrorsToCorrectBarcodesMap` keeps the reference's exact
+hash-map semantics for the streaming host path; the bulk device path
+(sctools_tpu.ops.whitelist) instead scores one-hot barcode columns against
+the whitelist on the MXU and produces identical corrections (tested against
+this map).
+"""
+
+import itertools
+from collections import Counter
+from typing import Iterable, Iterator, Mapping
+
+import numpy as np
+
+from . import consts
+from .encodings import TwoBit
+from .stats import base4_entropy
+
+_SUBSTITUTION_ALPHABET = "ACGTN"  # N enumerated as a 5th letter, like the map
+# the reference builds (barcode.py:330-334, fastqpreprocessing utilities.cpp)
+
+_HAMMING_SUMMARY_KEYS = (
+    "minimum",
+    "25th percentile",
+    "median",
+    "75th percentile",
+    "maximum",
+)
+
+
+class Barcodes:
+    """A set (multiset) of equal-length barcodes in 2-bit encoding."""
+
+    def __init__(self, barcodes: Mapping[str, int], barcode_length: int):
+        if not isinstance(barcodes, Mapping):
+            raise TypeError(
+                "barcodes must be a dict-like object mapping each (2-bit "
+                "encoded) barcode to its observation count"
+            )
+        # quirk inherited from the reference (barcode.py:57-59): the length
+        # check only fires for a non-int that compares > 0 — a non-positive
+        # int passes silently
+        if not (isinstance(barcode_length, int) or barcode_length <= 0):
+            raise ValueError("barcode_length must be a positive integer")
+        self._counts: Mapping[str, int] = barcodes
+        self._length: int = barcode_length
+
+    def __contains__(self, barcode) -> bool:
+        return barcode in self._counts
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._counts)
+
+    def __len__(self) -> int:
+        return len(self._counts)
+
+    def __getitem__(self, barcode) -> int:
+        return self._counts[barcode]
+
+    def summarize_hamming_distances(self) -> Mapping[str, float]:
+        """min/quartiles/max/mean hamming distance over all barcode pairs."""
+        pairwise = [
+            TwoBit.hamming_distance(a, b)
+            for a, b in itertools.combinations(self, 2)
+        ]
+        summary = dict(
+            zip(
+                _HAMMING_SUMMARY_KEYS,
+                np.percentile(pairwise, (0, 25, 50, 75, 100)),
+            )
+        )
+        summary["average"] = np.mean(pairwise)
+        return summary
+
+    def base_frequency(self, weighted=False) -> np.ndarray:
+        """(barcode_length, 4) counts of each 2-bit base code by position.
+
+        Position 0 is the barcode's first (highest-order) base. ``weighted``
+        is unimplemented — a reference todo preserved deliberately
+        (barcode.py:105-147).
+        """
+        if weighted:
+            raise NotImplementedError
+        codes = np.fromiter(self._counts.keys(), dtype=np.uint64)
+        frequency = np.zeros((self._length, 4), dtype=np.uint64)
+        for position in range(self._length):
+            shift = np.uint64(2 * (self._length - 1 - position))
+            bases = (codes >> shift) & np.uint64(3)
+            frequency[position] = np.bincount(bases.astype(np.int64), minlength=4)
+        return frequency
+
+    def effective_diversity(self, weighted=False) -> np.ndarray:
+        """Per-position base-4 entropy of the set; 1.0 == perfect 25% split."""
+        return base4_entropy(self.base_frequency(weighted=weighted))
+
+    @classmethod
+    def from_whitelist(cls, file_: str, barcode_length: int):
+        """One barcode per line, plain text; each gets count 1."""
+        encoder = TwoBit(barcode_length)
+        with open(file_, "rb") as lines:
+            counts = Counter(encoder.encode(line[:-1]) for line in lines)
+        return cls(counts, barcode_length)
+
+    @classmethod
+    def from_iterable_encoded(cls, iterable: Iterable[int], barcode_length: int):
+        return cls(Counter(iterable), barcode_length)
+
+    @classmethod
+    def from_iterable_strings(cls, iterable: Iterable[str], barcode_length: int):
+        encoder = TwoBit(barcode_length)
+        return cls(
+            Counter(encoder.encode(b.encode()) for b in iterable), barcode_length
+        )
+
+    @classmethod
+    def from_iterable_bytes(cls, iterable: Iterable[bytes], barcode_length: int):
+        encoder = TwoBit(barcode_length)
+        return cls(Counter(encoder.encode(b) for b in iterable), barcode_length)
+
+
+class ErrorsToCorrectBarcodesMap:
+    """Map from barcodes within hamming distance 1 to their whitelist barcode."""
+
+    def __init__(self, errors_to_barcodes: Mapping[str, str]):
+        if not isinstance(errors_to_barcodes, Mapping):
+            raise TypeError(
+                "errors_to_barcodes must map erroneous barcodes to their "
+                f"whitelisted corrections, got {type(errors_to_barcodes)}"
+            )
+        self._corrections = errors_to_barcodes
+
+    def get_corrected_barcode(self, barcode: str) -> str:
+        """The whitelisted barcode for ``barcode``; KeyError if distance > 1."""
+        return self._corrections[barcode]
+
+    @staticmethod
+    def _prepare_single_base_error_hash_table(
+        barcodes: Iterable[str],
+    ) -> Mapping[str, str]:
+        """Each whitelist barcode, plus its 1-substitution neighborhood over
+        ACGTN, mapped to itself. Whitelist order decides collisions
+        (last writer wins) — the invariant the device corrector's ambiguity
+        tests pin against this oracle."""
+        corrections = {}
+        for true_barcode in barcodes:
+            corrections[true_barcode] = true_barcode
+            for position, original in enumerate(true_barcode):
+                head = true_barcode[:position]
+                tail = true_barcode[position + 1:]
+                for substitute in _SUBSTITUTION_ALPHABET:
+                    if substitute != original:
+                        corrections[head + substitute + tail] = true_barcode
+        return corrections
+
+    @classmethod
+    def single_hamming_errors_from_whitelist(cls, whitelist_file: str):
+        with open(whitelist_file, "r") as lines:
+            stripped = (line[:-1] for line in lines)
+            return cls(cls._prepare_single_base_error_hash_table(stripped))
+
+    def correct_bam(self, bam_file: str, output_bam_file: str) -> None:
+        """Add corrected CB tags to every record of a bam, given raw CR tags.
+
+        Uncorrectable barcodes pass through with CB set to the raw CR value.
+        """
+        from .io.sam import AlignmentFile  # deferred: keep barcode import-light
+
+        with AlignmentFile(bam_file, "rb") as source, AlignmentFile(
+            output_bam_file, "wb", template=source
+        ) as sink:
+            for alignment in source:
+                raw = alignment.get_tag(consts.RAW_CELL_BARCODE_TAG_KEY)
+                try:
+                    corrected = self.get_corrected_barcode(raw)
+                except KeyError:
+                    corrected = raw
+                alignment.set_tag(
+                    tag=consts.CELL_BARCODE_TAG_KEY,
+                    value=corrected,
+                    value_type="Z",
+                )
+                sink.write(alignment)

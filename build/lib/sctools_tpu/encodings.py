@@ -1,0 +1,214 @@
+"""Compressed DNA encodings (host side).
+
+2-bit (ACGT, ambiguity randomized) and 3-bit (ACGTN) packed-integer
+encodings with GC content and hamming distance computed directly on the
+packed form. The bit layouts and code assignments are pinned to the
+reference's (src/sctools/encodings.py:124-296) so packed barcodes are
+interchangeable; the construction differs — one generic base-width engine
+drives both widths, and the columnar extensions pack whole barcode columns
+at once for device ingestion.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, Mapping
+
+import numpy as np
+
+
+class Encoding:
+    """Interface for packed-integer DNA encodings.
+
+    Concrete encodings define ``bits_per_base`` plus byte<->code maps; the
+    packed-form arithmetic (encode, decode, gc, hamming) is shared: each
+    base occupies one ``bits_per_base`` field, first base in the highest-
+    order field, and GC-ness is exactly the low bit of every code in both
+    assignments.
+    """
+
+    bits_per_base: int = 0
+    encoding_map: Mapping[int, int] = {}
+    decoding_map: Dict[int, bytes] = {}
+
+    # -- shared packed-form arithmetic ------------------------------------
+
+    @classmethod
+    def encode(cls, sequence: bytes) -> int:
+        packed = 0
+        for byte in sequence:
+            packed = (packed << cls.bits_per_base) | cls.encoding_map[byte]
+        return packed
+
+    @classmethod
+    def _field_mask(cls) -> int:
+        return (1 << cls.bits_per_base) - 1
+
+    @classmethod
+    def _decode_fields(cls, packed: int, n_fields: int) -> bytes:
+        mask = cls._field_mask()
+        bases = bytearray()
+        for _ in range(n_fields):
+            bases += cls.decoding_map[packed & mask]
+            packed >>= cls.bits_per_base
+        bases.reverse()
+        return bytes(bases)
+
+    @classmethod
+    def _gc_fields(cls, packed: int, n_fields: int) -> int:
+        # C and G carry the low bit in both code assignments
+        total = 0
+        for _ in range(n_fields):
+            total += packed & 1
+            packed >>= cls.bits_per_base
+        return total
+
+    @classmethod
+    def _hamming_fields(cls, a: int, b: int) -> int:
+        mask = cls._field_mask()
+        diff = a ^ b
+        distance = 0
+        while diff:
+            distance += 1 if diff & mask else 0
+            diff >>= cls.bits_per_base
+        return distance
+
+    @staticmethod
+    def hamming_distance(a: int, b: int) -> int:
+        raise NotImplementedError
+
+
+class TwoBit(Encoding):
+    """2 bits per base: A=0, C=1, T=2, G=3.
+
+    Cannot represent N; IUPAC-ambiguous codes randomize to a real base
+    (the reference's policy, src/sctools/encodings.py:147-173). Because
+    0 == 'A', decoding requires the sequence length.
+    """
+
+    class TwoBitEncodingMap:
+        """byte -> 2-bit code; random base for IUPAC-ambiguous codes."""
+
+        map_ = {
+            ord(base): code
+            for code, pair in enumerate(("Aa", "Cc", "Tt", "Gg"))
+            for base in pair
+        }
+        iupac_ambiguous = {ord(c) for c in "MRWSYKVHDBNmrwsykvhdbn"}
+
+        def __getitem__(self, byte: int) -> int:
+            code = self.map_.get(byte)
+            if code is not None:
+                return code
+            if byte in self.iupac_ambiguous:
+                return random.randint(0, 3)
+            raise KeyError(f"{chr(byte)} is not a valid IUPAC nucleotide code")
+
+    bits_per_base = 2
+    encoding_map = TwoBitEncodingMap()
+    decoding_map = {0: b"A", 1: b"C", 2: b"T", 3: b"G"}
+
+    def __init__(self, sequence_length: int):
+        self.sequence_length = sequence_length
+
+    def decode(self, packed: int) -> bytes:
+        return self._decode_fields(packed, self.sequence_length)
+
+    def gc_content(self, packed: int) -> int:
+        return self._gc_fields(packed, self.sequence_length)
+
+    @staticmethod
+    def hamming_distance(a: int, b: int) -> int:
+        return TwoBit._hamming_fields(a, b)
+
+    # -- columnar extensions (framework-specific) --------------------------
+
+    _LUT = None
+
+    @classmethod
+    def _lut(cls) -> np.ndarray:
+        """256-entry byte -> code table; ambiguous codes map to 0 ('A').
+
+        The scalar path randomizes ambiguous bases; the columnar path used
+        for bulk device ingestion maps them to A deterministically so
+        results are reproducible under jit. Invalid characters also map to
+        0; callers that need strict validation use the scalar ``encode``.
+        """
+        if cls._LUT is None:
+            lut = np.zeros(256, dtype=np.uint8)
+            for byte, code in cls.TwoBitEncodingMap.map_.items():
+                lut[byte] = code
+            cls._LUT = lut
+        return cls._LUT
+
+    @classmethod
+    def encode_array(cls, sequences: np.ndarray) -> np.ndarray:
+        """Pack an (n, L) uint8 ASCII array into (n,) uint64 codes, L<=32."""
+        if sequences.ndim != 2:
+            raise ValueError("sequences must be a 2-d (n, L) byte array")
+        length = sequences.shape[1]
+        if length > 32:
+            raise ValueError(f"2-bit packing supports length <= 32, got {length}")
+        codes = cls._lut()[sequences].astype(np.uint64)
+        shifts = np.uint64(2) * np.arange(length - 1, -1, -1, dtype=np.uint64)
+        return (codes << shifts).sum(axis=1, dtype=np.uint64)
+
+    @classmethod
+    def decode_array(cls, packed: np.ndarray, sequence_length: int) -> np.ndarray:
+        """Unpack (n,) uint64 codes into an (n, L) uint8 ASCII array."""
+        alphabet = np.frombuffer(b"ACTG", dtype=np.uint8)
+        shifts = np.uint64(2) * np.arange(
+            sequence_length - 1, -1, -1, dtype=np.uint64
+        )
+        fields = (packed[:, None] >> shifts[None, :]) & np.uint64(3)
+        return alphabet[fields.astype(np.int64)]
+
+
+class ThreeBit(Encoding):
+    """3 bits per base: C=1, A=2, G=3, T=4, N=6 (0 never used).
+
+    No base encodes to 0, so packed strings self-terminate and decode
+    without a length. Code assignment matches the reference
+    (src/sctools/encodings.py:233-261).
+    """
+
+    class ThreeBitEncodingMap:
+        map_ = {
+            ord(base): code
+            for code, pair in zip((1, 2, 3, 4, 6), ("Cc", "Aa", "Gg", "Tt", "Nn"))
+            for base in pair
+        }
+
+        def __getitem__(self, byte: int) -> int:
+            # any non-standard nucleotide reads as N
+            return self.map_.get(byte, 6)
+
+    bits_per_base = 3
+    encoding_map = ThreeBitEncodingMap()
+    decoding_map = {1: b"C", 2: b"A", 3: b"G", 4: b"T", 6: b"N"}
+
+    def __init__(self, *args, **kwargs):
+        # accepts (and ignores) a sequence_length for parity with TwoBit
+        pass
+
+    @classmethod
+    def decode(cls, packed: int) -> bytes:
+        mask = cls._field_mask()
+        bases = bytearray()
+        while packed:
+            bases += cls.decoding_map[packed & mask]
+            packed >>= cls.bits_per_base
+        bases.reverse()
+        return bytes(bases)
+
+    @classmethod
+    def gc_content(cls, packed: int) -> int:
+        total = 0
+        while packed:
+            total += packed & 1
+            packed >>= cls.bits_per_base
+        return total
+
+    @staticmethod
+    def hamming_distance(a: int, b: int) -> int:
+        return ThreeBit._hamming_fields(a, b)

@@ -1,0 +1,187 @@
+"""Aggregation of external QC outputs (Picard, HISAT2, RSEM) for SS2 pipelines.
+
+Rebuild of the reference's groups module (src/sctools/groups.py:11-195) without
+the crimson dependency: Picard metric files are parsed directly (``## METRICS
+CLASS`` section, tab-separated, numbers coerced). One deliberate deviation:
+the reference appends a partial snapshot DataFrame per input file and writes
+them all (groups.py:71-74, a pandas-1.x ``.append`` pattern that emits
+duplicated partial blocks); this implementation writes only the complete
+final table — the last block of the reference's output, which is what
+downstream consumers read.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Union
+
+import pandas as pd
+
+_DROP_KEYS = ("SAMPLE", "LIBRARY", "READ_GROUP", "CATEGORY")
+
+
+def _coerce(value: str):
+    if value == "" or value == "?":
+        return None
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            continue
+    return value
+
+
+def parse_picard_metrics(file_name: str) -> Dict:
+    """Parse a Picard metrics file's METRICS CLASS section.
+
+    Returns {"class": <java class name>, "contents": dict | list[dict]} —
+    the subset of crimson.picard.parse output the aggregators consume
+    (single data row -> dict, several rows -> list of dicts).
+    """
+    class_name: Optional[str] = None
+    header: Optional[List[str]] = None
+    rows: List[Dict] = []
+    with open(file_name) as fileobj:
+        in_metrics = False
+        for line in fileobj:
+            line = line.rstrip("\n")
+            if line.startswith("## METRICS CLASS"):
+                class_name = line.split("\t", 1)[1].strip()
+                in_metrics = True
+                continue
+            if not in_metrics:
+                continue
+            if line.startswith("##") or line == "":
+                if rows or header:
+                    break  # end of metrics section (histogram follows)
+                continue
+            fields = line.split("\t")
+            if header is None:
+                header = fields
+            else:
+                row = {k: _coerce(v) for k, v in zip(header, fields)}
+                rows.append(row)
+    if class_name is None:
+        raise ValueError(f"{file_name}: no '## METRICS CLASS' section found")
+    contents: Union[Dict, List[Dict]] = rows[0] if len(rows) == 1 else rows
+    return {"metrics": {"class": class_name, "contents": contents}}
+
+
+def write_aggregated_picard_metrics_by_row(file_names, output_name) -> None:
+    """Aggregate per-cell Picard row metrics into one CSV.
+
+    Input basenames must look like 'samplename_qc.<class>.txt' (reference
+    groups.py:16-19). AlignmentSummaryMetrics rows are flattened per CATEGORY
+    (key '<METRIC>.<CATEGORY>'); multi-line InsertSizeMetrics keep the first
+    line (reference groups.py:38-59).
+    """
+    metrics: Dict[str, Dict] = {}
+    metric_class: Dict[str, str] = {}
+    for file_name in file_names:
+        cell_id = os.path.basename(file_name).split("_qc")[0]
+        metrics.setdefault(cell_id, {})
+        parsed = parse_picard_metrics(file_name)
+        class_name = parsed["metrics"]["class"].split(".")[2]
+        contents = parsed["metrics"]["contents"]
+        if class_name == "AlignmentSummaryMetrics":
+            # unpaired runs yield one dict; paired runs one entry per
+            # CATEGORY (PAIR/R1/R2), flattened here into suffixed keys
+            category_rows = contents if isinstance(contents, list) else [contents]
+            rows = {}
+            for row in category_rows:
+                suffix = "." + row["CATEGORY"]
+                for key, value in row.items():
+                    if key not in _DROP_KEYS:
+                        rows[key + suffix] = value
+        elif class_name == "InsertSizeMetrics":
+            rows = contents[0] if isinstance(contents, list) else contents
+        else:
+            rows = contents
+        row_values = {k: v for k, v in rows.items() if k not in _DROP_KEYS}
+        metrics[cell_id].update(row_values)
+        for key in row_values:
+            metric_class.setdefault(key, class_name)
+
+    df = pd.DataFrame.from_dict(metrics, orient="columns")
+    df.insert(0, "Class", pd.Series(metric_class))
+    df.T.to_csv(output_name + ".csv")
+
+
+def write_aggregated_picard_metrics_by_table(file_names, output_name) -> None:
+    """One CSV per Picard table-metrics file, named by metrics class
+    (reference groups.py:77-96)."""
+    for file_name in file_names:
+        cell_id = os.path.basename(file_name).split("_qc")[0]
+        class_name = os.path.basename(file_name).split(".")[1]
+        parsed = parse_picard_metrics(file_name)
+        contents = parsed["metrics"]["contents"]
+        if isinstance(contents, dict):
+            contents = [contents]
+        dat = pd.DataFrame.from_dict(contents)
+        dat.insert(0, "Sample", cell_id)
+        dat.to_csv(output_name + "_" + class_name + ".csv", index=False)
+
+
+def write_aggregated_qc_metrics(file_names, output_name) -> None:
+    """Outer-join previously aggregated QC CSVs column-wise
+    (reference groups.py:99-117)."""
+    df = pd.DataFrame()
+    for file_name in file_names:
+        dat = pd.read_csv(file_name, index_col=0)
+        df = pd.concat([df, dat], axis=1, join="outer")
+    df.to_csv(output_name + ".csv", index=True)
+
+
+def parse_hisat2_log(file_names, output_name) -> None:
+    """Aggregate HISAT2 alignment summaries; '_qc' logs are genome
+    alignments (HISAT2G), '_rsem' logs transcriptome (HISAT2T)
+    (reference groups.py:120-152)."""
+    metrics: Dict[str, Dict] = {}
+    tag = "NONE"
+    for file_name in file_names:
+        base = os.path.basename(file_name)
+        if "_qc" in file_name:
+            cell_id, tag = base.split("_qc")[0], "HISAT2G"
+        elif "_rsem" in file_name:
+            cell_id, tag = base.split("_rsem")[0], "HISAT2T"
+        else:
+            cell_id = base
+        with open(file_name) as fileobj:
+            sections = [x.strip().split(":") for x in fileobj]
+        del sections[0]  # the section's first row is a header
+        metrics[cell_id] = {
+            parts[0]: parts[1].strip().split(" ")[0]
+            for parts in sections
+            if len(parts) > 1
+        }
+    df = pd.DataFrame.from_dict(metrics, orient="columns")
+    df.insert(0, "Class", tag)
+    df.T.to_csv(output_name + ".csv")
+
+
+def parse_rsem_cnt(file_names, output_name) -> None:
+    """Aggregate RSEM .cnt statistics per cell (reference groups.py:155-195)."""
+    # row labels in output order; .cnt line 1 = alignability counts,
+    # line 2 = multimapping counts, line 3 = hit total + strandedness
+    row_labels = (
+        "unalignable reads", "alignable reads", "filtered reads",
+        "total reads", "unique aligned", "multiple mapped",
+        "total alignments", "strand", "uncertain reads",
+    )
+    metrics: Dict[str, Dict] = {}
+    for file_name in file_names:
+        cell_id = os.path.basename(file_name).split("_rsem")[0]
+        with open(file_name) as fileobj:
+            n0, n1, n2, n_tot = fileobj.readline().split()
+            n_unique, n_multi, n_uncertain = fileobj.readline().split()
+            n_hits, read_type = fileobj.readline().split()
+        metrics[cell_id] = dict(
+            zip(
+                row_labels,
+                (n0, n1, n2, n_tot, n_unique, n_multi, n_hits, read_type,
+                 n_uncertain),
+            )
+        )
+    df = pd.DataFrame.from_dict(metrics, orient="columns")
+    df.insert(0, "Class", "RSEM")
+    df.T.to_csv(output_name + ".csv")

@@ -1,0 +1,12 @@
+"""Host I/O layer: BGZF, BAM/SAM codecs, and packed-tensor record frames.
+
+This is the framework's own htslib-equivalent. The reference leans on pysam
+(src/sctools/bam.py:58) and, for hot paths, on htslib/libStatGen in C++
+(fastqpreprocessing/). Here the pure-Python codec provides correctness and
+universality; the C++ native layer (sctools_tpu/native) accelerates bulk decode
+into packed numpy columns for device ingestion.
+"""
+
+from . import bgzf, sam  # noqa: F401
+
+__all__ = ["bgzf", "sam", "packed"]

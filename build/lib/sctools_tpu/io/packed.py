@@ -1,0 +1,521 @@
+"""ReadFrame: BAM records as packed struct-of-arrays columns.
+
+The device pipeline's input format. Each alignment collapses to a handful of
+int32/float32 scalars — the same information TagSort extracts per alignment
+into its 17-field TSV tuple (reference fastqpreprocessing/src/
+htslib_tagsort.cpp:73-89,106-218) — with strings dictionary-encoded host-side:
+cell/molecule barcodes, gene names, and query names become indices into
+lexicographically sorted vocabularies, so device sort order over codes equals
+the reference's string sort order (src/sctools/bam.py:698-709), and CSV row
+order matches without any device-side string handling.
+
+Missing tags encode as vocabulary entry "" (which sorts first, like the
+reference's empty-string sort default, bam.py:660) and flag columns record
+true absence where semantics require it (e.g. XF missingness feeding
+reads_unmapped, reference aggregator.py:522-527).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .. import consts
+from .sam import AlignmentReader, BamRecord
+
+_QUAL_THRESHOLD = 30
+
+# Padding fill per column for device batches. Columns absent here pad with
+# 0/False; these sentinels mean "absent" to the metric semantics (NH missing,
+# perfect-barcode not computable) and must be used by every padder so the
+# policy cannot diverge between the single-device and sharded paths.
+PAD_FILLS = {
+    "nh": -1,
+    "perfect_umi": -1,
+    "perfect_cb": -1,
+}
+
+# Bit layout of the packed per-record ``flags`` device column. Seven narrow
+# columns (three bools, strand, the XF code, two tri-state perfect-barcode
+# fields, and the NH==1 predicate the metrics actually consume) travel as one
+# int16: a 1M-record batch ships ~7 MB less over the host->device link, which
+# on a tunneled TPU is a first-order cost. A zero value means "padding": all
+# flags off, perfect fields absent, NH missing.
+FLAG_STRAND = 1 << 0
+FLAG_UNMAPPED = 1 << 1
+FLAG_DUPLICATE = 1 << 2
+FLAG_SPLICED = 1 << 3
+FLAG_XF_SHIFT = 4  # 3 bits: consts.XF_* codes 0..5
+FLAG_PUMI_SHIFT = 7  # 2 bits: stored value+1 (-1 absent / 0 / 1 -> 0,1,2)
+FLAG_PCB_SHIFT = 9  # 2 bits: same encoding
+FLAG_NH1_SHIFT = 11  # 1 bit: NH tag present and == 1
+FLAG_MITO = 1 << 12  # gene is mitochondrial (host vocabulary lookup)
+
+# Packed device-sort key layout, shared by the host packer
+# (metrics.gatherer._pad_columns) and the device unpacker
+# (metrics.device.compute_entity_metrics, prepacked=True) so the two sides
+# cannot drift: three codes < 2^KEY_CODE_BITS ride two i32 operands as
+#   key_hi = k1 << KEY_HI_SHIFT | k2 >> KEY_HI_SHIFT
+#   key_lo = (k2 & KEY_LO_MASK) << KEY_CODE_BITS | k3
+# plus m_ref = mapped-last << KEY_UNMAPPED_SHIFT | (ref+1) and
+# ps = pos << 1 | strand (injective for the host-checked ranges).
+KEY_CODE_BITS = 20
+KEY_HI_SHIFT = 10
+KEY_LO_MASK = (1 << KEY_HI_SHIFT) - 1
+KEY_CODE_MASK = (1 << KEY_CODE_BITS) - 1
+KEY_UNMAPPED_SHIFT = 30
+
+
+# 3-bit-per-base packed barcodes (the native decoder's scheme,
+# native/bamdecode.cpp kBaseCode): A=1 C=2 G=3 N=4 T=5, left-aligned in a
+# uint64, so integer order == byte-lexicographic string order and ""
+# (missing tag) packs to 0, sorting first. Strings that cannot pack
+# (non-ACGTN or > 21 bases) have no u64 form — callers assign synthetic ids
+# above 2**63 (all regular packings are < 5<<60 < 2**63).
+_BASE_CODE = {"A": 1, "C": 2, "G": 3, "N": 4, "T": 5}
+_CODE_BASE = {v: k for k, v in _BASE_CODE.items()}
+BARCODE_U64_MAX_LEN = 21
+IRREGULAR_BARCODE_BASE = np.uint64(1) << np.uint64(63)
+
+
+def pack_barcode_u64(value: str):
+    """Pack an ACGTN string (<= 21 bases) to its order-preserving uint64.
+
+    Returns None when the string cannot pack (caller assigns a synthetic
+    irregular id).
+    """
+    if len(value) > BARCODE_U64_MAX_LEN:
+        return None
+    packed = 0
+    shift = 60
+    for ch in value:
+        code = _BASE_CODE.get(ch)
+        if code is None:
+            return None
+        packed |= code << shift
+        shift -= 3
+    return packed
+
+
+def unpack_barcode_u64(packed: int) -> str:
+    """Inverse of pack_barcode_u64 for regular (non-synthetic) values."""
+    out = []
+    for shift in range(60, -1, -3):
+        code = (int(packed) >> shift) & 7
+        if code == 0:
+            break
+        out.append(_CODE_BASE[code])
+    return "".join(out)
+
+
+def pack_flags(
+    strand: np.ndarray,
+    unmapped: np.ndarray,
+    duplicate: np.ndarray,
+    spliced: np.ndarray,
+    xf: np.ndarray,
+    perfect_umi: np.ndarray,
+    perfect_cb: np.ndarray,
+    nh: np.ndarray,
+    is_mito: np.ndarray,
+) -> np.ndarray:
+    """Pack per-record flag fields into the int16 device ``flags`` column."""
+    flags = np.asarray(strand, dtype=np.int32) & 1
+    flags |= (np.asarray(unmapped, dtype=np.int32) & 1) << 1
+    flags |= (np.asarray(duplicate, dtype=np.int32) & 1) << 2
+    flags |= (np.asarray(spliced, dtype=np.int32) & 1) << 3
+    flags |= (np.asarray(xf, dtype=np.int32) & 7) << FLAG_XF_SHIFT
+    flags |= ((np.asarray(perfect_umi, dtype=np.int32) + 1) & 3) << FLAG_PUMI_SHIFT
+    flags |= ((np.asarray(perfect_cb, dtype=np.int32) + 1) & 3) << FLAG_PCB_SHIFT
+    flags |= (np.asarray(nh, dtype=np.int32) == 1).astype(np.int32) << FLAG_NH1_SHIFT
+    flags |= np.asarray(is_mito, dtype=np.int32) << 12
+    return flags.astype(np.int16)
+
+
+@dataclass
+class ReadFrame:
+    """Columnar batch of alignment records (host numpy; device-ready)."""
+
+    # dictionary-coded strings
+    cell: np.ndarray  # int32 codes into cell_names
+    umi: np.ndarray
+    gene: np.ndarray
+    qname: np.ndarray
+    cell_names: List[str]
+    umi_names: List[str]
+    gene_names: List[str]
+    qname_names: List[str]
+
+    # alignment coordinates / flags
+    ref: np.ndarray  # int32, -1 when unmapped
+    pos: np.ndarray  # int32
+    strand: np.ndarray  # int8, 1 == reverse
+    unmapped: np.ndarray  # bool
+    duplicate: np.ndarray  # bool
+    spliced: np.ndarray  # bool (cigar contains N op)
+
+    # tag-derived fields
+    xf: np.ndarray  # int8, consts.XF_* codes (XF_MISSING when absent)
+    nh: np.ndarray  # int32, -1 when absent
+    perfect_umi: np.ndarray  # int8: 1 match / 0 mismatch / -1 not computable
+    perfect_cb: np.ndarray  # int8: same convention, gated on CB presence
+
+    # quality summaries, exact integer form: the wire cost of four float32
+    # columns (16 B/record) collapses to 6 B and the device recovers the
+    # float32 values by one f32 division each (identical where the backend
+    # divides correctly-rounded, within ~1 ulp otherwise)
+    umi_qual: np.ndarray  # uint16: above30<<8 | len(UY); 0 == tag missing
+    cb_qual: np.ndarray  # uint16: above30<<8 | len(CY); 0 == tag missing
+    genomic_qual: np.ndarray  # uint32: above30<<16 | aligned len; 0 == none
+    genomic_total: np.ndarray  # uint32: sum of aligned phred scores
+
+    extras: Dict[str, np.ndarray] = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return len(self.cell)
+
+    @property
+    def n_records(self) -> int:
+        return len(self.cell)
+
+    # ---- derived float views (compat: parallel/synth paths, tests) -------
+
+    @property
+    def umi_frac30(self) -> np.ndarray:
+        """float32 fraction of UY qualities > 30 (nan when tag missing)."""
+        return _qual_frac(self.umi_qual, 8)
+
+    @property
+    def cb_frac30(self) -> np.ndarray:
+        """float32 fraction of CY qualities > 30 (nan when tag missing)."""
+        return _qual_frac(self.cb_qual, 8)
+
+    @property
+    def genomic_frac30(self) -> np.ndarray:
+        """float32 fraction of aligned qualities > 30 (nan when absent)."""
+        return _qual_frac(self.genomic_qual, 16)
+
+    @property
+    def genomic_mean(self) -> np.ndarray:
+        """float32 mean aligned quality (nan when absent)."""
+        length = (self.genomic_qual & 0xFFFF).astype(np.float32)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            out = self.genomic_total.astype(np.float32) / length
+        return np.where(length > 0, out, np.float32(np.nan)).astype(np.float32)
+
+
+def _qual_frac(packed: np.ndarray, shift: int) -> np.ndarray:
+    mask = (1 << shift) - 1
+    length = (packed & mask).astype(np.float32)
+    above = (packed >> shift).astype(np.float32)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        out = above / length
+    return np.where(length > 0, out, np.float32(np.nan)).astype(np.float32)
+
+
+def _pack_string_qual(qual: Optional[str], threshold: int = _QUAL_THRESHOLD) -> int:
+    """above30<<8 | len for a string-encoded quality tag (0 == missing).
+
+    Lengths above 255 cannot be represented and degrade to "missing" — no
+    sequencing barcode approaches that (the packed-barcode cap is 21 bases).
+    """
+    if not qual or len(qual) > 0xFF:
+        return 0
+    above = sum(1 for c in qual if ord(c) - 33 > threshold)
+    return (above << 8) | len(qual)
+
+
+def _pack_aligned_qual(qualities: Sequence[int], threshold: int = _QUAL_THRESHOLD):
+    """(above30<<16 | len, total) for aligned phred scores (0, 0 == absent)."""
+    n = len(qualities)
+    if not n or n > 0xFFFF:
+        return 0, 0
+    above = sum(1 for q in qualities if q > threshold)
+    return (above << 16) | n, sum(qualities)
+
+
+def _encode_column(values: List[str]):
+    """values -> (int32 codes, sorted vocabulary). '' sorts first."""
+    arr = np.asarray(values, dtype=object)
+    vocabulary, codes = np.unique(arr, return_inverse=True)
+    return codes.astype(np.int32), [str(v) for v in vocabulary]
+
+
+DEFAULT_TAG_KEYS = ("CB", "UB", "GE")
+
+
+def frame_from_records(
+    records: Iterable[BamRecord],
+    tag_keys: tuple = DEFAULT_TAG_KEYS,
+) -> ReadFrame:
+    """Pack an iterable of BamRecords into a ReadFrame.
+
+    ``tag_keys`` = (cell, molecule, gene) tag names; non-default keys feed
+    the cell/umi/gene columns from those tags instead (the reference's
+    --cell-barcode-tag/--molecule-barcode-tag/--gene-name-tag flags,
+    src/sctools/count.py:134-153). Perfect-barcode comparisons stay defined
+    against the 10x raw-tag pairs (CR/UR), which have no custom variants.
+    """
+    cells: List[str] = []
+    umis: List[str] = []
+    genes: List[str] = []
+    qnames: List[str] = []
+    ref: List[int] = []
+    pos: List[int] = []
+    strand: List[int] = []
+    unmapped: List[bool] = []
+    duplicate: List[bool] = []
+    spliced: List[bool] = []
+    xf: List[int] = []
+    nh: List[int] = []
+    perfect_umi: List[int] = []
+    perfect_cb: List[int] = []
+    umi_qual: List[int] = []
+    cb_qual: List[int] = []
+    genomic_qual: List[int] = []
+    genomic_total: List[int] = []
+
+    cb_key, ub_key, ge_key = tag_keys
+    for record in records:
+        tags = record.tags
+        cb = tags.get(cb_key, (None, ""))[1]
+        cr = tags.get("CR", (None, None))[1]
+        ub = tags.get(ub_key, (None, ""))[1]
+        ur = tags.get("UR", (None, None))[1]
+        ge = tags.get(ge_key, (None, ""))[1]
+        uy = tags.get("UY", (None, None))[1]
+        cy = tags.get("CY", (None, None))[1]
+        xf_value = tags.get("XF", (None, None))[1]
+        nh_value = tags.get("NH", (None, None))[1]
+
+        cells.append(cb)
+        umis.append(ub)
+        genes.append(ge)
+        qnames.append(record.query_name)
+        ref.append(record.reference_id)
+        pos.append(record.pos)
+        strand.append(1 if record.is_reverse else 0)
+        unmapped.append(record.is_unmapped)
+        duplicate.append(record.is_duplicate)
+        cigar_stats, _ = record.get_cigar_stats()
+        spliced.append(cigar_stats[3] > 0)
+        if xf_value is None:
+            xf.append(consts.XF_MISSING)
+        else:
+            xf.append(consts.XF_VALUE_TO_CODE.get(xf_value, consts.XF_OTHER))
+        nh.append(nh_value if nh_value is not None else -1)
+        if ur is not None and "UB" in tags:
+            perfect_umi.append(1 if ur == ub else 0)
+        else:
+            perfect_umi.append(-1)
+        if "CB" in tags and cr is not None:
+            perfect_cb.append(1 if cr == cb else 0)
+        else:
+            perfect_cb.append(-1)
+        umi_qual.append(_pack_string_qual(uy))
+        cb_qual.append(_pack_string_qual(cy))
+        gq, gt = _pack_aligned_qual(record.query_alignment_qualities or [])
+        genomic_qual.append(gq)
+        genomic_total.append(gt)
+
+    cell_codes, cell_names = _encode_column(cells)
+    umi_codes, umi_names = _encode_column(umis)
+    gene_codes, gene_names = _encode_column(genes)
+    qname_codes, qname_names = _encode_column(qnames)
+
+    return ReadFrame(
+        cell=cell_codes,
+        umi=umi_codes,
+        gene=gene_codes,
+        qname=qname_codes,
+        cell_names=cell_names,
+        umi_names=umi_names,
+        gene_names=gene_names,
+        qname_names=qname_names,
+        ref=np.asarray(ref, dtype=np.int32),
+        pos=np.asarray(pos, dtype=np.int32),
+        strand=np.asarray(strand, dtype=np.int8),
+        unmapped=np.asarray(unmapped, dtype=bool),
+        duplicate=np.asarray(duplicate, dtype=bool),
+        spliced=np.asarray(spliced, dtype=bool),
+        xf=np.asarray(xf, dtype=np.int8),
+        nh=np.asarray(nh, dtype=np.int32),
+        perfect_umi=np.asarray(perfect_umi, dtype=np.int8),
+        perfect_cb=np.asarray(perfect_cb, dtype=np.int8),
+        umi_qual=np.asarray(umi_qual, dtype=np.uint16),
+        cb_qual=np.asarray(cb_qual, dtype=np.uint16),
+        genomic_qual=np.asarray(genomic_qual, dtype=np.uint32),
+        genomic_total=np.asarray(genomic_total, dtype=np.uint32),
+    )
+
+
+_PER_RECORD_FIELDS = (
+    "cell", "umi", "gene", "qname", "ref", "pos", "strand", "unmapped",
+    "duplicate", "spliced", "xf", "nh", "perfect_umi", "perfect_cb",
+    "umi_qual", "cb_qual", "genomic_qual", "genomic_total",
+)
+_CODED_FIELDS = ("cell", "umi", "gene", "qname")
+
+
+def slice_frame(frame: ReadFrame, start: int, stop: int) -> ReadFrame:
+    """Row-slice a frame; vocabularies are shared (codes stay valid)."""
+    kwargs = {name: getattr(frame, name)[start:stop] for name in _PER_RECORD_FIELDS}
+    for name in _CODED_FIELDS:
+        kwargs[f"{name}_names"] = getattr(frame, f"{name}_names")
+    return ReadFrame(**kwargs)
+
+
+def compact_frame(frame: ReadFrame) -> ReadFrame:
+    """Shrink each vocabulary to the names actually referenced.
+
+    Slicing shares the parent's (possibly merged) vocabularies; a carry frame
+    held across streaming batches must compact them, or the name lists would
+    accumulate the union of every batch seen so far and host memory would
+    scale with file size again. Codes are remapped onto the compacted (still
+    sorted) vocabulary.
+    """
+    kwargs = {name: getattr(frame, name) for name in _PER_RECORD_FIELDS}
+    for name in _CODED_FIELDS:
+        codes = getattr(frame, name)
+        names = getattr(frame, f"{name}_names")
+        used = np.unique(codes)
+        if len(used) == len(names):
+            kwargs[f"{name}_names"] = names
+            continue
+        remap = np.zeros(len(names), dtype=np.int32)
+        remap[used] = np.arange(len(used), dtype=np.int32)
+        kwargs[name] = remap[codes]
+        kwargs[f"{name}_names"] = [names[int(code)] for code in used]
+    return ReadFrame(**kwargs)
+
+
+def _merge_coded(codes_a, names_a, codes_b, names_b):
+    """Concatenate two dictionary-coded columns under one merged vocabulary.
+
+    Both vocabularies are sorted (np.unique order), so the union stays sorted
+    and a searchsorted gather remaps each side's codes.
+    """
+    if names_a == names_b:
+        return np.concatenate([codes_a, codes_b]).astype(np.int32), names_a
+    a = np.asarray(names_a, dtype=object)
+    b = np.asarray(names_b, dtype=object)
+    union = np.union1d(a, b)
+    remap_a = np.searchsorted(union, a).astype(np.int32)
+    remap_b = np.searchsorted(union, b).astype(np.int32)
+    codes = np.concatenate([
+        remap_a[codes_a] if len(codes_a) else codes_a,
+        remap_b[codes_b] if len(codes_b) else codes_b,
+    ]).astype(np.int32)
+    return codes, [str(value) for value in union]
+
+
+def concat_frames(a: ReadFrame, b: ReadFrame) -> ReadFrame:
+    """Concatenate two frames, merging their vocabularies.
+
+    The carry mechanism of the streaming pipeline: the incomplete trailing
+    entity of batch k is prepended to batch k+1, so record order is
+    preserved and codes are remapped into the merged (still sorted)
+    vocabularies.
+    """
+    if a.n_records == 0:
+        return b
+    if b.n_records == 0:
+        return a
+    kwargs = {}
+    for name in _CODED_FIELDS:
+        codes, names = _merge_coded(
+            getattr(a, name), getattr(a, f"{name}_names"),
+            getattr(b, name), getattr(b, f"{name}_names"),
+        )
+        kwargs[name] = codes
+        kwargs[f"{name}_names"] = names
+    for name in _PER_RECORD_FIELDS:
+        if name in _CODED_FIELDS:
+            continue
+        kwargs[name] = np.concatenate([getattr(a, name), getattr(b, name)])
+    return ReadFrame(**kwargs)
+
+
+def iter_frames_from_bam(
+    path: str,
+    batch_records: int,
+    mode: Optional[str] = None,
+    want_qname: bool = False,
+    tag_keys: tuple = DEFAULT_TAG_KEYS,
+):
+    """Yield ReadFrames of <= batch_records alignments in file order.
+
+    The bounded-memory decode path (native stream when available, Python
+    AlignmentReader batching otherwise) — the TPU build's analog of the
+    reference's alignments_per_batch streaming reads (htslib_tagsort.cpp:
+    308-393). Each frame has its own (sorted) vocabularies. Non-default
+    ``tag_keys`` route through the Python decoder (the native parser reads
+    the fixed 10x tag set).
+    """
+    import itertools
+
+    if batch_records < 1:
+        # both backends would otherwise read 0 as clean EOF and yield an
+        # empty-but-valid result for what is always a caller bug
+        raise ValueError(f"batch_records must be >= 1, got {batch_records}")
+    if tuple(tag_keys) != DEFAULT_TAG_KEYS:
+        with AlignmentReader(path, mode) as reader:
+            records = iter(reader)
+            while True:
+                chunk = list(itertools.islice(records, batch_records))
+                if not chunk:
+                    break
+                yield frame_from_records(chunk, tag_keys=tuple(tag_keys))
+        return
+
+    from . import bgzf
+
+    if mode != "r" and bgzf.is_gzip(path):
+        from .. import native
+
+        if native.available():
+            stream = native.stream_frames_native(
+                path, batch_records, want_qname=want_qname
+            )
+            try:
+                first = next(stream, None)
+            except RuntimeError:
+                first = None
+                stream = None  # fall through to the Python decoder
+            if stream is not None:
+                if first is not None:
+                    yield first
+                    yield from stream
+                return
+    with AlignmentReader(path, mode) as reader:
+        records = iter(reader)
+        while True:
+            chunk = list(itertools.islice(records, batch_records))
+            if not chunk:
+                break
+            yield frame_from_records(chunk)
+
+
+def frame_from_bam(path: str, mode: Optional[str] = None) -> ReadFrame:
+    """Decode a BAM/SAM file into a ReadFrame.
+
+    BGZF-compressed inputs (sniffed by content, like AlignmentReader) route
+    through the native C++ decoder (sctools_tpu.native: thread-pooled BGZF
+    inflate, direct columnar extraction) when the library is available; SAM
+    inputs, environments without a toolchain, and native decode failures use
+    the pure-Python record path. ``SCTOOLS_TPU_NATIVE=0`` forces Python.
+    """
+    from . import bgzf
+
+    if mode != "r" and bgzf.is_gzip(path):
+        from .. import native
+
+        if native.available():
+            try:
+                return native.frame_from_bam_native(path)
+            except RuntimeError:
+                pass  # fall back to the Python decoder (and its diagnostics)
+    with AlignmentReader(path, mode) as reader:
+        return frame_from_records(reader)

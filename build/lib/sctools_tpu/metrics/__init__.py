@@ -1,0 +1,15 @@
+"""QC metrics engine: per-cell and per-gene aggregation.
+
+Two backends produce identical output schemas (35-column cell / 26-column gene
+CSVs, matching the reference's vars()-derived headers,
+src/sctools/metrics/aggregator.py:132-189,437-461,561-568):
+
+- ``device``: the TPU path — records packed to tensors, groups realized as
+  sorted-segment reductions (sctools_tpu.metrics.device).
+- ``aggregator``: a streaming host implementation used as the parity oracle
+  and for tiny inputs where a device round-trip isn't worth it.
+"""
+
+from . import aggregator, gatherer, merge, schema, writer  # noqa: F401
+
+__all__ = ["aggregator", "device", "gatherer", "merge", "schema", "writer"]

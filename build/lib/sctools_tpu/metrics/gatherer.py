@@ -1,0 +1,534 @@
+"""Metric gatherers: drive a BAM through a backend and write the CSV.
+
+The reference gatherer walks a tag-sorted BAM with nested group iterators and
+one Python aggregator per entity (src/sctools/metrics/gatherer.py:116-232).
+Here the default backend packs the whole file into a ReadFrame, computes every
+entity's metrics in one jit-compiled device pass (sctools_tpu.metrics.device),
+and writes rows in entity vocabulary order — which equals the reference's row
+order for its documented sorted-input precondition. ``backend='cpu'`` runs the
+streaming host aggregators instead (exact reference semantics, no device).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import closing
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
+from ..io.packed import (
+    KEY_CODE_BITS,
+    KEY_HI_SHIFT,
+    KEY_LO_MASK,
+    KEY_UNMAPPED_SHIFT,
+    ReadFrame,
+    compact_frame,
+    concat_frames,
+    iter_frames_from_bam,
+    pack_flags,
+    slice_frame,
+)
+from ..io.sam import AlignmentReader
+from ..ops.segments import bucket_size
+from ..utils import prefetch_iterator
+from .aggregator import CellMetrics, GeneMetrics
+from .schema import CELL_COLUMNS, GENE_COLUMNS, INT_COLUMNS
+from .writer import MetricCSVWriter
+
+# Device batch size: at most this many alignments are held in host RAM and
+# processed per compiled pass. The streaming analog of the reference's
+# alignments_per_batch default (fastqpreprocessing/src/input_options.h:16).
+DEFAULT_BATCH_RECORDS = 1 << 20
+
+
+_I32_MAX = np.iinfo(np.int32).max
+
+
+def _pad_columns(
+    frame: ReadFrame,
+    is_mito: np.ndarray,
+    pad_to: int = 0,
+    prepacked_keys: tuple = None,
+    pair_mito: bool = False,
+    small_ref: bool = False,
+):
+    """ReadFrame -> (device-ready padded columns, static engine flags).
+
+    ``pad_to`` pins the padded size (streaming batches all share one compiled
+    shape); it is ignored when the frame is larger (e.g. a single entity that
+    outgrew the batch capacity). Seven narrow per-record fields pack into the
+    single int16 ``flags`` column (io.packed.pack_flags): host->device
+    transfer is a wall-clock cost (a tunneled TPU especially), so each batch
+    ships 6 int32/float32 columns, one int16 and one bool — ~39 bytes/record.
+
+    ``prepacked_keys`` = the (k1, k2, k3) key column names in entity order:
+    when the caller verified codes/coordinates fit the packed bit budget
+    (metrics.device compact-key docs), the batch ships the device sort's
+    FOUR packed operands plus a scalar valid count instead of
+    cell/umi/gene/ref/pos/valid — ~34 bytes/record, and the device does no
+    key packing at all. With ``pair_mito`` the k2 (pair) slot carries
+    ``code << 1 | is_mito`` — the cell axis' (cell, gene) histogram and its
+    mito split then ride the device's single sorted view.
+    """
+    n = frame.n_records
+    padded = pad_to if pad_to >= n else bucket_size(n)
+
+    def pad(arr, fill=0, dtype=None):
+        arr = np.asarray(arr)
+        out = np.full(padded, fill, dtype=dtype or arr.dtype)
+        out[:n] = arr
+        return out
+
+    flags = pack_flags(
+        frame.strand, frame.unmapped, frame.duplicate, frame.spliced,
+        frame.xf, frame.perfect_umi, frame.perfect_cb, frame.nh,
+        is_mito[frame.gene],
+    )
+    cols = {"flags": pad(flags, 0, np.int16)}
+    if prepacked_keys is None:
+        # plain schema ships the derived float32 views (the compat
+        # properties recover exactly the floats the old decoder shipped)
+        cols.update(
+            umi_frac30=pad(
+                np.nan_to_num(frame.umi_frac30, nan=0.0), 0.0, np.float32
+            ),
+            cb_frac30=pad(
+                np.nan_to_num(frame.cb_frac30, nan=0.0), 0.0, np.float32
+            ),
+            genomic_frac30=pad(
+                np.nan_to_num(frame.genomic_frac30, nan=0.0), 0.0, np.float32
+            ),
+            genomic_mean=pad(
+                np.nan_to_num(frame.genomic_mean, nan=0.0), 0.0, np.float32
+            ),
+            cell=pad(frame.cell, 0, np.int32),
+            umi=pad(frame.umi, 0, np.int32),
+            gene=pad(frame.gene, 0, np.int32),
+            ref=pad(frame.ref, 0, np.int32),
+            pos=pad(frame.pos, 0, np.int32),
+            valid=np.arange(padded) < n,
+        )
+        return cols, {}
+    # prepacked schema v2: quality columns travel as exact integer
+    # summaries (one device-side f32 division each recovers the old float
+    # schema's values) and m_ref narrows to u8 when the
+    # reference count allows — ~23 B/record on the wire vs 34 with the
+    # float columns
+    k1, k2, k3 = (
+        getattr(frame, name).astype(np.int32) for name in prepacked_keys
+    )
+    if pair_mito:
+        k2 = (k2 << 1) | is_mito[frame.gene].astype(np.int32)
+    mapped = ~np.asarray(frame.unmapped, dtype=bool)
+    genomic_len = frame.genomic_qual & np.uint32(0xFFFF)
+    narrow_genomic = bool(genomic_len.max(initial=0) <= 0xFF)
+    if narrow_genomic:
+        gq = ((frame.genomic_qual >> np.uint32(16)) << np.uint32(8)) | genomic_len
+        cols.update(
+            genomic_qual=pad(gq.astype(np.uint16), 0, np.uint16),
+            genomic_total=pad(frame.genomic_total.astype(np.uint16), 0, np.uint16),
+        )
+    else:
+        cols.update(
+            genomic_qual=pad(frame.genomic_qual, 0, np.uint32),
+            genomic_total=pad(frame.genomic_total, 0, np.uint32),
+        )
+    ref_plus_1 = frame.ref.astype(np.int32) + 1
+    if small_ref:
+        m_ref = pad(
+            (np.where(mapped, 0, 0x80) | ref_plus_1).astype(np.uint8),
+            0xFF,
+            np.uint8,
+        )
+    else:
+        m_ref = pad(
+            np.where(mapped, 0, 1 << KEY_UNMAPPED_SHIFT) + ref_plus_1,
+            _I32_MAX,
+            np.int32,
+        )
+    cols.update(
+        umi_qual=pad(frame.umi_qual, 0, np.uint16),
+        cb_qual=pad(frame.cb_qual, 0, np.uint16),
+        key_hi=pad((k1 << KEY_HI_SHIFT) | (k2 >> KEY_HI_SHIFT), _I32_MAX, np.int32),
+        key_lo=pad(((k2 & KEY_LO_MASK) << KEY_CODE_BITS) | k3, _I32_MAX, np.int32),
+        m_ref=m_ref,
+        ps=pad(
+            (frame.pos.astype(np.int32) << 1) | frame.strand.astype(np.int32),
+            _I32_MAX,
+            np.int32,
+        ),
+        n_valid=np.asarray([n], dtype=np.int32),
+    )
+    return cols, {"wide_genomic": not narrow_genomic, "small_ref": small_ref}
+
+
+class MetricGatherer:
+    """Common driver: pack, compute on the selected backend, write csv."""
+
+    entity_kind: str = ""
+    columns: List[str] = []
+
+    def __init__(
+        self,
+        bam_file: str,
+        output_stem: str,
+        mitochondrial_gene_ids: Set[str] = set(),
+        compress: bool = True,
+        backend: str = "device",
+        batch_records: int = DEFAULT_BATCH_RECORDS,
+        frame_source=None,
+    ):
+        """``frame_source``: optional zero-arg callable yielding sorted
+        ReadFrames in place of decoding ``bam_file`` (the fused tag-sort
+        path streams the merge straight in here via
+        native.tagsort_stream_frames). ``bam_file`` still names the
+        unsorted input: the device backend reads its header for wire-schema
+        decisions; the cpu backend does not support frame sources."""
+        self._bam_file = bam_file
+        self._output_stem = output_stem
+        self._compress = compress
+        self._mitochondrial_gene_ids = mitochondrial_gene_ids
+        self._backend = backend
+        self._batch_records = batch_records
+        self._frame_source = frame_source
+
+    @property
+    def bam_file(self) -> str:
+        return self._bam_file
+
+    def extract_metrics(self, mode: str = "rb") -> None:
+        if self._backend == "device":
+            self._extract_device(mode)
+        elif self._backend == "cpu":
+            if self._frame_source is not None:
+                raise ValueError("frame_source requires the device backend")
+            self._extract_cpu(mode)
+        else:
+            raise ValueError(f"unknown backend {self._backend!r}")
+
+    # ---- device backend --------------------------------------------------
+
+    def _extract_device(self, mode: str) -> None:
+        """Streaming device pass: bounded host memory for any file size.
+
+        Batches of <= batch_records alignments decode off a prefetch thread
+        (decode overlaps device compute); each batch is cut at the last
+        entity boundary and the incomplete tail entity carries into the next
+        batch — sorted input means an entity never spans two processed
+        batches, so per-batch results need no cross-batch merging. Memory is
+        one batch plus the largest single entity, the reference gatherer's
+        own model ("one molecule group in memory", metrics/gatherer.py:41-43,
+        scaled to batches).
+        """
+        from ..utils.cache import enable_compilation_cache
+        from . import device as device_engine  # deferred jax import
+
+        enable_compilation_cache()
+        # wire-schema decisions that must not flip mid-stream: the u8 m_ref
+        # column is chosen from the header's reference count (fixed for the
+        # whole file), and wide_genomic ratchets one-way in the dispatch
+        # loop — at most one recompile per run, never schema flapping
+        with AlignmentReader(
+            self._bam_file, mode if mode != "rb" else None
+        ) as header_probe:
+            self._small_ref = len(header_probe.header.references) <= 0x7F
+        self._wide_genomic = False
+        if self._frame_source is not None:
+            frames = prefetch_iterator(self._frame_source())
+        else:
+            frames = prefetch_iterator(
+                iter_frames_from_bam(
+                    self._bam_file,
+                    self._batch_records,
+                    mode if mode != "rb" else None,
+                )
+            )
+        out = MetricCSVWriter(self._output_stem, self._compress)
+        try:
+            with closing(out):
+                out.write_header({c: None for c in self.columns})
+                self._stream_device_batches(frames, device_engine, out)
+        except BaseException:
+            # never leave a partial, valid-looking CSV behind (mirrors the
+            # native attach path's unlink-on-error)
+            try:
+                os.remove(out.filename)
+            except OSError:
+                pass
+            raise
+
+    # batches in flight on the device before the oldest result is pulled.
+    # Depth 2 lets the main thread prep + dispatch batch k+2 while k's pull
+    # waits behind k+1's upload on a shared (tunneled) host<->device link.
+    _PIPELINE_DEPTH = 2
+
+    def _stream_device_batches(self, frames, device_engine, out) -> None:
+        import sys
+        from collections import deque
+
+        carry: Optional[ReadFrame] = None
+        pending = deque()  # dispatched but not yet written
+        multi_batch = False
+        processed = 0
+        next_progress = 10_000_000  # reference cadence (fastq_common.cpp:340)
+        for frame in frames:
+            processed += frame.n_records
+            if processed >= next_progress:
+                print(
+                    f"[{type(self).__name__}] {processed} records decoded",
+                    file=sys.stderr,
+                )
+                next_progress += 10_000_000
+            if carry is not None:
+                frame = concat_frames(carry, frame)
+                carry = None
+            key = (
+                frame.cell if self.entity_kind == "cell" else frame.gene
+            )
+            changes = np.nonzero(key[1:] != key[:-1])[0]
+            if changes.size == 0:
+                carry = frame  # one entity so far; keep accumulating
+                continue
+            # cut at the last entity boundary that fits the capacity, so
+            # every batch of a multi-batch run pads to ONE fixed shape
+            # and the device pass compiles exactly once; only an entity
+            # larger than the whole capacity overflows it (and then
+            # falls back to a bigger padded shape). A file smaller than
+            # one batch stays at its own bucket size — padding a tiny
+            # input to the full capacity would waste ~capacity/n of
+            # device compute and transfer.
+            capacity = bucket_size(self._batch_records)
+            multi_batch = multi_batch or frame.n_records >= self._batch_records
+            eligible = changes[changes < capacity]
+            # when even the first entity overflows capacity, cut right after
+            # it — the smallest oversized batch that keeps it intact, rather
+            # than the whole accumulated frame
+            cut = int(eligible[-1] if eligible.size else changes[0]) + 1
+            # dispatch is async: later batches compute on the device while
+            # earlier rows transfer back and write below. Ascending entity
+            # order is the presorted contract; grouped-but-unsorted input
+            # (e.g. samtools collate) falls back to the device-sorted path
+            # for the batch instead of mis-attributing sorted-side metrics.
+            ascending = bool(np.all(key[1:cut] >= key[: cut - 1]))
+            pending.append(
+                self._dispatch_device_batch(
+                    slice_frame(frame, 0, cut),
+                    device_engine,
+                    pad_to=capacity if multi_batch else 0,
+                    presorted=ascending,
+                )
+            )
+            if len(pending) > self._PIPELINE_DEPTH:
+                self._finalize_device_batch(
+                    *pending.popleft(), device_engine, out
+                )
+            # compact, or the carried vocabularies would accumulate the
+            # union of every batch seen so far
+            carry = compact_frame(slice_frame(frame, cut, frame.n_records))
+        if carry is not None and carry.n_records:
+            tail_key = (
+                carry.cell if self.entity_kind == "cell" else carry.gene
+            )
+            pending.append(
+                self._dispatch_device_batch(
+                    carry,
+                    device_engine,
+                    pad_to=bucket_size(self._batch_records) if multi_batch else 0,
+                    presorted=bool(np.all(tail_key[1:] >= tail_key[:-1])),
+                )
+            )
+        while pending:
+            self._finalize_device_batch(*pending.popleft(), device_engine, out)
+
+    def _dispatch_device_batch(
+        self, frame: ReadFrame, device_engine, pad_to: int, presorted: bool = True
+    ):
+        is_mito = np.asarray(
+            [name in self._mitochondrial_gene_ids for name in frame.gene_names],
+            dtype=bool,
+        )
+        # the input BAM is sorted by the entity tag triple (the documented
+        # precondition, reference gatherer.py:91-95) and vocabulary codes
+        # preserve string order, so batches are presorted: the device pass
+        # skips its primary sort entirely; the caller verifies ascending
+        # entity order per batch and passes presorted=False otherwise. When
+        # every code and coordinate also fits the packed-key bit budget,
+        # the host ships the FOUR packed sort operands directly (~34 B per
+        # record instead of ~39, and no device-side key packing). The code
+        # maxima are checked EXPLICITLY: a dispatched slice shares its
+        # parent's concat-merged vocabulary, which can exceed the slice's
+        # own record count, so record count is no bound.
+        code_cap = 1 << KEY_CODE_BITS
+        # the cell axis packs gene<<1|mito into the pair slot, so the gene
+        # code loses one bit of budget there
+        gene_cap = code_cap >> 1 if self.entity_kind == "cell" else code_cap
+        prepacked = (
+            presorted
+            and frame.n_records > 0
+            and int(frame.cell.max(initial=0)) < code_cap
+            and int(frame.umi.max(initial=0)) < code_cap
+            and int(frame.gene.max(initial=0)) < gene_cap
+            and int(frame.ref.max(initial=0)) < (1 << KEY_UNMAPPED_SHIFT) - 1
+            # pos shifts left by 1 into ps: bound it so the packed int32
+            # cannot wrap and the key stays order-preserving, not merely
+            # equality-preserving
+            and int(frame.pos.max(initial=0)) < (1 << 30)
+        )
+        key_order = (
+            ("cell", "gene", "umi")
+            if self.entity_kind == "cell"
+            else ("gene", "cell", "umi")
+        )
+        cols, static_flags = _pad_columns(
+            frame,
+            is_mito,
+            pad_to=pad_to,
+            prepacked_keys=key_order if prepacked else None,
+            pair_mito=self.entity_kind == "cell",
+            small_ref=self._small_ref,
+        )
+        if static_flags.get("wide_genomic"):
+            # one-way ratchet: once any batch needs the wide genomic
+            # columns, later batches stay wide (at most one extra compile
+            # per run instead of flapping between schemas)
+            self._wide_genomic = True
+        if self._wide_genomic:
+            static_flags["wide_genomic"] = True
+        num_segments = len(cols["flags"])
+        result = device_engine.compute_entity_metrics(
+            {k: np.asarray(v) for k, v in cols.items()},
+            num_segments=num_segments,
+            kind=self.entity_kind,
+            presorted=presorted,
+            prepacked=prepacked,
+            **static_flags,
+        )
+        # keep only what finalize reads: pinning the whole frame would hold
+        # ~40 MB of record arrays per in-flight batch for no reason
+        return self._entity_names(frame), result, num_segments
+
+    def _finalize_device_batch(
+        self, entity_names, result, num_segments: int, device_engine, out
+    ) -> None:
+        # compact device->host transfer: pull only (a bucketed bound on) the
+        # real entity rows, as two stacked arrays instead of 38 padded ones
+        n_entities = int(result["n_entities"])
+        k = min(bucket_size(n_entities, minimum=1024), num_segments)
+        int_names = ("entity_code",) + tuple(
+            c for c in self.columns if c in INT_COLUMNS
+        )
+        float_names = tuple(c for c in self.columns if c not in INT_COLUMNS)
+        ints, floats = device_engine.compact_results(
+            result, int_names, float_names, k
+        )
+        self._write_device_rows(
+            entity_names, n_entities, int_names, float_names,
+            np.asarray(ints), np.asarray(floats), out,
+        )
+
+    def _entity_names(self, frame: ReadFrame) -> List[str]:
+        return frame.cell_names if self.entity_kind == "cell" else frame.gene_names
+
+    def _filter_rows(self, names: np.ndarray):
+        """Vectorized row mask (None = keep all); gene path drops multi-genes."""
+        return None
+
+    def _write_device_rows(
+        self,
+        entity_names,
+        n_entities: int,
+        int_names,
+        float_names,
+        ints: np.ndarray,
+        floats: np.ndarray,
+        out: MetricCSVWriter,
+    ) -> None:
+        """Format one batch's entity rows as a CSV block (vectorized).
+
+        Per-row Python dict formatting was a measured bottleneck at
+        65k-entity scale; the writer's block path renders the same bytes
+        (``str(float(x))`` of the engine's float32 results upcast to
+        float64) through the native formatter in ~1/10 the time.
+        """
+        names = np.asarray(entity_names, dtype=object)
+        int_of = {n: i for i, n in enumerate(int_names)}
+        float_of = {n: i for i, n in enumerate(float_names)}
+        codes = ints[:n_entities, int_of["entity_code"]].astype(np.int64)
+        row_names = names[codes]
+        keep = self._filter_rows(row_names)
+        if keep is None:
+            keep = slice(None)
+        index = np.where(row_names == "", "None", row_names)[keep]
+        columns = [
+            ints[:n_entities, int_of[column]][keep].astype(np.int64)
+            if column in int_of
+            else floats[:n_entities, float_of[column]][keep].astype(np.float64)
+            for column in self.columns
+        ]
+        out.write_block(index.astype(str), columns)
+
+    # ---- cpu backend (exact reference streaming semantics) ---------------
+
+    def _extract_cpu(self, mode: str) -> None:
+        raise NotImplementedError
+
+
+class GatherCellMetrics(MetricGatherer):
+    """Per-cell metrics; input must be sorted by CB, UB, GE (gene fastest)."""
+
+    entity_kind = "cell"
+    columns = CELL_COLUMNS
+
+    def _extract_cpu(self, mode: str = "rb") -> None:
+        with AlignmentReader(self._bam_file, mode if mode != "rb" else None) as bam_iterator, closing(
+            MetricCSVWriter(self._output_stem, self._compress)
+        ) as cell_metrics_output:
+            cell_metrics_output.write_header(vars(CellMetrics()))
+            for cell_iterator, cell_tag in iter_cell_barcodes(bam_iterator=iter(bam_iterator)):
+                metric_aggregator = CellMetrics()
+                for molecule_iterator, molecule_tag in iter_molecule_barcodes(
+                    bam_iterator=cell_iterator
+                ):
+                    for gene_iterator, gene_tag in iter_genes(bam_iterator=molecule_iterator):
+                        metric_aggregator.parse_molecule(
+                            tags=(cell_tag, molecule_tag, gene_tag),
+                            records=gene_iterator,
+                        )
+                metric_aggregator.finalize(
+                    mitochondrial_genes=self._mitochondrial_gene_ids
+                )
+                cell_metrics_output.write(cell_tag, vars(metric_aggregator))
+
+
+class GatherGeneMetrics(MetricGatherer):
+    """Per-gene metrics; input must be sorted by GE, CB, UB (molecule fastest)."""
+
+    entity_kind = "gene"
+    columns = GENE_COLUMNS
+
+    def _filter_rows(self, names: np.ndarray):
+        # multi-gene "a,b" groups are skipped entirely, like the counting
+        # stage (reference gatherer.py:211-212); vectorized comma scan
+        return np.char.find(names.astype(str), ",") < 0
+
+    def _extract_cpu(self, mode: str = "rb") -> None:
+        with AlignmentReader(self._bam_file, mode if mode != "rb" else None) as bam_iterator, closing(
+            MetricCSVWriter(self._output_stem, self._compress)
+        ) as gene_metrics_output:
+            gene_metrics_output.write_header(vars(GeneMetrics()))
+            for gene_iterator, gene_tag in iter_genes(bam_iterator=iter(bam_iterator)):
+                metric_aggregator = GeneMetrics()
+                if gene_tag and len(gene_tag.split(",")) > 1:
+                    continue
+                for cell_iterator, cell_tag in iter_cell_barcodes(bam_iterator=gene_iterator):
+                    for molecule_iterator, molecule_tag in iter_molecule_barcodes(
+                        bam_iterator=cell_iterator
+                    ):
+                        metric_aggregator.parse_molecule(
+                            tags=(gene_tag, cell_tag, molecule_tag),
+                            records=molecule_iterator,
+                        )
+                metric_aggregator.finalize()
+                gene_metrics_output.write(gene_tag, vars(metric_aggregator))

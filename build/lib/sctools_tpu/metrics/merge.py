@@ -1,0 +1,105 @@
+"""Merge per-chunk metric CSVs.
+
+Chunks hold disjoint cell sets (the split invariant), so cell metrics
+concatenate; gene metrics must be combined: counts sum, quality moments
+average weighted by reads, and ratio metrics are recomputed — the same
+semantics as the reference merger (src/sctools/metrics/merge.py:59-191),
+written for modern pandas.
+
+The device analog of this file-level merge is a psum/all_gather collective
+over the mesh (sctools_tpu.parallel); this module remains the file-boundary
+fallback and the egress format.
+"""
+
+from typing import List, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+class MergeMetrics:
+    """Merges multiple metrics files into one gzip-compressed csv."""
+
+    def __init__(self, metric_files: Sequence[str], output_file: str):
+        self._metric_files = metric_files
+        if not output_file.endswith(".csv.gz"):
+            output_file += ".csv.gz"
+        self._output_file = output_file
+
+    def execute(self) -> None:
+        raise NotImplementedError
+
+
+class MergeCellMetrics(MergeMetrics):
+    def execute(self) -> None:
+        """Concatenate cell metric files (cell sets are disjoint by construction)."""
+        metric_dataframes: List[pd.DataFrame] = [
+            pd.read_csv(f, index_col=0) for f in self._metric_files
+        ]
+        concatenated_frame: pd.DataFrame = pd.concat(metric_dataframes, axis=0)
+        concatenated_frame.to_csv(self._output_file, compression="gzip")
+
+
+class MergeGeneMetrics(MergeMetrics):
+    COUNT_COLUMNS_TO_SUM = [
+        "n_reads",
+        "noise_reads",
+        "perfect_molecule_barcodes",
+        "reads_mapped_exonic",
+        "reads_mapped_intronic",
+        "reads_mapped_utr",
+        "reads_mapped_uniquely",
+        "reads_mapped_multiple",
+        "duplicate_reads",
+        "spliced_reads",
+        "antisense_reads",
+        "n_molecules",
+        "n_fragments",
+        "fragments_with_single_read_evidence",
+        "molecules_with_single_read_evidence",
+        "number_cells_detected_multiple",
+        "number_cells_expressing",
+    ]
+
+    READ_WEIGHTED_COLUMNS = [
+        "molecule_barcode_fraction_bases_above_30_mean",
+        "molecule_barcode_fraction_bases_above_30_variance",
+        "genomic_reads_fraction_bases_quality_above_30_mean",
+        "genomic_reads_fraction_bases_quality_above_30_variance",
+        "genomic_read_quality_mean",
+        "genomic_read_quality_variance",
+    ]
+
+    def _merge_pair(self, nucleus: pd.DataFrame, leaf: pd.DataFrame) -> pd.DataFrame:
+        """Merge one chunk into the running result."""
+        concatenated = pd.concat([nucleus, leaf], axis=0)
+        grouped = concatenated.groupby(level=0)
+
+        summed_columns = grouped[self.COUNT_COLUMNS_TO_SUM].sum()
+
+        def weighted_average(data_frame: pd.DataFrame) -> pd.Series:
+            weights = data_frame["n_reads"].values
+            return pd.Series(
+                {
+                    c: np.average(data_frame[c], weights=weights)
+                    for c in self.READ_WEIGHTED_COLUMNS
+                }
+            )
+
+        averaged_columns = grouped[
+            self.READ_WEIGHTED_COLUMNS + ["n_reads"]
+        ].apply(weighted_average)
+
+        merged = pd.concat([summed_columns, averaged_columns], axis=1)
+        merged["reads_per_molecule"] = merged["n_reads"] / merged["n_molecules"]
+        merged["fragments_per_molecule"] = merged["n_fragments"] / merged["n_molecules"]
+        merged["reads_per_fragment"] = merged["n_reads"] / merged["n_fragments"]
+        return merged
+
+    def execute(self) -> None:
+        """Incrementally fold each chunk file into the merged result."""
+        nucleus = pd.read_csv(self._metric_files[0], index_col=0)
+        for filename in self._metric_files[1:]:
+            leaf = pd.read_csv(filename, index_col=0)
+            nucleus = self._merge_pair(nucleus, leaf)
+        nucleus.to_csv(self._output_file, compression="gzip")

@@ -1,0 +1,109 @@
+"""Buffered metric CSV writer (optionally gzipped).
+
+Output format is pinned by the reference's CSV contract (src/sctools/
+metrics/writer.py:27-107): a header line starting with a bare comma (the
+unnamed index column), one row per entity, non-string indices rendered via
+repr. Construction differs: rows are formatted into an in-memory block and
+flushed in batches, which keeps the gzip stream fed with large writes
+instead of one small write per entity — and whole result batches bypass
+Python formatting entirely via ``write_block`` (the native CSV formatter).
+"""
+
+from numbers import Number
+from typing import Any, List, Mapping
+
+import gzip
+
+_FLUSH_EVERY = 4096  # rows per underlying write
+
+
+class MetricCSVWriter:
+    """Accumulates entity rows and writes them through in batches."""
+
+    def __init__(self, output_stem: str, compress=True):
+        suffix = ".csv.gz" if compress else ".csv"
+        if not output_stem.endswith(suffix):
+            output_stem += suffix
+        self._filename = output_stem
+        if compress:
+            # level 1: on numeric CSV rows the ratio loss vs the default (9)
+            # is small while compression drops from the top of the profile —
+            # the writer shares one host core with decode and device dispatch
+            self._sink = gzip.open(self._filename, "wb", compresslevel=1)
+        else:
+            self._sink = open(self._filename, "wb")
+        self._columns: List[str] = []
+        self._rows: List[str] = []
+
+    @property
+    def filename(self) -> str:
+        return self._filename
+
+    def _push(self, line: str) -> None:
+        self._rows.append(line)
+        if len(self._rows) >= _FLUSH_EVERY:
+            self._flush()
+
+    def _flush(self) -> None:
+        if self._rows:
+            self._sink.write(("\n".join(self._rows) + "\n").encode())
+            self._rows.clear()
+
+    def write_header(self, record: Mapping[str, Any]) -> None:
+        """Column names = keys of ``record``, privates (_-prefixed) dropped."""
+        self._columns = [key for key in record if not key.startswith("_")]
+        self._push("," + ",".join(self._columns))
+
+    def write(self, index: str, record: Mapping[str, Number]) -> None:
+        """Append one entity row; ``index`` is the cell barcode / gene name."""
+        if not isinstance(index, str):
+            index = repr(index)  # None genes/cells render as 'None'
+        values = ",".join(str(record[column]) for column in self._columns)
+        self._push(index + "," + values)
+
+    def write_block(self, index, columns) -> None:
+        """Append many rows at once.
+
+        ``index`` holds the entity names; ``columns`` is a list of
+        equal-length numpy arrays (integer or floating) in header order.
+        The native block formatter renders values byte-identically to the
+        per-value ``str()`` contract (including the trailing ``.0`` on
+        integral floats) an order of magnitude faster than per-row Python
+        formatting at 10^4-entity batch sizes; when the native library is
+        unavailable the rows format through the same ``str()`` path as
+        ``write``.
+        """
+        import numpy as np
+
+        from ..native import format_csv_block
+
+        self._flush()  # keep row order: pending str rows go first
+        # canonicalize dtypes BEFORE choosing a path so native and fallback
+        # render identical bytes (str(np.float32) and str(np.bool_) differ
+        # from their 64-bit casts)
+        columns = [
+            arr.astype(
+                np.float64
+                if np.issubdtype(arr.dtype, np.floating)
+                else np.int64,
+                copy=False,
+            )
+            for arr in map(np.asarray, columns)
+        ]
+        index = [str(name) for name in index]
+        for name in index:
+            # an index value containing a separator would silently shift
+            # every later column in its row (the old Arrow path raised here
+            # too; multi-gene "a,b" rows are filtered before the writer)
+            if "," in name or "\n" in name:
+                raise ValueError(f"index value needs CSV quoting: {name!r}")
+        block = format_csv_block(index, columns)
+        if block is not None:
+            self._sink.write(block)
+            return
+        for i, name in enumerate(index):
+            self._push(name + "," + ",".join(str(col[i]) for col in columns))
+
+    def close(self) -> None:
+        self._flush()
+        self._sink.close()

@@ -1,0 +1,1049 @@
+"""ctypes bindings for the native host layer (libsctools_native.so).
+
+The C++ decoder (bamdecode.cpp) replaces the pure-Python BAM -> ReadFrame
+path for large inputs: BGZF blocks inflate on a thread pool and records
+parse straight into packed columns — the role the reference's
+fastqpreprocessing/ binaries play for its pipeline, re-targeted at the
+device pipeline's columnar input format.
+
+The library builds on demand with make (g++/zlib only); when the toolchain
+or build is unavailable, callers fall back to the Python decoder —
+``available()`` reports which path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libsctools_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _host_fingerprint() -> str:
+    """CPU identity the compiled library is specific to (-march=native)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    import hashlib
+
+                    return hashlib.sha256(line.encode()).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
+def _build() -> bool:
+    sources = [
+        os.path.join(_DIR, name)
+        for name in os.listdir(_DIR)
+        if name.endswith((".cpp", ".h"))  # headers too: native_io.h is
+        # included by attach/synth and must trigger rebuilds (Makefile HDRS)
+    ]
+    marker = _LIB_PATH + ".buildhost"
+    fingerprint = _host_fingerprint()
+    try:
+        stale = not os.path.exists(_LIB_PATH) or any(
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(source)
+            for source in sources
+        )
+        # the library is built -march=native: an up-to-date .so from another
+        # machine (shared filesystem, container image) could carry illegal
+        # instructions for this CPU — force a rebuild when the host changed
+        # (make alone would see the foreign .so as fresh and do nothing)
+        force = False
+        if not stale:
+            try:
+                with open(marker) as f:
+                    force = f.read().strip() != fingerprint
+            except OSError:
+                force = True
+        if stale or force:
+            subprocess.run(
+                ["make", "-s", "-C", _DIR] + (["-B"] if force else []),
+                check=True,
+                capture_output=True,
+                timeout=300,
+            )
+            with open(marker, "w") as f:
+                f.write(fingerprint)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("SCTOOLS_TPU_NATIVE", "1") == "0" or not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.scx_decode_bam.restype = ctypes.c_void_p
+        lib.scx_decode_bam.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_n_records.restype = ctypes.c_long
+        lib.scx_n_records.argtypes = [ctypes.c_void_p]
+        lib.scx_col_i32.restype = ctypes.POINTER(ctypes.c_int32)
+        lib.scx_col_i32.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_col_i8.restype = ctypes.POINTER(ctypes.c_int8)
+        lib.scx_col_i8.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_col_u16.restype = ctypes.POINTER(ctypes.c_uint16)
+        lib.scx_col_u16.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_col_u32.restype = ctypes.POINTER(ctypes.c_uint32)
+        lib.scx_col_u32.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_vocab_size.restype = ctypes.c_long
+        lib.scx_vocab_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_vocab_bytes.restype = ctypes.POINTER(ctypes.c_char)
+        lib.scx_vocab_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.scx_vocab_offsets.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.scx_vocab_offsets.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_free.restype = None
+        lib.scx_free.argtypes = [ctypes.c_void_p]
+        lib.scx_stream_open.restype = ctypes.c_void_p
+        lib.scx_stream_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_stream_next.restype = ctypes.c_long
+        lib.scx_stream_next.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.scx_stream_error.restype = ctypes.c_char_p
+        lib.scx_stream_error.argtypes = [ctypes.c_void_p]
+        lib.scx_stream_close.restype = None
+        lib.scx_stream_close.argtypes = [ctypes.c_void_p]
+        lib.scx_synth_bam.restype = ctypes.c_long
+        lib.scx_synth_bam.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_tagsort.restype = ctypes.c_long
+        lib.scx_tagsort.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_tagsort_pipe_open.restype = ctypes.c_void_p
+        lib.scx_tagsort_pipe_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_tagsort_pipe_fd.restype = ctypes.c_int
+        lib.scx_tagsort_pipe_fd.argtypes = [ctypes.c_void_p]
+        lib.scx_tagsort_pipe_finish.restype = ctypes.c_long
+        lib.scx_tagsort_pipe_finish.argtypes = [ctypes.c_void_p]
+        lib.scx_tagsort_pipe_error.restype = ctypes.c_char_p
+        lib.scx_tagsort_pipe_error.argtypes = [ctypes.c_void_p]
+        lib.scx_tagsort_pipe_free.restype = None
+        lib.scx_tagsort_pipe_free.argtypes = [ctypes.c_void_p]
+        lib.scx_fqm.restype = ctypes.c_long
+        lib.scx_fqm.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_sfq_open.restype = ctypes.c_void_p
+        lib.scx_sfq_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.scx_sfq_next.restype = ctypes.c_long
+        lib.scx_sfq_next.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.scx_sfq_buf.restype = ctypes.POINTER(ctypes.c_char)
+        lib.scx_sfq_buf.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_sfq_len.restype = ctypes.c_int
+        lib.scx_sfq_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.scx_sfq_write.restype = ctypes.c_long
+        lib.scx_sfq_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.scx_sfq_close.restype = ctypes.c_int
+        lib.scx_sfq_close.argtypes = [ctypes.c_void_p]
+        lib.scx_sfq_error.restype = ctypes.c_char_p
+        lib.scx_sfq_error.argtypes = [ctypes.c_void_p]
+        lib.scx_sfq_free.restype = None
+        lib.scx_sfq_free.argtypes = [ctypes.c_void_p]
+        lib.scx_format_csv_block.restype = ctypes.c_long
+        lib.scx_format_csv_block.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_char_p, ctypes.c_long,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """Whether the native decoder can be used (builds lazily on first call)."""
+    return _load() is not None
+
+
+def _copy_array(pointer, n, dtype):
+    return np.ctypeslib.as_array(pointer, shape=(n,)).astype(dtype, copy=True)
+
+
+def _vocab(lib, handle, name: bytes) -> List[str]:
+    size = lib.scx_vocab_size(handle, name)
+    total = ctypes.c_long(0)
+    data = lib.scx_vocab_bytes(handle, name, ctypes.byref(total))
+    offsets = lib.scx_vocab_offsets(handle, name)
+    raw = ctypes.string_at(data, total.value) if total.value else b""
+    out = []
+    for i in range(size):
+        out.append(raw[offsets[i]:offsets[i + 1]].decode("ascii"))
+    return out
+
+
+def _empty_frame():
+    from ..io.packed import ReadFrame
+
+    empty_i32 = np.zeros(0, np.int32)
+    return ReadFrame(
+        cell=empty_i32, umi=empty_i32.copy(), gene=empty_i32.copy(),
+        qname=empty_i32.copy(),
+        cell_names=[], umi_names=[], gene_names=[], qname_names=[],
+        ref=empty_i32.copy(), pos=empty_i32.copy(),
+        strand=np.zeros(0, np.int8),
+        unmapped=np.zeros(0, bool), duplicate=np.zeros(0, bool),
+        spliced=np.zeros(0, bool),
+        xf=np.zeros(0, np.int8), nh=empty_i32.copy(),
+        perfect_umi=np.zeros(0, np.int8),
+        perfect_cb=np.zeros(0, np.int8),
+        umi_qual=np.zeros(0, np.uint16),
+        cb_qual=np.zeros(0, np.uint16),
+        genomic_qual=np.zeros(0, np.uint32),
+        genomic_total=np.zeros(0, np.uint32),
+    )
+
+
+def _frame_from_handle(lib, handle, want_qname: bool):
+    """Copy the handle's current batch out into a ReadFrame."""
+    from ..io.packed import ReadFrame
+
+    n = lib.scx_n_records(handle)
+    if n == 0:
+        return _empty_frame()
+
+    def i32(name):
+        return _copy_array(lib.scx_col_i32(handle, name), n, np.int32)
+
+    def i8(name, dtype=np.int8):
+        return _copy_array(lib.scx_col_i8(handle, name), n, dtype)
+
+    def u16(name):
+        return _copy_array(lib.scx_col_u16(handle, name), n, np.uint16)
+
+    def u32(name):
+        return _copy_array(lib.scx_col_u32(handle, name), n, np.uint32)
+
+    return ReadFrame(
+        cell=i32(b"cell"), umi=i32(b"umi"), gene=i32(b"gene"),
+        qname=i32(b"qname"),
+        cell_names=_vocab(lib, handle, b"cell"),
+        umi_names=_vocab(lib, handle, b"umi"),
+        gene_names=_vocab(lib, handle, b"gene"),
+        qname_names=_vocab(lib, handle, b"qname") if want_qname else [""],
+        ref=i32(b"ref"), pos=i32(b"pos"),
+        strand=i8(b"strand"),
+        unmapped=i8(b"unmapped").astype(bool),
+        duplicate=i8(b"duplicate").astype(bool),
+        spliced=i8(b"spliced").astype(bool),
+        xf=i8(b"xf"), nh=i32(b"nh"),
+        perfect_umi=i8(b"perfect_umi"),
+        perfect_cb=i8(b"perfect_cb"),
+        umi_qual=u16(b"umi_qual"),
+        cb_qual=u16(b"cb_qual"),
+        genomic_qual=u32(b"genomic_qual"),
+        genomic_total=u32(b"genomic_total"),
+    )
+
+
+def frame_from_bam_native(path: str, n_threads: Optional[int] = None):
+    """Decode a whole BAM file into one ReadFrame via the native library.
+
+    Raises RuntimeError when the library is unavailable or the file is
+    malformed; io.packed.frame_from_bam handles fallback.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native decoder unavailable")
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_decode_bam(
+        path.encode(), n_threads, errbuf, ctypes.sizeof(errbuf)
+    )
+    if not handle:
+        raise RuntimeError(
+            f"native BAM decode failed: {errbuf.value.decode(errors='replace')}"
+        )
+    try:
+        return _frame_from_handle(lib, handle, want_qname=True)
+    finally:
+        lib.scx_free(handle)
+
+
+def stream_frames_native(
+    path: str,
+    batch_records: int,
+    n_threads: Optional[int] = None,
+    want_qname: bool = False,
+):
+    """Yield ReadFrames of <= batch_records alignments in file order.
+
+    Bounded host memory: the native stream (scx_stream_*) holds only the
+    current batch plus one compressed chunk — the reference's
+    alignments_per_batch memory model (input_options.h:16). With
+    ``want_qname=False`` the qname column is all zeros and its vocabulary is
+    [""], skipping the near-one-entry-per-record dictionary that metrics
+    never read.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native decoder unavailable")
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_stream_open(
+        path.encode(), n_threads, 1 if want_qname else 0,
+        errbuf, ctypes.sizeof(errbuf),
+    )
+    if not handle:
+        raise RuntimeError(
+            f"native BAM stream open failed: "
+            f"{errbuf.value.decode(errors='replace')}"
+        )
+    try:
+        while True:
+            n = lib.scx_stream_next(handle, batch_records)
+            if n < 0:
+                raise RuntimeError(
+                    "native BAM stream failed: "
+                    f"{lib.scx_stream_error(handle).decode(errors='replace')}"
+                )
+            if n == 0:
+                break
+            yield _frame_from_handle(lib, handle, want_qname)
+    finally:
+        lib.scx_stream_close(handle)
+
+
+def synth_bam_native(
+    path: str,
+    n_cells: int,
+    molecules_per_cell: int = 8,
+    reads_per_molecule: int = 4,
+    n_genes: int = 4096,
+    seq_len: int = 98,
+    seed: int = 42,
+    compress_level: int = 1,
+) -> int:
+    """Write a cell-sorted fully tagged synthetic BAM at native speed.
+
+    Used by bench.py and large-scale streaming tests to build
+    north-star-sized inputs. Returns records written. Raises RuntimeError
+    when the native layer is unavailable (callers fall back to the Python
+    writer in tests/helpers or skip).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    errbuf = ctypes.create_string_buffer(256)
+    written = lib.scx_synth_bam(
+        path.encode(), n_cells, molecules_per_cell, reads_per_molecule,
+        n_genes, seq_len, seed, compress_level, errbuf, ctypes.sizeof(errbuf),
+    )
+    if written < 0:
+        raise RuntimeError(
+            f"synth bam failed: {errbuf.value.decode(errors='replace')}"
+        )
+    return written
+
+
+def tagsort_native(
+    input_bam: str,
+    output_bam: str,
+    tag_keys,
+    batch_records: int = 500_000,
+    compress_level: int = 6,
+) -> int:
+    """Out-of-core tag sort in C++ (scx_tagsort). Returns records written.
+
+    Sorts by exactly three tag keys then query name — the reference
+    TagSort's key shape (htslib_tagsort.cpp TagOrder). Raises RuntimeError
+    when the native layer is unavailable or the key count differs (callers
+    fall back to the Python path).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    keys = list(tag_keys)
+    if len(keys) != 3 or any(len(k) != 2 for k in keys):
+        raise RuntimeError("native tagsort requires exactly three 2-char tags")
+    errbuf = ctypes.create_string_buffer(512)
+    n = lib.scx_tagsort(
+        input_bam.encode(), output_bam.encode(),
+        keys[0].encode(), keys[1].encode(), keys[2].encode(),
+        batch_records, compress_level, errbuf, ctypes.sizeof(errbuf),
+    )
+    if n < 0:
+        raise RuntimeError(
+            f"native tagsort failed: {errbuf.value.decode(errors='replace')}"
+        )
+    return n
+
+
+def format_csv_block(index, columns) -> Optional[bytes]:
+    """Render one batch of metric rows to CSV bytes (scx_format_csv_block).
+
+    ``index`` is a sequence of entity-name strings; ``columns`` is a list of
+    equal-length 1-D numpy arrays in header order — int64 and float64 render
+    exactly; other dtypes are cast to one of the two first (callers wanting
+    fallback-identical bytes must pre-cast, as MetricCSVWriter.write_block
+    does). The native formatter reproduces Python's per-value ``str()``
+    rendering of those canonical dtypes byte-for-byte (the reference
+    writer's contract, src/sctools/metrics/writer.py:84-103). Returns None
+    when the native library is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    if hasattr(index, "tolist"):
+        index = index.tolist()
+    n = len(index)
+    if n == 0:
+        return b""
+    encoded = [str(s).encode() for s in index]
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    blob = b"".join(encoded)
+    is_float = np.asarray(
+        [np.issubdtype(np.asarray(c).dtype, np.floating) for c in columns],
+        dtype=np.int8,
+    )
+    col_src = np.zeros(len(columns), np.int32)
+    int_cols, float_cols = [], []
+    for i, column in enumerate(columns):
+        column = np.asarray(column)
+        if len(column) != n:
+            # a silent mismatch would read out-of-bounds in C
+            raise ValueError(
+                f"column {i} has {len(column)} rows, index has {n}"
+            )
+        group = float_cols if is_float[i] else int_cols
+        col_src[i] = len(group)
+        group.append(column)
+    ints = np.ascontiguousarray(
+        np.column_stack(int_cols) if int_cols else np.zeros((n, 0)), np.int64
+    )
+    floats = np.ascontiguousarray(
+        np.column_stack(float_cols) if float_cols else np.zeros((n, 0)),
+        np.float64,
+    )
+    capacity = len(blob) + n * (33 * len(columns) + 1) + 64
+    out = ctypes.create_string_buffer(capacity)
+    written = lib.scx_format_csv_block(
+        blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        ints.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), ints.shape[1],
+        floats.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), floats.shape[1],
+        is_float.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        col_src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(columns), out, capacity,
+    )
+    if written < 0:
+        raise RuntimeError("csv block formatting overflowed its buffer")
+    # copy only the written prefix (.raw would materialize all of capacity)
+    return ctypes.string_at(out, written)
+
+
+def tagsort_stream_frames(
+    input_bam: str,
+    tag_keys,
+    batch_records: int = 1 << 20,
+    sort_batch_records: int = 500_000,
+    bam_output: Optional[str] = None,
+    bam_compress_level: int = 1,
+    scratch_prefix: Optional[str] = None,
+    n_threads: Optional[int] = None,
+    want_qname: bool = False,
+):
+    """Yield sorted ReadFrames streamed straight out of the tag-sort merge.
+
+    The fused one-pass path (the reference computes metrics DURING its
+    k-way merge, fastqpreprocessing/src/tagsort.cpp:185-196): a worker
+    thread runs the out-of-core sort and streams the merged records as
+    plain BAM through a pipe; the parallel column decoder reads the other
+    end. No sorted BAM is written, compressed, or re-read — unless
+    ``bam_output`` is given, in which case the same merge pass tees the
+    compressed sorted BAM to disk.
+
+    Raises RuntimeError on sort or decode failure; on early abandonment of
+    the generator the worker is unblocked by closing the pipe ends.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    keys = list(tag_keys)
+    if len(keys) != 3 or any(len(k) != 2 for k in keys):
+        raise RuntimeError("native tagsort requires exactly three 2-char tags")
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    if scratch_prefix is None:
+        # next to the teed output when there is one, else the temp dir —
+        # never beside the input (which may be on a read-only mount)
+        import tempfile
+
+        base = bam_output or os.path.join(
+            tempfile.gettempdir(), os.path.basename(input_bam)
+        )
+        scratch_prefix = base + ".tagsort_partial"
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_tagsort_pipe_open(
+        input_bam.encode(), keys[0].encode(), keys[1].encode(),
+        keys[2].encode(), sort_batch_records,
+        (bam_output or "").encode(), bam_compress_level,
+        scratch_prefix.encode(), errbuf, ctypes.sizeof(errbuf),
+    )
+    if not handle:
+        raise RuntimeError(
+            f"tagsort pipe open failed: {errbuf.value.decode(errors='replace')}"
+        )
+    stream = None
+    try:
+        read_fd = lib.scx_tagsort_pipe_fd(handle)
+        stream = lib.scx_stream_open(
+            f"/proc/self/fd/{read_fd}".encode(), n_threads,
+            1 if want_qname else 0, errbuf, ctypes.sizeof(errbuf),
+        )
+        if not stream:
+            raise RuntimeError(
+                "tagsort stream open failed: "
+                f"{errbuf.value.decode(errors='replace')}"
+            )
+        total = 0
+        while True:
+            n = lib.scx_stream_next(stream, batch_records)
+            if n < 0:
+                raise RuntimeError(
+                    "tagsort stream failed: "
+                    f"{lib.scx_stream_error(stream).decode(errors='replace')}"
+                )
+            if n == 0:
+                break
+            total += n
+            yield _frame_from_handle(lib, stream, want_qname)
+        # close OUR read descriptors before joining the worker, so a
+        # failed/blocked writer cannot deadlock the join
+        lib.scx_stream_close(stream)
+        stream = None
+        merged = lib.scx_tagsort_pipe_finish(handle)
+        if merged < 0:
+            raise RuntimeError(
+                "tagsort merge failed: "
+                f"{lib.scx_tagsort_pipe_error(handle).decode(errors='replace')}"
+            )
+        if merged != total:
+            raise RuntimeError(
+                f"tagsort stream truncated: decoded {total} of {merged} records"
+            )
+    finally:
+        if stream is not None:
+            lib.scx_stream_close(stream)
+        lib.scx_tagsort_pipe_free(handle)
+
+
+def fastq_metrics_native(
+    fastq_files,
+    cb_spans,
+    umi_spans,
+    min_length: int,
+    output_prefix: str,
+    n_threads: Optional[int] = None,
+) -> int:
+    """Native per-shard parallel fastq_metrics scan (scx_fqm).
+
+    Writes the reference's four output files with bytes identical to the
+    Python FastQMetrics oracle. Returns reads processed; raises
+    RuntimeError when the native layer is unavailable or a shard fails.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    cb_arr, n_cb = _spans_array(cb_spans)
+    umi_arr, n_umi = _spans_array(umi_spans)
+    errbuf = ctypes.create_string_buffer(512)
+    n = lib.scx_fqm(
+        "\n".join(fastq_files).encode(), cb_arr, n_cb, umi_arr, n_umi,
+        min_length, output_prefix.encode(), n_threads,
+        errbuf, ctypes.sizeof(errbuf),
+    )
+    if n == -2:  # validation failure: the Python oracle's ValueError
+        raise ValueError(errbuf.value.decode(errors="replace"))
+    if n < 0:
+        raise RuntimeError(
+            f"fastq metrics failed: {errbuf.value.decode(errors='replace')}"
+        )
+    return n
+
+
+def sample_fastq_native(
+    r1_files,
+    r2_files,
+    whitelist: str,
+    cb_spans,
+    umi_spans,
+    output_prefix: str,
+    batch_size: int = 1 << 16,
+):
+    """Native samplefastq: C++ IO loop + device whitelist correction.
+
+    Mirrors the reference pipeline (samplefastq.cpp:85-103) the way
+    fastqprocess does: batches of R1/R2 reads stream through native IO,
+    each batch's cell barcodes correct on the device kernel, and kept
+    reads re-emit with the fixed slide-seq R1 rewrite. Returns
+    (kept, total); output bytes are identical to the Python oracle.
+    """
+    from ..ops.whitelist import WhitelistCorrector
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    corrector = WhitelistCorrector.from_file(whitelist)
+    cb_arr, n_cb = _spans_array(cb_spans)
+    umi_arr, n_umi = _spans_array(umi_spans)
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_sfq_open(
+        "\n".join(r1_files).encode(), "\n".join(r2_files).encode(),
+        cb_arr, n_cb, umi_arr, n_umi, output_prefix.encode(),
+        errbuf, ctypes.sizeof(errbuf),
+    )
+    if not handle:
+        raise RuntimeError(
+            f"samplefastq open failed: {errbuf.value.decode(errors='replace')}"
+        )
+    kept = total = 0
+    failed = False
+    try:
+        cb_len = lib.scx_sfq_len(handle, b"cr")
+        if cb_len != corrector.barcode_length:
+            raise RuntimeError(
+                f"whitelist barcode length {corrector.barcode_length} does "
+                f"not match the cell barcode span length {cb_len}"
+            )
+        while True:
+            n = lib.scx_sfq_next(handle, batch_size)
+            if n == -2:  # strict-zip mismatch: the oracle's ValueError
+                raise ValueError(lib.scx_sfq_error(handle).decode())
+            if n < 0:
+                raise RuntimeError(
+                    f"samplefastq read failed: {lib.scx_sfq_error(handle).decode()}"
+                )
+            if n == 0:
+                break
+            total += n
+            raw = ctypes.string_at(lib.scx_sfq_buf(handle, b"cr"), n * cb_len)
+            # shared batch-correction helper: the keep mask is exactly its
+            # corrected-vs-None mask (attach/fastqprocess use the same one)
+            _, _, _, keep_mask = _correct_batch(corrector, raw, n, cb_len)
+            written = lib.scx_sfq_write(handle, n, keep_mask)
+            if written < 0:
+                raise RuntimeError(
+                    f"samplefastq write failed: {lib.scx_sfq_error(handle).decode()}"
+                )
+            kept += written
+        if lib.scx_sfq_close(handle) != 0:
+            raise RuntimeError("samplefastq close failed")
+        return kept, total
+    except BaseException:
+        failed = True
+        raise
+    finally:
+        lib.scx_sfq_free(handle)
+        if failed:
+            for suffix in (".R1", ".R2"):
+                try:
+                    os.remove(output_prefix + suffix)
+                except OSError:
+                    pass
+
+
+def _correct_batch(corrector, raw: bytes, n: int, cb_len: int):
+    """Run device whitelist correction over one fixed-width barcode buffer.
+
+    Returns (queries, corrected, cb_bytes, cb_mask): the decoded raw
+    barcodes, the per-row corrected values (None = uncorrectable), and the
+    fixed-width byte buffer + mask handed back to the native writer.
+    Shared by the attach and fastqprocess pipelines so the batch-correction
+    logic cannot drift between them.
+    """
+    queries = [
+        raw[i * cb_len:(i + 1) * cb_len].rstrip(b"\0").decode("ascii")
+        for i in range(n)
+    ]
+    corrected = corrector.correct(queries)
+    mask = bytearray(n)
+    fixed = bytearray(n * cb_len)
+    for i, value in enumerate(corrected):
+        if value is not None:
+            mask[i] = 1
+            fixed[i * cb_len:(i + 1) * cb_len] = value.encode("ascii")
+    return queries, corrected, bytes(fixed), (ctypes.c_uint8 * n).from_buffer(mask)
+
+
+# ----------------------------------------------------------- fastqprocess
+
+def _load_fqp(lib) -> None:
+    if getattr(lib, "_fqp_bound", False):
+        return
+    lib.scx_fqp_open.restype = ctypes.c_void_p
+    lib.scx_fqp_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.scx_fqp_next.restype = ctypes.c_long
+    lib.scx_fqp_next.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.scx_fqp_buf.restype = ctypes.POINTER(ctypes.c_char)
+    lib.scx_fqp_buf.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.scx_fqp_len.restype = ctypes.c_int
+    lib.scx_fqp_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.scx_fqp_write.restype = ctypes.c_long
+    lib.scx_fqp_write.argtypes = [
+        ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.scx_fqp_stats.restype = None
+    lib.scx_fqp_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_long)]
+    lib.scx_fqp_close.restype = ctypes.c_int
+    lib.scx_fqp_close.argtypes = [ctypes.c_void_p]
+    lib.scx_fqp_error.restype = ctypes.c_char_p
+    lib.scx_fqp_error.argtypes = [ctypes.c_void_p]
+    lib.scx_fqp_free.restype = None
+    lib.scx_fqp_free.argtypes = [ctypes.c_void_p]
+    lib._fqp_bound = True
+
+
+def fastqprocess_native(
+    r1_files,
+    r2_files,
+    output_prefix: str,
+    cb_spans,
+    umi_spans,
+    sample_spans=None,
+    i1_files=None,
+    whitelist: Optional[str] = None,
+    n_shards: int = 1,
+    output_format: str = "BAM",
+    sample_id: str = "",
+    batch_size: int = 1 << 16,
+    compress_level: int = 6,
+) -> dict:
+    """The fastqprocess scatter: FASTQ triplets -> disjoint-barcode shards.
+
+    Native IO with device whitelist correction per batch (the reference
+    fastqprocess pipeline, fastq_common.cpp:362-414). Returns the
+    correction counter dict and prints the summary line the reference
+    prints at reader exit (fastq_common.cpp:356-359).
+    """
+    import sys as _sys
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    _load_fqp(lib)
+
+    corrector = None
+    if whitelist is not None:
+        from ..ops.whitelist import WhitelistCorrector
+
+        corrector = WhitelistCorrector.from_file(whitelist)
+
+    fmt = {"BAM": 0, "FASTQ": 1}.get(output_format.upper())
+    if fmt is None:
+        raise ValueError("output_format must be BAM or FASTQ")
+    cb_arr, n_cb = _spans_array(cb_spans)
+    umi_arr, n_umi = _spans_array(umi_spans)
+    sample_arr, n_sample = _spans_array(sample_spans)
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_fqp_open(
+        "\n".join(r1_files).encode(),
+        "\n".join(i1_files or []).encode(),
+        "\n".join(r2_files).encode(),
+        output_prefix.encode(), n_shards, fmt, sample_id.encode(),
+        cb_arr, n_cb, umi_arr, n_umi, sample_arr, n_sample,
+        compress_level, errbuf, ctypes.sizeof(errbuf),
+    )
+    if not handle:
+        raise RuntimeError(
+            f"fastqprocess open failed: {errbuf.value.decode(errors='replace')}"
+        )
+    failed = False
+    try:
+        cb_len = lib.scx_fqp_len(handle, b"cb")
+        if corrector is not None and cb_len != corrector.barcode_length:
+            raise RuntimeError(
+                f"whitelist barcode length {corrector.barcode_length} does "
+                f"not match the cell barcode span length {cb_len}"
+            )
+        while True:
+            n = lib.scx_fqp_next(handle, batch_size)
+            if n < 0:
+                raise RuntimeError(
+                    f"fastqprocess read failed: {lib.scx_fqp_error(handle).decode()}"
+                )
+            if n == 0:
+                break
+            cb_bytes = None
+            cb_mask = None
+            if corrector is not None and cb_len > 0:
+                raw = ctypes.string_at(lib.scx_fqp_buf(handle, b"cr"), n * cb_len)
+                _, _, cb_bytes, cb_mask = _correct_batch(
+                    corrector, raw, n, cb_len
+                )
+            written = lib.scx_fqp_write(handle, n, cb_bytes, cb_mask)
+            if written < 0:
+                raise RuntimeError(
+                    f"fastqprocess write failed: {lib.scx_fqp_error(handle).decode()}"
+                )
+        if lib.scx_fqp_close(handle) != 0:
+            raise RuntimeError("fastqprocess close failed")
+        stats_arr = (ctypes.c_long * 4)()
+        lib.scx_fqp_stats(handle, stats_arr)
+        stats = {
+            "total_reads": stats_arr[0],
+            "correct": stats_arr[1],
+            "corrected": stats_arr[2],
+            "uncorrectable": stats_arr[3],
+        }
+        if corrector is not None and stats["total_reads"]:
+            # the reference's reader-exit summary (fastq_common.cpp:356-359)
+            pct = stats["uncorrectable"] / stats["total_reads"] * 100.0
+            print(
+                f"Total barcodes:{stats['total_reads']}\n"
+                f" correct:{stats['correct']}\n"
+                f"corrected:{stats['corrected']}\n"
+                f"uncorrectible:{stats['uncorrectable']}\n"
+                f"uncorrected:{pct:f}",
+                file=_sys.stderr,
+            )
+        return stats
+    except BaseException:
+        failed = True
+        raise
+    finally:
+        lib.scx_fqp_free(handle)
+        if failed:
+            # never leave partial shard outputs that could read as complete;
+            # delete exactly the files this run creates (a glob could take
+            # unrelated files sharing the prefix with it)
+            if fmt == 1:
+                paths = [
+                    f"{output_prefix}_{r}_{i}.fastq.gz"
+                    for i in range(n_shards)
+                    for r in ("R1", "R2")
+                ]
+            else:
+                paths = [f"{output_prefix}_{i}.bam" for i in range(n_shards)]
+            for path in paths:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+
+# ---------------------------------------------------------------- attach
+
+def _load_attach(lib) -> None:
+    if getattr(lib, "_attach_bound", False):
+        return
+    lib.scx_attach_open.restype = ctypes.c_void_p
+    lib.scx_attach_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.scx_attach_next.restype = ctypes.c_long
+    lib.scx_attach_next.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.scx_attach_buf.restype = ctypes.POINTER(ctypes.c_char)
+    lib.scx_attach_buf.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.scx_attach_len.restype = ctypes.c_int
+    lib.scx_attach_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.scx_attach_write.restype = ctypes.c_long
+    lib.scx_attach_write.argtypes = [
+        ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.scx_attach_close.restype = ctypes.c_int
+    lib.scx_attach_close.argtypes = [ctypes.c_void_p]
+    lib.scx_attach_error.restype = ctypes.c_char_p
+    lib.scx_attach_error.argtypes = [ctypes.c_void_p]
+    lib.scx_attach_free.restype = None
+    lib.scx_attach_free.argtypes = [ctypes.c_void_p]
+    lib._attach_bound = True
+
+
+def _spans_array(spans):
+    flat = []
+    for start, end in spans or []:
+        flat.extend([start, end])
+    arr = (ctypes.c_int32 * len(flat))(*flat)
+    return arr, len(flat) // 2
+
+
+def attach_barcodes_native(
+    r1: str,
+    u2: str,
+    output_bam: str,
+    cb_spans,
+    umi_spans,
+    sample_spans=None,
+    i1: Optional[str] = None,
+    whitelist: Optional[str] = None,
+    batch_size: int = 1 << 16,
+) -> int:
+    """Attach barcode tags to a BAM with native IO + device correction.
+
+    The fastqprocess-equivalent pipeline: native fastq/BAM streaming and
+    BGZF writing, with whitelist correction per batch on the device kernel
+    (sctools_tpu.ops.whitelist). Spans are [start, end) slices of r1 (i1 for
+    sample); split barcodes pass several spans. Returns records written.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    _load_attach(lib)
+
+    corrector = None
+    if whitelist is not None:
+        from ..ops.whitelist import WhitelistCorrector
+
+        corrector = WhitelistCorrector.from_file(whitelist)
+
+    cb_arr, n_cb = _spans_array(cb_spans)
+    umi_arr, n_umi = _spans_array(umi_spans)
+    sample_arr, n_sample = _spans_array(sample_spans)
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.scx_attach_open(
+        r1.encode(), (i1 or "").encode(), u2.encode(), output_bam.encode(),
+        cb_arr, n_cb, umi_arr, n_umi, sample_arr, n_sample,
+        errbuf, ctypes.sizeof(errbuf),
+    )
+    if not handle:
+        raise RuntimeError(
+            f"attach open failed: {errbuf.value.decode(errors='replace')}"
+        )
+    total_written = 0
+    n_correct = n_corrected = n_uncorrectable = 0
+    next_progress = 10_000_000  # the reference's cadence (fastq_common.cpp:340)
+    failed = False
+    try:
+        cb_len = lib.scx_attach_len(handle, b"cb")
+        if corrector is not None and cb_len != corrector.barcode_length:
+            raise RuntimeError(
+                f"whitelist barcode length {corrector.barcode_length} does "
+                f"not match the cell barcode span length {cb_len}"
+            )
+        while True:
+            n = lib.scx_attach_next(handle, batch_size)
+            if n < 0:
+                raise RuntimeError(
+                    f"attach read failed: {lib.scx_attach_error(handle).decode()}"
+                )
+            if n == 0:
+                break
+            cb_bytes = None
+            cb_mask = None
+            queries = corrected = None
+            if corrector is not None and cb_len > 0:
+                raw = ctypes.string_at(
+                    lib.scx_attach_buf(handle, b"cr"), n * cb_len
+                )
+                queries, corrected, cb_bytes, cb_mask = _correct_batch(
+                    corrector, raw, n, cb_len
+                )
+            written = lib.scx_attach_write(handle, n, cb_bytes, cb_mask)
+            if written < 0:
+                raise RuntimeError(
+                    f"attach write failed: {lib.scx_attach_error(handle).decode()}"
+                )
+            if corrected is not None:
+                # count only the records actually written: the final batch
+                # can truncate when u2 runs out before the fastq (zip
+                # semantics), and the summary must stay consistent with
+                # Total barcodes
+                for value, query in zip(corrected[:written], queries[:written]):
+                    if value is None:
+                        n_uncorrectable += 1
+                    elif value == query:
+                        n_correct += 1
+                    else:
+                        n_corrected += 1
+            total_written += written
+            if total_written >= next_progress:
+                import sys as _sys
+
+                print(
+                    f"[attach] {total_written} reads processed",
+                    file=_sys.stderr,
+                )
+                next_progress += 10_000_000
+            if written < n:
+                break  # u2 exhausted before the fastq (zip semantics)
+        if lib.scx_attach_close(handle) != 0:
+            raise RuntimeError("attach close failed")
+        if corrector is not None and total_written:
+            # the reference's reader-exit summary (fastq_common.cpp:356-359)
+            import sys as _sys
+
+            pct = n_uncorrectable / total_written * 100.0
+            print(
+                f"Total barcodes:{total_written}\n correct:{n_correct}\n"
+                f"corrected:{n_corrected}\nuncorrectible:{n_uncorrectable}\n"
+                f"uncorrected:{pct:f}",
+                file=_sys.stderr,
+            )
+    except BaseException:
+        failed = True
+        raise
+    finally:
+        lib.scx_attach_free(handle)
+        if failed:
+            # never leave a partial output that could read as complete
+            try:
+                os.remove(output_bam)
+            except OSError:
+                pass
+    return total_written

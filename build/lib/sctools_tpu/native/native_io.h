@@ -1,0 +1,444 @@
+// Shared native IO building blocks: streaming inflate, buffered byte/line
+// access, and BGZF block writing. Used by the attach pipeline (attach.cpp),
+// the synthetic workload writer (synth.cpp), and future native writers.
+//
+// BGZF framing matches the spec: <=64KB payloads, BC extra field, CRC32,
+// trailing EOF block (the container format of the reference's BAM IO, which
+// it gets from htslib; ours is self-contained over zlib).
+
+#ifndef SCTOOLS_NATIVE_IO_H_
+#define SCTOOLS_NATIVE_IO_H_
+
+#include <libdeflate.h>
+#include <zlib.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace scx {
+
+constexpr size_t kBgzfMaxPayload = 0xff00;  // htslib's conventional max
+
+// generic zlib pull-reader over a file (gzip/BGZF via window bits 15+32,
+// concatenated members handled by inflateReset)
+class InflateReader {
+ public:
+  bool open(const char* path) {
+    file_ = std::fopen(path, "rb");
+    if (!file_) return false;
+    std::memset(&strm_, 0, sizeof(strm_));
+    plain_probe();
+    if (!plain_) {
+      if (inflateInit2(&strm_, 15 + 32) != Z_OK) return false;
+      inited_ = true;
+    }
+    return true;
+  }
+
+  // fill out with up to len bytes; returns bytes produced (0 = EOF)
+  size_t read(uint8_t* out, size_t len) {
+    if (plain_) return std::fread(out, 1, len, file_);
+    size_t produced = 0;
+    while (produced < len) {
+      if (strm_.avail_in == 0 && !feed()) break;
+      strm_.next_out = out + produced;
+      strm_.avail_out = static_cast<uInt>(len - produced);
+      int ret = inflate(&strm_, Z_NO_FLUSH);
+      produced = len - strm_.avail_out;
+      if (ret == Z_STREAM_END) {
+        // possibly another concatenated gzip member (BGZF is many members)
+        if (strm_.avail_in == 0 && !feed()) break;
+        if (inflateReset(&strm_) != Z_OK) break;
+      } else if (ret != Z_OK && ret != Z_BUF_ERROR) {
+        error_ = true;
+        break;
+      } else if (ret == Z_BUF_ERROR && strm_.avail_in == 0 && !feed()) {
+        break;
+      }
+    }
+    return produced;
+  }
+
+  bool failed() const { return error_; }
+
+  ~InflateReader() {
+    if (file_) std::fclose(file_);
+    // only after a successful inflateInit2: this reader is a member of
+    // BgzfInflateReader and may never have been opened at all (BGZF/plain
+    // inputs) — inflateEnd on an uninitialized z_stream reads garbage
+    if (inited_) inflateEnd(&strm_);
+  }
+
+ private:
+  void plain_probe() {
+    int c0 = std::fgetc(file_);
+    int c1 = std::fgetc(file_);
+    std::rewind(file_);
+    plain_ = !(c0 == 0x1f && c1 == 0x8b);
+  }
+
+  bool feed() {
+    size_t n = std::fread(inbuf_, 1, sizeof(inbuf_), file_);
+    strm_.next_in = inbuf_;
+    strm_.avail_in = static_cast<uInt>(n);
+    return n > 0;
+  }
+
+  FILE* file_ = nullptr;
+  z_stream strm_;
+  uint8_t inbuf_[1 << 16];
+  bool plain_ = false;
+  bool error_ = false;
+  bool inited_ = false;
+};
+
+// BGZF-aware reader: libdeflate per block (~3-4x zlib), falling back to
+// the generic zlib path for non-BGZF gzip and raw passthrough for plain
+// files. Sequential single-threaded; the parallel batch decoder in
+// bamdecode.cpp remains the multi-core path.
+class BgzfInflateReader {
+ public:
+  bool open(const char* path) {
+    file_ = std::fopen(path, "rb");
+    if (!file_) return false;
+    uint8_t head[18];
+    size_t n = std::fread(head, 1, sizeof(head), file_);
+    std::rewind(file_);
+    if (n >= 2 && head[0] == 0x1f && head[1] == 0x8b) {
+      bool bgzf = n >= 18 && (head[3] & 4) && head[12] == 'B' &&
+                  head[13] == 'C';
+      if (!bgzf) {
+        std::fclose(file_);
+        file_ = nullptr;
+        mode_ = kGzip;
+        return zlib_.open(path);
+      }
+      mode_ = kBgzf;
+      dec_ = libdeflate_alloc_decompressor();
+      return dec_ != nullptr;
+    }
+    mode_ = kPlain;
+    return true;
+  }
+
+  size_t read(uint8_t* out, size_t len) {
+    if (mode_ == kGzip) return zlib_.read(out, len);
+    if (mode_ == kPlain) return std::fread(out, 1, len, file_);
+    size_t produced = 0;
+    while (produced < len) {
+      if (out_pos_ < out_buf_.size()) {
+        size_t take = std::min(len - produced, out_buf_.size() - out_pos_);
+        std::memcpy(out + produced, out_buf_.data() + out_pos_, take);
+        out_pos_ += take;
+        produced += take;
+        continue;
+      }
+      if (!next_block()) break;
+    }
+    return produced;
+  }
+
+  bool failed() const { return mode_ == kGzip ? zlib_.failed() : error_; }
+
+  ~BgzfInflateReader() {
+    if (file_) std::fclose(file_);
+    if (dec_) libdeflate_free_decompressor(dec_);
+  }
+
+ private:
+  bool next_block() {
+    for (;;) {
+      uint8_t hdr[12];
+      size_t n = std::fread(hdr, 1, sizeof(hdr), file_);
+      if (n == 0) return false;
+      if (n != sizeof(hdr) || hdr[0] != 0x1f || hdr[1] != 0x8b) {
+        error_ = true;
+        return false;
+      }
+      uint16_t xlen = hdr[10] | (hdr[11] << 8);
+      extra_.resize(xlen);
+      if (xlen && std::fread(extra_.data(), 1, xlen, file_) != xlen) {
+        error_ = true;
+        return false;
+      }
+      uint32_t bsize = 0;
+      for (size_t p = 0; p + 4 <= extra_.size();) {
+        uint16_t slen = extra_[p + 2] | (extra_[p + 3] << 8);
+        if (extra_[p] == 'B' && extra_[p + 1] == 'C' && slen == 2 &&
+            p + 6 <= extra_.size())
+          bsize = (extra_[p + 4] | (extra_[p + 5] << 8)) + 1u;
+        p += 4 + slen;
+      }
+      if (bsize < 12u + xlen + 8u) {
+        error_ = true;
+        return false;
+      }
+      size_t payload = bsize - 12 - xlen - 8;
+      comp_.resize(payload + 8);
+      if (std::fread(comp_.data(), 1, payload + 8, file_) != payload + 8) {
+        error_ = true;
+        return false;
+      }
+      uint32_t isize = comp_[payload + 4] | (comp_[payload + 5] << 8) |
+                       (comp_[payload + 6] << 16) |
+                       (uint32_t(comp_[payload + 7]) << 24);
+      if (isize == 0) continue;  // EOF marker (or empty) block: keep going
+      out_buf_.resize(isize);
+      out_pos_ = 0;
+      size_t actual = 0;
+      if (libdeflate_deflate_decompress(dec_, comp_.data(), payload,
+                                        out_buf_.data(), isize, &actual) !=
+              LIBDEFLATE_SUCCESS ||
+          actual != isize) {
+        error_ = true;
+        return false;
+      }
+      return true;
+    }
+  }
+
+  enum Mode { kBgzf, kGzip, kPlain };
+  Mode mode_ = kBgzf;
+  FILE* file_ = nullptr;
+  libdeflate_decompressor* dec_ = nullptr;
+  InflateReader zlib_;
+  std::vector<uint8_t> extra_, comp_, out_buf_;
+  size_t out_pos_ = 0;
+  bool error_ = false;
+};
+
+// buffered line/record access on top of a pull reader
+template <class Reader>
+class BasicByteStream {
+ public:
+  bool open(const char* path) { return reader_.open(path); }
+
+  // read exactly n bytes into out; false at EOF/short
+  bool read_exact(uint8_t* out, size_t n) {
+    while (buffer_.size() - offset_ < n) {
+      if (!refill()) return false;
+    }
+    std::memcpy(out, buffer_.data() + offset_, n);
+    offset_ += n;
+    compact();
+    return true;
+  }
+
+  // next '\n'-terminated line (newline stripped); false at EOF
+  bool read_line(std::string& line) {
+    for (;;) {
+      const uint8_t* base = buffer_.data() + offset_;
+      size_t avail = buffer_.size() - offset_;
+      const void* nl = std::memchr(base, '\n', avail);
+      if (nl) {
+        size_t len = static_cast<const uint8_t*>(nl) - base;
+        line.assign(reinterpret_cast<const char*>(base), len);
+        offset_ += len + 1;
+        compact();
+        return true;
+      }
+      if (!refill()) {
+        if (avail == 0) return false;
+        line.assign(reinterpret_cast<const char*>(base), avail);
+        offset_ += avail;
+        return true;
+      }
+    }
+  }
+
+  bool failed() const { return reader_.failed(); }
+
+ private:
+  bool refill() {
+    uint8_t chunk[1 << 16];
+    size_t n = reader_.read(chunk, sizeof(chunk));
+    if (n == 0) return false;
+    buffer_.insert(buffer_.end(), chunk, chunk + n);
+    return true;
+  }
+
+  void compact() {
+    if (offset_ > (1 << 20)) {
+      buffer_.erase(buffer_.begin(), buffer_.begin() + offset_);
+      offset_ = 0;
+    }
+  }
+
+  Reader reader_;
+  std::vector<uint8_t> buffer_;
+  size_t offset_ = 0;
+};
+
+using ByteStream = BasicByteStream<InflateReader>;
+using BgzfByteStream = BasicByteStream<BgzfInflateReader>;
+
+class BgzfWriter {
+ public:
+  // level 6 matches the reference's output sizing; level 1 is ~3x faster
+  // for scratch/synthetic outputs
+  bool open(const char* path, int level = 6) {
+    file_ = std::fopen(path, "wb");
+    level_ = level;
+    return file_ != nullptr;
+  }
+
+  void write(const uint8_t* data, size_t len) {
+    while (len > 0) {
+      size_t take = std::min(len, kBgzfMaxPayload - pending_.size());
+      pending_.insert(pending_.end(), data, data + take);
+      data += take;
+      len -= take;
+      if (pending_.size() >= kBgzfMaxPayload) flush_block();
+    }
+  }
+
+  bool close() {
+    if (!file_) return true;
+    if (!pending_.empty()) flush_block();
+    // spec EOF marker block
+    static const uint8_t kEof[28] = {
+        0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff, 0x06, 0x00, 0x42,
+        0x43, 0x02, 0x00, 0x1b, 0x00, 0x03, 0, 0, 0, 0, 0, 0, 0, 0, 0};
+    std::fwrite(kEof, 1, sizeof(kEof), file_);
+    int rc = std::fclose(file_);
+    file_ = nullptr;
+    return rc == 0 && !error_;
+  }
+
+  // close WITHOUT flushing pending data or writing the EOF marker: the
+  // error path. A partial output must not end in a valid EOF block, or it
+  // would read as a complete (silently truncated) BAM downstream.
+  void abort_close() {
+    if (!file_) return;
+    std::fclose(file_);
+    file_ = nullptr;
+    pending_.clear();
+  }
+
+  bool failed() const { return error_; }
+
+  ~BgzfWriter() {
+    close();
+    if (compressor_) libdeflate_free_compressor(compressor_);
+  }
+
+ private:
+  void flush_block() {
+    // libdeflate: ~3-4x zlib's deflate throughput at equal levels; level 0
+    // emits stored blocks (near-memcpy), used for scratch partials
+    uint8_t compressed[kBgzfMaxPayload + 1024];
+    if (!compressor_) compressor_ = libdeflate_alloc_compressor(level_);
+    if (!compressor_) {
+      error_ = true;
+      pending_.clear();
+      return;
+    }
+    size_t clen = libdeflate_deflate_compress(
+        compressor_, pending_.data(), pending_.size(), compressed,
+        sizeof(compressed));
+    if (clen == 0) {
+      error_ = true;
+      pending_.clear();
+      return;
+    }
+    uint32_t crc = libdeflate_crc32(0, pending_.data(), pending_.size());
+    uint32_t isize = static_cast<uint32_t>(pending_.size());
+    uint16_t bsize = static_cast<uint16_t>(clen + 25);  // total block - 1
+
+    uint8_t header[18] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff,
+                          0x06, 0x00, 0x42, 0x43, 0x02, 0x00,
+                          static_cast<uint8_t>(bsize & 0xff),
+                          static_cast<uint8_t>(bsize >> 8)};
+    uint8_t footer[8] = {
+        static_cast<uint8_t>(crc & 0xff), static_cast<uint8_t>(crc >> 8),
+        static_cast<uint8_t>(crc >> 16), static_cast<uint8_t>(crc >> 24),
+        static_cast<uint8_t>(isize & 0xff), static_cast<uint8_t>(isize >> 8),
+        static_cast<uint8_t>(isize >> 16), static_cast<uint8_t>(isize >> 24)};
+    if (std::fwrite(header, 1, 18, file_) != 18 ||
+        std::fwrite(compressed, 1, clen, file_) != clen ||
+        std::fwrite(footer, 1, 8, file_) != 8)
+      error_ = true;
+    pending_.clear();
+  }
+
+  FILE* file_ = nullptr;
+  std::vector<uint8_t> pending_;
+  bool error_ = false;
+  int level_ = 6;
+  libdeflate_compressor* compressor_ = nullptr;
+};
+
+// ---------------------------------------------------------- shared helpers
+// (used by attach.cpp, fastqprocess.cpp, synth.cpp — one definition so a
+// fix in one pipeline cannot silently miss the others)
+
+struct Span {
+  int32_t start, end;
+};
+
+inline std::string extract_spans(const std::string& read,
+                                 const std::vector<Span>& spans) {
+  std::string out;
+  for (const Span& span : spans) {
+    int32_t lo = std::min<int32_t>(span.start, read.size());
+    int32_t hi = std::min<int32_t>(span.end, read.size());
+    if (hi > lo) out.append(read, lo, hi - lo);
+  }
+  return out;
+}
+
+inline int span_len(const std::vector<Span>& spans) {
+  int total = 0;
+  for (const Span& s : spans) total += s.end - s.start;
+  return total;
+}
+
+inline void fill_fixed(std::vector<char>& buffer, long index, int width,
+                       const std::string& value) {
+  std::memset(buffer.data() + index * width, 0, width);
+  std::memcpy(buffer.data() + index * width, value.data(),
+              std::min<size_t>(width, value.size()));
+}
+
+inline void append_z_tag(std::vector<uint8_t>& rec, const char* tag,
+                         const char* value, size_t len) {
+  rec.push_back(tag[0]);
+  rec.push_back(tag[1]);
+  rec.push_back('Z');
+  rec.insert(rec.end(), value, value + len);
+  rec.push_back('\0');
+}
+
+inline void put_u32(std::vector<uint8_t>& out, uint32_t v) {
+  out.push_back(v & 0xff);
+  out.push_back((v >> 8) & 0xff);
+  out.push_back((v >> 16) & 0xff);
+  out.push_back((v >> 24) & 0xff);
+}
+
+struct FastqRecord {
+  std::string name, seq, qual;
+};
+
+// one 4-line record; name stripped of '@' and anything after a space
+template <class Stream>
+bool next_fastq(Stream& stream, FastqRecord& rec) {
+  std::string plus, name_line;
+  if (!stream.read_line(name_line)) return false;
+  if (!stream.read_line(rec.seq)) return false;
+  if (!stream.read_line(plus)) return false;
+  if (!stream.read_line(rec.qual)) return false;
+  size_t start = name_line.empty() ? 0 : (name_line[0] == '@' ? 1 : 0);
+  size_t space = name_line.find(' ', start);
+  rec.name = name_line.substr(
+      start, space == std::string::npos ? std::string::npos : space - start);
+  return true;
+}
+
+}  // namespace scx
+
+#endif  // SCTOOLS_NATIVE_IO_H_

@@ -1,0 +1,12 @@
+"""Device-side primitives (JAX/XLA/Pallas).
+
+Everything in this package is jit-compatible with static shapes: packed-key
+construction, lexicographic device sort, run/segment detection, segment
+reductions, two-pass moment statistics, and the whitelist-correction kernel.
+These are the TPU-native replacements for the reference's Python Counters and
+hash maps (SURVEY.md section 7 design stance).
+"""
+
+from . import segments  # noqa: F401
+
+__all__ = ["segments", "correction", "encodings"]

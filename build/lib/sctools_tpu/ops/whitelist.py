@@ -1,0 +1,218 @@
+"""Device whitelist correction: hamming<=1 barcode matching on the MXU.
+
+The reference corrects barcodes through a precomputed hash map holding every
+whitelist barcode plus all its single-base substitutions over ACGTN — about
+5*L*|whitelist| entries (src/sctools/barcode.py:310-335; C++ twin
+fastqpreprocessing/src/utilities.cpp:14-53). The TPU reformulation needs no
+table at all: one-hot encode barcodes as [L, 4] indicators and
+
+    matching_positions(q, w) = dot(onehot(q), onehot(w))
+
+so "hamming distance <= 1" is ``score >= L - 1``. That turns correction into
+a [n_queries, 4L] x [4L, n_whitelist] matmul — exactly the shape the MXU
+systolic array wants — followed by a thresholded argmax.
+
+Semantics match the reference Python map exactly:
+- an N in the query zeroes that position's one-hot row, so it can never
+  match: a query with one N matches barcodes equal everywhere else (N was a
+  substitution letter, barcode.py:330-334); two or more Ns never match;
+- among several whitelist barcodes within distance 1, the LAST one in file
+  order wins — the dict is built in order and later inserts overwrite
+  earlier ones — realized here as a max over hit indices.
+
+Two implementations: a pure jnp path (runs anywhere, used as oracle and CPU
+fallback) and a Pallas TPU kernel that tiles the scores matmul through VMEM
+and keeps a running best-index accumulator so the [n_queries, n_whitelist]
+score matrix never materializes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BASE_TO_COL = {"A": 0, "C": 1, "G": 2, "T": 3}
+# byte value -> one-hot column (A=0 C=1 G=2 T=3); 4 = no column. Uppercase
+# ACGT only: the reference's mutation map is case-sensitive (barcode.py:
+# 310-335 enumerates uppercase substitutions), so a soft-masked 'acgt' base
+# must behave like N (zero row, cannot match), not like its uppercase base.
+_COL_LUT = np.full(256, 4, dtype=np.uint8)
+for _base, _col in _BASE_TO_COL.items():
+    _COL_LUT[ord(_base)] = _col
+
+
+def onehot_barcodes(barcodes: Sequence[str], length: int) -> np.ndarray:
+    """[n, length*4] float32 one-hot; N (or any non-ACGT) rows are all zero.
+
+    Vectorized: barcodes are truncated/padded to ``length`` bytes, mapped
+    through a byte LUT, and scattered with fancy indexing — no per-base
+    Python loop on the correction hot path.
+    """
+    n = len(barcodes)
+    out = np.zeros((n, length, 5), dtype=np.float32)
+    if n == 0:
+        return out[:, :, :4].reshape(n, length * 4)
+    fixed = [b[:length].ljust(length, "\0") for b in barcodes]
+    flat = np.frombuffer("".join(fixed).encode("latin-1"), dtype=np.uint8)
+    cols = _COL_LUT[flat].reshape(n, length)
+    rows = np.repeat(np.arange(n), length)
+    positions = np.tile(np.arange(length), n)
+    out[rows, positions, cols.reshape(-1)] = 1.0
+    # column 4 collected the N/other hits; drop it
+    return out[:, :, :4].reshape(n, length * 4)
+
+
+@functools.partial(jax.jit, static_argnames=("length",))
+def _correct_jnp(queries_onehot, whitelist_onehot, length: int):
+    scores = jnp.dot(
+        queries_onehot, whitelist_onehot.T, preferred_element_type=jnp.float32
+    )
+    hits = scores >= (length - 1)
+    index = jnp.arange(whitelist_onehot.shape[0], dtype=jnp.int32)
+    best = jnp.max(jnp.where(hits, index[None, :], -1), axis=1)
+    return best
+
+
+def _pallas_kernel(q_ref, w_ref, out_ref, *, length: int, tile_w: int):
+    """Grid = (n_query_tiles, n_whitelist_tiles).
+
+    Accumulates, per query row, the largest whitelist index whose score
+    crosses the threshold. Whitelist tiles are visited in ascending index
+    order (the innermost grid dimension), so a running elementwise max
+    realizes last-writer-wins.
+    """
+    from jax.experimental import pallas as pl  # deferred: TPU-only path
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.full_like(out_ref, -1)
+
+    scores = jnp.dot(q_ref[:], w_ref[:].T, preferred_element_type=jnp.float32)
+    base = j * tile_w
+    col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, dimension=1)
+    hit_index = jnp.where(scores >= (length - 1), base + col, -1)
+    out_ref[:] = jnp.maximum(out_ref[:], jnp.max(hit_index, axis=1, keepdims=True))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("length", "tile_q", "tile_w", "interpret")
+)
+def _correct_pallas(
+    queries_onehot,
+    whitelist_onehot,
+    length: int,
+    tile_q: int = 256,
+    tile_w: int = 2048,
+    interpret: bool = False,
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_q, feat = queries_onehot.shape
+    n_w = whitelist_onehot.shape[0]
+    grid = (pl.cdiv(n_q, tile_q), pl.cdiv(n_w, tile_w))
+
+    out = pl.pallas_call(
+        functools.partial(_pallas_kernel, length=length, tile_w=tile_w),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_q, feat), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_w, feat), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_q, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_q, 1), jnp.int32),
+        interpret=interpret,
+    )(queries_onehot, whitelist_onehot)
+    return out[:, 0]
+
+
+def _pad_rows(array: np.ndarray, multiple: int) -> np.ndarray:
+    n = array.shape[0]
+    padded = ((n + multiple - 1) // multiple) * multiple
+    if padded == n:
+        return array
+    out = np.zeros((padded, array.shape[1]), dtype=array.dtype)
+    out[:n] = array
+    return out
+
+
+class WhitelistCorrector:
+    """Batch barcode corrector backed by the device matmul kernel.
+
+    The drop-in replacement for the reference's ErrorsToCorrectBarcodesMap on
+    batch workloads: build once from the whitelist, then ``correct`` maps raw
+    barcode strings to whitelisted ones (None where nothing is within
+    hamming distance 1).
+    """
+
+    def __init__(
+        self,
+        whitelist: Sequence[str],
+        use_pallas: Optional[bool] = None,
+        interpret: bool = False,
+    ):
+        whitelist = list(whitelist)
+        if not whitelist:
+            raise ValueError("whitelist must not be empty")
+        self._length = len(whitelist[0])
+        if any(len(b) != self._length for b in whitelist):
+            raise ValueError("whitelist barcodes must share one length")
+        self._whitelist = whitelist
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
+        if self._length < 2:
+            # the Pallas path pads the whitelist with zero rows, which score
+            # 0 — below the L-1 threshold only when L >= 2. For L == 1 every
+            # pair is trivially within hamming distance 1 anyway; the
+            # unpadded jnp path computes that correctly.
+            use_pallas = False
+        self._use_pallas = use_pallas
+        self._interpret = interpret
+        # padded once: the whitelist matrix is invariant across batches, and
+        # zero-padded rows score 0 (< L-1) so they can never hit
+        w_onehot = onehot_barcodes(whitelist, self._length)
+        self._w_onehot = jax.device_put(
+            _pad_rows(w_onehot, 2048) if use_pallas else w_onehot
+        )
+
+    @classmethod
+    def from_file(cls, whitelist_file: str, **kwargs) -> "WhitelistCorrector":
+        with open(whitelist_file) as fileobj:
+            return cls([line.strip() for line in fileobj if line.strip()], **kwargs)
+
+    @property
+    def barcode_length(self) -> int:
+        return self._length
+
+    def correct_indices(self, barcodes: Sequence[str]) -> np.ndarray:
+        """int32 whitelist index per query (-1 = uncorrectable)."""
+        if len(barcodes) == 0:
+            return np.zeros(0, dtype=np.int32)
+        # queries are padded to one compiled batch shape; padded rows are
+        # sliced off, so every batch size reuses a single executable
+        q = _pad_rows(onehot_barcodes(barcodes, self._length), 256)
+        if self._use_pallas:
+            result = _correct_pallas(
+                q, self._w_onehot, self._length, interpret=self._interpret
+            )[: len(barcodes)]
+        else:
+            result = _correct_jnp(q, self._w_onehot, self._length)[: len(barcodes)]
+        result = np.asarray(result)
+        # the reference hash map has no keys of other lengths: a query whose
+        # length differs can never correct (a one-short query would otherwise
+        # pass the >= L-1 threshold via truncation)
+        lengths = np.asarray([len(b) for b in barcodes])
+        return np.where(lengths == self._length, result, -1).astype(np.int32)
+
+    def correct(self, barcodes: Sequence[str]) -> List[Optional[str]]:
+        """Corrected barcode per query, None where uncorrectable."""
+        indices = self.correct_indices(barcodes)
+        return [self._whitelist[i] if i >= 0 else None for i in indices]

@@ -1,0 +1,36 @@
+"""Device-mesh parallelism: sharding, collectives, and distributed pipelines.
+
+The reference's only distributed axis is file-level scatter-gather over cell
+barcodes (SplitBam -> per-chunk Calculate -> Merge, src/sctools/bam.py:361-488,
+src/sctools/metrics/merge.py) orchestrated by an external WDL pipeline. Here the
+same invariant — an entity (cell or gene) never spans shards — is realized on a
+``jax.sharding.Mesh``: records are partitioned by entity-code hash, per-shard
+metric passes run under ``shard_map``, and re-keying between entity axes is an
+``all_to_all`` collective over ICI instead of a new pass over files.
+"""
+
+from .mesh import make_hybrid_mesh, make_mesh
+from .shard import partition_columns, shard_assignment
+from .count import sharded_count_molecules
+from .metrics import (
+    collect_sharded_rows,
+    distributed_metrics_step,
+    hybrid_metrics_step,
+    required_reshard_capacity,
+    reshard_by_key,
+    sharded_entity_metrics,
+)
+
+__all__ = [
+    "make_mesh",
+    "make_hybrid_mesh",
+    "hybrid_metrics_step",
+    "partition_columns",
+    "shard_assignment",
+    "sharded_count_molecules",
+    "sharded_entity_metrics",
+    "reshard_by_key",
+    "distributed_metrics_step",
+    "collect_sharded_rows",
+    "required_reshard_capacity",
+]

@@ -1,0 +1,70 @@
+"""Mesh construction helpers.
+
+One logical axis (``shard``) is enough for this framework's domain: the record
+space is partitioned by entity hash, and every collective (all_to_all rekey,
+all_gather of disjoint per-entity rows, psum of per-gene partials) rides that
+axis. On real hardware the axis should span ICI; across slices XLA routes the
+same collectives over DCN without code changes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+DEFAULT_AXIS = "shard"
+
+
+DCN_AXIS = "dcn"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = DEFAULT_AXIS,
+    devices: Optional[Sequence] = None,
+) -> jax.sharding.Mesh:
+    """A 1-D mesh over the first ``n_devices`` available devices."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return jax.sharding.Mesh(np.asarray(devices), (axis_name,))
+
+
+def make_hybrid_mesh(
+    n_slices: int,
+    devices_per_slice: Optional[int] = None,
+    ici_axis: str = DEFAULT_AXIS,
+    dcn_axis: str = DCN_AXIS,
+) -> jax.sharding.Mesh:
+    """A 2-D (dcn, ici) mesh: slices x chips-per-slice.
+
+    Multi-slice/multi-host layout: the leading axis crosses slice
+    boundaries (DCN), the trailing axis stays within a slice (ICI). The
+    framework's collectives are laid out so the heavy all_to_all rekey
+    rides the ICI axis; crossing slices is reserved for the cheap
+    disjoint-row gathers — the collective-placement recipe of the scaling
+    playbook (shard the fast axis, reduce over the slow one). On real
+    multi-slice hardware, replace the device list slicing with
+    mesh_utils.create_hybrid_device_mesh; the mesh axes and all downstream
+    code are unchanged.
+    """
+    devices = jax.devices()
+    if devices_per_slice is None:
+        if len(devices) % n_slices:
+            raise ValueError(
+                f"{len(devices)} devices do not divide into {n_slices} slices"
+            )
+        devices_per_slice = len(devices) // n_slices
+    need = n_slices * devices_per_slice
+    if need > len(devices):
+        raise ValueError(
+            f"requested {need} devices, only {len(devices)} available"
+        )
+    grid = np.asarray(devices[:need]).reshape(n_slices, devices_per_slice)
+    return jax.sharding.Mesh(grid, (dcn_axis, ici_axis))

@@ -1,0 +1,336 @@
+"""Sharded metric computation under shard_map, with all_to_all rekeying.
+
+The distributed design replaces the reference's scatter-gather-over-files
+(SplitBam -> per-chunk gatherer -> MergeCellMetrics/MergeGeneMetrics,
+src/sctools/bam.py:361-488 + src/sctools/metrics/merge.py) with mesh
+collectives:
+
+- records arrive sharded by *cell* hash (a cell never spans shards), so cell
+  metrics are exact per shard and "merge" is mere concatenation of disjoint
+  rows — the device analog of MergeCellMetrics' concat (merge.py:60-71);
+- gene metrics need gene-disjoint sharding, so the step *reshards* the batch
+  by gene hash with one ``all_to_all`` over the mesh axis, after which gene
+  metrics are also exact per shard — replacing MergeGeneMetrics' groupby-sum /
+  weighted-average recomputation (merge.py:75-191) with a data movement that
+  makes the merge trivial.
+
+All shapes are static; resharding uses a capacity buffer per (src, dst) pair.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metrics.device import compute_entity_metrics
+from ..ops import segments as seg
+from .mesh import DEFAULT_AXIS
+
+_I32_MAX = np.iinfo(np.int32).max
+
+P = jax.sharding.PartitionSpec
+
+
+def _squeeze_local(cols: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    return {k: v[0] for k, v in cols.items()}
+
+
+def _expand_local(out: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    return {k: v[None] for k, v in out.items()}
+
+
+def reshard_by_key(
+    cols: Dict[str, jnp.ndarray],
+    key: str,
+    axis_name: str,
+    n_shards: int,
+    capacity: Optional[int] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Move every record to shard ``code % n_shards`` via all_to_all.
+
+    Runs *inside* shard_map: ``cols`` are the local [S] columns. Each source
+    shard packs its records into an [n_shards, capacity] send buffer (row =
+    destination), the buffers are exchanged along ``axis_name``, and the
+    received [n_shards, capacity] block flattens into the new local batch of
+    size ``n_shards * capacity`` with ``valid`` marking real records.
+
+    Columns of one dtype ride a single stacked collective, so the exchange
+    costs one all_to_all per distinct dtype (3 for the metric column set),
+    not one per column.
+
+    ``capacity`` is the per-(src, dst) bucket cap. The default S is always
+    sufficient; callers with host visibility of the data should pass the
+    tight value from ``required_reshard_capacity``.
+
+    Returns ``(cols, n_dropped)``: records beyond an undersized capacity are
+    dropped from the exchange, and ``n_dropped`` (a per-shard device scalar)
+    counts them so callers can surface the loss after the jit boundary —
+    this function itself cannot raise under jit.
+    """
+    local_size = cols[key].shape[0]
+    if capacity is None:
+        capacity = local_size
+    valid = cols["valid"].astype(bool)
+    dest = jnp.where(valid, cols[key].astype(jnp.int32) % n_shards, n_shards)
+
+    # order records by destination; position within the destination run
+    order = seg.sort_permutation([dest])
+    sorted_dest = dest[order]
+    starts = seg.run_starts([sorted_dest])
+    run_ids = seg.segment_ids_from_starts(starts)
+    first = seg.first_index_per_segment(starts, run_ids, local_size)
+    iota = jnp.arange(local_size, dtype=jnp.int32)
+    col_in_bucket = iota - first[run_ids]
+
+    ok = (sorted_dest < n_shards) & (col_in_bucket < capacity)
+    # out-of-bounds rows are dropped by scatter mode='drop'; count them so
+    # the loss is observable (silent truncation would corrupt metrics)
+    n_dropped = jnp.sum(
+        ((sorted_dest < n_shards) & ~ok).astype(jnp.int32)
+    )
+    row = jnp.where(ok, sorted_dest, n_shards)
+
+    # scatter each column into its send buffer, grouped by dtype
+    names = list(cols)
+    buffers: Dict[str, jnp.ndarray] = {}
+    for name in names:
+        scol = cols[name][order]
+        if name == "valid":
+            scol = scol.astype(bool) & ok
+        base = jnp.zeros((n_shards, capacity), dtype=scol.dtype)
+        buffers[name] = base.at[row, col_in_bucket].set(scol, mode="drop")
+
+    out: Dict[str, jnp.ndarray] = {}
+    by_dtype: Dict[np.dtype, list] = {}
+    for name in names:
+        by_dtype.setdefault(buffers[name].dtype, []).append(name)
+    for dtype, group in by_dtype.items():
+        stacked = jnp.stack([buffers[n] for n in group])  # [C, n_shards, cap]
+        received = jax.lax.all_to_all(
+            stacked, axis_name, split_axis=1, concat_axis=1, tiled=True
+        )
+        for i, name in enumerate(group):
+            out[name] = received[i].reshape(n_shards * capacity)
+    return out, n_dropped
+
+
+def required_reshard_capacity(
+    stacked_cols: Dict[str, np.ndarray], key: str, n_shards: int
+) -> int:
+    """Max records any (src shard, dst shard) pair exchanges when rekeying.
+
+    Host-side companion to ``reshard_by_key``: computed from concrete data
+    before jit so the device exchange can use a tight static capacity instead
+    of the worst-case full shard size.
+    """
+    codes = np.asarray(stacked_cols[key])
+    valid = np.asarray(stacked_cols["valid"], dtype=bool)
+    most = 0
+    for s in range(codes.shape[0]):
+        dst = codes[s][valid[s]].astype(np.int64) % n_shards
+        if dst.size:
+            most = max(most, int(np.bincount(dst, minlength=n_shards).max()))
+    return most
+
+
+def sharded_entity_metrics(
+    stacked_cols: Dict[str, np.ndarray],
+    mesh: jax.sharding.Mesh,
+    kind: str,
+    axis_name: str = DEFAULT_AXIS,
+) -> Dict[str, np.ndarray]:
+    """Per-shard metrics over entity-sharded records ([n_shards, S] columns).
+
+    Requires records partitioned so the ``kind`` entity never spans shards
+    (parallel.shard.partition_columns with key=kind). Each device computes the
+    full metric set for its local entities; outputs stack to [n_shards, S]
+    and rows across shards are disjoint by construction.
+    """
+    n_shards, shard_size = stacked_cols["cell"].shape
+    _check_shard_count(n_shards, mesh, axis_name)
+    return _build_sharded_metrics(mesh, axis_name, shard_size, kind)(stacked_cols)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sharded_metrics(mesh, axis_name: str, shard_size: int, kind: str):
+    """Compiled per-shard metrics pass, cached so repeat batches of one shape
+    reuse a single executable instead of re-tracing the shard_map closure."""
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis_name),),
+        out_specs=P(axis_name),
+        check_vma=False,
+    )
+    def run(local):
+        out = compute_entity_metrics(
+            _squeeze_local(local), num_segments=shard_size, kind=kind
+        )
+        return _expand_local(out)
+
+    return jax.jit(run)
+
+
+def _check_shard_count(n_shards: int, mesh: jax.sharding.Mesh, axis_name):
+    """A stacked batch must carry exactly one shard per mesh device.
+
+    With a mismatch, shard_map would hand each device a [k>1, S] block whose
+    trailing shards ``_squeeze_local`` silently discards — records would
+    vanish from the metrics with no error. ``axis_name`` may be a tuple of
+    axes (hybrid meshes); the shard count must match their size product.
+    """
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    mesh_size = 1
+    for axis in axes:
+        mesh_size *= mesh.shape[axis]
+    if n_shards != mesh_size:
+        raise ValueError(
+            f"batch has {n_shards} shards but mesh axes {axes!r} hold "
+            f"{mesh_size} devices; repartition with n_shards={mesh_size}"
+        )
+
+
+def distributed_metrics_step(
+    stacked_cols: Dict[str, np.ndarray],
+    mesh: jax.sharding.Mesh,
+    axis_name=DEFAULT_AXIS,
+    capacity: Optional[int] = None,
+) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """The full distributed pipeline step: cell AND gene metrics in one jit.
+
+    Input is cell-sharded ([n_shards, S] columns). Cell metrics run in place;
+    the batch is then resharded by gene hash (all_to_all) and gene metrics run
+    on the gene-disjoint layout. This one function exercises every collective
+    the framework's scatter-gather story needs and is what
+    ``__graft_entry__.dryrun_multichip`` compiles over an N-device mesh.
+
+    ``axis_name`` may be one mesh axis or a TUPLE of axes: on a 2-D
+    (dcn, ici) mesh (make_hybrid_mesh) the step shards cells over the
+    flattened device grid and the gene rekey's all_to_all runs over both
+    axes jointly — XLA routes the intra-slice fraction over ICI and only
+    cross-slice records over DCN.
+
+    ``capacity`` (per-(src,dst) reshard bucket) is computed tight from the
+    concrete input when omitted, and falls back to the always-sufficient full
+    shard size when the input is a tracer. An explicit capacity is *checked
+    on device*: the reshard counts every record an undersized bucket would
+    drop, and this function raises after the step instead of silently losing
+    records (the round-robin file binning it replaces cannot overflow,
+    src/sctools/bam.py:442-448 — neither may the collective).
+    """
+    n_shards, shard_size = stacked_cols["cell"].shape
+    _check_shard_count(n_shards, mesh, axis_name)
+    concrete = not isinstance(stacked_cols["gene"], jax.core.Tracer)
+    if concrete:
+        # cheap host-side pre-flight: an undersized explicit capacity fails
+        # BEFORE the device pass runs (the on-device drop counter still
+        # backstops tracer inputs, where this check cannot see the data)
+        required = required_reshard_capacity(stacked_cols, "gene", n_shards)
+        if capacity is None:
+            cap = seg.bucket_size(required, minimum=8)
+        elif capacity < required:
+            raise ValueError(
+                f"reshard capacity={capacity} too small: a (src,dst) shard "
+                f"pair exchanges up to {required} records"
+            )
+        else:
+            cap = capacity
+    else:
+        cap = capacity if capacity is not None else shard_size
+
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    cell_out, gene_out, dropped = _build_distributed_step(
+        mesh, axes, n_shards, shard_size, cap
+    )(stacked_cols)
+    if not isinstance(dropped, jax.core.Tracer):
+        # eager call: surface any overflow loss immediately. Under an outer
+        # jit the counter is a tracer and cannot be read here — such callers
+        # compose reshard_by_key directly and own the check.
+        n_dropped = int(np.sum(np.asarray(dropped)))
+        if n_dropped:
+            raise RuntimeError(
+                f"reshard capacity={cap} too small: {n_dropped} records "
+                "were dropped in the all_to_all rekey; rerun with a larger "
+                "capacity (see required_reshard_capacity)"
+            )
+    return cell_out, gene_out
+
+
+@functools.lru_cache(maxsize=64)
+def _build_distributed_step(
+    mesh, axes: tuple, n_shards: int, shard_size: int, cap: int
+):
+    """Compiled full pipeline step, cached per (mesh, shapes, capacity)."""
+    spec = P(axes if len(axes) > 1 else axes[0])
+    collective_axes = axes if len(axes) > 1 else axes[0]
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(spec, spec, spec),
+        check_vma=False,
+    )
+    def step(local):
+        local = _squeeze_local(local)
+        cell_out = compute_entity_metrics(
+            local, num_segments=shard_size, kind="cell"
+        )
+        regene, dropped = reshard_by_key(
+            local, "gene", collective_axes, n_shards, capacity=cap
+        )
+        gene_out = compute_entity_metrics(
+            regene, num_segments=n_shards * cap, kind="gene"
+        )
+        return _expand_local(cell_out), _expand_local(gene_out), dropped[None]
+
+    return jax.jit(step)
+
+
+def hybrid_metrics_step(
+    stacked_cols: Dict[str, np.ndarray],
+    mesh: jax.sharding.Mesh,
+    capacity: Optional[int] = None,
+) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """The distributed step on a 2-D (dcn, ici) mesh (parallel.make_hybrid_mesh).
+
+    Cells shard over the FLATTENED (dcn, ici) device grid — per-device cell
+    metrics need no communication at all, the multi-slice scaling property
+    the reference gets from file-level scatter (SplitBam chunks across VMs).
+    A thin wrapper over ``distributed_metrics_step`` with the tuple axis:
+    the gene rekey's all_to_all runs over both axes jointly, so XLA routes
+    the intra-slice fraction over ICI and only cross-slice records over DCN.
+    Input layout: [n_slices * per_slice, S] columns, cell-partitioned with
+    parallel.shard.partition_columns(n_shards = total devices).
+    """
+    return distributed_metrics_step(
+        stacked_cols, mesh, axis_name=tuple(mesh.axis_names), capacity=capacity
+    )
+
+
+def collect_sharded_rows(
+    result: Dict[str, np.ndarray],
+) -> Dict[int, Dict[str, float]]:
+    """Flatten a stacked sharded result into {entity_code: {metric: value}}.
+
+    Host-side helper for writers: walks every shard's valid segments. Codes
+    are globally disjoint across shards (sharding invariant), so no merging
+    arithmetic is needed — the device analog of MergeCellMetrics being a
+    plain concat (reference merge.py:60-71).
+    """
+    rows: Dict[int, Dict[str, float]] = {}
+    n_shards = result["n_entities"].shape[0]
+    skip = {"entity_code", "segment_valid", "n_entities"}
+    for s in range(n_shards):
+        n_entities = int(result["n_entities"][s])
+        for r in range(n_entities):
+            code = int(result["entity_code"][s][r])
+            rows[code] = {
+                k: result[k][s][r] for k in result if k not in skip
+            }
+    return rows

@@ -1,0 +1,71 @@
+"""Host-side record partitioning by entity hash.
+
+The sharding invariant is the reference's: a cell barcode never spans chunks
+(src/sctools/bam.py:442-448 assigns barcode -> bin by round-robin mod;
+fastqpreprocessing/src/fastq_common.cpp:257 buckets by hash(barcode) %
+num_writers). Here the "chunk" is a mesh device: records are partitioned by
+``entity_code % n_shards`` into a stacked ``[n_shards, shard_size]`` columnar
+batch that a ``shard_map`` consumes with one shard per device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..io.packed import PAD_FILLS
+from ..ops.segments import bucket_size
+
+
+def shard_assignment(codes: np.ndarray, n_shards: int) -> np.ndarray:
+    """Destination shard per record: round-robin over entity codes.
+
+    Entity codes index a sorted vocabulary, so ``% n_shards`` spreads
+    lexicographically adjacent entities across shards — the same
+    round-robin-mod policy as the reference's barcode binning
+    (src/sctools/bam.py:442-448).
+    """
+    return np.asarray(codes, dtype=np.int64) % n_shards
+
+
+def partition_columns(
+    cols: Dict[str, np.ndarray],
+    n_shards: int,
+    key: str = "cell",
+    shard_size: Optional[int] = None,
+) -> Dict[str, np.ndarray]:
+    """Partition a columnar batch into ``[n_shards, shard_size]`` stacked columns.
+
+    ``cols`` must hold equal-length 1-D arrays including a boolean ``valid``
+    mask. Only valid records are distributed; each shard is padded to a common
+    power-of-two ``shard_size`` (jit shape stability; see
+    ops.segments.bucket_size) with ``valid=False`` rows.
+    """
+    valid = np.asarray(cols["valid"], dtype=bool)
+    dest = shard_assignment(cols[key], n_shards)
+    dest = np.where(valid, dest, -1)
+
+    per_shard_indices = [np.nonzero(dest == s)[0] for s in range(n_shards)]
+    max_count = max((len(ix) for ix in per_shard_indices), default=0)
+    if shard_size is None:
+        shard_size = bucket_size(max_count)
+    elif max_count > shard_size:
+        raise ValueError(
+            f"shard_size={shard_size} too small: largest shard holds {max_count}"
+        )
+
+    out: Dict[str, np.ndarray] = {}
+    for name, col in cols.items():
+        if name == "valid":
+            continue
+        col = np.asarray(col)
+        fill = PAD_FILLS.get(name, False if col.dtype == bool else 0)
+        stacked = np.full((n_shards, shard_size), fill, dtype=col.dtype)
+        for s, ix in enumerate(per_shard_indices):
+            stacked[s, : len(ix)] = col[ix]
+        out[name] = stacked
+    out["valid"] = np.zeros((n_shards, shard_size), dtype=bool)
+    for s, ix in enumerate(per_shard_indices):
+        out["valid"][s, : len(ix)] = True
+    return out

@@ -1,0 +1,1254 @@
+"""Command-line layer: the 12 console entry points.
+
+Rebuild of the reference's platform module (src/sctools/platform.py:42-1126):
+every entry point is a classmethod taking an optional ``args`` list so tests
+can inject arguments (the testability pattern of platform.py:83-86). Console
+scripts are wired in pyproject.toml the way the reference wires setup.py:37-58.
+
+Extensions over the reference surface: metric/count commands accept
+``--backend {device,cpu}`` (device = the jit TPU engine, cpu = the
+reference-semantics streaming path; default device).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from . import bam, consts, fastq, groups, gtf
+from .io.sam import AlignmentReader, AlignmentWriter
+
+
+def _build_parser(*specs, description=None, defaults=None) -> argparse.ArgumentParser:
+    """An ArgumentParser from compact ``(flags, options)`` pairs.
+
+    Shared by every entry point: the flag surface mirrors the reference CLI
+    exactly (same flags, dests, defaults), while the construction stays
+    declarative and each command's parser reads as a table.
+    """
+    parser = argparse.ArgumentParser(description=description)
+    if defaults:
+        parser.set_defaults(**defaults)
+    for flags, options in specs:
+        parser.add_argument(*flags, **options)
+    return parser
+
+
+def _normalize_backend(value: str) -> str:
+    return "device" if value in ("device", "tpu") else value
+
+
+_BACKEND_SPEC = (
+    ("--backend",),
+    dict(
+        default="device",
+        choices=["device", "tpu", "cpu"],
+        help="compute backend: device/tpu = compiled JAX engine, cpu = "
+        "streaming reference-semantics path (default: device)",
+    ),
+)
+
+# barcode kind -> (sequence tag, quality tag) for EmbeddedBarcode building
+_BARCODE_TAG_PAIRS = {
+    "cell": (consts.RAW_CELL_BARCODE_TAG_KEY, consts.QUALITY_CELL_BARCODE_TAG_KEY),
+    "molecule": (
+        consts.RAW_MOLECULE_BARCODE_TAG_KEY,
+        consts.QUALITY_MOLECULE_BARCODE_TAG_KEY,
+    ),
+    "sample": (
+        consts.RAW_SAMPLE_BARCODE_TAG_KEY,
+        consts.QUALITY_SAMPLE_BARCODE_TAG_KEY,
+    ),
+}
+
+
+def _embedded(kind: str, start: int, end: int) -> fastq.EmbeddedBarcode:
+    sequence_tag, quality_tag = _BARCODE_TAG_PAIRS[kind]
+    return fastq.EmbeddedBarcode(start, end, sequence_tag, quality_tag)
+
+
+class GenericPlatform:
+    """Entry points shared by all sequencing platforms."""
+
+    @classmethod
+    def _tag_bamfile(
+        cls, input_bamfile_name: str, output_bamfile_name: str, tag_generators
+    ) -> None:
+        bam.Tagger(input_bamfile_name).tag(output_bamfile_name, tag_generators)
+
+    @classmethod
+    def _attach_with_native(
+        cls, r1, u2, output_bam, cb_spans, umi_spans, sample_spans, i1, whitelist
+    ) -> bool:
+        """Try the native attach pipeline; True when it handled the job.
+
+        Native path: C++ fastq/BGZF streaming with per-batch device whitelist
+        correction (sctools_tpu.native.attach_barcodes_native) — the
+        fastqprocess-equivalent fast path. Falls back to the Python
+        generator pipeline for SAM/uncompressed inputs, multi-file r1, or a
+        missing toolchain.
+        """
+        if isinstance(r1, (list, tuple)):
+            return False
+        from .io import bgzf
+
+        try:
+            if not bgzf.is_gzip(u2):
+                return False
+            from . import native
+
+            if not native.available():
+                return False
+            native.attach_barcodes_native(
+                r1, u2, output_bam,
+                cb_spans or [], umi_spans or [],
+                sample_spans if i1 else [],
+                i1=i1, whitelist=whitelist,
+            )
+            return True
+        except (OSError, RuntimeError) as error:
+            print(
+                f"warning: native attach failed ({error}); using Python path",
+                file=sys.stderr,
+            )
+            return False
+
+    @classmethod
+    def get_tags(cls, raw_tags: Optional[Sequence[str]]) -> Iterable[str]:
+        # flatten a potentially nested list (argparse nargs='+' + action='append')
+        flattened: List[str] = []
+        for tag in raw_tags or []:
+            flattened.extend(tag if isinstance(tag, list) else [tag])
+        return flattened
+
+    @classmethod
+    def tag_sort_bam(cls, args: Iterable = None) -> int:
+        """Sort a bam by zero or more tags, then query name
+        (reference platform.py:55-97).
+
+        Like the reference's TagSort binary, metrics can be computed DURING
+        the k-way merge (fastqpreprocessing/src/tagsort.cpp:185-196): with
+        ``--cell-metrics-output`` / ``--gene-metrics-output`` the merged
+        sorted stream feeds the device metrics engine directly — one pass,
+        and when ``-o`` is omitted no sorted BAM is written at all.
+        """
+        parser = _build_parser(
+            (("-i", "--input_bam"), dict(required=True, help="the bam to sort")),
+            (
+                ("-o", "--output_bam"),
+                dict(
+                    default=None,
+                    help="where the sorted bam goes (optional when a "
+                    "metrics output is requested)",
+                ),
+            ),
+            (
+                ("-t", "--tags"),
+                dict(
+                    nargs="+",
+                    action="append",
+                    help="sort keys in priority order (space separated), "
+                    "e.g. -t CB GE UB; query name always breaks ties",
+                ),
+            ),
+            (
+                ("--records-per-chunk",),
+                dict(
+                    type=int,
+                    default=None,
+                    help="bound memory by spilling sorted chunks of this many "
+                    "records and k-way merging them (out-of-core; default: "
+                    "all in memory when unset)",
+                ),
+            ),
+            (
+                ("--cell-metrics-output",),
+                dict(
+                    default=None,
+                    help="compute per-cell metrics from the merged stream "
+                    "(one pass; requires -t CB UB GE) and write this csv "
+                    "stem",
+                ),
+            ),
+            (
+                ("--gene-metrics-output",),
+                dict(
+                    default=None,
+                    help="compute per-gene metrics from the merged stream "
+                    "(one pass; requires -t GE CB UB) and write this csv "
+                    "stem",
+                ),
+            ),
+            (
+                ("-a", "--gtf-annotation-file"),
+                dict(
+                    default=None,
+                    help="annotation for the mitochondrial metrics "
+                    "(cell metrics only)",
+                ),
+            ),
+            description="Sort a bam by a list of zero or more tags, then query name",
+        )
+        args = parser.parse_args(args)
+
+        tags = cls.get_tags(args.tags)
+        fused = cls._fused_metrics_request(parser, args, tags)
+        if fused is not None:
+            return cls._tag_sort_with_metrics(args, tags, *fused)
+        if args.output_bam is None:
+            parser.error("-o/--output_bam is required without a metrics output")
+        if args.records_per_chunk is not None:
+            from .tagsort import tag_sort_bam_out_of_core
+
+            tag_sort_bam_out_of_core(
+                args.input_bam, args.output_bam, tags,
+                records_per_chunk=args.records_per_chunk,
+            )
+            return 0
+        with AlignmentReader(args.input_bam, "rb") as f:
+            header = f.header.copy()
+            sorted_records = bam.sort_by_tags_and_queryname(iter(f), tags)
+        with AlignmentWriter(args.output_bam, header, "wb") as f:
+            for record in sorted_records:
+                f.write(record)
+        return 0
+
+    @classmethod
+    def _fused_metrics_request(cls, parser, args, tags):
+        """Validate the fused-metrics flags; None when not requested.
+
+        Tag order is the metric type's contract (the reference validates
+        the same permutations, input_options.cpp:264-276): cell metrics
+        need (CB, UB, GE), gene metrics (GE, CB, UB).
+        """
+        if args.cell_metrics_output and args.gene_metrics_output:
+            parser.error(
+                "pass either --cell-metrics-output or --gene-metrics-output"
+            )
+        if args.cell_metrics_output:
+            if list(tags) != ["CB", "UB", "GE"]:
+                parser.error("--cell-metrics-output requires -t CB UB GE")
+            return ("cell", args.cell_metrics_output)
+        if args.gene_metrics_output:
+            if list(tags) != ["GE", "CB", "UB"]:
+                parser.error("--gene-metrics-output requires -t GE CB UB")
+            return ("gene", args.gene_metrics_output)
+        return None
+
+    @classmethod
+    def _tag_sort_with_metrics(cls, args, tags, kind, metrics_stem) -> int:
+        """One merge pass: sorted stream -> device metrics (+ optional bam).
+
+        Falls back to sequential sort-then-gather when the native layer is
+        unavailable (same outputs, two passes).
+        """
+        from . import native
+        from .io import bgzf
+        from .metrics.gatherer import GatherCellMetrics, GatherGeneMetrics
+
+        mitochondrial_gene_ids: Set[str] = set()
+        if args.gtf_annotation_file:
+            mitochondrial_gene_ids = gtf.get_mitochondrial_gene_names(
+                args.gtf_annotation_file
+            )
+        gatherer_cls = GatherCellMetrics if kind == "cell" else GatherGeneMetrics
+
+        native_ok = (
+            not args.input_bam.endswith(".sam")
+            and bgzf.is_gzip(args.input_bam)
+            and native.available()
+        )
+        if native_ok:
+            sort_batch = args.records_per_chunk or 500_000
+            gatherer = gatherer_cls(
+                args.input_bam,
+                metrics_stem,
+                mitochondrial_gene_ids,
+                frame_source=lambda: native.tagsort_stream_frames(
+                    args.input_bam,
+                    tags,
+                    sort_batch_records=sort_batch,
+                    bam_output=args.output_bam,
+                ),
+            )
+            gatherer.extract_metrics()
+            return 0
+        # two-pass fallback: sort to a file (a temporary one when the
+        # caller didn't ask for the sorted bam), then gather from it
+        import tempfile
+
+        from .tagsort import tag_sort_bam_out_of_core
+
+        sorted_path = args.output_bam
+        temp = None
+        if sorted_path is None:
+            temp = tempfile.NamedTemporaryFile(
+                suffix=".bam", delete=False,
+                dir=os.path.dirname(os.path.abspath(metrics_stem)) or ".",
+            )
+            temp.close()
+            sorted_path = temp.name
+        try:
+            tag_sort_bam_out_of_core(
+                args.input_bam, sorted_path, tags,
+                records_per_chunk=args.records_per_chunk or 500_000,
+            )
+            gatherer_cls(
+                sorted_path, metrics_stem, mitochondrial_gene_ids
+            ).extract_metrics()
+        finally:
+            if temp is not None:
+                try:
+                    os.remove(temp.name)
+                except OSError:
+                    pass
+        return 0
+
+    @classmethod
+    def verify_bam_sort(cls, args: Iterable = None) -> int:
+        """Verify a bam is sorted by tags then query name
+        (reference platform.py:99-143)."""
+        parser = _build_parser(
+            (("-i", "--input_bam"), dict(required=True, help="the bam to check")),
+            (
+                ("-t", "--tags"),
+                dict(
+                    nargs="+",
+                    action="append",
+                    help="the expected sort keys (space separated), "
+                    "e.g. -t CB GE UB",
+                ),
+            ),
+            description="Check that a bam is sorted by the given tags, then query name",
+        )
+        args = parser.parse_args(args)
+
+        tags = cls.get_tags(args.tags)
+        with AlignmentReader(args.input_bam, "rb") as f:
+            sortable_records = (
+                bam.TagSortableRecord.from_aligned_segment(r, tags) for r in f
+            )
+            bam.verify_sort(sortable_records, tags)
+        print(f"{args.input_bam} is correctly sorted by {tags} and query name")
+        return 0
+
+    @classmethod
+    def split_bam(cls, args: Iterable = None) -> int:
+        """Split bamfiles into disjoint-barcode chunks of approximately equal
+        size (reference platform.py:152-223); prints chunk filenames."""
+        parser = _build_parser(
+            (
+                ("-b", "--bamfile"),
+                dict(nargs="+", required=True, help="the bam(s) to partition"),
+            ),
+            (
+                ("-p", "--output-prefix"),
+                dict(required=True, help="filename stem for the chunks"),
+            ),
+            (
+                ("-s", "--subfile-size"),
+                dict(
+                    required=False,
+                    default=1000,
+                    type=float,
+                    help="per-chunk size target in MB (default 1000)",
+                ),
+            ),
+            (
+                ("--num-processes",),
+                dict(
+                    required=False,
+                    default=None,
+                    type=int,
+                    help="worker process count for the scan and write pools",
+                ),
+            ),
+            (
+                ("-t", "--tags"),
+                dict(
+                    nargs="+",
+                    help="partition tag(s), tried in order per record: a "
+                    "later tag is consulted only when every earlier one is "
+                    "absent",
+                ),
+            ),
+            (
+                ("--drop-missing",),
+                dict(
+                    dest="raise_missing",
+                    action="store_false",
+                    help="silently skip records carrying none of the tags "
+                    "(default: raise)",
+                ),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        chunk_names = bam.split(
+            args.bamfile,
+            args.output_prefix,
+            args.tags,
+            approx_mb_per_split=args.subfile_size,
+            raise_missing=args.raise_missing,
+            num_processes=args.num_processes,
+        )
+        print(" ".join(chunk_names))
+        return 0
+
+    @classmethod
+    def calculate_gene_metrics(cls, args: Iterable[str] = None) -> int:
+        """Per-gene QC metrics csv from a (GE, CB, UB)-sorted bam
+        (reference platform.py:225-261)."""
+        parser = _build_parser(
+            (("-i", "--input-bam"), dict(required=True, help="the sorted tagged bam")),
+            (
+                ("-o", "--output-filestem"),
+                dict(required=True, help="stem for the metrics csv"),
+            ),
+            _BACKEND_SPEC,
+        )
+        args = parser.parse_args(args)
+
+        from .metrics.gatherer import GatherGeneMetrics
+
+        gene_metric_gatherer = GatherGeneMetrics(
+            args.input_bam,
+            args.output_filestem,
+            backend=_normalize_backend(args.backend),
+        )
+        gene_metric_gatherer.extract_metrics()
+        return 0
+
+    @classmethod
+    def calculate_cell_metrics(cls, args: Iterable[str] = None) -> int:
+        """Per-cell QC metrics csv from a (CB, UB, GE)-sorted bam
+        (reference platform.py:263-313)."""
+        parser = _build_parser(
+            (("-i", "--input-bam"), dict(required=True, help="the sorted tagged bam")),
+            (
+                ("-o", "--output-filestem"),
+                dict(required=True, help="stem for the metrics csv"),
+            ),
+            (
+                ("-a", "--gtf-annotation-file"),
+                dict(
+                    required=False,
+                    default=None,
+                    help="the annotation the bam was aligned against; enables "
+                    "the mitochondrial metrics",
+                ),
+            ),
+            _BACKEND_SPEC,
+        )
+        args = parser.parse_args(args)
+
+        mitochondrial_gene_ids: Set[str] = set()
+        if args.gtf_annotation_file:
+            mitochondrial_gene_ids = gtf.get_mitochondrial_gene_names(
+                args.gtf_annotation_file
+            )
+
+        from .metrics.gatherer import GatherCellMetrics
+
+        cell_metric_gatherer = GatherCellMetrics(
+            args.input_bam,
+            args.output_filestem,
+            mitochondrial_gene_ids,
+            backend=_normalize_backend(args.backend),
+        )
+        cell_metric_gatherer.extract_metrics()
+        return 0
+
+    @classmethod
+    def merge_gene_metrics(cls, args: Iterable[str] = None) -> int:
+        """Merge chunked gene metrics csvs (reference platform.py:315-347)."""
+        parser = _build_parser(
+            (("metric_files",), dict(nargs="+", help="the chunked metric csvs")),
+            (
+                ("-o", "--output-filestem"),
+                dict(required=True, help="stem for the merged csv"),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        from .metrics.merge import MergeGeneMetrics
+
+        MergeGeneMetrics(args.metric_files, args.output_filestem).execute()
+        return 0
+
+    @classmethod
+    def merge_cell_metrics(cls, args: Iterable[str] = None) -> int:
+        """Merge chunked cell metrics csvs (cells are disjoint across chunks;
+        reference platform.py:349-381)."""
+        parser = _build_parser(
+            (("metric_files",), dict(nargs="+", help="the chunked metric csvs")),
+            (
+                ("-o", "--output-filestem"),
+                dict(required=True, help="stem for the merged csv"),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        from .metrics.merge import MergeCellMetrics
+
+        MergeCellMetrics(args.metric_files, args.output_filestem).execute()
+        return 0
+
+    @classmethod
+    def bam_to_count_matrix(cls, args: Iterable[str] = None) -> int:
+        """Count matrix from a tagged bam (reference platform.py:383-473)."""
+        parser = _build_parser(
+            (
+                ("-b", "--bam-file"),
+                dict(required=True, help="the queryname-sorted tagged bam"),
+            ),
+            (
+                ("-o", "--output-prefix"),
+                dict(required=True, help="stem for the .npz/.npy matrix files"),
+            ),
+            (
+                ("-a", "--gtf-annotation-file"),
+                dict(
+                    required=True,
+                    help="the annotation the bam was aligned against "
+                    "(defines the gene axis)",
+                ),
+            ),
+            (
+                ("-c", "--cell-barcode-tag"),
+                dict(
+                    help="cell barcode tag "
+                    f"(default = {consts.CELL_BARCODE_TAG_KEY})"
+                ),
+            ),
+            (
+                ("-m", "--molecule-barcode-tag"),
+                dict(
+                    help="molecule barcode tag "
+                    f"(default = {consts.MOLECULE_BARCODE_TAG_KEY})"
+                ),
+            ),
+            (
+                ("-g", "--gene-id-tag"),
+                dict(
+                    dest="gene_name_tag",
+                    help=f"gene name tag (default = {consts.GENE_NAME_TAG_KEY})",
+                ),
+            ),
+            (
+                ("-n", "--sn-rna-seq-mode"),
+                dict(action="store_true", help="snRNA Seq mode (default = False)"),
+            ),
+            (
+                ("--batch-records",),
+                dict(
+                    type=int,
+                    default=None,
+                    help="alignments decoded per streaming batch (bounds host "
+                    "memory; default 524288)",
+                ),
+            ),
+            _BACKEND_SPEC,
+            defaults=dict(
+                cell_barcode_tag=consts.CELL_BARCODE_TAG_KEY,
+                molecule_barcode_tag=consts.MOLECULE_BARCODE_TAG_KEY,
+                gene_name_tag=consts.GENE_NAME_TAG_KEY,
+            ),
+        )
+        args = parser.parse_args(args)
+
+        open_mode = "r" if args.bam_file.endswith(".sam") else "rb"
+        gene_name_to_index: Dict[str, int] = gtf.extract_gene_names(
+            args.gtf_annotation_file
+        )
+        # snRNA mode loads extended gene locations in the reference
+        # (platform.py:455-459) but the counting algorithm never consumes
+        # them (count.py keeps alignments unmodified at :255-256); the flag
+        # is accepted for CLI parity.
+
+        backend = _normalize_backend(args.backend)
+
+        from .count import DEFAULT_BATCH_RECORDS, CountMatrix
+
+        matrix = CountMatrix.from_sorted_tagged_bam(
+            bam_file=args.bam_file,
+            gene_name_to_index=gene_name_to_index,
+            cell_barcode_tag=args.cell_barcode_tag,
+            molecule_barcode_tag=args.molecule_barcode_tag,
+            gene_name_tag=args.gene_name_tag,
+            open_mode=open_mode,
+            backend=backend,
+            batch_records=(
+                args.batch_records
+                if args.batch_records is not None
+                else DEFAULT_BATCH_RECORDS
+            ),
+        )
+        matrix.save(args.output_prefix)
+        return 0
+
+    @classmethod
+    def merge_count_matrices(cls, args: Iterable[str] = None) -> int:
+        """Concatenate chunked count matrices (reference platform.py:475-516)."""
+        parser = _build_parser(
+            (
+                ("-i", "--input-prefixes"),
+                dict(
+                    nargs="+",
+                    help="stems of the chunked matrices: PREFIX names "
+                    "PREFIX.npz, PREFIX_col_index.npy and PREFIX_row_index.npy",
+                ),
+            ),
+            (
+                ("-o", "--output-stem"),
+                dict(required=True, help="stem for the merged csr matrix"),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        from .count import CountMatrix
+
+        count_matrix = CountMatrix.merge_matrices(args.input_prefixes)
+        count_matrix.save(args.output_stem)
+        return 0
+
+    @classmethod
+    def group_qc_outputs(cls, args: Iterable[str] = None) -> int:
+        """Aggregate Picard / HISAT2 / RSEM QC files
+        (reference platform.py:518-576)."""
+        parser = _build_parser(
+            (
+                ("-f", "--file_names"),
+                dict(
+                    dest="file_names",
+                    nargs="+",
+                    required=True,
+                    help="the QC files to aggregate",
+                ),
+            ),
+            (
+                ("-o", "--output_name"),
+                dict(dest="output_name", required=True, help="the csv to write"),
+            ),
+            (
+                ("-t", "--metrics_type"),
+                dict(
+                    dest="metrics_type",
+                    choices=["Picard", "PicardTable", "Core", "HISAT2", "RSEM"],
+                    required=True,
+                    help="which parser/aggregation to apply",
+                ),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        dispatch = {
+            "Picard": groups.write_aggregated_picard_metrics_by_row,
+            "PicardTable": groups.write_aggregated_picard_metrics_by_table,
+            "Core": groups.write_aggregated_qc_metrics,
+            "HISAT2": groups.parse_hisat2_log,
+            "RSEM": groups.parse_rsem_cnt,
+        }
+        dispatch[args.metrics_type](args.file_names, args.output_name)
+        return 0
+
+    @classmethod
+    def check_barcode_partition(cls, args: Iterable[str] = None) -> int:
+        """Verify that split/scatter outputs hold disjoint cell barcodes.
+
+        The validation utility of the reference pipeline
+        (fastqpreprocessing/utils/check_barcode_partition.py): loads the CB
+        tags of every chunk and fails if any barcode appears in more than
+        one file — the invariant every downstream merge relies on.
+        """
+        parser = _build_parser(
+            (
+                ("-b", "--bam-files"),
+                dict(
+                    nargs="+",
+                    required=True,
+                    help="the split/scatter output BAMs to validate",
+                ),
+            ),
+            (
+                ("-t", "--tag"),
+                dict(
+                    default=consts.CELL_BARCODE_TAG_KEY,
+                    help=f"partition tag (default {consts.CELL_BARCODE_TAG_KEY})",
+                ),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        owner: Dict[str, str] = {}
+        violations = 0
+        for path in args.bam_files:
+            mode = "r" if path.endswith(".sam") else None
+            with AlignmentReader(path, mode) as reader:
+                seen = set()
+                for record in reader:
+                    value = record.tags.get(args.tag)
+                    if value is None:
+                        continue
+                    seen.add(value[1])
+            for barcode in seen:
+                if barcode in owner and owner[barcode] != path:
+                    print(
+                        f"barcode {barcode} appears in {owner[barcode]} "
+                        f"AND {path}",
+                        file=sys.stderr,
+                    )
+                    violations += 1
+                else:
+                    owner[barcode] = path
+        if violations:
+            print(
+                f"partition INVALID: {violations} barcode(s) span files",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"partition OK: {len(owner)} barcode(s) disjoint across "
+            f"{len(args.bam_files)} file(s)",
+            file=sys.stderr,
+        )
+        return 0
+
+    @classmethod
+    def fastq_metrics(cls, args: Iterable[str] = None) -> int:
+        """FASTQ-level barcode/UMI statistics (the capability of the
+        reference's fastq_metrics binary, fastqpreprocessing/src/
+        fastq_metrics.cpp:174-242)."""
+        parser = _build_parser(
+            (("--R1",), dict(nargs="+", required=True, help="R1 fastq file shard(s)")),
+            (
+                ("--read-structure",),
+                dict(
+                    required=True,
+                    help="read structure of R1, e.g. 16C10M or 8C18X6C9M1X",
+                ),
+            ),
+            (
+                ("--sample-id",),
+                dict(required=True, help="prefix for the four output files"),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        from .fastq_metrics import compute_fastq_metrics
+
+        compute_fastq_metrics(args.R1, args.read_structure, args.sample_id)
+        return 0
+
+    @classmethod
+    def sample_fastq(cls, args: Iterable[str] = None) -> int:
+        """Downsample fastqs to whitelist-correctable reads (the capability
+        of the reference's samplefastq binary, fastqpreprocessing/src/
+        samplefastq.cpp:69-104)."""
+        parser = _build_parser(
+            (("--R1",), dict(nargs="+", required=True, help="R1 fastq(s)")),
+            (("--R2",), dict(nargs="+", required=True, help="R2 fastq(s)")),
+            (
+                ("--white-list",),
+                dict(required=True, help="cell barcode whitelist file"),
+            ),
+            (
+                ("--read-structure",),
+                dict(required=True, help="read structure of R1"),
+            ),
+            (
+                ("--output-prefix",),
+                dict(
+                    default="sampled_down",
+                    help="output prefix (default: sampled_down)",
+                ),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        from .samplefastq import sample_fastq
+
+        kept, total = sample_fastq(
+            args.R1, args.R2, args.white_list, args.read_structure,
+            args.output_prefix,
+        )
+        print(f"kept {kept} of {total} reads")
+        return 0
+
+
+class TenXV2(GenericPlatform):
+    """10x Genomics v2 geometry: cell barcode r1[0:16), molecule barcode
+    r1[16:26), sample barcode i1[0:8) (reference platform.py:608-625)."""
+
+    cell_barcode = _embedded("cell", 0, 16)
+    molecule_barcode = _embedded("molecule", 16, 26)
+    sample_barcode = _embedded("sample", 0, 8)
+
+    @classmethod
+    def _make_tag_generators(cls, r1, i1=None, whitelist=None) -> List:
+        if whitelist is not None:
+            r1_generator = fastq.BarcodeGeneratorWithCorrectedCellBarcodes(
+                whitelist=whitelist,
+                fastq_files=r1,
+                embedded_cell_barcode=cls.cell_barcode,
+                other_embedded_barcodes=[cls.molecule_barcode],
+            )
+        else:
+            r1_generator = fastq.EmbeddedBarcodeGenerator(
+                fastq_files=r1,
+                embedded_barcodes=[cls.cell_barcode, cls.molecule_barcode],
+            )
+        if i1 is None:
+            return [r1_generator]
+        sample_generator = fastq.EmbeddedBarcodeGenerator(
+            embedded_barcodes=[cls.sample_barcode], fastq_files=i1
+        )
+        return [r1_generator, sample_generator]
+
+    @classmethod
+    def attach_barcodes(cls, args=None):
+        """Attach 10x barcodes from r1 (+ optional i1) fastqs to an unaligned
+        bam (reference platform.py:706-758)."""
+        parser = _build_parser(
+            (
+                ("--r1",),
+                dict(required=True, help="barcode fastq (read 1) of the 10x v2 run"),
+            ),
+            (
+                ("--u2",),
+                dict(
+                    required=True,
+                    help="unaligned bam holding the cDNA reads (picard "
+                    "FastqToSam of read 2)",
+                ),
+            ),
+            (
+                ("--i1",),
+                dict(default=None, help="i7 index fastq, when a sample "
+                     "barcode should be attached"),
+            ),
+            (
+                ("-o", "--output-bamfile"),
+                dict(required=True, help="where the tagged bam goes"),
+            ),
+            (
+                ("-w", "--whitelist"),
+                dict(
+                    default=None,
+                    help="cell barcode whitelist; when given, barcodes within "
+                    "hamming distance 1 of a whitelisted value also get a "
+                    "corrected CB tag",
+                ),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        if cls._attach_with_native(
+            args.r1, args.u2, args.output_bamfile,
+            [(cls.cell_barcode.start, cls.cell_barcode.end)],
+            [(cls.molecule_barcode.start, cls.molecule_barcode.end)],
+            [(cls.sample_barcode.start, cls.sample_barcode.end)],
+            args.i1, args.whitelist,
+        ):
+            return 0
+        tag_generators = cls._make_tag_generators(args.r1, args.i1, args.whitelist)
+        cls._tag_bamfile(args.u2, args.output_bamfile, tag_generators)
+        return 0
+
+    @classmethod
+    def fastq_process(cls, args=None):
+        """The fastqprocess scatter: FASTQ triplets -> N disjoint-barcode
+        shards (reference fastqpreprocessing/src/fastqprocess.cpp +
+        fastq_common.cpp:362-414).
+
+        Each read routes to shard hash(corrected-or-raw cell barcode) %
+        n_shards, so a cell never spans output files — the partitioning
+        invariant downstream scatter-gather relies on. Shard count follows
+        the reference's sizing rule: ceil(total input GiB / --bam-size)
+        (input_options.cpp:53-72). Outputs are unaligned tagged BAM shards
+        or R1/R2 fastq.gz pairs (--output-format).
+        """
+        parser = _build_parser(
+            (
+                ("--r1",),
+                dict(nargs="+", required=True,
+                     help="read 1 fastq files (barcode + umi reads)"),
+            ),
+            (
+                ("--r2",),
+                dict(nargs="+", required=True, help="read 2 fastq files (cDNA reads)"),
+            ),
+            (
+                ("--i1",),
+                dict(nargs="+", default=None, help="(optional) i7 index fastq files"),
+            ),
+            (
+                ("-w", "--whitelist"),
+                dict(default=None, help="cell barcode whitelist for correction"),
+            ),
+            (
+                ("--output-format",),
+                dict(default="BAM", choices=["BAM", "FASTQ"],
+                     help="shard output type (default BAM)"),
+            ),
+            (
+                ("--bam-size",),
+                dict(type=float, default=1.0,
+                     help="target GiB of input per output shard "
+                     "(default 1.0; reference input_options.h:29)"),
+            ),
+            (
+                ("--sample-id",),
+                dict(default="", help="@RG SM value for BAM shard headers"),
+            ),
+            (
+                ("-o", "--output-prefix"),
+                dict(default="subfile", help="shard filename prefix (default subfile)"),
+            ),
+            (("--barcode-length",), dict(type=int, default=16)),
+            (("--umi-length",), dict(type=int, default=10)),
+            (("--sample-length",), dict(type=int, default=8)),
+            (
+                ("--read-structure",),
+                dict(
+                    default=None,
+                    help="R1 layout as a read-structure string, e.g. "
+                    "8C18X6C9M1X (C=cell, M=umi, S=sample, X=skip) — the "
+                    "slide-seq geometry DSL (reference fastq_slideseq."
+                    "cpp:4-18); overrides --barcode-length/--umi-length",
+                ),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        if len(args.r1) != len(args.r2):
+            parser.error("--r1 and --r2 need the same number of files")
+        if args.i1 is not None and len(args.i1) != len(args.r1):
+            parser.error("--i1 must match --r1 in file count")
+        if args.bam_size <= 0:
+            parser.error("--bam-size must be positive")
+
+        import math
+        import os as _os
+
+        total_bytes = sum(
+            _os.path.getsize(f)
+            for f in args.r1 + args.r2 + (args.i1 or [])
+        )
+        n_shards = max(1, math.ceil(total_bytes / (args.bam_size * (1 << 30))))
+
+        from . import native
+
+        if not native.available():
+            raise RuntimeError(
+                "FastqProcess requires the native layer (C++ toolchain); "
+                "use Attach10xBarcodes for the single-output Python path"
+            )
+        if args.read_structure:
+            structure = fastq.ReadStructure(args.read_structure)
+            cb_spans = structure.spans("C")
+            umi_spans = structure.spans("M")
+            sample_spans = structure.spans("S") or (
+                [(0, args.sample_length)] if args.i1 else None
+            )
+        else:
+            cb_spans = [(0, args.barcode_length)]
+            umi_spans = [
+                (args.barcode_length, args.barcode_length + args.umi_length)
+            ]
+            sample_spans = [(0, args.sample_length)] if args.i1 else None
+        stats = native.fastqprocess_native(
+            r1_files=args.r1,
+            r2_files=args.r2,
+            i1_files=args.i1,
+            output_prefix=args.output_prefix,
+            cb_spans=cb_spans,
+            umi_spans=umi_spans,
+            sample_spans=sample_spans,
+            whitelist=args.whitelist,
+            n_shards=n_shards,
+            output_format=args.output_format,
+            sample_id=args.sample_id,
+        )
+        print(
+            f"wrote {n_shards} {args.output_format} shard(s), "
+            f"{stats['total_reads']} reads",
+            file=sys.stderr,
+        )
+        return 0
+
+
+class BarcodePlatform(GenericPlatform):
+    """User-defined barcode geometry (generalizes TenXV2.attach_barcodes;
+    reference platform.py:761-1126)."""
+
+    cell_barcode: Optional[fastq.EmbeddedBarcode] = None
+    molecule_barcode: Optional[fastq.EmbeddedBarcode] = None
+    sample_barcode: Optional[fastq.EmbeddedBarcode] = None
+
+    @classmethod
+    def _validate_barcode_input(cls, given_value: int, min_value: int) -> int:
+        if given_value >= min_value:
+            return given_value
+        raise argparse.ArgumentTypeError("barcode length/position out of range")
+
+    @classmethod
+    def _validate_barcode_start_pos(cls, given_value) -> int:
+        return cls._validate_barcode_input(int(given_value), 0)
+
+    @classmethod
+    def _validate_barcode_length(cls, given_value) -> int:
+        return cls._validate_barcode_input(int(given_value), 1)
+
+    @classmethod
+    def _validate_barcode_length_and_position(
+        cls, barcode_start_position, barcode_length
+    ) -> None:
+        has_start = barcode_start_position is not None
+        has_length = barcode_length is not None
+        if has_start != has_length:
+            raise argparse.ArgumentTypeError(
+                "Invalid position/length, both position and length must be "
+                "provided by the user together"
+            )
+
+    @classmethod
+    def _validate_barcode_args(cls, args) -> None:
+        for start, length in (
+            (args.cell_barcode_start_pos, args.cell_barcode_length),
+            (args.molecule_barcode_start_pos, args.molecule_barcode_length),
+            (args.sample_barcode_start_pos, args.sample_barcode_length),
+        ):
+            cls._validate_barcode_length_and_position(start, length)
+        if args.whitelist is not None and args.cell_barcode_length is None:
+            raise argparse.ArgumentTypeError(
+                "A whitelist can only be provided with a cell barcode "
+                "position and length"
+            )
+        # a sample barcode lives in the i7 index read (reference
+        # platform.py:824-827)
+        if args.sample_barcode_length is not None and not args.i1:
+            raise argparse.ArgumentTypeError(
+                "An i7 index fastq file must be given to attach a sample barcode"
+            )
+        # cell and molecule barcodes must not overlap in r1 (reference
+        # platform.py:830-836: molecule must start at or after cell end)
+        if (
+            args.cell_barcode_length is not None
+            and args.molecule_barcode_length is not None
+        ):
+            cls._validate_barcode_input(
+                args.molecule_barcode_start_pos,
+                args.cell_barcode_start_pos + args.cell_barcode_length,
+            )
+
+    @classmethod
+    def _make_tag_generators(cls, r1, i1=None, whitelist=None) -> List:
+        tag_generators = []
+        if i1:
+            tag_generators.append(
+                fastq.EmbeddedBarcodeGenerator(
+                    fastq_files=i1, embedded_barcodes=[cls.sample_barcode]
+                )
+            )
+        if whitelist:
+            corrected_kwargs = dict(
+                fastq_files=r1,
+                whitelist=whitelist,
+                embedded_cell_barcode=cls.cell_barcode,
+            )
+            if cls.molecule_barcode:
+                corrected_kwargs.update(
+                    other_embedded_barcodes=[cls.molecule_barcode]
+                )
+            tag_generators.append(
+                fastq.BarcodeGeneratorWithCorrectedCellBarcodes(**corrected_kwargs)
+            )
+        else:
+            embedded = [
+                b for b in (cls.cell_barcode, cls.molecule_barcode) if b is not None
+            ]
+            if embedded:
+                tag_generators.append(
+                    fastq.EmbeddedBarcodeGenerator(
+                        fastq_files=r1, embedded_barcodes=embedded
+                    )
+                )
+        return tag_generators
+
+    @classmethod
+    def attach_barcodes(cls, args=None):
+        """Attach barcodes at user-specified positions
+        (reference platform.py:1004-1126)."""
+        start_type = cls._validate_barcode_start_pos
+        length_type = cls._validate_barcode_length
+        parser = _build_parser(
+            (
+                ("--r1",),
+                dict(
+                    required=True,
+                    help="fastq carrying the cell and molecule barcodes",
+                ),
+            ),
+            (
+                ("--u2",),
+                dict(
+                    required=True,
+                    help="unaligned bam holding the cDNA reads (picard "
+                    "FastqToSam of read 2)",
+                ),
+            ),
+            (
+                ("-o", "--output-bamfile"),
+                dict(required=True, help="where the tagged bam goes"),
+            ),
+            (
+                ("-w", "--whitelist"),
+                dict(
+                    default=None,
+                    help="cell barcode whitelist; when given, barcodes within "
+                    "hamming distance 1 of a whitelisted value also get a "
+                    "corrected CB tag",
+                ),
+            ),
+            (
+                ("--i1",),
+                dict(default=None, help="i7 index fastq carrying the sample barcode"),
+            ),
+            (
+                ("--sample-barcode-start-position",),
+                dict(
+                    dest="sample_barcode_start_pos",
+                    default=None,
+                    help="0-based position of the sample barcode in i1",
+                    type=start_type,
+                ),
+            ),
+            (
+                ("--sample-barcode-length",),
+                dict(
+                    dest="sample_barcode_length",
+                    default=None,
+                    help="base-pair length of the sample barcode",
+                    type=length_type,
+                ),
+            ),
+            (
+                ("--cell-barcode-start-position",),
+                dict(
+                    dest="cell_barcode_start_pos",
+                    default=None,
+                    help="0-based position of the cell barcode in r1",
+                    type=start_type,
+                ),
+            ),
+            (
+                ("--cell-barcode-length",),
+                dict(
+                    dest="cell_barcode_length",
+                    default=None,
+                    help="base-pair length of the cell barcode",
+                    type=length_type,
+                ),
+            ),
+            (
+                ("--molecule-barcode-start-position",),
+                dict(
+                    dest="molecule_barcode_start_pos",
+                    default=None,
+                    help="0-based position of the molecule barcode in r1 "
+                    "(must start at or after the cell barcode's end when "
+                    "both are given)",
+                    type=start_type,
+                ),
+            ),
+            (
+                ("--molecule-barcode-length",),
+                dict(
+                    dest="molecule_barcode_length",
+                    default=None,
+                    help="base-pair length of the molecule barcode",
+                    type=length_type,
+                ),
+            ),
+            (
+                ("--read-structure",),
+                dict(
+                    default=None,
+                    help="read-structure string describing r1, e.g. "
+                    "8C18X6C9M1X (C = cell, M = molecule, S = sample, "
+                    "X = skip); replaces the position/length arguments and "
+                    "supports split barcodes",
+                ),
+            ),
+        )
+        args = parser.parse_args(args)
+
+        if args.read_structure is not None:
+            if any(
+                value is not None
+                for value in (
+                    args.cell_barcode_start_pos,
+                    args.cell_barcode_length,
+                    args.molecule_barcode_start_pos,
+                    args.molecule_barcode_length,
+                    args.sample_barcode_start_pos,
+                    args.sample_barcode_length,
+                )
+            ):
+                raise argparse.ArgumentTypeError(
+                    "--read-structure replaces the barcode position/length arguments"
+                )
+            if args.i1:
+                raise argparse.ArgumentTypeError(
+                    "--read-structure describes r1 only; encode a sample "
+                    "barcode as an S segment instead of passing --i1"
+                )
+            structure = fastq.ReadStructure(args.read_structure)
+            if not structure.spans("S") and cls._attach_with_native(
+                args.r1, args.u2, args.output_bamfile,
+                structure.spans("C"), structure.spans("M"), [],
+                None, args.whitelist,
+            ):
+                return 0
+            generators = [
+                fastq.ReadStructureBarcodeGenerator(
+                    args.r1, args.read_structure, whitelist=args.whitelist
+                )
+            ]
+            cls._tag_bamfile(args.u2, args.output_bamfile, generators)
+            return 0
+
+        cls._validate_barcode_args(args)
+
+        if args.cell_barcode_length:
+            cls.cell_barcode = _embedded(
+                "cell",
+                args.cell_barcode_start_pos,
+                args.cell_barcode_start_pos + args.cell_barcode_length,
+            )
+        if args.molecule_barcode_length:
+            cls.molecule_barcode = _embedded(
+                "molecule",
+                args.molecule_barcode_start_pos,
+                args.molecule_barcode_start_pos + args.molecule_barcode_length,
+            )
+        if args.sample_barcode_length:
+            cls.sample_barcode = _embedded(
+                "sample",
+                args.sample_barcode_start_pos,
+                args.sample_barcode_start_pos + args.sample_barcode_length,
+            )
+
+        span_of = lambda b: [(b.start, b.end)] if b is not None else []
+        if cls._attach_with_native(
+            args.r1, args.u2, args.output_bamfile,
+            span_of(cls.cell_barcode), span_of(cls.molecule_barcode),
+            span_of(cls.sample_barcode), args.i1, args.whitelist,
+        ):
+            return 0
+        tag_generators = cls._make_tag_generators(args.r1, args.i1, args.whitelist)
+        cls._tag_bamfile(args.u2, args.output_bamfile, tag_generators)
+        return 0

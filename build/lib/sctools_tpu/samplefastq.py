@@ -1,0 +1,104 @@
+"""Downsample FASTQs to whitelist-correctable reads (samplefastq capability).
+
+Rebuild of the reference's samplefastq binary (fastqpreprocessing/src/
+samplefastq.cpp): reads paired R1/R2 fastqs, extracts the cell barcode by
+read structure, and re-emits ONLY the reads whose barcode corrects to the
+whitelist — R1 rewritten in the fixed slide-seq layout (barcode[0:8] +
+linker + barcode[8:14] + UMI + 'T', samplefastq.cpp:91-97), R2 passed
+through unchanged.
+
+Correction runs through the device whitelist kernel
+(sctools_tpu.ops.whitelist) in batches instead of the reference's per-read
+hash-map lookup.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+from .fastq import ReadStructure, Reader
+from .ops.whitelist import WhitelistCorrector
+
+# the fixed slide-seq spacer the reference hardcodes (samplefastq.cpp:94)
+SLIDESEQ_LINKER = "CTTCAGCGTTCCCGAGAG"
+_LINKER_QUALITY = "F" * len(SLIDESEQ_LINKER)
+
+_BATCH_SIZE = 1 << 14
+
+
+def sample_fastq(
+    r1_files: Union[str, List[str]],
+    r2_files: Union[str, List[str]],
+    whitelist_file: str,
+    read_structure: str,
+    output_prefix: str = "sampled_down",
+) -> Tuple[int, int]:
+    """Write ``<prefix>.R1`` / ``<prefix>.R2``; returns (kept, total) reads.
+
+    The R1 rewrite assumes the slide-seq split-barcode geometry the
+    reference assumes (8 + 6 barcode bases around the linker,
+    samplefastq.cpp:91-97).
+    """
+    structure = ReadStructure(read_structure)
+    if isinstance(r1_files, str):
+        r1_files = [r1_files]
+    if isinstance(r2_files, str):
+        r2_files = [r2_files]
+    from . import native
+
+    if native.available():
+        # native IO loop + device correction (byte-identical to the Python
+        # loop below, which is the pinned oracle — tests/test_fastq_metrics)
+        return native.sample_fastq_native(
+            r1_files, r2_files, whitelist_file,
+            structure.spans("C"), structure.spans("M"), output_prefix,
+        )
+    corrector = WhitelistCorrector.from_file(whitelist_file)
+
+    kept = 0
+    total = 0
+    with open(output_prefix + ".R1", "w") as out_r1, open(
+        output_prefix + ".R2", "w"
+    ) as out_r2:
+        batch: List[Tuple] = []
+
+        def flush():
+            nonlocal kept
+            corrected = corrector.correct([b[1] for b in batch])
+            for (r1, barcode, quality, umi, umi_quality, r2), fixed in zip(
+                batch, corrected
+            ):
+                if fixed is None:
+                    continue
+                kept += 1
+                # Record names always start with '@' (the setter enforces it)
+                name = r1.name[1:].split()[0]
+                out_r1.write(
+                    f"@{name}\n{barcode[:8]}{SLIDESEQ_LINKER}{barcode[8:]}"
+                    f"{umi}T\n+\n"
+                    f"{quality[:8]}{_LINKER_QUALITY}{quality[8:]}{umi_quality}F\n"
+                )
+                r2_name = r2.name[1:].split()[0]
+                out_r2.write(
+                    f"@{r2_name}\n{r2.sequence.rstrip()}\n+\n{r2.quality.rstrip()}\n"
+                )
+
+        # strict: a truncated shard must error, not silently drop the tail
+        for r1, r2 in zip(Reader(r1_files), Reader(r2_files), strict=True):
+            total += 1
+            batch.append(
+                (
+                    r1,
+                    structure.extract(r1.sequence, "C"),
+                    structure.extract(r1.quality, "C"),
+                    structure.extract(r1.sequence, "M"),
+                    structure.extract(r1.quality, "M"),
+                    r2,
+                )
+            )
+            if len(batch) >= _BATCH_SIZE:
+                flush()
+                batch = []
+        if batch:
+            flush()
+    return kept, total

@@ -1,0 +1,91 @@
+"""Statistics primitives: base-4 entropy and mergeable moment accumulators.
+
+Capability match for the reference stats layer (src/sctools/stats.py:24-103)
+with a different construction: the accumulator carries the classic
+(count, mean, M2) sufficient statistic, updates either one value at a time
+(numerically Welford — the reference's Python variant, which we take as
+ground truth over its sum-of-squares C++ variant, SURVEY.md section 5 quirk
+2), a whole vector at once, or by merging another accumulator (Chan's
+parallel combine — what the streaming/sharded pipelines need that the
+reference never had). The segment-parallel device equivalents live in
+sctools_tpu.metrics.device (_stacked_moments).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def base4_entropy(x, axis: int = 1) -> np.ndarray:
+    """Entropy in base 4 of a frequency matrix, bounded in [0, 1].
+
+    Rows (or the chosen axis) are normalized to probabilities; the
+    0*log(0)=0 convention applies.
+    """
+    x = np.asarray(x, dtype=float)
+    totals = np.sum(x, axis=axis, keepdims=True)
+    p = x / totals
+    log4p = np.zeros_like(p)
+    positive = p > 0
+    log4p[positive] = np.log(p[positive]) / np.log(4.0)
+    return np.abs(-np.sum(p * log4p, axis=axis))
+
+
+class OnlineGaussianSufficientStatistic:
+    """Mergeable (count, mean, M2) moment accumulator."""
+
+    __slots__ = ("_count", "_mean", "_m2")
+
+    def __init__(self, count: int = 0, mean: float = 0.0, m2: float = 0.0):
+        self._count = count
+        self._mean = mean
+        self._m2 = m2
+
+    def update(self, new_value: float) -> None:
+        """Fold in one observation (Welford step)."""
+        self._count += 1
+        step = new_value - self._mean
+        self._mean += step / self._count
+        self._m2 += step * (new_value - self._mean)
+
+    def update_batch(self, values) -> None:
+        """Fold in a vector of observations at once."""
+        values = np.asarray(values, dtype=float)
+        if values.size == 0:
+            return
+        self.merge(
+            OnlineGaussianSufficientStatistic(
+                count=int(values.size),
+                mean=float(values.mean()),
+                m2=float(((values - values.mean()) ** 2).sum()),
+            )
+        )
+
+    def merge(self, other: "OnlineGaussianSufficientStatistic") -> None:
+        """Combine another accumulator into this one (Chan's method)."""
+        if other._count == 0:
+            return
+        if self._count == 0:
+            self._count, self._mean, self._m2 = (
+                other._count, other._mean, other._m2,
+            )
+            return
+        total = self._count + other._count
+        delta = other._mean - self._mean
+        self._mean += delta * other._count / total
+        self._m2 += other._m2 + delta * delta * self._count * other._count / total
+        self._count = total
+
+    @property
+    def mean(self) -> float:
+        """Current mean (0.0 when nothing observed)."""
+        return self._mean
+
+    def calculate_variance(self) -> float:
+        """Sample variance; nan below two observations."""
+        return self._m2 / (self._count - 1) if self._count >= 2 else float("nan")
+
+    def mean_and_variance(self) -> Tuple[float, float]:
+        return self.mean, self.calculate_variance()

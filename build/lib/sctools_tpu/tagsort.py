@@ -1,0 +1,135 @@
+"""Out-of-core tag sorting: chunked sorts + k-way merge.
+
+The capability of the reference's TagSort binary (fastqpreprocessing/src/
+htslib_tagsort.cpp:466-486 writes sorted partial files, tagsort.cpp:144-294
+heap-merges them) for inputs that exceed memory. Phase 1 streams the BAM in
+bounded chunks, sorts each by (tags..., query name), and writes a sorted
+partial BAM; phase 2 merges the partials with a lazy k-way heap merge
+(``heapq.merge``) holding one record per partial in memory.
+
+Note the framework's compute paths do NOT need sorted files — the device
+metrics/count engines sort codes on device (sctools_tpu/metrics/device.py) —
+so this tool exists for interop with consumers of tag-sorted BAMs, exactly
+the role TagSort's sorted-output file plays in the reference pipeline.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import tempfile
+from typing import Iterator, List, Sequence
+
+from .bam import TagSortableRecord, sort_by_tags_and_queryname
+from .io.sam import AlignmentReader, AlignmentWriter
+
+DEFAULT_RECORDS_PER_CHUNK = 500_000
+
+
+def _sort_key(tag_keys):
+    def key(record):
+        sortable = TagSortableRecord.from_aligned_segment(record, tag_keys)
+        return (tuple(sortable.tag_values), sortable.query_name)
+
+    return key
+
+
+def _write_partial(records, header, tag_keys, directory, index) -> str:
+    path = os.path.join(directory, f"partial_{index:05d}.bam")
+    with AlignmentWriter(path, header, "wb") as writer:
+        for record in sort_by_tags_and_queryname(iter(records), tag_keys):
+            writer.write(record)
+    return path
+
+
+def _iter_partial(path: str) -> Iterator:
+    with AlignmentReader(path, "rb") as reader:
+        yield from reader
+
+
+def tag_sort_bam_out_of_core(
+    input_bam: str,
+    output_bam: str,
+    tag_keys: Sequence[str],
+    records_per_chunk: int = DEFAULT_RECORDS_PER_CHUNK,
+    compress_level: int = 1,
+) -> int:
+    """Sort ``input_bam`` by tags then query name with bounded memory.
+
+    Memory ~ ``records_per_chunk`` records (the reference's
+    alignments_per_batch knob, input_options.h:16) plus one record per
+    partial during the merge. Returns the number of records written.
+    Single-chunk inputs skip the partial-file round trip entirely.
+
+    BAM inputs keyed on a permutation of the barcode/umi/gene string tags —
+    the reference TagSort's entire key domain (htslib_tagsort.cpp TagOrder's
+    six permutations) — sort through the native C++ path: raw record bytes,
+    no record objects, at native speed. Anything else (SAM input, other tag
+    keys — which may hold integer values whose Python ordering is numeric,
+    not lexicographic — or no toolchain) uses the Python chunked sort + heap
+    merge below; note the Python writer uses its own default compression,
+    so ``compress_level`` only shapes the native path's output.
+    """
+    tag_keys = list(tag_keys)
+    string_tags = {"CB", "CR", "UB", "UR", "GE", "SR"}
+    if (
+        len(tag_keys) == 3
+        and set(tag_keys) <= string_tags
+        and not input_bam.endswith(".sam")
+    ):
+        from . import native
+        from .io import bgzf
+
+        if bgzf.is_gzip(input_bam) and native.available():
+            # level 1 default: a tag-sorted BAM is pipeline-intermediate
+            # (feeds metrics/counting); compression would otherwise dominate
+            # single-core wall time. Native errors PROPAGATE: the input gate
+            # above already covers every fall-back-able condition, and a
+            # real failure (malformed tags, truncated input, disk full)
+            # would only fail again — slower and less specifically — on the
+            # Python path.
+            return native.tagsort_native(
+                input_bam,
+                output_bam,
+                tag_keys,
+                batch_records=records_per_chunk,
+                compress_level=compress_level,
+            )
+    with tempfile.TemporaryDirectory(
+        prefix="tagsort_", dir=os.path.dirname(os.path.abspath(output_bam)) or "."
+    ) as tmpdir:
+        partials: List[str] = []
+        current: List = []
+        with AlignmentReader(input_bam, "rb") as reader:
+            header = reader.header.copy()
+            for record in reader:
+                current.append(record)
+                if len(current) >= records_per_chunk:
+                    partials.append(
+                        _write_partial(current, header, tag_keys, tmpdir, len(partials))
+                    )
+                    current = []
+
+        if not partials:
+            # whole file fit in one chunk: plain in-memory sort
+            with AlignmentWriter(output_bam, header, "wb") as writer:
+                for sorted_record in sort_by_tags_and_queryname(
+                    iter(current), tag_keys
+                ):
+                    writer.write(sorted_record)
+            return len(current)
+
+        if current:
+            partials.append(
+                _write_partial(current, header, tag_keys, tmpdir, len(partials))
+            )
+            current = []
+
+        n = 0
+        key = _sort_key(tag_keys)
+        streams = [_iter_partial(p) for p in partials]
+        with AlignmentWriter(output_bam, header, "wb") as writer:
+            for record in heapq.merge(*streams, key=key):
+                writer.write(record)
+                n += 1
+        return n

@@ -1,0 +1,11 @@
+"""Utility helpers: synthetic workloads, prefetching, compilation cache."""
+
+from .cache import enable_compilation_cache
+from .prefetch import prefetch_iterator
+from .synth import make_synthetic_columns
+
+__all__ = [
+    "enable_compilation_cache",
+    "make_synthetic_columns",
+    "prefetch_iterator",
+]

@@ -1,0 +1,39 @@
+"""Persistent XLA compilation cache setup.
+
+TPU compiles of the metrics/count programs take tens of seconds (more over a
+tunneled device); the persistent cache makes them one-time per machine. The
+reference has no equivalent concern (no compilation step); this is part of
+the TPU build's XLA-semantics design (SURVEY.md section 7).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(path: str = "") -> None:
+    """Point JAX at an on-disk compilation cache unless one is configured.
+
+    Respects an explicit ``jax_compilation_cache_dir`` (or the JAX env var);
+    ``SCTOOLS_TPU_XLA_CACHE=0`` disables. Safe to call any number of times,
+    before or after backends initialize.
+    """
+    env = os.environ.get("SCTOOLS_TPU_XLA_CACHE", "")
+    if env == "0":
+        return
+    import jax
+
+    if jax.config.jax_compilation_cache_dir:
+        return
+    # env values "1"/"" mean "enabled, default location"; anything else is
+    # an explicit cache path
+    env_path = env if env not in ("", "1") else ""
+    path = path or env_path or os.path.expanduser("~/.cache/sctools_tpu/xla")
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache everything that takes meaningful time; tiny programs stay in
+    # the in-memory cache only
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,76 @@
+"""Background-thread iterator prefetching.
+
+Overlaps host decode with device compute: while the consumer processes batch
+k on the device, the producer thread decodes batch k+1 (the native decoder
+releases the GIL inside ctypes calls, and the TPU works independently of the
+host either way). The role the reference's reader/writer thread pools play
+around its processing loops (fastq_common.cpp:30-40), reduced to one
+bounded-queue producer.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+_SENTINEL = object()
+
+
+def prefetch_iterator(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
+    """Yield from ``iterable``, producing up to ``depth`` items ahead.
+
+    Exceptions raised by the producer re-raise in the consumer at the point
+    of the failed item. When the consumer abandons the iterator (exception,
+    generator close), the producer notices via a stop event, closes the
+    underlying iterable if it is a generator (releasing e.g. a native stream
+    handle), and exits — nothing stays pinned for the process lifetime.
+    """
+    items: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def put_until_stopped(item) -> bool:
+        while not stop.is_set():
+            try:
+                items.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce() -> None:
+        try:
+            try:
+                for item in iterable:
+                    if not put_until_stopped(item):
+                        return
+            except BaseException as error:  # re-raised on the consumer side
+                put_until_stopped((_SENTINEL, error))
+            else:
+                put_until_stopped((_SENTINEL, None))
+        finally:
+            if stop.is_set():
+                close = getattr(iterable, "close", None)
+                if close is not None:
+                    close()
+
+    thread = threading.Thread(target=produce, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = items.get()
+            if (
+                isinstance(item, tuple)
+                and len(item) == 2
+                and item[0] is _SENTINEL
+            ):
+                error = item[1]
+                if error is not None:
+                    raise error
+                return
+            yield item
+    finally:
+        stop.set()
+        thread.join()
