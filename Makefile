@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: ci native lint test clean
+.PHONY: ci native lint test tpu-test clean
 
 ci: native lint test
 
@@ -21,6 +21,12 @@ lint:
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# hardware tier: the same kernels on the REAL accelerator (skips on CPU).
+# The main suite forces a virtual CPU mesh for the sharding tests, so this
+# is the only tier that exercises actual TPU lowering.
+tpu-test:
+	$(PY) -m pytest tpu_tests/ -q
 
 clean:
 	$(MAKE) -C sctools_tpu/native clean

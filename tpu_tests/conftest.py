@@ -1,0 +1,19 @@
+"""Hardware-gated tier: runs on the REAL accelerator, not the virtual mesh.
+
+The main suite (tests/) forces an 8-device virtual CPU platform — necessary
+for the sharding tests, but it means CI never exercises the actual TPU
+lowering of the MXU whitelist kernel or the metrics engine. This tier runs
+on whatever real device JAX finds (`make tpu-test`); it skips itself
+entirely when only CPU is available.
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    platform = jax.devices()[0].platform
+    if platform in ("cpu",):
+        skip = pytest.mark.skip(reason=f"no accelerator (platform={platform})")
+        for item in items:
+            item.add_marker(skip)
