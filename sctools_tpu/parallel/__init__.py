@@ -12,6 +12,7 @@ metric passes run under ``shard_map``, and re-keying between entity axes is an
 from .mesh import make_hybrid_mesh, make_mesh
 from .shard import partition_columns, shard_assignment
 from .count import sharded_count_molecules
+from .sort import distributed_sort, required_sort_capacity
 from .metrics import (
     collect_sharded_rows,
     distributed_metrics_step,
@@ -33,4 +34,6 @@ __all__ = [
     "distributed_metrics_step",
     "collect_sharded_rows",
     "required_reshard_capacity",
+    "distributed_sort",
+    "required_sort_capacity",
 ]

@@ -49,6 +49,7 @@ def reshard_by_key(
     axis_name: str,
     n_shards: int,
     capacity: Optional[int] = None,
+    drop_key: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """Move every record to shard ``code % n_shards`` via all_to_all.
 
@@ -69,7 +70,9 @@ def reshard_by_key(
     Returns ``(cols, n_dropped)``: records beyond an undersized capacity are
     dropped from the exchange, and ``n_dropped`` (a per-shard device scalar)
     counts them so callers can surface the loss after the jit boundary —
-    this function itself cannot raise under jit.
+    this function itself cannot raise under jit. ``drop_key`` excludes the
+    routing column itself from the exchange (for synthetic destination
+    columns the receiver has no use for).
     """
     local_size = cols[key].shape[0]
     if capacity is None:
@@ -95,7 +98,7 @@ def reshard_by_key(
     row = jnp.where(ok, sorted_dest, n_shards)
 
     # scatter each column into its send buffer, grouped by dtype
-    names = list(cols)
+    names = [n for n in cols if not (drop_key and n == key)]
     buffers: Dict[str, jnp.ndarray] = {}
     for name in names:
         scol = cols[name][order]

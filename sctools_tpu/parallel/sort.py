@@ -1,0 +1,214 @@
+"""Cross-device sample sort: a globally sorted order over the mesh.
+
+The device analog of the out-of-core TagSort merge when one device cannot
+hold the data (SURVEY.md section 2.3 maps the reference's k-way file merge,
+fastqpreprocessing/src/tagsort.cpp:144-294, to "on-device segmented sort +
+cross-device sample-sort/all_to_all"). Classic regular-sampling sample
+sort, entirely in XLA collectives:
+
+1. each shard sorts its slice locally (lexicographic, padding last);
+2. each shard contributes n_shards-1 evenly spaced sample keys; an
+   all_gather + sort of the pooled samples yields n_shards-1 global pivots
+   (identical on every shard — the pool is replicated);
+3. every record routes to shard ``count(pivots < key)`` through the same
+   capacity-bounded all_to_all exchange the metrics rekey uses
+   (``reshard_by_key``: one collective per dtype, on-device drop counter);
+4. each shard re-sorts what it received.
+
+Flattening the shards in mesh order then yields the global sort: shard i's
+keys are <= shard i+1's (records equal to a pivot all land on one side).
+Balance depends on the sampling; correctness does not. Extreme key skew
+(one key dominating) concentrates records on one shard and is surfaced by
+the capacity pre-flight / drop counter rather than silently truncated.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import segments as seg
+from .metrics import P, _check_shard_count, reshard_by_key
+
+_I32_MAX = np.iinfo(np.int32).max
+
+
+def _masked_keys(cols, key_names, local_size):
+    valid = cols["valid"].astype(bool)
+    return [
+        jnp.where(valid, cols[name].astype(jnp.int32), _I32_MAX)
+        for name in key_names
+    ]
+
+
+def _sample_positions(local_size: int, n_shards: int) -> np.ndarray:
+    """Evenly spaced sample indices into a locally sorted slice (host+device
+    agree on these by construction)."""
+    k = n_shards - 1
+    return ((np.arange(1, k + 1) * local_size) // n_shards).astype(np.int32)
+
+
+def _pivot_positions(pool_size: int, n_shards: int) -> np.ndarray:
+    return (
+        (np.arange(1, n_shards) * pool_size) // n_shards
+    ).astype(np.int32)
+
+
+def _dest_from_pivots(keys, pivot_cols) -> jnp.ndarray:
+    """count(pivot < key) per record, lexicographic over 1 or 2 key columns."""
+    k1 = keys[0][:, None]
+    p1 = pivot_cols[0][None, :]
+    less = p1 < k1
+    if len(keys) > 1:
+        k2 = keys[1][:, None]
+        p2 = pivot_cols[1][None, :]
+        less = less | ((p1 == k1) & (p2 < k2))
+    return jnp.sum(less.astype(jnp.int32), axis=1)
+
+
+def required_sort_capacity(
+    stacked_cols: Dict[str, np.ndarray],
+    key_names: List[str],
+    n_shards: int,
+) -> int:
+    """Max (src, dst) bucket size of the sample-sort exchange.
+
+    Host-side mirror of the device pivot computation (same sample and pivot
+    positions), so the all_to_all can run with a tight static capacity.
+    """
+    local_size = np.asarray(stacked_cols[key_names[0]]).shape[1]
+    valid = np.asarray(stacked_cols["valid"], dtype=bool)
+    keys = [
+        np.where(valid, np.asarray(stacked_cols[n], dtype=np.int64), _I32_MAX)
+        for n in key_names
+    ]
+    # pack lexicographic pairs into one comparable int64 (host only);
+    # biasing each int32 key to unsigned keeps negative values ordered the
+    # way the device's signed comparisons order them
+    bias = np.int64(1) << 31
+    packed = (keys[0] + bias) << 32
+    if len(keys) > 1:
+        packed = packed | (keys[1] + bias)
+    packed_sorted = np.sort(packed, axis=1)
+    samples = packed_sorted[:, _sample_positions(local_size, n_shards)]
+    pool = np.sort(samples.reshape(-1))
+    pivots = pool[_pivot_positions(pool.size, n_shards)]
+    most = 0
+    for s in range(n_shards):
+        row = packed[s][valid[s]]
+        # the device rule exactly: count(pivot < key), equal-to-pivot keys
+        # route right
+        dest = (pivots[None, :] < row[:, None]).sum(axis=1)
+        if dest.size:
+            most = max(most, int(np.bincount(dest, minlength=n_shards).max()))
+    return most
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sample_sort(
+    mesh,
+    key_names: Tuple[str, ...],
+    n_shards: int,
+    axis_name: str,
+    capacity: int,
+):
+    """Compiled sample-sort step, cached per (mesh, shape, capacity)."""
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis_name),),
+        out_specs=(P(axis_name), P(axis_name)),
+        check_vma=False,
+    )
+    def run(stacked):
+        local = {k: v[0] for k, v in stacked.items()}
+        local_size = local[key_names[0]].shape[0]
+
+        # 1. local sort (payload rides the permutation once)
+        perm = seg.sort_permutation(_masked_keys(local, key_names, local_size))
+        local = {k: v[perm] for k, v in local.items()}
+        keys = _masked_keys(local, key_names, local_size)
+
+        # 2. pooled samples -> identical pivots everywhere
+        sample_at = jnp.asarray(_sample_positions(local_size, n_shards))
+        samples = [k[sample_at] for k in keys]
+        pools = [
+            jax.lax.all_gather(s, axis_name).reshape(-1) for s in samples
+        ]
+        pools = jax.lax.sort(pools, num_keys=len(pools))
+        pivot_at = jnp.asarray(_pivot_positions(pools[0].shape[0], n_shards))
+        pivots = [p[pivot_at] for p in pools]
+
+        # 3. capacity-bounded exchange by pivot bucket
+        local = dict(local)
+        local["_dest"] = _dest_from_pivots(keys, pivots)
+        exchanged, n_dropped = reshard_by_key(
+            local, "_dest", axis_name, n_shards, capacity=capacity,
+            drop_key=True,  # the receiver has no use for the routing column
+        )
+
+        # 4. local re-sort of the received records
+        new_size = exchanged[key_names[0]].shape[0]
+        perm = seg.sort_permutation(
+            _masked_keys(exchanged, key_names, new_size)
+        )
+        exchanged = {k: v[perm] for k, v in exchanged.items()}
+        return (
+            {k: v[None] for k, v in exchanged.items()},
+            n_dropped[None],
+        )
+
+    return jax.jit(run)
+
+
+def distributed_sort(
+    stacked_cols: Dict[str, np.ndarray],
+    key_names: List[str],
+    mesh: jax.sharding.Mesh,
+    axis_name: str = "shard",
+    capacity: Optional[int] = None,
+):
+    """Sort sharded columns globally by 1-2 int32 key columns.
+
+    ``stacked_cols``: [n_shards, S] columns including ``valid``. Returns
+    columns of shape [n_shards, n_shards * capacity]: each shard locally
+    sorted, shards ascending in mesh order — flattening valid rows in shard
+    order is the global sort. Raises when an undersized ``capacity`` would
+    drop records (tight default computed host-side from concrete input;
+    a worst-case shard-size fallback is used under tracing).
+    """
+    n_shards, shard_size = stacked_cols[key_names[0]].shape
+    _check_shard_count(n_shards, mesh, axis_name)
+    concrete = not isinstance(
+        stacked_cols[key_names[0]], jax.core.Tracer
+    )
+    if concrete:
+        required = required_sort_capacity(stacked_cols, key_names, n_shards)
+        if capacity is None:
+            # bucketed so streaming batches of similar skew reuse one
+            # compiled program instead of recompiling per exact capacity
+            capacity = seg.bucket_size(max(required, 1), minimum=8)
+        elif capacity < required:
+            raise ValueError(
+                f"sort capacity={capacity} too small: a (src,dst) bucket "
+                f"holds {required} records"
+            )
+    elif capacity is None:
+        capacity = shard_size
+    out, dropped = _build_sample_sort(
+        mesh, tuple(key_names), n_shards, axis_name, capacity
+    )(stacked_cols)
+    if not isinstance(dropped, jax.core.Tracer):
+        n_dropped = int(np.asarray(dropped).sum())
+        if n_dropped:
+            raise RuntimeError(
+                f"distributed sort dropped {n_dropped} records: raise "
+                "capacity (extreme key skew concentrates records on one "
+                "shard)"
+            )
+    return out
