@@ -25,10 +25,13 @@ record batch:
    - ``count == 1`` / ``count > 1`` histogram predicates: two shifted
      run-start flag vectors (ops.segments.run_is_singleton/plural) — no
      per-run reduction at all;
-   - only the float quality moments keep a (stacked) record-order
-     ``segment_sum``: scan trees re-associate f32 additions, which would
-     make output bytes depend on batch offsets; the scatter accumulates in
-     record order, keeping CSV bytes identical across batch splits.
+   - float quality moments ride the same scans: a Hillis-Steele segment
+     total's combine tree depends only on positions RELATIVE to the
+     segment (both the stride offsets and the boundary gating), so a
+     segment's f32 result is a pure function of its own records and
+     length — identical wherever the entity lands in a batch, which is
+     exactly the byte-stability-across-batch-splits guarantee
+     (empirically pinned by tests/test_streaming.py).
 
 Record flags travel bit-packed in one int16 ``flags`` column (see
 ``io.packed.pack_flags``): a 1M-record batch ships ~7 fewer byte-wide
@@ -101,31 +104,27 @@ def _unpack_frac(packed: jnp.ndarray, shift: int) -> jnp.ndarray:
 
 
 def _stacked_moments(
-    columns, valid: jnp.ndarray, outer_ids: jnp.ndarray, num_segments: int,
-    count: jnp.ndarray,
+    columns, valid: jnp.ndarray, outer_ids: jnp.ndarray,
+    outer_bounds, count: jnp.ndarray,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-segment (means, sample variances) of stacked float columns.
 
     Two-pass centered moments (as stable as Welford, embarrassingly
     parallel; the variance convention matches the Python reference: sample
     variance, nan below two observations — stats.py:94-99, deliberately not
-    the C++ sum-of-squares variant, SURVEY.md section 5 quirk 2). The two
-    reductions are record-order scatters on purpose — see the module
-    docstring — but stacked, so the pass costs 2 scatters total instead of
-    2 per metric.
+    the C++ sum-of-squares variant, SURVEY.md section 5 quirk 2). Both
+    reductions ride the segmented scans (see the module docstring for why
+    the f32 results stay batch-offset-independent); ``outer_ids`` only
+    broadcasts the means back per record for the centering pass.
     """
     stacked = jnp.stack(columns, axis=1)
     masked = jnp.where(valid[:, None], stacked, 0.0)
-    totals = jax.ops.segment_sum(
-        masked, outer_ids, num_segments=num_segments, indices_are_sorted=True
-    )
+    totals = outer_bounds.sum(masked)
     safe_count = jnp.maximum(count, 1).astype(stacked.dtype)[:, None]
     means = jnp.where(count[:, None] > 0, totals / safe_count, 0.0)
     centered = stacked - means[outer_ids]
     sq = jnp.where(valid[:, None], centered * centered, 0.0)
-    m2 = jax.ops.segment_sum(
-        sq, outer_ids, num_segments=num_segments, indices_are_sorted=True
-    )
+    m2 = outer_bounds.sum(sq)
     variances = jnp.where(
         count[:, None] >= 2,
         m2 / jnp.maximum(count - 1, 1).astype(stacked.dtype)[:, None],
@@ -345,7 +344,7 @@ def compute_entity_metrics(
     n_fragments = sorted_sums[:, 2]
     frag_single = sorted_sums[:, 3]
 
-    # ---- float quality moments: two stacked record-order scatters --------
+    # ---- float quality moments: same stacked segmented scans -------------
     if prepacked:
         gshift = 16 if wide_genomic else 8
         glen = (
@@ -374,7 +373,7 @@ def compute_entity_metrics(
         quality_cols,
         valid,
         outer_ids,
-        num_segments,
+        outer_bounds,
         n_reads,
     )
 
