@@ -88,12 +88,18 @@ def bench_end_to_end(bam_path: str, profile: bool = False) -> dict:
 
     out = "/tmp/sctools_tpu_bench_out.csv.gz"
 
+    bytes_moved = {}
+
     def run() -> float:
         start = time.perf_counter()
-        GatherCellMetrics(
+        gatherer = GatherCellMetrics(
             bam_path, out, backend="device", batch_records=BATCH_RECORDS
-        ).extract_metrics()
-        return time.perf_counter() - start
+        )
+        gatherer.extract_metrics()
+        elapsed = time.perf_counter() - start
+        bytes_moved["h2d"] = gatherer.bytes_h2d
+        bytes_moved["d2h"] = gatherer.bytes_d2h
+        return elapsed
 
     import statistics
 
@@ -106,7 +112,7 @@ def bench_end_to_end(bam_path: str, profile: bool = False) -> dict:
         # runs minutes apart (BASELINE.md caveats); the median is a
         # defensible single-number summary where any one draw is weather
         timed = statistics.median(run() for _ in range(3))
-    return {"end_to_end_s": timed, "warm_s": warm}
+    return {"end_to_end_s": timed, "warm_s": warm, **bytes_moved}
 
 
 def bench_decode_only(bam_path: str) -> float:
@@ -248,24 +254,42 @@ def main():
     timings = bench_end_to_end(bam_path, profile=profile)
     cells_per_sec = N_CELLS / timings["end_to_end_s"]
 
+    link = bench_link_bandwidth()
     result = {
         "metric": "calculate_cell_metrics_end_to_end",
         "value": round(cells_per_sec, 2),
         "unit": "cells/sec",
         "vs_baseline": round(cells_per_sec / cpu_cells_per_sec, 2),
         # measured link weather: the headline's dominant environmental term
-        "link_MBps": bench_link_bandwidth(),
+        "link_MBps": link,
     }
     if breakdown:
         decode_s = bench_decode_only(bam_path)
         compute_s = bench_compute_only()
         n_reads = N_CELLS * MOLECULES_PER_CELL * READS_PER_MOLECULE
+        # transfer-floor accounting: the pipeline ships bytes_h2d up and
+        # bytes_d2h down per run (monoblock wire, gatherer counters). The
+        # serial floor is what those bytes cost at the measured bandwidth
+        # if nothing overlapped; the duplex floor if the two directions
+        # fully overlap. end_to_end_s at/near the floor means compute,
+        # decode and CSV are hidden behind the link and the headline is
+        # the link's number, not the code's.
+        floor_h2d = timings["h2d"] / (link["h2d_MBps"] * 1e6)
+        floor_d2h = timings["d2h"] / (link["d2h_MBps"] * 1e6)
         result["breakdown"] = {
             "end_to_end_s": round(timings["end_to_end_s"], 3),
             "decode_only_s": round(decode_s, 3),
             "decode_rec_per_s": round(n_reads / decode_s),
             "compute_only_s_per_1M_batch": round(compute_s, 3),
             "cpu_baseline_cells_per_s": round(cpu_cells_per_sec, 2),
+            "bytes_h2d": timings["h2d"],
+            "bytes_d2h": timings["d2h"],
+            "wire_bytes_per_record": round(timings["h2d"] / n_reads, 1),
+            "transfer_floor_serial_s": round(floor_h2d + floor_d2h, 3),
+            "transfer_floor_duplex_s": round(max(floor_h2d, floor_d2h), 3),
+            "exposed_nontransfer_s": round(
+                max(0.0, timings["end_to_end_s"] - floor_h2d - floor_d2h), 3
+            ),
         }
     print(json.dumps(result))
 

@@ -68,6 +68,31 @@ KEY_CODE_MASK = (1 << KEY_CODE_BITS) - 1
 KEY_UNMAPPED_SHIFT = 30
 
 
+def wire_layout(wide_genomic: bool, small_ref: bool):
+    """Ordered (column name, lane width) spec of the monoblock wire.
+
+    The SINGLE source of truth for the one-int32-buffer batch transport:
+    the host packer (metrics.gatherer._pack_wire) and the device unpacker
+    (metrics.device._unpack_wire) both iterate this list, so section order
+    can never drift between the two sides. Widths are bytes per record
+    (4 = int32/uint32 lane, 2 = uint16 lane, 1 = uint8 lane); wider lanes
+    come first so every section stays 4-byte aligned for any padded record
+    count that is a multiple of 4. ``n_valid`` is a single leading int32
+    word, not a per-record lane, and is listed separately by both sides.
+    """
+    cols = [("key_hi", 4), ("key_lo", 4), ("ps", 4)]
+    if wide_genomic:
+        cols += [("genomic_qual", 4), ("genomic_total", 4)]
+    if not small_ref:
+        cols.append(("m_ref", 4))
+    cols += [("umi_qual", 2), ("cb_qual", 2), ("flags", 2)]
+    if not wide_genomic:
+        cols += [("genomic_qual", 2), ("genomic_total", 2)]
+    if small_ref:
+        cols.append(("m_ref", 1))
+    return cols
+
+
 # 3-bit-per-base packed barcodes (the native decoder's scheme,
 # native/bamdecode.cpp kBaseCode): A=1 C=2 G=3 N=4 T=5, left-aligned in a
 # uint64, so integer order == byte-lexicographic string order and ""

@@ -64,6 +64,7 @@ from ..io.packed import (
     KEY_CODE_BITS,
     KEY_HI_SHIFT,
     KEY_UNMAPPED_SHIFT,
+    wire_layout,
 )
 from ..ops import segments as seg
 
@@ -133,6 +134,43 @@ def _stacked_moments(
     return means, variances
 
 
+def _unpack_wire(
+    wire: jnp.ndarray,
+    num_segments: int,
+    wide_genomic: bool,
+    small_ref: bool,
+) -> Dict[str, jnp.ndarray]:
+    """Monoblock wire -> the prepacked named columns (zero-copy bitcasts).
+
+    The tunneled host<->device link charges ~85 ms of fixed overhead per
+    transferred buffer on top of bandwidth (measured; BASELINE.md), so the
+    gatherer ships each batch as ONE int32 block (metrics.gatherer._pack_wire
+    builds it; layout documented there) instead of nine arrays. Slicing plus
+    ``lax.bitcast_convert_type`` recovers every column exactly — the bitcast
+    bit order matches the host's little-endian numpy views.
+    """
+    n = num_segments
+    cols: Dict[str, jnp.ndarray] = {"n_valid": wire[:1]}
+    off = 1
+    for name, width in wire_layout(wide_genomic, small_ref):
+        words = n * width // 4
+        chunk = wire[off : off + words]  # offsets are Python ints: static
+        off += words
+        if width == 4:
+            col = (
+                jax.lax.bitcast_convert_type(chunk, jnp.uint32)
+                if name in ("genomic_qual", "genomic_total")
+                else chunk
+            )
+        else:
+            lane = jnp.uint16 if width == 2 else jnp.uint8
+            col = jax.lax.bitcast_convert_type(chunk, lane).reshape(n)
+            if name == "flags":
+                col = col.astype(jnp.int16)
+        cols[name] = col
+    return cols
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -191,6 +229,11 @@ def compute_entity_metrics(
         raise ValueError(f"kind must be 'cell' or 'gene', got {kind!r}")
     if prepacked and not presorted:
         raise ValueError("prepacked batches must also be presorted")
+
+    if prepacked and tuple(cols) == ("wire",):
+        # monoblock transport: one int32 buffer carrying every prepacked
+        # column (gatherer._pack_wire layout) — bitcast back to names here
+        cols = _unpack_wire(cols["wire"], num_segments, wide_genomic, small_ref)
 
     if prepacked:
         # host shipped the four packed sort operands plus a scalar valid
@@ -486,3 +529,24 @@ def compact_results(
         [result[name][:k].astype(jnp.float32) for name in float_names], axis=1
     )
     return ints, floats
+
+
+@functools.partial(jax.jit, static_argnames=("int_names", "float_names", "k"))
+def compact_results_wire(
+    result: Dict[str, jnp.ndarray],
+    int_names: Tuple[str, ...],
+    float_names: Tuple[str, ...],
+    k: int,
+) -> jnp.ndarray:
+    """compact_results fused into ONE [k, n_int + n_float] int32 pull.
+
+    The float block travels as its exact float32 bit pattern
+    (``bitcast_convert_type``) so a single device->host transfer replaces
+    two — each buffer pays ~85 ms of fixed tunnel overhead regardless of
+    size (BASELINE.md) — with zero precision risk: the host views the
+    float columns back via ``ndarray.view(np.float32)``, bit-identical.
+    """
+    ints, floats = compact_results(result, int_names, float_names, k)
+    return jnp.concatenate(
+        [ints, jax.lax.bitcast_convert_type(floats, jnp.int32)], axis=1
+    )

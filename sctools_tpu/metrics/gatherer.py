@@ -29,6 +29,7 @@ from ..io.packed import (
     iter_frames_from_bam,
     pack_flags,
     slice_frame,
+    wire_layout,
 )
 from ..io.sam import AlignmentReader
 from ..ops.segments import bucket_size
@@ -53,6 +54,7 @@ def _pad_columns(
     prepacked_keys: tuple = None,
     pair_mito: bool = False,
     small_ref: bool = False,
+    force_wide_genomic: bool = False,
 ):
     """ReadFrame -> (device-ready padded columns, static engine flags).
 
@@ -123,7 +125,14 @@ def _pad_columns(
         k2 = (k2 << 1) | is_mito[frame.gene].astype(np.int32)
     mapped = ~np.asarray(frame.unmapped, dtype=bool)
     genomic_len = frame.genomic_qual & np.uint32(0xFFFF)
-    narrow_genomic = bool(genomic_len.max(initial=0) <= 0xFF)
+    # ``force_wide_genomic`` is the gatherer's one-way ratchet: once any
+    # batch needed the wide u32 genomic columns, later batches PACK wide
+    # too, so the emitted columns always agree with the static flags the
+    # device unpacks by (a narrow-packed batch under a wide flag would
+    # shear the monoblock wire layout)
+    narrow_genomic = not force_wide_genomic and bool(
+        genomic_len.max(initial=0) <= 0xFF
+    )
     if narrow_genomic:
         gq = ((frame.genomic_qual >> np.uint32(16)) << np.uint32(8)) | genomic_len
         cols.update(
@@ -164,6 +173,34 @@ def _pad_columns(
     return cols, {"wide_genomic": not narrow_genomic, "small_ref": small_ref}
 
 
+def _pack_wire(cols: Dict[str, np.ndarray], static_flags: dict) -> np.ndarray:
+    """Prepacked named columns -> ONE contiguous int32 wire block.
+
+    The tunneled host<->device link charges a fixed ~85 ms per transferred
+    buffer on top of bandwidth (measured round 5; BASELINE.md): nine
+    per-column uploads per batch cost ~0.7 s of pure overhead. This packs
+    every prepacked column into a single int32 buffer the device bit-slices
+    back apart (metrics.device._unpack_wire — the numpy little-endian views
+    here match ``lax.bitcast_convert_type`` bit order exactly).
+
+    The section order and widths come from io.packed.wire_layout — the one
+    shared spec both this packer and metrics.device._unpack_wire iterate,
+    after a single leading n_valid word.
+    """
+    layout = wire_layout(
+        bool(static_flags.get("wide_genomic")),
+        bool(static_flags.get("small_ref")),
+    )
+    parts = [cols["n_valid"]]
+    for name, width in layout:
+        col = cols[name]
+        parts.append(
+            col if width == 4 and col.dtype == np.int32
+            else np.ascontiguousarray(col).view(np.int32)
+        )
+    return np.concatenate(parts)
+
+
 class MetricGatherer:
     """Common driver: pack, compute on the selected backend, write csv."""
 
@@ -193,6 +230,10 @@ class MetricGatherer:
         self._backend = backend
         self._batch_records = batch_records
         self._frame_source = frame_source
+        # device-path transfer accounting (bench.py --breakdown reads these
+        # to compare the measured wall against the bytes/bandwidth floor)
+        self.bytes_h2d = 0
+        self.bytes_d2h = 0
 
     @property
     def bam_file(self) -> str:
@@ -321,9 +362,7 @@ class MetricGatherer:
                 )
             )
             if len(pending) > self._PIPELINE_DEPTH:
-                self._finalize_device_batch(
-                    *pending.popleft(), device_engine, out
-                )
+                self._finalize_device_batch(*pending.popleft(), out)
             # compact, or the carried vocabularies would accumulate the
             # union of every batch seen so far
             carry = compact_frame(slice_frame(frame, cut, frame.n_records))
@@ -331,16 +370,21 @@ class MetricGatherer:
             tail_key = (
                 carry.cell if self.entity_kind == "cell" else carry.gene
             )
+            # the tail pads to its OWN bucket, not the full batch capacity:
+            # a 65k-record tail padded to 512k ships ~12 MB of dead wire
+            # bytes over a link that is the measured end-to-end floor. The
+            # extra compile for the tail shape amortizes across runs via
+            # the persistent compilation cache.
             pending.append(
                 self._dispatch_device_batch(
                     carry,
                     device_engine,
-                    pad_to=bucket_size(self._batch_records) if multi_batch else 0,
+                    pad_to=bucket_size(carry.n_records) if multi_batch else 0,
                     presorted=bool(np.all(tail_key[1:] >= tail_key[:-1])),
                 )
             )
         while pending:
-            self._finalize_device_batch(*pending.popleft(), device_engine, out)
+            self._finalize_device_batch(*pending.popleft(), out)
 
     def _dispatch_device_batch(
         self, frame: ReadFrame, device_engine, pad_to: int, presorted: bool = True
@@ -388,15 +432,23 @@ class MetricGatherer:
             prepacked_keys=key_order if prepacked else None,
             pair_mito=self.entity_kind == "cell",
             small_ref=self._small_ref,
+            force_wide_genomic=self._wide_genomic,
         )
         if static_flags.get("wide_genomic"):
             # one-way ratchet: once any batch needs the wide genomic
-            # columns, later batches stay wide (at most one extra compile
-            # per run instead of flapping between schemas)
+            # columns, later batches pack and compute wide too (at most one
+            # extra compile per run instead of flapping between schemas);
+            # threading the ratchet INTO _pad_columns keeps the packed
+            # dtypes and the static flags in agreement always
             self._wide_genomic = True
-        if self._wide_genomic:
-            static_flags["wide_genomic"] = True
         num_segments = len(cols["flags"])
+        if prepacked:
+            # monoblock transport: one upload per batch instead of nine
+            # (each buffer pays fixed tunnel overhead; _pack_wire docs)
+            cols = {"wire": _pack_wire(cols, static_flags)}
+            self.bytes_h2d += cols["wire"].nbytes
+        else:
+            self.bytes_h2d += sum(np.asarray(v).nbytes for v in cols.values())
         result = device_engine.compute_entity_metrics(
             {k: np.asarray(v) for k, v in cols.items()},
             num_segments=num_segments,
@@ -405,27 +457,47 @@ class MetricGatherer:
             prepacked=prepacked,
             **static_flags,
         )
-        # keep only what finalize reads: pinning the whole frame would hold
-        # ~40 MB of record arrays per in-flight batch for no reason
-        return self._entity_names(frame), result, num_segments
-
-    def _finalize_device_batch(
-        self, entity_names, result, num_segments: int, device_engine, out
-    ) -> None:
-        # compact device->host transfer: pull only (a bucketed bound on) the
-        # real entity rows, as two stacked arrays instead of 38 padded ones
-        n_entities = int(result["n_entities"])
+        # the entity count is host-knowable (distinct outer keys in the
+        # slice), so the compacting pull dispatches HERE, async with the
+        # batch's compute — finalize then blocks on exactly one transfer
+        # instead of a round trip for n_entities plus a second for the rows
+        # (each round trip costs ~100 ms on the tunneled link)
+        key = frame.cell if self.entity_kind == "cell" else frame.gene
+        if presorted:
+            n_entities = int(np.count_nonzero(key[1:] != key[:-1])) + 1
+        else:
+            n_entities = int(np.unique(key).size)
         k = min(bucket_size(n_entities, minimum=1024), num_segments)
         int_names = ("entity_code",) + tuple(
             c for c in self.columns if c in INT_COLUMNS
         )
         float_names = tuple(c for c in self.columns if c not in INT_COLUMNS)
-        ints, floats = device_engine.compact_results(
+        block = device_engine.compact_results_wire(
             result, int_names, float_names, k
         )
+        # keep only what finalize reads: pinning the whole frame or the full
+        # result dict would hold ~40 MB of arrays per in-flight batch
+        return (
+            self._entity_names(frame), block, n_entities,
+            int_names, float_names,
+        )
+
+    def _finalize_device_batch(
+        self, entity_names, block, n_entities: int, int_names, float_names,
+        out,
+    ) -> None:
+        # ONE blocking pull per batch: entity rows already compacted on
+        # device into a fused [k, ints+floats] int32 block (float32 bits
+        # bitcast onto the int lanes; viewed back exactly below)
+        block = np.asarray(block)
+        self.bytes_d2h += block.nbytes
+        ints = block[:, : len(int_names)]
+        floats = np.ascontiguousarray(
+            block[:, len(int_names):]
+        ).view(np.float32)
         self._write_device_rows(
             entity_names, n_entities, int_names, float_names,
-            np.asarray(ints), np.asarray(floats), out,
+            ints, floats, out,
         )
 
     def _entity_names(self, frame: ReadFrame) -> List[str]:

@@ -304,3 +304,47 @@ def test_prepacked_wide_fallbacks_match_plain(tmp_path):
             np.asarray(a[key]), np.asarray(b[key]),
             rtol=1e-6, atol=0, equal_nan=True, err_msg=key,
         )
+
+
+def test_wide_genomic_ratchet_across_batches(tmp_path):
+    """A wide-genomic early batch must not shear later narrow batches.
+
+    Once any batch needs the wide u32 genomic columns the gatherer's
+    one-way ratchet keeps every later batch wide; a later batch whose own
+    data is narrow must therefore also PACK wide, or the monoblock wire
+    the device slices by static offsets would come up short (regression:
+    round-5 review finding)."""
+    import random as _random
+
+    rng = _random.Random(11)
+    cells = sorted(
+        "".join(rng.choice("ACGT") for _ in range(8)) for _ in range(9)
+    )
+    records = []
+    for idx, cb in enumerate(cells):
+        # only the FIRST cell's reads have >255 aligned bases (wide);
+        # every later batch is narrow on its own data
+        seq = "ACGT" * (80 if idx == 0 else 20)
+        for i in range(6):
+            records.append(
+                make_record(
+                    name=f"{cb}{i}", cb=cb, cr=cb, cy="IIII",
+                    ub="".join(rng.choice("ACGT") for _ in range(4)),
+                    ur="ACGT", uy="IIII", ge=rng.choice(["G1", "G2"]),
+                    xf="CODING", nh=1, pos=rng.randrange(1000),
+                    sequence=seq,
+                )
+            )
+    bam = write_bam(str(tmp_path / "ratchet.bam"), records)
+    dev = tmp_path / "dev.csv.gz"
+    cpu = tmp_path / "cpu.csv.gz"
+    # batch_records small enough that the wide cell fills batch 0 alone
+    GatherCellMetrics(
+        bam, str(dev), backend="device", batch_records=8
+    ).extract_metrics()
+    GatherCellMetrics(bam, str(cpu), backend="cpu").extract_metrics()
+    import pandas as pd
+
+    d = pd.read_csv(dev, index_col=0).sort_index()
+    c = pd.read_csv(cpu, index_col=0).sort_index()
+    pd.testing.assert_frame_equal(d, c, rtol=1e-5, atol=1e-6, check_dtype=False)
