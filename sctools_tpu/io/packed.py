@@ -52,6 +52,10 @@ FLAG_PUMI_SHIFT = 7  # 2 bits: stored value+1 (-1 absent / 0 / 1 -> 0,1,2)
 FLAG_PCB_SHIFT = 9  # 2 bits: same encoding
 FLAG_NH1_SHIFT = 11  # 1 bit: NH tag present and == 1
 FLAG_MITO = 1 << 12  # gene is mitochondrial (host vocabulary lookup)
+# 1 bit: first record of a (k1,k2,k3) molecule run (run-keyed wire only —
+# the per-record sort keys then live in a per-run table the device gathers
+# back through cumsum of these bits; metrics.gatherer._pad_columns sets it)
+FLAG_RUN_START = 1 << 13
 
 # Packed device-sort key layout, shared by the host packer
 # (metrics.gatherer._pad_columns) and the device unpacker
@@ -68,7 +72,7 @@ KEY_CODE_MASK = (1 << KEY_CODE_BITS) - 1
 KEY_UNMAPPED_SHIFT = 30
 
 
-def wire_layout(wide_genomic: bool, small_ref: bool):
+def wire_layout(wide_genomic: bool, small_ref: bool, run_keys: bool = False):
     """Ordered (column name, lane width) spec of the monoblock wire.
 
     The SINGLE source of truth for the one-int32-buffer batch transport:
@@ -79,8 +83,17 @@ def wire_layout(wide_genomic: bool, small_ref: bool):
     come first so every section stays 4-byte aligned for any padded record
     count that is a multiple of 4. ``n_valid`` is a single leading int32
     word, not a per-record lane, and is listed separately by both sides.
+
+    With ``run_keys`` the two per-record sort-key lanes move OFF the wire:
+    records of one (k1,k2,k3) molecule run are adjacent in the sorted
+    input, so the keys ship once per run in a trailing table —
+    ``key_hi_runs`` then ``key_lo_runs``, each ``num_runs`` (a padded
+    bucket) int32 words appended after these per-record lanes — and each
+    record's FLAG_RUN_START bit rebuilds the record->run mapping on
+    device. ~8 bytes/record becomes ~8 bytes/run.
     """
-    cols = [("key_hi", 4), ("key_lo", 4), ("ps", 4)]
+    cols = [] if run_keys else [("key_hi", 4), ("key_lo", 4)]
+    cols.append(("ps", 4))
     if wide_genomic:
         cols += [("genomic_qual", 4), ("genomic_total", 4)]
     if not small_ref:

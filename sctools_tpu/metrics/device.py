@@ -60,6 +60,7 @@ from ..io.packed import (
     FLAG_NH1_SHIFT,
     FLAG_PCB_SHIFT,
     FLAG_PUMI_SHIFT,
+    FLAG_RUN_START,
     FLAG_XF_SHIFT,
     KEY_CODE_BITS,
     KEY_HI_SHIFT,
@@ -139,6 +140,7 @@ def _unpack_wire(
     num_segments: int,
     wide_genomic: bool,
     small_ref: bool,
+    num_runs: int = 0,
 ) -> Dict[str, jnp.ndarray]:
     """Monoblock wire -> the prepacked named columns (zero-copy bitcasts).
 
@@ -152,7 +154,7 @@ def _unpack_wire(
     n = num_segments
     cols: Dict[str, jnp.ndarray] = {"n_valid": wire[:1]}
     off = 1
-    for name, width in wire_layout(wide_genomic, small_ref):
+    for name, width in wire_layout(wide_genomic, small_ref, bool(num_runs)):
         words = n * width // 4
         chunk = wire[off : off + words]  # offsets are Python ints: static
         off += words
@@ -168,6 +170,22 @@ def _unpack_wire(
             if name == "flags":
                 col = col.astype(jnp.int16)
         cols[name] = col
+    if num_runs:
+        # run-keyed wire: rebuild per-record sort keys from the trailing
+        # per-run table through cumsum of the FLAG_RUN_START bits (gather
+        # over a small table; sub-ms at 512k records). Padding records
+        # carry no start bit and clamp to the last real run — masked to
+        # INT32_MAX so they still sort last, exactly like the dense wire.
+        table_hi = wire[off : off + num_runs]
+        table_lo = wire[off + num_runs : off + 2 * num_runs]
+        start = (
+            (cols["flags"].astype(jnp.int32) & FLAG_RUN_START) != 0
+        ).astype(jnp.int32)
+        run_id = jnp.cumsum(start) - 1
+        valid = jnp.arange(n, dtype=jnp.int32) < cols["n_valid"][0]
+        run_id = jnp.clip(run_id, 0, num_runs - 1)
+        cols["key_hi"] = jnp.where(valid, table_hi[run_id], _I32_MAX)
+        cols["key_lo"] = jnp.where(valid, table_lo[run_id], _I32_MAX)
     return cols
 
 
@@ -175,7 +193,7 @@ def _unpack_wire(
     jax.jit,
     static_argnames=(
         "num_segments", "kind", "presorted", "prepacked", "wide_genomic",
-        "small_ref",
+        "small_ref", "num_runs",
     ),
 )
 def compute_entity_metrics(
@@ -186,6 +204,7 @@ def compute_entity_metrics(
     prepacked: bool = False,
     wide_genomic: bool = False,
     small_ref: bool = False,
+    num_runs: int = 0,
 ) -> Dict[str, jnp.ndarray]:
     """All metrics for one entity axis in a single compiled pass.
 
@@ -233,7 +252,9 @@ def compute_entity_metrics(
     if prepacked and tuple(cols) == ("wire",):
         # monoblock transport: one int32 buffer carrying every prepacked
         # column (gatherer._pack_wire layout) — bitcast back to names here
-        cols = _unpack_wire(cols["wire"], num_segments, wide_genomic, small_ref)
+        cols = _unpack_wire(
+            cols["wire"], num_segments, wide_genomic, small_ref, num_runs
+        )
 
     if prepacked:
         # host shipped the four packed sort operands plus a scalar valid

@@ -19,6 +19,7 @@ import numpy as np
 
 from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
 from ..io.packed import (
+    FLAG_RUN_START,
     KEY_CODE_BITS,
     KEY_HI_SHIFT,
     KEY_LO_MASK,
@@ -55,6 +56,8 @@ def _pad_columns(
     pair_mito: bool = False,
     small_ref: bool = False,
     force_wide_genomic: bool = False,
+    run_keys_bucket: int = 0,
+    run_starts: np.ndarray = None,
 ):
     """ReadFrame -> (device-ready padded columns, static engine flags).
 
@@ -157,11 +160,11 @@ def _pad_columns(
             _I32_MAX,
             np.int32,
         )
+    key_hi = (k1 << KEY_HI_SHIFT) | (k2 >> KEY_HI_SHIFT)
+    key_lo = ((k2 & KEY_LO_MASK) << KEY_CODE_BITS) | k3
     cols.update(
         umi_qual=pad(frame.umi_qual, 0, np.uint16),
         cb_qual=pad(frame.cb_qual, 0, np.uint16),
-        key_hi=pad((k1 << KEY_HI_SHIFT) | (k2 >> KEY_HI_SHIFT), _I32_MAX, np.int32),
-        key_lo=pad(((k2 & KEY_LO_MASK) << KEY_CODE_BITS) | k3, _I32_MAX, np.int32),
         m_ref=m_ref,
         ps=pad(
             (frame.pos.astype(np.int32) << 1) | frame.strand.astype(np.int32),
@@ -170,7 +173,27 @@ def _pad_columns(
         ),
         n_valid=np.asarray([n], dtype=np.int32),
     )
-    return cols, {"wide_genomic": not narrow_genomic, "small_ref": small_ref}
+    static_flags = {"wide_genomic": not narrow_genomic, "small_ref": small_ref}
+    if run_keys_bucket:
+        # run-keyed wire: records of one (k1,k2,k3) run are adjacent in the
+        # sorted input, so the 8 key bytes ship once per run — a trailing
+        # (key_hi_runs, key_lo_runs) table the device gathers back through
+        # cumsum of per-record FLAG_RUN_START bits (wire_layout docs).
+        # ``run_starts`` comes from the caller that sized the bucket — ONE
+        # start definition, so the table can never outgrow its bucket.
+        starts = run_starts
+        cols["flags"][:n] |= np.int16(FLAG_RUN_START) * starts
+        def pad_runs(arr):
+            out = np.full(run_keys_bucket, _I32_MAX, dtype=np.int32)
+            out[: arr.size] = arr
+            return out
+        cols["key_hi_runs"] = pad_runs(key_hi[starts])
+        cols["key_lo_runs"] = pad_runs(key_lo[starts])
+        static_flags["num_runs"] = run_keys_bucket
+    else:
+        cols["key_hi"] = pad(key_hi, _I32_MAX, np.int32)
+        cols["key_lo"] = pad(key_lo, _I32_MAX, np.int32)
+    return cols, static_flags
 
 
 def _pack_wire(cols: Dict[str, np.ndarray], static_flags: dict) -> np.ndarray:
@@ -190,6 +213,7 @@ def _pack_wire(cols: Dict[str, np.ndarray], static_flags: dict) -> np.ndarray:
     layout = wire_layout(
         bool(static_flags.get("wide_genomic")),
         bool(static_flags.get("small_ref")),
+        run_keys=bool(static_flags.get("num_runs")),
     )
     parts = [cols["n_valid"]]
     for name, width in layout:
@@ -198,6 +222,8 @@ def _pack_wire(cols: Dict[str, np.ndarray], static_flags: dict) -> np.ndarray:
             col if width == 4 and col.dtype == np.int32
             else np.ascontiguousarray(col).view(np.int32)
         )
+    if static_flags.get("num_runs"):
+        parts += [cols["key_hi_runs"], cols["key_lo_runs"]]
     return np.concatenate(parts)
 
 
@@ -234,6 +260,7 @@ class MetricGatherer:
         # to compare the measured wall against the bytes/bandwidth floor)
         self.bytes_h2d = 0
         self.bytes_d2h = 0
+        self.run_keyed_batches = 0
 
     @property
     def bam_file(self) -> str:
@@ -276,6 +303,7 @@ class MetricGatherer:
         ) as header_probe:
             self._small_ref = len(header_probe.header.references) <= 0x7F
         self._wide_genomic = False
+        self._runs_bucket = 0  # run-table high-water (one-way, like above)
         if self._frame_source is not None:
             frames = prefetch_iterator(self._frame_source())
         else:
@@ -370,16 +398,17 @@ class MetricGatherer:
             tail_key = (
                 carry.cell if self.entity_kind == "cell" else carry.gene
             )
-            # the tail pads to its OWN bucket, not the full batch capacity:
-            # a 65k-record tail padded to 512k ships ~12 MB of dead wire
-            # bytes over a link that is the measured end-to-end floor. The
-            # extra compile for the tail shape amortizes across runs via
-            # the persistent compilation cache.
+            # the tail pads to its OWN bucket (pad_to=0 -> bucket_size of
+            # the record count), not the full batch capacity: a 65k-record
+            # tail padded to 512k ships ~12 MB of dead wire bytes over a
+            # link that is the measured end-to-end floor. The extra compile
+            # for the tail shape amortizes across runs via the persistent
+            # compilation cache.
             pending.append(
                 self._dispatch_device_batch(
                     carry,
                     device_engine,
-                    pad_to=bucket_size(carry.n_records) if multi_batch else 0,
+                    pad_to=0,
                     presorted=bool(np.all(tail_key[1:] >= tail_key[:-1])),
                 )
             )
@@ -425,6 +454,34 @@ class MetricGatherer:
             if self.entity_kind == "cell"
             else ("gene", "cell", "umi")
         )
+        run_keys_bucket = 0
+        run_starts = None
+        if prepacked:
+            # run-keyed wire sizing: molecule runs are adjacent in sorted
+            # input, so 8 key bytes/record become 8 bytes/run + 1 flag bit.
+            # Starts are defined ONCE, here, on the tag triple (the packed
+            # keys are injective in it — the prepacked gate checked the bit
+            # budget above); _pad_columns consumes this array verbatim. The
+            # run-table bucket ratchets (never shrinks mid-stream) to bound
+            # recompiles; the gate skips the mode when the table would eat
+            # most of the saving (rare: near-singleton runs).
+            run_starts = np.empty(frame.n_records, dtype=bool)
+            run_starts[0] = True
+            np.logical_or(
+                frame.cell[1:] != frame.cell[:-1],
+                frame.gene[1:] != frame.gene[:-1],
+                out=run_starts[1:],
+            )
+            run_starts[1:] |= frame.umi[1:] != frame.umi[:-1]
+            n_runs = int(np.count_nonzero(run_starts))
+            self._runs_bucket = max(self._runs_bucket, bucket_size(n_runs))
+            padded = (
+                pad_to if pad_to >= frame.n_records
+                else bucket_size(frame.n_records)
+            )
+            if self._runs_bucket <= padded // 2:
+                run_keys_bucket = self._runs_bucket
+                self.run_keyed_batches += 1
         cols, static_flags = _pad_columns(
             frame,
             is_mito,
@@ -433,6 +490,8 @@ class MetricGatherer:
             pair_mito=self.entity_kind == "cell",
             small_ref=self._small_ref,
             force_wide_genomic=self._wide_genomic,
+            run_keys_bucket=run_keys_bucket,
+            run_starts=run_starts,
         )
         if static_flags.get("wide_genomic"):
             # one-way ratchet: once any batch needs the wide genomic
