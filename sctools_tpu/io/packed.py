@@ -35,6 +35,12 @@ PAD_FILLS = {
     "nh": -1,
     "perfect_umi": -1,
     "perfect_cb": -1,
+    # prepacked sort operands: padding must sort after every real record
+    # (the device masks by n_valid, but the keys drive the auxiliary sort)
+    "key_hi": np.iinfo(np.int32).max,
+    "key_lo": np.iinfo(np.int32).max,
+    "ps": np.iinfo(np.int32).max,
+    "m_ref": np.iinfo(np.int32).max,
 }
 
 # Bit layout of the packed per-record ``flags`` device column. Seven narrow

@@ -227,6 +227,32 @@ def _pack_wire(cols: Dict[str, np.ndarray], static_flags: dict) -> np.ndarray:
     return np.concatenate(parts)
 
 
+def prepacked_gate(frame: ReadFrame, entity_kind: str) -> bool:
+    """True when every code/coordinate fits the packed-key bit budget.
+
+    Shared by the single-device dispatch and the mesh-sharded gatherer so
+    both paths make the SAME schema decision per batch — the byte-identity
+    of their CSVs depends on the per-record quality floats being derived
+    the same way (integer summaries divided on device vs host floats).
+    The checks are EXPLICIT maxima: a dispatched slice shares its parent's
+    concat-merged vocabulary, which can exceed the slice's own record
+    count, so record count is no bound. The cell axis packs gene<<1|mito
+    into the pair slot (one less gene bit), and pos shifts left by 1 into
+    ps, so both get tighter caps that keep the packed int32 keys
+    order-preserving, not merely equality-preserving.
+    """
+    code_cap = 1 << KEY_CODE_BITS
+    gene_cap = code_cap >> 1 if entity_kind == "cell" else code_cap
+    return (
+        frame.n_records > 0
+        and int(frame.cell.max(initial=0)) < code_cap
+        and int(frame.umi.max(initial=0)) < code_cap
+        and int(frame.gene.max(initial=0)) < gene_cap
+        and int(frame.ref.max(initial=0)) < (1 << KEY_UNMAPPED_SHIFT) - 1
+        and int(frame.pos.max(initial=0)) < (1 << 30)
+    )
+
+
 class MetricGatherer:
     """Common driver: pack, compute on the selected backend, write csv."""
 
@@ -415,48 +441,68 @@ class MetricGatherer:
         while pending:
             self._finalize_device_batch(*pending.popleft(), out)
 
-    def _dispatch_device_batch(
-        self, frame: ReadFrame, device_engine, pad_to: int, presorted: bool = True
+    def _prepare_batch(
+        self,
+        frame: ReadFrame,
+        presorted: bool,
+        pad_to: int = 0,
+        run_keys_bucket: int = 0,
+        run_starts: np.ndarray = None,
     ):
+        """Shared dispatch prologue -> (cols, static_flags, prepacked).
+
+        ONE place makes the schema decision and builds the padded columns,
+        for both the single-device dispatch and the mesh-sharded gatherer
+        (parallel.gatherer) — their CSV byte-identity contract requires the
+        per-record quality floats to be derived identically, which means
+        the prepacked decision, key order, and ratchets must never drift
+        between the two paths.
+
+        The input BAM is sorted by the entity tag triple (the documented
+        precondition, reference gatherer.py:91-95) and vocabulary codes
+        preserve string order, so batches are presorted; the caller
+        verifies ascending entity order per batch and passes
+        presorted=False otherwise. When every code and coordinate also
+        fits the packed-key bit budget (prepacked_gate), the host ships
+        the packed sort operands directly and the quality columns as
+        integer summaries.
+        """
         is_mito = np.asarray(
             [name in self._mitochondrial_gene_ids for name in frame.gene_names],
             dtype=bool,
         )
-        # the input BAM is sorted by the entity tag triple (the documented
-        # precondition, reference gatherer.py:91-95) and vocabulary codes
-        # preserve string order, so batches are presorted: the device pass
-        # skips its primary sort entirely; the caller verifies ascending
-        # entity order per batch and passes presorted=False otherwise. When
-        # every code and coordinate also fits the packed-key bit budget,
-        # the host ships the FOUR packed sort operands directly (~34 B per
-        # record instead of ~39, and no device-side key packing). The code
-        # maxima are checked EXPLICITLY: a dispatched slice shares its
-        # parent's concat-merged vocabulary, which can exceed the slice's
-        # own record count, so record count is no bound.
-        code_cap = 1 << KEY_CODE_BITS
-        # the cell axis packs gene<<1|mito into the pair slot, so the gene
-        # code loses one bit of budget there
-        gene_cap = code_cap >> 1 if self.entity_kind == "cell" else code_cap
-        prepacked = (
-            presorted
-            and frame.n_records > 0
-            and int(frame.cell.max(initial=0)) < code_cap
-            and int(frame.umi.max(initial=0)) < code_cap
-            and int(frame.gene.max(initial=0)) < gene_cap
-            and int(frame.ref.max(initial=0)) < (1 << KEY_UNMAPPED_SHIFT) - 1
-            # pos shifts left by 1 into ps: bound it so the packed int32
-            # cannot wrap and the key stays order-preserving, not merely
-            # equality-preserving
-            and int(frame.pos.max(initial=0)) < (1 << 30)
-        )
+        prepacked = presorted and prepacked_gate(frame, self.entity_kind)
         key_order = (
             ("cell", "gene", "umi")
             if self.entity_kind == "cell"
             else ("gene", "cell", "umi")
         )
+        cols, static_flags = _pad_columns(
+            frame,
+            is_mito,
+            pad_to=pad_to,
+            prepacked_keys=key_order if prepacked else None,
+            pair_mito=self.entity_kind == "cell",
+            small_ref=self._small_ref,
+            force_wide_genomic=self._wide_genomic,
+            run_keys_bucket=run_keys_bucket if prepacked else 0,
+            run_starts=run_starts,
+        )
+        if static_flags.get("wide_genomic"):
+            # one-way ratchet: once any batch needs the wide genomic
+            # columns, later batches pack and compute wide too (at most one
+            # extra compile per run instead of flapping between schemas);
+            # threading the ratchet INTO _pad_columns keeps the packed
+            # dtypes and the static flags in agreement always
+            self._wide_genomic = True
+        return cols, static_flags, prepacked
+
+    def _dispatch_device_batch(
+        self, frame: ReadFrame, device_engine, pad_to: int, presorted: bool = True
+    ):
         run_keys_bucket = 0
         run_starts = None
-        if prepacked:
+        if presorted and prepacked_gate(frame, self.entity_kind):
             # run-keyed wire sizing: molecule runs are adjacent in sorted
             # input, so 8 key bytes/record become 8 bytes/run + 1 flag bit.
             # Starts are defined ONCE, here, on the tag triple (the packed
@@ -482,24 +528,10 @@ class MetricGatherer:
             if self._runs_bucket <= padded // 2:
                 run_keys_bucket = self._runs_bucket
                 self.run_keyed_batches += 1
-        cols, static_flags = _pad_columns(
-            frame,
-            is_mito,
-            pad_to=pad_to,
-            prepacked_keys=key_order if prepacked else None,
-            pair_mito=self.entity_kind == "cell",
-            small_ref=self._small_ref,
-            force_wide_genomic=self._wide_genomic,
-            run_keys_bucket=run_keys_bucket,
-            run_starts=run_starts,
+        cols, static_flags, prepacked = self._prepare_batch(
+            frame, presorted, pad_to=pad_to,
+            run_keys_bucket=run_keys_bucket, run_starts=run_starts,
         )
-        if static_flags.get("wide_genomic"):
-            # one-way ratchet: once any batch needs the wide genomic
-            # columns, later batches pack and compute wide too (at most one
-            # extra compile per run instead of flapping between schemas);
-            # threading the ratchet INTO _pad_columns keeps the packed
-            # dtypes and the static flags in agreement always
-            self._wide_genomic = True
         num_segments = len(cols["flags"])
         if prepacked:
             # monoblock transport: one upload per batch instead of nine

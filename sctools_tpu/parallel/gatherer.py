@@ -1,0 +1,136 @@
+"""Mesh-sharded metric gatherers: the distributed pipeline behind the CLI.
+
+The product face of the parallel layer (``CalculateCellMetrics --devices N``
+and friends): the same streaming BAM loop as the single-device gatherer
+(entity-boundary cuts, tail carry), but each batch is partitioned by entity
+hash over an N-device mesh (parallel.shard.partition_columns), computed with
+one shard_map pass per batch (parallel.metrics.sharded_entity_metrics), and
+the disjoint per-shard rows are collected and written in entity vocabulary
+order — byte-identical to the single-device CSV, because the engine's
+per-entity results are independent of where an entity lands in a batch
+(metrics.device module docs), the shard partition never splits an entity,
+and the schema decision is shared (MetricGatherer._prepare_batch).
+
+This replaces the reference's user-facing scatter-gather
+(SplitBam -> per-chunk Calculate -> Merge, src/sctools/platform.py:152-223
+and the WDL scatter contract in src/sctools/metrics/README.md:19-28) with a
+single command on a device mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.packed import KEY_HI_SHIFT
+from ..metrics.gatherer import GatherCellMetrics, GatherGeneMetrics
+from ..metrics.schema import INT_COLUMNS
+from ..ops.segments import bucket_size
+from .metrics import sharded_entity_metrics
+from .shard import partition_columns
+
+
+class _ShardedMixin:
+    """Overrides the dispatch/finalize pair with the mesh-sharded pass.
+
+    The inherited streaming loop (_stream_device_batches) is unchanged: it
+    owns batch cutting, entity carry, and pipelining, and treats the tuple
+    returned here as opaque.
+    """
+
+    def __init__(self, *args, mesh=None, **kwargs):
+        if mesh is None:
+            raise ValueError("sharded gatherers require a mesh")
+        super().__init__(*args, **kwargs)
+        self._mesh = mesh
+        self._n_shards = int(np.prod(list(mesh.shape.values())))
+
+    def _dispatch_device_batch(self, frame, device_engine, pad_to, presorted=True):
+        # the SAME schema decision as the single-device path (shared
+        # prologue): byte-identical CSVs require both paths to derive the
+        # per-record quality floats the same way. The run-keyed wire is a
+        # tunnel-transport concern and does not apply here.
+        cols, static_flags, prepacked = self._prepare_batch(frame, presorted)
+        if prepacked:
+            # partition routes by the outer entity code recovered from the
+            # packed key; the per-shard valid prefix count replaces the mask
+            n = len(cols["flags"])
+            valid = np.arange(n) < cols.pop("n_valid")[0]
+            outer = (cols["key_hi"] >> KEY_HI_SHIFT).astype(np.int32)
+            cols["valid"] = valid
+            cols["_outer"] = outer
+            stacked = partition_columns(cols, self._n_shards, key="_outer")
+            del stacked["_outer"]
+            stacked["n_valid"] = (
+                stacked.pop("valid").sum(axis=1).astype(np.int32)[:, None]
+            )
+            engine_flags = dict(presorted=True, prepacked=True, **static_flags)
+            outer_codes = outer[valid]
+        else:
+            # plain named-column schema; partitioning preserves record
+            # order, so per-shard groups stay ascending and presorted
+            # passes straight through (no per-shard re-sort)
+            stacked = partition_columns(
+                cols, self._n_shards, key=self.entity_kind
+            )
+            engine_flags = dict(presorted=presorted)
+            outer_codes = np.asarray(cols[self.entity_kind])[
+                np.asarray(cols["valid"], dtype=bool)
+            ]
+        self.bytes_h2d += sum(v.nbytes for v in stacked.values())
+        shard_size = max(v.shape[1] for v in stacked.values())
+        # per-shard entity counts are host-knowable (distinct codes routed
+        # to each shard), so each shard compacts its rows ON DEVICE into
+        # the same fused int32 block the single-device path pulls —
+        # record-scale result arrays never cross the host link
+        unique_codes = np.unique(outer_codes)
+        per_shard = np.bincount(
+            unique_codes % self._n_shards, minlength=self._n_shards
+        )
+        k = min(
+            bucket_size(int(per_shard.max(initial=1)), minimum=1024),
+            shard_size,
+        )
+        int_names = ("entity_code",) + tuple(
+            c for c in self.columns if c in INT_COLUMNS
+        )
+        float_names = tuple(c for c in self.columns if c not in INT_COLUMNS)
+        blocks, n_entities = sharded_entity_metrics(
+            stacked, self._mesh, kind=self.entity_kind,
+            compact=(int_names, float_names, k), **engine_flags,
+        )
+        return (
+            self._entity_names(frame), blocks, n_entities,
+            int_names, float_names,
+        )
+
+    def _finalize_device_batch(
+        self, entity_names, blocks, n_entities, int_names, float_names, out
+    ) -> None:
+        blocks = np.asarray(blocks)
+        n_entities = np.asarray(n_entities).reshape(-1)
+        self.bytes_d2h += blocks.nbytes + n_entities.nbytes
+        rows = np.concatenate(
+            [blocks[s, : int(n_entities[s])] for s in range(len(n_entities))]
+        )
+        # entity vocabulary order == ascending codes == the single-device
+        # row order (codes preserve string order); shards are disjoint so
+        # this sort is the whole merge
+        rows = rows[np.argsort(rows[:, 0])]
+        ints = rows[:, : len(int_names)]
+        floats = np.ascontiguousarray(rows[:, len(int_names):]).view(np.float32)
+        self._write_device_rows(
+            entity_names, rows.shape[0], int_names, float_names,
+            ints, floats, out,
+        )
+
+
+class ShardedCellMetrics(_ShardedMixin, GatherCellMetrics):
+    """GatherCellMetrics over a device mesh (cells never span shards)."""
+
+
+class ShardedGeneMetrics(_ShardedMixin, GatherGeneMetrics):
+    """GatherGeneMetrics over a device mesh (genes never span shards)."""
+
+
+def sharded_gatherer_cls(kind: str):
+    return ShardedCellMetrics if kind == "cell" else ShardedGeneMetrics

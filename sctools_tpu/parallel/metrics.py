@@ -145,6 +145,8 @@ def sharded_entity_metrics(
     mesh: jax.sharding.Mesh,
     kind: str,
     axis_name: str = DEFAULT_AXIS,
+    compact=None,
+    **engine_flags,
 ) -> Dict[str, np.ndarray]:
     """Per-shard metrics over entity-sharded records ([n_shards, S] columns).
 
@@ -152,31 +154,61 @@ def sharded_entity_metrics(
     (parallel.shard.partition_columns with key=kind). Each device computes the
     full metric set for its local entities; outputs stack to [n_shards, S]
     and rows across shards are disjoint by construction.
+
+    ``engine_flags`` pass through to ``compute_entity_metrics`` (presorted /
+    prepacked / wide_genomic / small_ref): the sharded CLI gatherer mirrors
+    the single-device schema decision per batch so both paths derive the
+    per-record quality floats identically — the byte-identity contract.
+
+    ``compact=(int_names, float_names, k)`` compacts each shard's result
+    ON DEVICE into the fused [k, ints+floats] int32 block the single-device
+    path pulls (metrics.device.compact_results_wire) and returns
+    ``(blocks [n_shards, k, C], n_entities [n_shards])`` — record-scale
+    result arrays never cross the host link.
     """
-    n_shards, shard_size = stacked_cols["cell"].shape
+    first = next(iter(stacked_cols.values()))
+    n_shards = first.shape[0]
+    # the widest per-record dimension; scalar-ish columns (n_valid [n, 1])
+    # must not win this max
+    shard_size = max(v.shape[1] for v in stacked_cols.values())
     _check_shard_count(n_shards, mesh, axis_name)
-    return _build_sharded_metrics(mesh, axis_name, shard_size, kind)(stacked_cols)
+    return _build_sharded_metrics(
+        mesh, axis_name, shard_size, kind,
+        tuple(sorted(engine_flags.items())), compact,
+    )(stacked_cols)
 
 
 @functools.lru_cache(maxsize=64)
-def _build_sharded_metrics(mesh, axis_name: str, shard_size: int, kind: str):
+def _build_sharded_metrics(
+    mesh, axis_name: str, shard_size: int, kind: str,
+    engine_flags: tuple = (), compact=None,
+):
     """Compiled per-shard metrics pass, cached so repeat batches of one shape
     reuse a single executable instead of re-tracing the shard_map closure."""
+    flags = dict(engine_flags)
 
-    @functools.partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(P(axis_name),),
-        out_specs=P(axis_name),
-        check_vma=False,
-    )
     def run(local):
         out = compute_entity_metrics(
-            _squeeze_local(local), num_segments=shard_size, kind=kind
+            _squeeze_local(local), num_segments=shard_size, kind=kind, **flags
         )
-        return _expand_local(out)
+        if compact is None:
+            return _expand_local(out)
+        from ..metrics.device import compact_results_wire
 
-    return jax.jit(run)
+        int_names, float_names, k = compact
+        block = compact_results_wire(out, int_names, float_names, k)
+        return block[None], out["n_entities"][None]
+
+    out_specs = P(axis_name) if compact is None else (P(axis_name), P(axis_name))
+    return jax.jit(
+        jax.shard_map(
+            run,
+            mesh=mesh,
+            in_specs=(P(axis_name),),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    )
 
 
 def _check_shard_count(n_shards: int, mesh: jax.sharding.Mesh, axis_name):

@@ -61,6 +61,10 @@ def partition_columns(
             continue
         col = np.asarray(col)
         fill = PAD_FILLS.get(name, False if col.dtype == bool else 0)
+        if np.issubdtype(col.dtype, np.integer):
+            # a sort-last fill (int32 max) clamps to the column's dtype:
+            # the u8 m_ref pads with 0xFF, exactly the single-device fill
+            fill = min(int(fill), int(np.iinfo(col.dtype).max))
         stacked = np.full((n_shards, shard_size), fill, dtype=col.dtype)
         for s, ix in enumerate(per_shard_indices):
             stacked[s, : len(ix)] = col[ix]
