@@ -9,6 +9,17 @@ metric passes run under ``shard_map``, and re-keying between entity axes is an
 ``all_to_all`` collective over ICI instead of a new pass over files.
 """
 
+from .gatherer import ShardedCellMetrics, ShardedGeneMetrics
+from .launch import (
+    global_mesh,
+    host_local_to_global,
+    initialize_distributed,
+    local_mesh,
+    merge_sorted_csv_parts,
+    process_chunks,
+    run_process_cell_metrics,
+    sync_processes,
+)
 from .mesh import make_hybrid_mesh, make_mesh
 from .shard import partition_columns, shard_assignment
 from .count import sharded_count_molecules
@@ -23,6 +34,16 @@ from .metrics import (
 )
 
 __all__ = [
+    "ShardedCellMetrics",
+    "ShardedGeneMetrics",
+    "initialize_distributed",
+    "global_mesh",
+    "local_mesh",
+    "host_local_to_global",
+    "process_chunks",
+    "run_process_cell_metrics",
+    "merge_sorted_csv_parts",
+    "sync_processes",
     "make_mesh",
     "make_hybrid_mesh",
     "hybrid_metrics_step",

@@ -260,8 +260,43 @@ def distributed_metrics_step(
     """
     n_shards, shard_size = stacked_cols["cell"].shape
     _check_shard_count(n_shards, mesh, axis_name)
-    concrete = not isinstance(stacked_cols["gene"], jax.core.Tracer)
-    if concrete:
+    # host pre-flight needs the concrete values: impossible under tracing.
+    # For multi-process global arrays (parallel.launch), no single process
+    # holds every shard — each process computes the requirement over its
+    # LOCAL shards and an allgather of the max keeps the tight static
+    # capacity (identical on every process, as compilation requires)
+    # instead of the worst-case full shard size.
+    tracer = isinstance(stacked_cols["gene"], jax.core.Tracer)
+    concrete = not tracer and getattr(
+        stacked_cols["gene"], "is_fully_addressable", True
+    )
+    if not tracer and not concrete:
+        from jax.experimental import multihost_utils
+
+        local = {
+            name: np.concatenate(
+                [np.asarray(s.data) for s in stacked_cols[name].addressable_shards]
+            )
+            for name in ("gene", "valid")
+        }
+        local_required = required_reshard_capacity(local, "gene", n_shards)
+        required = int(
+            np.max(
+                multihost_utils.process_allgather(
+                    np.asarray([local_required]), tiled=True
+                )
+            )
+        )
+        if capacity is None:
+            cap = seg.bucket_size(max(required, 1), minimum=8)
+        elif capacity < required:
+            raise ValueError(
+                f"reshard capacity={capacity} too small: a (src,dst) shard "
+                f"pair exchanges up to {required} records"
+            )
+        else:
+            cap = capacity
+    elif concrete:
         # cheap host-side pre-flight: an undersized explicit capacity fails
         # BEFORE the device pass runs (the on-device drop counter still
         # backstops tracer inputs, where this check cannot see the data)
@@ -285,8 +320,27 @@ def distributed_metrics_step(
     if not isinstance(dropped, jax.core.Tracer):
         # eager call: surface any overflow loss immediately. Under an outer
         # jit the counter is a tracer and cannot be read here — such callers
-        # compose reshard_by_key directly and own the check.
-        n_dropped = int(np.sum(np.asarray(dropped)))
+        # compose reshard_by_key directly and own the check. On a
+        # multi-process mesh each process sees only its own shards, so the
+        # local counts allgather before the decision: every process raises
+        # TOGETHER, or none does — a process-local raise would leave peers
+        # blocking forever at their next collective.
+        if getattr(dropped, "is_fully_addressable", True):
+            n_dropped = int(np.sum(np.asarray(dropped)))
+        else:
+            from jax.experimental import multihost_utils
+
+            local_dropped = sum(
+                int(np.sum(np.asarray(shard.data)))
+                for shard in dropped.addressable_shards
+            )
+            n_dropped = int(
+                np.sum(
+                    multihost_utils.process_allgather(
+                        np.asarray([local_dropped]), tiled=True
+                    )
+                )
+            )
         if n_dropped:
             raise RuntimeError(
                 f"reshard capacity={cap} too small: {n_dropped} records "

@@ -1,0 +1,101 @@
+"""Worker process for tests/test_distributed.py (2 procs x 4 CPU devices).
+
+Runs both multi-process tiers (parallel.launch module docs):
+tier 1 — per-process chunk ingest on the LOCAL mesh, parts merged by rank 0;
+tier 2 — global-mesh collectives: every process feeds its local shards into
+one distributed_metrics_step whose gene rekey crosses the process boundary.
+
+Invoked as: python distributed_worker.py <pid> <nprocs> <coordinator>
+<workdir>. Must be a fresh process: the virtual-device flags have to land
+before any JAX backend initializes.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    process_id = int(sys.argv[1])
+    num_processes = int(sys.argv[2])
+    coordinator = sys.argv[3]
+    workdir = sys.argv[4]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import glob
+
+    import numpy as np
+
+    from sctools_tpu.parallel import (
+        distributed_metrics_step,
+        global_mesh,
+        host_local_to_global,
+        initialize_distributed,
+        merge_sorted_csv_parts,
+        partition_columns,
+        run_process_cell_metrics,
+        sync_processes,
+    )
+    from sctools_tpu.utils import make_synthetic_columns
+
+    initialize_distributed(coordinator, num_processes, process_id)
+    assert len(jax.devices()) == 4 * num_processes, len(jax.devices())
+    assert len(jax.local_devices()) == 4
+
+    # ---- tier 1: per-process chunk ingest, local mesh, rank-0 merge ------
+    chunks = sorted(glob.glob(os.path.join(workdir, "chunks", "*.bam")))
+    assert chunks, "no chunk files prepared"
+    run_process_cell_metrics(
+        chunks,
+        os.path.join(workdir, f"proc{process_id}"),
+        num_processes,
+        process_id,
+    )
+    sync_processes("parts-written")
+    if process_id == 0:
+        n_rows = merge_sorted_csv_parts(
+            os.path.join(workdir, "proc*.part*.csv.gz"),
+            os.path.join(workdir, "merged.csv.gz"),
+        )
+        print(f"[p0] merged {n_rows} rows", flush=True)
+
+    # ---- tier 2: global-mesh collectives across the process boundary -----
+    mesh = global_mesh()
+    n_shards = 4 * num_processes
+    n_records = 480
+    cols = make_synthetic_columns(
+        n_records=n_records, n_cells=4 * n_shards, n_genes=2 * n_shards, seed=7
+    )
+    stacked = partition_columns(cols, n_shards, key="cell")
+    local = {
+        k: v[process_id * 4 : (process_id + 1) * 4] for k, v in stacked.items()
+    }
+    garr = host_local_to_global(local, mesh)
+    cell_out, gene_out = distributed_metrics_step(stacked_cols=garr, mesh=mesh)
+    local_cell = sum(
+        int(np.sum(np.asarray(shard.data)))
+        for shard in cell_out["n_reads"].addressable_shards
+    )
+    local_gene = sum(
+        int(np.sum(np.asarray(shard.data)))
+        for shard in gene_out["n_reads"].addressable_shards
+    )
+    from jax.experimental import multihost_utils
+
+    totals = multihost_utils.process_allgather(
+        np.asarray([local_cell, local_gene]), tiled=False
+    )
+    total_cell = int(np.asarray(totals)[:, 0].sum())
+    total_gene = int(np.asarray(totals)[:, 1].sum())
+    assert total_cell == n_records, (total_cell, n_records)
+    assert total_gene == n_records, (total_gene, n_records)
+    print(f"[p{process_id}] OK tier2 cell={total_cell} gene={total_gene}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,124 @@
+"""Multi-process launch test: 2 processes x 4 virtual CPU devices.
+
+The process-level analog of the reference's cross-VM WDL scatter
+(src/sctools/metrics/README.md:19-21): SplitBam chunks assigned to
+processes, each process computing on its own devices under one
+jax.distributed runtime, a rank-0 merge reproducing the single-process
+CSV byte for byte, plus a global-mesh collective step whose all_to_all
+crosses the process boundary (parallel.launch module docs).
+
+Spawned as real subprocesses: jax.distributed requires fresh processes
+(backends are finalized at first use, and os.fork is unsafe under JAX).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from helpers import make_record, write_bam
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "distributed_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _make_input(path: str, n_cells: int = 48) -> None:
+    import random
+
+    rng = random.Random(31)
+    records = []
+    for cb in sorted(
+        "".join(rng.choice("ACGT") for _ in range(12)) for _ in range(n_cells)
+    ):
+        for ub in sorted(
+            "".join(rng.choice("ACGT") for _ in range(6)) for _ in range(3)
+        ):
+            ge = rng.choice(["G1", "G2", "G3"])
+            for i in range(2):
+                records.append(
+                    make_record(
+                        name=f"{cb}{ub}{i}", cb=cb, cr=cb, cy="IIII",
+                        ub=ub, ur=ub, uy="IIII", ge=ge, xf="CODING",
+                        nh=1, pos=rng.randrange(1000),
+                    )
+                )
+    write_bam(path, records)
+
+
+@pytest.mark.timeout(600)
+def test_two_process_four_device_launch(tmp_path):
+    bam = str(tmp_path / "input.bam")
+    _make_input(bam)
+
+    # single-process ground truth (the current in-process 8-device runtime)
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    single = tmp_path / "single.csv.gz"
+    GatherCellMetrics(bam, str(single), backend="device").extract_metrics()
+
+    # SplitBam the input into cell-disjoint chunks (the reference's own
+    # scatter preparation, platform.py:152-223)
+    from sctools_tpu.platform import GenericPlatform
+
+    chunk_dir = tmp_path / "chunks"
+    chunk_dir.mkdir()
+    GenericPlatform.split_bam(
+        [
+            "-b", bam,
+            "-p", str(chunk_dir / "chunk"),
+            "-s", "0.002",  # MB: force several chunks at this input size
+            "-t", "CB",
+        ]
+    )
+    assert len(list(chunk_dir.glob("*.bam"))) >= 2
+
+    # spawn the 2-process distributed run (fresh interpreters: jax backends
+    # must not be initialized before jax.distributed.initialize)
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(pid), "2", coordinator, str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    outputs = []
+    try:
+        for proc in procs:
+            out, _ = proc.communicate(timeout=540)
+            outputs.append(out)
+        for pid, (proc, out) in enumerate(zip(procs, outputs)):
+            assert proc.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+            assert "OK tier2" in out
+    finally:
+        # a hung or failed worker must not outlive the test holding the
+        # coordinator port (and wedging the pytest session)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    # the rank-0 merge must reproduce the single-process CSV byte for byte
+    with gzip.open(single, "rb") as f:
+        expected = f.read()
+    with gzip.open(tmp_path / "merged.csv.gz", "rb") as f:
+        merged = f.read()
+    assert merged == expected
