@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: ci native lint test tpu-test clean
+.PHONY: ci ci-deep native native-tsan lint test test-threads tpu-test clean
 
 ci: native lint test
 
@@ -27,6 +27,26 @@ test:
 # is the only tier that exercises actual TPU lowering.
 tpu-test:
 	$(PY) -m pytest tpu_tests/ -q
+
+# forced-thread tier on its own (also part of the main suite)
+test-threads:
+	$(PY) -m pytest tests/test_native_threads.py -q
+
+native-tsan:
+	$(MAKE) -C sctools_tpu/native tsan
+
+# deep gate: the threaded native paths under ThreadSanitizer. libtsan must
+# be preloaded because the python host binary is uninstrumented; the same
+# $(CXX) that built the instrumented lib resolves the runtime so the two
+# cannot mismatch. SCTOOLS_TPU_REQUIRE_NATIVE turns the suite's
+# native-unavailable skip into a hard failure — a gate that cannot load
+# the sanitizer build must fail, not pass vacuously.
+ci-deep: ci native-tsan
+	LD_PRELOAD=$$($(CXX) -print-file-name=libtsan.so) \
+	TSAN_OPTIONS="report_bugs=1 exitcode=66 suppressions=$(CURDIR)/sctools_tpu/native/tsan.supp" \
+	SCTOOLS_TPU_NATIVE_LIB=$(CURDIR)/sctools_tpu/native/libsctools_native.tsan.so \
+	SCTOOLS_TPU_REQUIRE_NATIVE=1 \
+	$(PY) -m pytest tests/test_native_threads.py -q
 
 clean:
 	$(MAKE) -C sctools_tpu/native clean

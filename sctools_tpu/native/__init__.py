@@ -22,7 +22,12 @@ from typing import List, Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_DIR, "libsctools_native.so")
+# SCTOOLS_TPU_NATIVE_LIB points the loader at an alternate build (the
+# ThreadSanitizer library `make ci-deep` produces); default is the
+# release build next to this file.
+_LIB_PATH = os.environ.get(
+    "SCTOOLS_TPU_NATIVE_LIB", os.path.join(_DIR, "libsctools_native.so")
+)
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -284,6 +289,26 @@ def _frame_from_handle(lib, handle, want_qname: bool):
     )
 
 
+def _default_threads() -> int:
+    """Native worker default; SCTOOLS_TPU_THREADS overrides the CPU count.
+
+    The same knob the C++ layer reads (native_io.h effective_concurrency):
+    one env var drives every pool so CI can force the multi-core paths on
+    1-core hosts.
+    """
+    env = os.environ.get("SCTOOLS_TPU_THREADS")
+    if env:
+        try:
+            value = int(env)
+            # the same 1..1024 validity window as the C++ side, so the
+            # contract cannot diverge between the two halves of a pipeline
+            if 0 < value <= 1024:
+                return value
+        except ValueError:
+            pass
+    return min(os.cpu_count() or 1, 16)
+
+
 def frame_from_bam_native(path: str, n_threads: Optional[int] = None):
     """Decode a whole BAM file into one ReadFrame via the native library.
 
@@ -294,7 +319,7 @@ def frame_from_bam_native(path: str, n_threads: Optional[int] = None):
     if lib is None:
         raise RuntimeError("native decoder unavailable")
     if n_threads is None:
-        n_threads = min(os.cpu_count() or 1, 16)
+        n_threads = _default_threads()
     errbuf = ctypes.create_string_buffer(512)
     handle = lib.scx_decode_bam(
         path.encode(), n_threads, errbuf, ctypes.sizeof(errbuf)
@@ -328,7 +353,7 @@ def stream_frames_native(
     if lib is None:
         raise RuntimeError("native decoder unavailable")
     if n_threads is None:
-        n_threads = min(os.cpu_count() or 1, 16)
+        n_threads = _default_threads()
     errbuf = ctypes.create_string_buffer(512)
     handle = lib.scx_stream_open(
         path.encode(), n_threads, 1 if want_qname else 0,
@@ -513,7 +538,7 @@ def tagsort_stream_frames(
     if len(keys) != 3 or any(len(k) != 2 for k in keys):
         raise RuntimeError("native tagsort requires exactly three 2-char tags")
     if n_threads is None:
-        n_threads = min(os.cpu_count() or 1, 16)
+        n_threads = _default_threads()
     if scratch_prefix is None:
         # next to the teed output when there is one, else the temp dir —
         # never beside the input (which may be on a read-only mount)
@@ -596,7 +621,7 @@ def fastq_metrics_native(
     if lib is None:
         raise RuntimeError("native layer unavailable")
     if n_threads is None:
-        n_threads = min(os.cpu_count() or 1, 16)
+        n_threads = _default_threads()
     cb_arr, n_cb = _spans_array(cb_spans)
     umi_arr, n_umi = _spans_array(umi_spans)
     errbuf = ctypes.create_string_buffer(512)

@@ -267,7 +267,7 @@ long scx_fqm(const char* r1_paths, const int32_t* cb_spans_flat, int n_cb,
   // fastq_metrics.cpp:174-209, bounded by its global thread cap)
   int workers = static_cast<int>(files.size());
   if (n_threads > 0 && workers > n_threads) workers = n_threads;
-  unsigned hw = std::thread::hardware_concurrency();
+  unsigned hw = scx::effective_concurrency();
   if (hw > 0 && workers > static_cast<int>(hw)) workers = hw;
   if (workers < 1) workers = 1;
   std::atomic<size_t> next{0};

@@ -15,8 +15,10 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace scx {
@@ -379,6 +381,23 @@ class BgzfWriter {
 struct Span {
   int32_t start, end;
 };
+
+
+// Worker-thread budget for every native pool/overlap path. The env var
+// SCTOOLS_TPU_THREADS (a positive integer) overrides the hardware count so
+// CI can exercise the multi-core paths (AsyncSink/PartialWriter overlap,
+// shard fan-out) on 1-core hosts and pin their outputs byte-identical --
+// untested concurrency code is where sanitizer bugs live.
+inline unsigned effective_concurrency() {
+  const char* env = std::getenv("SCTOOLS_TPU_THREADS");
+  if (env && *env) {
+    char* end = nullptr;
+    long v = std::strtol(env, &end, 10);
+    if (end && *end == '\0' && v > 0 && v <= 1024)
+      return static_cast<unsigned>(v);
+  }
+  return std::thread::hardware_concurrency();
+}
 
 inline std::string extract_spans(const std::string& read,
                                  const std::vector<Span>& spans) {

@@ -650,7 +650,7 @@ long tagsort_core(const char* input, OutSink& out,
   bool eof = false;
 
   // the writer threads only pay off with a second core to run on
-  const bool overlap = std::thread::hardware_concurrency() > 1;
+  const bool overlap = scx::effective_concurrency() > 1;
   PartialWriter partial_writer;
   auto cleanup = [&]() {
     for (const std::string& p : partials) std::remove(p.c_str());
@@ -877,7 +877,7 @@ long scx_tagsort(const char* input, const char* output, const char* tag1,
   BgzfSink sink;
   if (!sink.open(output, compress_level))
     return fail(std::string("cannot open ") + output);
-  const bool overlap = std::thread::hardware_concurrency() > 1;
+  const bool overlap = scx::effective_concurrency() > 1;
   AsyncSink async;
   OutSink* out = &sink;
   if (overlap) {

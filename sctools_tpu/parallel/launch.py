@@ -75,10 +75,18 @@ def local_mesh(axis_name: str = DEFAULT_AXIS):
 
 def process_chunks(
     chunks: Sequence[str], num_processes: int, process_id: int
-) -> List[str]:
-    """This process's share of the chunk files (round-robin, like the
-    reference's barcode->bin assignment, src/sctools/bam.py:442-448)."""
-    return sorted(chunks)[process_id::num_processes]
+) -> List[tuple]:
+    """This process's share of the chunk files as (global_index, path).
+
+    Round-robin over the sorted paths, like the reference's barcode->bin
+    assignment (src/sctools/bam.py:442-448); the global index names the
+    output part so rank 0 can glob every process's parts in order.
+    """
+    return [
+        (index, chunk)
+        for index, chunk in enumerate(sorted(chunks))
+        if index % num_processes == process_id
+    ]
 
 
 def host_local_to_global(
@@ -128,9 +136,7 @@ def run_process_cell_metrics(
 
     mesh = mesh if mesh is not None else local_mesh()
     parts = []
-    for index, chunk in enumerate(sorted(chunks)):
-        if index % num_processes != process_id:
-            continue
+    for index, chunk in process_chunks(chunks, num_processes, process_id):
         part = f"{part_stem}.part{index:04d}"
         ShardedCellMetrics(
             chunk, part, set(mitochondrial_gene_ids), mesh=mesh

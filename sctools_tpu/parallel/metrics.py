@@ -270,7 +270,15 @@ def distributed_metrics_step(
     concrete = not tracer and getattr(
         stacked_cols["gene"], "is_fully_addressable", True
     )
-    if not tracer and not concrete:
+    # cheap host-side pre-flight: an undersized explicit capacity fails
+    # BEFORE the device pass runs (the on-device drop counter still
+    # backstops tracer inputs, where this check cannot see the data)
+    if concrete:
+        required = required_reshard_capacity(stacked_cols, "gene", n_shards)
+    elif not tracer:
+        # multi-process global arrays: each process measures its LOCAL
+        # shards and the max allgathers so every process compiles with the
+        # same tight capacity
         from jax.experimental import multihost_utils
 
         local = {
@@ -287,31 +295,19 @@ def distributed_metrics_step(
                 )
             )
         )
-        if capacity is None:
-            cap = seg.bucket_size(max(required, 1), minimum=8)
-        elif capacity < required:
-            raise ValueError(
-                f"reshard capacity={capacity} too small: a (src,dst) shard "
-                f"pair exchanges up to {required} records"
-            )
-        else:
-            cap = capacity
-    elif concrete:
-        # cheap host-side pre-flight: an undersized explicit capacity fails
-        # BEFORE the device pass runs (the on-device drop counter still
-        # backstops tracer inputs, where this check cannot see the data)
-        required = required_reshard_capacity(stacked_cols, "gene", n_shards)
-        if capacity is None:
-            cap = seg.bucket_size(required, minimum=8)
-        elif capacity < required:
-            raise ValueError(
-                f"reshard capacity={capacity} too small: a (src,dst) shard "
-                f"pair exchanges up to {required} records"
-            )
-        else:
-            cap = capacity
     else:
+        required = None
+    if required is None:
         cap = capacity if capacity is not None else shard_size
+    elif capacity is None:
+        cap = seg.bucket_size(max(required, 1), minimum=8)
+    elif capacity < required:
+        raise ValueError(
+            f"reshard capacity={capacity} too small: a (src,dst) shard "
+            f"pair exchanges up to {required} records"
+        )
+    else:
+        cap = capacity
 
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
     cell_out, gene_out, dropped = _build_distributed_step(

@@ -1,0 +1,160 @@
+"""Forced-thread tier: the multi-core native paths on a 1-core CI host.
+
+SCTOOLS_TPU_THREADS=4 (read at call time by native_io.h
+effective_concurrency and native._default_threads) switches on the
+concurrency the 1-core host otherwise gates off — tagsort's
+AsyncSink/PartialWriter compression overlap, the fastq-metrics shard
+fan-out, the BGZF inflate pool — and every output must stay byte-identical
+to the single-threaded run (round-5 VERDICT item 4: untested concurrency
+code is where the next sanitizer bug lives). `make ci-deep` reruns this
+module under ThreadSanitizer.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import os
+import random
+
+import pytest
+
+from helpers import make_header, make_record, write_bam
+from sctools_tpu import native
+
+# under `make ci-deep` (SCTOOLS_TPU_REQUIRE_NATIVE=1) an unloadable
+# sanitizer build must FAIL the gate, not skip it into a vacuous pass
+pytestmark = pytest.mark.skipif(
+    not native.available()
+    and not os.environ.get("SCTOOLS_TPU_REQUIRE_NATIVE"),
+    reason="native library unavailable",
+)
+
+
+def test_native_library_loads():
+    assert native.available(), (
+        "native library failed to load (SCTOOLS_TPU_NATIVE_LIB="
+        f"{os.environ.get('SCTOOLS_TPU_NATIVE_LIB', '<default>')})"
+    )
+
+TAGS = ["CB", "UB", "GE"]
+
+
+def _tagged_records(n=3000, seed=21):
+    rng = random.Random(seed)
+    header = make_header()
+    cells = ["".join(rng.choice("ACGT") for _ in range(8)) for _ in range(40)]
+    records = []
+    for i in range(n):
+        records.append(
+            make_record(
+                name=f"q{rng.randrange(100_000):06d}",
+                cb=rng.choice(cells),
+                cr=rng.choice(cells),
+                cy="IIIIIIII",
+                ub="".join(rng.choice("ACGT") for _ in range(6)),
+                ur="ACGTAC",
+                uy="IIIIII",
+                ge=rng.choice(["G1", "G2", "G3", None]),
+                xf=rng.choice(["CODING", "INTERGENIC", None]),
+                nh=rng.choice([1, 2]),
+                pos=rng.randrange(100_000),
+                header=header,
+            )
+        )
+    return records, header
+
+
+@pytest.fixture(scope="module")
+def messy_bam(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("threads")
+    records, header = _tagged_records()
+    return str(write_bam(tmp / "messy.bam", records, header))
+
+
+def _read_bam_bytes(path: str) -> bytes:
+    """Decompressed BGZF payload (container bytes vary with writer timing)."""
+    with gzip.open(path, "rb") as f:
+        return f.read()
+
+
+def test_tagsort_overlap_threads_byte_identical(messy_bam, tmp_path, monkeypatch):
+    """AsyncSink/PartialWriter overlap (threads=4) == inline (threads=1)."""
+    one = str(tmp_path / "one.bam")
+    four = str(tmp_path / "four.bam")
+    monkeypatch.setenv("SCTOOLS_TPU_THREADS", "1")
+    n1 = native.tagsort_native(messy_bam, one, TAGS, batch_records=512)
+    monkeypatch.setenv("SCTOOLS_TPU_THREADS", "4")
+    n4 = native.tagsort_native(messy_bam, four, TAGS, batch_records=512)
+    assert n1 == n4 == 3000
+    assert _read_bam_bytes(one) == _read_bam_bytes(four)
+    # no partial files left behind by either run
+    assert not glob.glob(str(tmp_path / "*.tagsort_partial_*"))
+
+
+def test_fused_pipe_metrics_threads_byte_identical(tmp_path, monkeypatch):
+    """The fused merge->metrics pipe under threads=4 == threads=1."""
+    records, header = _tagged_records(n=2000, seed=5)
+    bam = str(write_bam(tmp_path / "fused_in.bam", records, header))
+    from sctools_tpu.platform import GenericPlatform
+
+    outs = {}
+    for threads in ("1", "4"):
+        monkeypatch.setenv("SCTOOLS_TPU_THREADS", threads)
+        stem = str(tmp_path / f"cell_{threads}")
+        GenericPlatform.tag_sort_bam(
+            [
+                "-i", bam, "-t", "CB", "UB", "GE",
+                "--cell-metrics-output", stem,
+                "--records-per-chunk", "400",
+            ]
+        )
+        with gzip.open(stem + ".csv.gz", "rb") as f:
+            outs[threads] = f.read()
+    assert outs["1"] == outs["4"]
+
+
+def test_bam_decode_pool_threads_identical(messy_bam, monkeypatch):
+    """The BGZF inflate pool (n_threads=4) decodes the same columns."""
+    import numpy as np
+
+    monkeypatch.setenv("SCTOOLS_TPU_THREADS", "1")
+    one = native.frame_from_bam_native(messy_bam)
+    monkeypatch.setenv("SCTOOLS_TPU_THREADS", "4")
+    four = native.frame_from_bam_native(messy_bam)
+    assert one.n_records == four.n_records == 3000
+    for field in ("cell", "umi", "gene", "ref", "pos", "umi_qual", "cb_qual"):
+        np.testing.assert_array_equal(
+            getattr(one, field), getattr(four, field), err_msg=field
+        )
+    assert one.cell_names == four.cell_names
+
+
+def test_fastq_metrics_shards_threads_identical(tmp_path, monkeypatch):
+    """The per-shard fastq-metrics fan-out (4 workers) == sequential."""
+    from sctools_tpu.fastq_metrics import compute_fastq_metrics
+
+    rng = random.Random(11)
+    shards = []
+    for s in range(4):
+        path = tmp_path / f"r1_{s}.fastq.gz"
+        with gzip.open(path, "wt") as f:
+            for i in range(300):
+                seq = "".join(rng.choice("ACGT") for _ in range(26))
+                f.write(f"@r{s}_{i}\n{seq}\n+\n{'I' * 26}\n")
+        shards.append(str(path))
+
+    def run(threads: str) -> dict:
+        monkeypatch.setenv("SCTOOLS_TPU_THREADS", threads)
+        stem = str(tmp_path / f"fqm_{threads}")
+        assert compute_fastq_metrics(shards, "16C10M", stem) is None  # native
+        return {
+            path.rsplit("/", 1)[-1].split(f"fqm_{threads}")[-1]: open(
+                path, "rb"
+            ).read()
+            for path in sorted(glob.glob(stem + "*"))
+        }
+
+    one = run("1")
+    four = run("4")
+    assert one == four and len(one) == 4
