@@ -16,10 +16,16 @@ sort, entirely in XLA collectives:
 4. each shard re-sorts what it received.
 
 Flattening the shards in mesh order then yields the global sort: shard i's
-keys are <= shard i+1's (records equal to a pivot all land on one side).
-Balance depends on the sampling; correctness does not. Extreme key skew
-(one key dominating) concentrates records on one shard and is surfaced by
-the capacity pre-flight / drop counter rather than silently truncated.
+keys are <= shard i+1's. Balance does not depend on key distribution:
+routing extends every key with a TIEBREAKER — the record's global position
+in locally-sorted order (shard * S + index) — making routing keys unique,
+so a heavy equal-key run (even one key = 50% of all records) splits across
+adjacent shards instead of concentrating on one. Equal user keys then
+land in tiebreaker order, which also makes the flattened output STABLE
+with respect to the locally-sorted shard-major order. The capacity
+pre-flight / drop counter remain as the correctness backstop, but under
+the tiebreaker the required capacity is ~S/n_shards + sampling slack for
+ANY key distribution, not the size of the heaviest key run.
 """
 
 from __future__ import annotations
@@ -59,14 +65,18 @@ def _pivot_positions(pool_size: int, n_shards: int) -> np.ndarray:
 
 
 def _dest_from_pivots(keys, pivot_cols) -> jnp.ndarray:
-    """count(pivot < key) per record, lexicographic over 1 or 2 key columns."""
-    k1 = keys[0][:, None]
-    p1 = pivot_cols[0][None, :]
-    less = p1 < k1
-    if len(keys) > 1:
-        k2 = keys[1][:, None]
-        p2 = pivot_cols[1][None, :]
-        less = less | ((p1 == k1) & (p2 < k2))
+    """count(pivot < key) per record, lexicographic over N key columns."""
+    less = None
+    equal_so_far = None
+    for key, pivot in zip(keys, pivot_cols):
+        k = key[:, None]
+        p = pivot[None, :]
+        this_less = p < k
+        if less is None:
+            less, equal_so_far = this_less, p == k
+        else:
+            less = less | (equal_so_far & this_less)
+            equal_so_far = equal_so_far & (p == k)
     return jnp.sum(less.astype(jnp.int32), axis=1)
 
 
@@ -80,7 +90,18 @@ def required_sort_capacity(
     Host-side mirror of the device pivot computation (same sample and pivot
     positions), so the all_to_all can run with a tight static capacity.
     """
+    if not 1 <= len(key_names) <= 2:
+        raise ValueError(
+            f"distributed sort supports 1-2 key columns, got {len(key_names)}"
+        )
     local_size = np.asarray(stacked_cols[key_names[0]]).shape[1]
+    n_rows = np.asarray(stacked_cols[key_names[0]]).shape[0]
+    if n_rows * local_size >= 1 << 31:
+        # the device tiebreaker (shard * S + index) is int32
+        raise ValueError(
+            f"total records {n_rows * local_size} overflow the int32 "
+            "routing tiebreaker; use smaller per-batch shards"
+        )
     valid = np.asarray(stacked_cols["valid"], dtype=bool)
     keys = [
         np.where(valid, np.asarray(stacked_cols[n], dtype=np.int64), _I32_MAX)
@@ -93,16 +114,40 @@ def required_sort_capacity(
     packed = (keys[0] + bias) << 32
     if len(keys) > 1:
         packed = packed | (keys[1] + bias)
-    packed_sorted = np.sort(packed, axis=1)
-    samples = packed_sorted[:, _sample_positions(local_size, n_shards)]
-    pool = np.sort(samples.reshape(-1))
-    pivots = pool[_pivot_positions(pool.size, n_shards)]
+    # ONE stable sort per shard serves both the sample positions and the
+    # valid-row bucket counting below
+    order = np.argsort(packed, axis=1, kind="stable")
+    packed_sorted = np.take_along_axis(packed, order, axis=1)
+    # the device's routing tiebreaker: global position in locally-sorted
+    # shard-major order. Equal packed keys occupy the same index RANGE
+    # under any sort, so bucket counts match the device exactly even
+    # though equal-key internal order may differ.
+    tie = (
+        np.arange(n_shards, dtype=np.int64)[:, None] * local_size
+        + np.arange(local_size, dtype=np.int64)[None, :]
+    )
+    sample_at = _sample_positions(local_size, n_shards)
+    samples = packed_sorted[:, sample_at]
+    sample_ties = tie[:, sample_at]
+    pool_order = np.lexsort(
+        (sample_ties.reshape(-1), samples.reshape(-1))
+    )
+    pool = samples.reshape(-1)[pool_order]
+    pool_tie = sample_ties.reshape(-1)[pool_order]
+    pivot_at = _pivot_positions(pool.size, n_shards)
+    pivots = pool[pivot_at]
+    pivot_ties = pool_tie[pivot_at]
     most = 0
     for s in range(n_shards):
-        row = packed[s][valid[s]]
-        # the device rule exactly: count(pivot < key), equal-to-pivot keys
-        # route right
-        dest = (pivots[None, :] < row[:, None]).sum(axis=1)
+        mask = valid[s][order[s]]
+        row = packed_sorted[s][mask]
+        row_tie = tie[s][mask]
+        # the device rule exactly: count(pivot < (key, tie)) lexicographic
+        less = (pivots[None, :] < row[:, None]) | (
+            (pivots[None, :] == row[:, None])
+            & (pivot_ties[None, :] < row_tie[:, None])
+        )
+        dest = less.sum(axis=1)
         if dest.size:
             most = max(most, int(np.bincount(dest, minlength=n_shards).max()))
     return most
@@ -133,10 +178,19 @@ def _build_sample_sort(
         perm = seg.sort_permutation(_masked_keys(local, key_names, local_size))
         local = {k: v[perm] for k, v in local.items()}
         keys = _masked_keys(local, key_names, local_size)
+        # routing tiebreaker: global position in locally-sorted shard-major
+        # order. Unique per record, so pivot buckets stay balanced under
+        # ANY key skew (module docstring) — a dominant equal-key run splits
+        # across shards instead of landing on one.
+        tie = (
+            jax.lax.axis_index(axis_name).astype(jnp.int32) * local_size
+            + jnp.arange(local_size, dtype=jnp.int32)
+        )
+        route_keys = keys + [tie]
 
         # 2. pooled samples -> identical pivots everywhere
         sample_at = jnp.asarray(_sample_positions(local_size, n_shards))
-        samples = [k[sample_at] for k in keys]
+        samples = [k[sample_at] for k in route_keys]
         pools = [
             jax.lax.all_gather(s, axis_name).reshape(-1) for s in samples
         ]
@@ -146,7 +200,7 @@ def _build_sample_sort(
 
         # 3. capacity-bounded exchange by pivot bucket
         local = dict(local)
-        local["_dest"] = _dest_from_pivots(keys, pivots)
+        local["_dest"] = _dest_from_pivots(route_keys, pivots)
         exchanged, n_dropped = reshard_by_key(
             local, "_dest", axis_name, n_shards, capacity=capacity,
             drop_key=True,  # the receiver has no use for the routing column
@@ -182,6 +236,10 @@ def distributed_sort(
     drop records (tight default computed host-side from concrete input;
     a worst-case shard-size fallback is used under tracing).
     """
+    if not 1 <= len(key_names) <= 2:
+        raise ValueError(
+            f"distributed sort supports 1-2 key columns, got {len(key_names)}"
+        )
     n_shards, shard_size = stacked_cols[key_names[0]].shape
     _check_shard_count(n_shards, mesh, axis_name)
     concrete = not isinstance(
@@ -208,7 +266,8 @@ def distributed_sort(
         if n_dropped:
             raise RuntimeError(
                 f"distributed sort dropped {n_dropped} records: raise "
-                "capacity (extreme key skew concentrates records on one "
-                "shard)"
+                "capacity (the tiebreaker balances key skew, so this "
+                "indicates a sampling-slack shortfall; required_sort_capacity "
+                "gives the tight bound)"
             )
     return out
