@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan lint test test-threads tpu-test clean
+.PHONY: ci ci-deep native native-tsan lint test test-threads tpu-test docs clean
 
 ci: native lint test
 
@@ -34,6 +34,10 @@ test-threads:
 
 native-tsan:
 	$(MAKE) -C sctools_tpu/native tsan
+
+# regenerate the per-flag CLI reference from the live parsers
+docs:
+	$(PY) docs/generate_cli_reference.py
 
 # deep gate: the threaded native paths under ThreadSanitizer. libtsan must
 # be preloaded because the python host binary is uninstrumented; the same

