@@ -253,6 +253,37 @@ def prepacked_gate(frame: ReadFrame, entity_kind: str) -> bool:
     )
 
 
+# columns that never cross the device->host wire: three counters the
+# reference never increments (synthesized as zeros at write time) and four
+# ratios that are pure f32 functions of shipped integer columns
+# (recomputed host-side with the engine's exact formulas). At 1.3M-cell
+# scale this cuts the pulled row block ~19%.
+_WIRE_ZERO_INTS = frozenset(
+    ("noise_reads", "antisense_reads", "reads_mapped_too_many_loci")
+)
+_WIRE_DERIVED_FLOATS = frozenset(
+    (
+        "reads_per_molecule",
+        "reads_per_fragment",
+        "fragments_per_molecule",
+        "pct_mitochondrial_molecules",
+    )
+)
+
+
+def wire_result_names(columns):
+    """(int_names, float_names) actually pulled from the device per batch."""
+    int_names = ("entity_code",) + tuple(
+        c for c in columns if c in INT_COLUMNS and c not in _WIRE_ZERO_INTS
+    )
+    float_names = tuple(
+        c
+        for c in columns
+        if c not in INT_COLUMNS and c not in _WIRE_DERIVED_FLOATS
+    )
+    return int_names, float_names
+
+
 class MetricGatherer:
     """Common driver: pack, compute on the selected backend, write csv."""
 
@@ -559,10 +590,7 @@ class MetricGatherer:
         else:
             n_entities = int(np.unique(key).size)
         k = min(bucket_size(n_entities, minimum=1024), num_segments)
-        int_names = ("entity_code",) + tuple(
-            c for c in self.columns if c in INT_COLUMNS
-        )
-        float_names = tuple(c for c in self.columns if c not in INT_COLUMNS)
+        int_names, float_names = wire_result_names(self.columns)
         block = device_engine.compact_results_wire(
             result, int_names, float_names, k
         )
@@ -624,13 +652,59 @@ class MetricGatherer:
         if keep is None:
             keep = slice(None)
         index = np.where(row_names == "", "None", row_names)[keep]
-        columns = [
-            ints[:n_entities, int_of[column]][keep].astype(np.int64)
-            if column in int_of
-            else floats[:n_entities, float_of[column]][keep].astype(np.float64)
-            for column in self.columns
-        ]
-        out.write_block(index.astype(str), columns)
+        def int_col(column):
+            return ints[:n_entities, int_of[column]][keep].astype(np.int64)
+
+        f32_cache: Dict[str, np.ndarray] = {}
+
+        def f32_of(column):
+            # shared across the derived ratios; computed once per batch
+            if column not in f32_cache:
+                f32_cache[column] = ints[:n_entities, int_of[column]][
+                    keep
+                ].astype(np.float32)
+            return f32_cache[column]
+
+        def derived(column):
+            # the engine's exact f32 formulas (metrics/device.py), applied
+            # to the SHIPPED integer columns instead of pulling the ratio.
+            # Every member of _WIRE_DERIVED_FLOATS needs a branch HERE —
+            # the final raise makes a missed addition loud, not silent.
+            if column == "reads_per_molecule":
+                nm, nr = f32_of("n_molecules"), f32_of("n_reads")
+                result = np.where(nm > 0, nr / np.maximum(nm, 1), np.nan)
+            elif column == "reads_per_fragment":
+                nf, nr = f32_of("n_fragments"), f32_of("n_reads")
+                result = np.where(nf > 0, nr / np.maximum(nf, 1), np.nan)
+            elif column == "fragments_per_molecule":
+                nm, nf = f32_of("n_molecules"), f32_of("n_fragments")
+                result = np.where(nm > 0, nf / np.maximum(nm, 1), np.nan)
+            elif column == "pct_mitochondrial_molecules":
+                mito = f32_of("n_mitochondrial_molecules")
+                nr = f32_of("n_reads")
+                result = np.where(
+                    mito > 0, mito / np.maximum(nr, 1) * np.float32(100.0), 0.0
+                )
+            else:
+                raise KeyError(
+                    f"no host derivation for wire-excluded column {column!r}"
+                )
+            return result.astype(np.float64)
+
+        def column_values(column):
+            if column in int_of:
+                return int_col(column)
+            if column in float_of:
+                return floats[:n_entities, float_of[column]][keep].astype(
+                    np.float64
+                )
+            if column in _WIRE_ZERO_INTS:
+                return np.zeros(index.shape[0], dtype=np.int64)
+            return derived(column)
+
+        out.write_block(
+            index.astype(str), [column_values(c) for c in self.columns]
+        )
 
     # ---- cpu backend (exact reference streaming semantics) ---------------
 

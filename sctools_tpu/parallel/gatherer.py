@@ -22,8 +22,11 @@ from __future__ import annotations
 import numpy as np
 
 from ..io.packed import KEY_HI_SHIFT
-from ..metrics.gatherer import GatherCellMetrics, GatherGeneMetrics
-from ..metrics.schema import INT_COLUMNS
+from ..metrics.gatherer import (
+    GatherCellMetrics,
+    GatherGeneMetrics,
+    wire_result_names,
+)
 from ..ops.segments import bucket_size
 from .metrics import sharded_entity_metrics
 from .shard import partition_columns
@@ -90,10 +93,7 @@ class _ShardedMixin:
             bucket_size(int(per_shard.max(initial=1)), minimum=1024),
             shard_size,
         )
-        int_names = ("entity_code",) + tuple(
-            c for c in self.columns if c in INT_COLUMNS
-        )
-        float_names = tuple(c for c in self.columns if c not in INT_COLUMNS)
+        int_names, float_names = wire_result_names(self.columns)
         blocks, n_entities = sharded_entity_metrics(
             stacked, self._mesh, kind=self.entity_kind,
             compact=(int_names, float_names, k), **engine_flags,
