@@ -78,7 +78,12 @@ KEY_CODE_MASK = (1 << KEY_CODE_BITS) - 1
 KEY_UNMAPPED_SHIFT = 30
 
 
-def wire_layout(wide_genomic: bool, small_ref: bool, run_keys: bool = False):
+def wire_layout(
+    wide_genomic: bool,
+    small_ref: bool,
+    run_keys: bool = False,
+    with_cb: bool = True,
+):
     """Ordered (column name, lane width) spec of the monoblock wire.
 
     The SINGLE source of truth for the one-int32-buffer batch transport:
@@ -90,7 +95,9 @@ def wire_layout(wide_genomic: bool, small_ref: bool, run_keys: bool = False):
     count that is a multiple of 4. ``n_valid`` is a single leading int32
     word, not a per-record lane, and is listed separately by both sides.
 
-    With ``run_keys`` the two per-record sort-key lanes move OFF the wire:
+    ``with_cb=False`` (the gene axis) omits the ``cb_qual`` lane its
+    engine never reads. With ``run_keys`` the two per-record sort-key
+    lanes move OFF the wire:
     records of one (k1,k2,k3) molecule run are adjacent in the sorted
     input, so the keys ship once per run in a trailing table —
     ``key_hi_runs`` then ``key_lo_runs``, each ``num_runs`` (a padded
@@ -104,7 +111,12 @@ def wire_layout(wide_genomic: bool, small_ref: bool, run_keys: bool = False):
         cols += [("genomic_qual", 4), ("genomic_total", 4)]
     if not small_ref:
         cols.append(("m_ref", 4))
-    cols += [("umi_qual", 2), ("cb_qual", 2), ("flags", 2)]
+    cols.append(("umi_qual", 2))
+    if with_cb:
+        # only the cell axis consumes the cell-barcode quality summary;
+        # the gene axis leaves these 2 bytes/record off the wire
+        cols.append(("cb_qual", 2))
+    cols.append(("flags", 2))
     if not wide_genomic:
         cols += [("genomic_qual", 2), ("genomic_total", 2)]
     if small_ref:

@@ -141,6 +141,7 @@ def _unpack_wire(
     wide_genomic: bool,
     small_ref: bool,
     num_runs: int = 0,
+    with_cb: bool = True,
 ) -> Dict[str, jnp.ndarray]:
     """Monoblock wire -> the prepacked named columns (zero-copy bitcasts).
 
@@ -154,7 +155,9 @@ def _unpack_wire(
     n = num_segments
     cols: Dict[str, jnp.ndarray] = {"n_valid": wire[:1]}
     off = 1
-    for name, width in wire_layout(wide_genomic, small_ref, bool(num_runs)):
+    for name, width in wire_layout(
+        wide_genomic, small_ref, bool(num_runs), with_cb
+    ):
         words = n * width // 4
         chunk = wire[off : off + words]  # offsets are Python ints: static
         off += words
@@ -193,7 +196,7 @@ def _unpack_wire(
     jax.jit,
     static_argnames=(
         "num_segments", "kind", "presorted", "prepacked", "wide_genomic",
-        "small_ref", "num_runs",
+        "small_ref", "num_runs", "with_cb",
     ),
 )
 def compute_entity_metrics(
@@ -205,6 +208,7 @@ def compute_entity_metrics(
     wide_genomic: bool = False,
     small_ref: bool = False,
     num_runs: int = 0,
+    with_cb: bool = True,
 ) -> Dict[str, jnp.ndarray]:
     """All metrics for one entity axis in a single compiled pass.
 
@@ -253,7 +257,8 @@ def compute_entity_metrics(
         # monoblock transport: one int32 buffer carrying every prepacked
         # column (gatherer._pack_wire layout) — bitcast back to names here
         cols = _unpack_wire(
-            cols["wire"], num_segments, wide_genomic, small_ref, num_runs
+            cols["wire"], num_segments, wide_genomic, small_ref, num_runs,
+            with_cb=with_cb,
         )
 
     if prepacked:

@@ -58,8 +58,14 @@ def _pad_columns(
     force_wide_genomic: bool = False,
     run_keys_bucket: int = 0,
     run_starts: np.ndarray = None,
+    include_cb: bool = True,
 ):
     """ReadFrame -> (device-ready padded columns, static engine flags).
+
+    ``include_cb=False`` (the gene axis) omits the cell-barcode quality
+    column from BOTH schemas — the gene engine never reads it — and
+    records the choice in the returned static flags (``with_cb``) so the
+    wire layout is agreed by construction, not by matching call sites.
 
     ``pad_to`` pins the padded size (streaming batches all share one compiled
     shape); it is ignored when the frame is larger (e.g. a single entity that
@@ -95,12 +101,13 @@ def _pad_columns(
     if prepacked_keys is None:
         # plain schema ships the derived float32 views (the compat
         # properties recover exactly the floats the old decoder shipped)
+        if include_cb:
+            cols["cb_frac30"] = pad(
+                np.nan_to_num(frame.cb_frac30, nan=0.0), 0.0, np.float32
+            )
         cols.update(
             umi_frac30=pad(
                 np.nan_to_num(frame.umi_frac30, nan=0.0), 0.0, np.float32
-            ),
-            cb_frac30=pad(
-                np.nan_to_num(frame.cb_frac30, nan=0.0), 0.0, np.float32
             ),
             genomic_frac30=pad(
                 np.nan_to_num(frame.genomic_frac30, nan=0.0), 0.0, np.float32
@@ -164,7 +171,6 @@ def _pad_columns(
     key_lo = ((k2 & KEY_LO_MASK) << KEY_CODE_BITS) | k3
     cols.update(
         umi_qual=pad(frame.umi_qual, 0, np.uint16),
-        cb_qual=pad(frame.cb_qual, 0, np.uint16),
         m_ref=m_ref,
         ps=pad(
             (frame.pos.astype(np.int32) << 1) | frame.strand.astype(np.int32),
@@ -173,7 +179,14 @@ def _pad_columns(
         ),
         n_valid=np.asarray([n], dtype=np.int32),
     )
-    static_flags = {"wide_genomic": not narrow_genomic, "small_ref": small_ref}
+    if include_cb:
+        # only the cell axis consumes the cell-barcode quality summary
+        cols["cb_qual"] = pad(frame.cb_qual, 0, np.uint16)
+    static_flags = {
+        "wide_genomic": not narrow_genomic,
+        "small_ref": small_ref,
+        "with_cb": include_cb,
+    }
     if run_keys_bucket:
         # run-keyed wire: records of one (k1,k2,k3) run are adjacent in the
         # sorted input, so the 8 key bytes ship once per run — a trailing
@@ -214,6 +227,7 @@ def _pack_wire(cols: Dict[str, np.ndarray], static_flags: dict) -> np.ndarray:
         bool(static_flags.get("wide_genomic")),
         bool(static_flags.get("small_ref")),
         run_keys=bool(static_flags.get("num_runs")),
+        with_cb=bool(static_flags.get("with_cb", True)),
     )
     parts = [cols["n_valid"]]
     for name, width in layout:
@@ -518,6 +532,7 @@ class MetricGatherer:
             force_wide_genomic=self._wide_genomic,
             run_keys_bucket=run_keys_bucket if prepacked else 0,
             run_starts=run_starts,
+            include_cb=self.entity_kind == "cell",
         )
         if static_flags.get("wide_genomic"):
             # one-way ratchet: once any batch needs the wide genomic

@@ -284,7 +284,9 @@ def test_prepacked_wide_fallbacks_match_plain(tmp_path):
     packed, static_flags = _pad_columns(
         frame, is_mito, prepacked_keys=("cell", "gene", "umi"), pair_mito=True
     )
-    assert static_flags == {"wide_genomic": True, "small_ref": False}
+    assert static_flags == {
+        "wide_genomic": True, "small_ref": False, "with_cb": True,
+    }
     n = len(plain["flags"])
     a = device_engine.compute_entity_metrics(
         {k: np.asarray(v) for k, v in plain.items()},
