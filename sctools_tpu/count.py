@@ -56,8 +56,10 @@ class _MoleculeAccumulator:
     other value.
     """
 
-    def __init__(self, gene_name_to_index: Dict[str, int]):
+    def __init__(self, gene_name_to_index: Dict[str, int], mesh=None):
         self._gene_name_to_index = gene_name_to_index
+        self._mesh = mesh
+        self._n_shards = 0 if mesh is None else mesh.size
         self._cells: List[np.ndarray] = []
         self._umis: List[np.ndarray] = []
         self._genes: List[np.ndarray] = []
@@ -106,6 +108,9 @@ class _MoleculeAccumulator:
         n = frame.n_records
         if n == 0:
             return
+        if self._mesh is not None:
+            self._add_batch_sharded(frame, offset, pad_to)
+            return
         cols = device_count_columns(frame, pad_to=pad_to)
         out = count_molecules(cols, num_segments=len(cols["valid"]))
         is_molecule = np.asarray(out["is_molecule"])
@@ -113,14 +118,60 @@ class _MoleculeAccumulator:
         umis = np.asarray(out["umi"])[is_molecule]
         genes = np.asarray(out["gene"])[is_molecule]
         first = np.asarray(out["first_index"])[is_molecule].astype(np.int64)
+        self._append_molecules(frame, cells, umis, genes, first, offset)
 
-        gene_vocab_cols = np.asarray(
+    def _add_batch_sharded(self, frame, offset: int, pad_to: int) -> None:
+        """The per-batch kernel over a device mesh (cells never span shards).
+
+        Query groups stay intact under the cell-hash partition (every
+        alignment of one query carries the same CB), and the kernel's
+        local ``first_index`` maps back to the original batch position
+        through a carried position column, so cross-batch dedup and the
+        first-observation row order are bit-identical to single-device.
+        """
+        from .parallel.count import sharded_count_molecules
+        from .parallel.shard import partition_columns
+
+        # pad_to=0: the partition drops padding rows and re-pads per shard
+        # anyway (shard_size derives from per-shard occupancy), so batch-
+        # level capacity padding would be pure wasted allocation here
+        cols = device_count_columns(frame, pad_to=0)
+        n_padded = len(cols["valid"])
+        cols["_orig"] = np.arange(n_padded, dtype=np.int64)
+        stacked = partition_columns(cols, self._n_shards, key="cell")
+        orig = stacked.pop("_orig")
+        out = sharded_count_molecules(stacked, self._mesh)
+        is_molecule = np.asarray(out["is_molecule"])
+        gene_vocab_cols = self._gene_vocab_cols(frame)
+        for shard in range(self._n_shards):
+            mask = is_molecule[shard]
+            if not mask.any():
+                continue
+            cells = np.asarray(out["cell"][shard])[mask]
+            umis = np.asarray(out["umi"][shard])[mask]
+            genes = np.asarray(out["gene"][shard])[mask]
+            local_first = np.asarray(out["first_index"][shard])[mask]
+            first = orig[shard][local_first.astype(np.int64)]
+            self._append_molecules(
+                frame, cells, umis, genes, first, offset, gene_vocab_cols
+            )
+
+    def _gene_vocab_cols(self, frame) -> np.ndarray:
+        """Batch gene vocabulary -> output column indices (once per frame)."""
+        return np.asarray(
             [
                 self._gene_name_to_index.get(name, -1)
                 for name in frame.gene_names
             ],
             dtype=np.int64,
         )
+
+    def _append_molecules(
+        self, frame, cells, umis, genes, first, offset: int,
+        gene_vocab_cols: np.ndarray = None,
+    ) -> None:
+        if gene_vocab_cols is None:
+            gene_vocab_cols = self._gene_vocab_cols(frame)
         gene_cols = gene_vocab_cols[genes]
         if np.any(gene_cols < 0):
             missing = {
@@ -133,7 +184,7 @@ class _MoleculeAccumulator:
         self._cells.append(self._pack_used(cells, frame.cell_names))
         self._umis.append(self._pack_used(umis, frame.umi_names))
         self._genes.append(gene_cols)
-        self._firsts.append(first + offset)
+        self._firsts.append(np.asarray(first, dtype=np.int64) + offset)
 
     def assemble(self):
         """Global dedup + matrix assembly (vectorized, one pass)."""
@@ -262,8 +313,13 @@ class CountMatrix:
         open_mode: str = "rb",
         backend: str = "device",
         batch_records: int = DEFAULT_BATCH_RECORDS,
+        mesh=None,
     ) -> "CountMatrix":
         """Count unique (cell, molecule, gene) triples from a tagged BAM.
+
+        ``mesh``: optional jax.sharding.Mesh — the per-batch kernel runs
+        sharded over its devices (cells never span shards; the CLI's
+        ``--devices N``), with output identical to single-device.
 
         The counting strategy is the reference's CellRanger-2.1.1 match
         (count.py:156-169): consider a query iff its alignments implicate
@@ -293,8 +349,11 @@ class CountMatrix:
                 open_mode=open_mode,
                 tag_keys=(cell_barcode_tag, molecule_barcode_tag, gene_name_tag),
                 batch_records=batch_records,
+                mesh=mesh,
             )
         if backend == "cpu":
+            if mesh is not None:
+                raise ValueError("mesh counting requires the device backend")
             return cls._from_bam_cpu(
                 bam_file,
                 gene_name_to_index,
@@ -313,6 +372,7 @@ class CountMatrix:
         open_mode: str = "rb",
         tag_keys=_DEFAULT_TAGS,
         batch_records: int = DEFAULT_BATCH_RECORDS,
+        mesh=None,
     ) -> "CountMatrix":
         from .io.packed import (
             compact_frame,
@@ -322,7 +382,7 @@ class CountMatrix:
         )
         from .ops.segments import bucket_size
 
-        accumulator = _MoleculeAccumulator(gene_name_to_index)
+        accumulator = _MoleculeAccumulator(gene_name_to_index, mesh=mesh)
         frames = iter_frames_from_bam(
             bam_file,
             batch_records,

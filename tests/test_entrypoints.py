@@ -270,6 +270,17 @@ def test_count_matrix_and_merge(tmp_path, tagged_bam, annotation_gtf):
     assert cm.matrix.shape == (len(CELLS), len(GENES))
     assert int(cm.matrix.sum()) == 120  # all umis unique in fixture
 
+    # --devices: the sharded kernel through the CLI == single-device
+    mesh_prefix = str(tmp_path / "counts_mesh")
+    rc = platform.GenericPlatform.bam_to_count_matrix(
+        ["-b", tagged_bam, "-o", mesh_prefix, "-a", annotation_gtf,
+         "--devices", "8"]
+    )
+    assert rc == 0
+    mesh_cm = CountMatrix.load(mesh_prefix)
+    np.testing.assert_array_equal(mesh_cm.row_index, cm.row_index)
+    assert (mesh_cm.matrix != cm.matrix).nnz == 0
+
     merged_prefix = str(tmp_path / "merged_counts")
     rc = platform.GenericPlatform.merge_count_matrices(
         ["-i", prefix, prefix, "-o", merged_prefix]
