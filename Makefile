@@ -5,19 +5,25 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan lint test test-threads tpu-test docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test docs clean
 
 ci: native lint test
 
 native:
 	$(MAKE) -C sctools_tpu/native
 
+# style floor (ruff when installed — not part of this image), then the
+# project's own gate: scx-lint (JAX/TPU anti-patterns + ctypes ABI drift
+# + tsan.supp audit, sctools_tpu/analysis). Both must pass for `make ci`.
+# tests/ is style-checked but excluded from scx-lint: it hosts the
+# deliberately-bad fixture corpus and test-local jax.config setup.
 lint:
 	@if $(PY) -c "import ruff" 2>/dev/null; then \
 		$(PY) -m ruff check sctools_tpu tests bench.py __graft_entry__.py; \
 	else \
 		$(PY) -m compileall -q sctools_tpu tests bench.py __graft_entry__.py; \
 	fi
+	$(PY) -m sctools_tpu.analysis sctools_tpu bench.py __graft_entry__.py
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -35,22 +41,46 @@ test-threads:
 native-tsan:
 	$(MAKE) -C sctools_tpu/native tsan
 
+native-asan:
+	$(MAKE) -C sctools_tpu/native asan
+
+native-ubsan:
+	$(MAKE) -C sctools_tpu/native ubsan
+
 # regenerate the per-flag CLI reference from the live parsers
 docs:
 	$(PY) docs/generate_cli_reference.py
 
-# deep gate: the threaded native paths under ThreadSanitizer. libtsan must
-# be preloaded because the python host binary is uninstrumented; the same
-# $(CXX) that built the instrumented lib resolves the runtime so the two
-# cannot mismatch. SCTOOLS_TPU_REQUIRE_NATIVE turns the suite's
-# native-unavailable skip into a hard failure — a gate that cannot load
-# the sanitizer build must fail, not pass vacuously.
-ci-deep: ci native-tsan
+# deep gate: the threaded native paths under ThreadSanitizer, then the
+# full native suite under Address- and UndefinedBehaviorSanitizer. Each
+# runtime must be preloaded because the python host binary is
+# uninstrumented; the same $(CXX) that built the instrumented lib
+# resolves the runtime so the two cannot mismatch.
+# SCTOOLS_TPU_REQUIRE_NATIVE turns the suite's native-unavailable skip
+# into a hard failure — a gate that cannot load the sanitizer build must
+# fail, not pass vacuously. The asan leg disables leak detection: LSan
+# would report the (uninstrumented) interpreter's arena allocations at
+# exit, drowning real reports from our library. libstdc++ rides the
+# asan/ubsan preloads: python itself doesn't link it, so without the
+# co-preload the sanitizer runtime initializes before any C++ runtime
+# exists and its __cxa_throw interceptor aborts the first time an
+# uninstrumented extension (jaxlib) throws.
+ci-deep: ci native-tsan native-asan native-ubsan
 	LD_PRELOAD=$$($(CXX) -print-file-name=libtsan.so) \
 	TSAN_OPTIONS="report_bugs=1 exitcode=66 suppressions=$(CURDIR)/sctools_tpu/native/tsan.supp" \
 	SCTOOLS_TPU_NATIVE_LIB=$(CURDIR)/sctools_tpu/native/libsctools_native.tsan.so \
 	SCTOOLS_TPU_REQUIRE_NATIVE=1 \
 	$(PY) -m pytest tests/test_native_threads.py -q
+	LD_PRELOAD="$$($(CXX) -print-file-name=libasan.so) $$($(CXX) -print-file-name=libstdc++.so)" \
+	ASAN_OPTIONS="detect_leaks=0 abort_on_error=0 exitcode=66" \
+	SCTOOLS_TPU_NATIVE_LIB=$(CURDIR)/sctools_tpu/native/libsctools_native.asan.so \
+	SCTOOLS_TPU_REQUIRE_NATIVE=1 \
+	$(PY) -m pytest tests/test_native.py -q
+	LD_PRELOAD="$$($(CXX) -print-file-name=libubsan.so) $$($(CXX) -print-file-name=libstdc++.so)" \
+	UBSAN_OPTIONS="halt_on_error=1 print_stacktrace=1" \
+	SCTOOLS_TPU_NATIVE_LIB=$(CURDIR)/sctools_tpu/native/libsctools_native.ubsan.so \
+	SCTOOLS_TPU_REQUIRE_NATIVE=1 \
+	$(PY) -m pytest tests/test_native.py -q
 
 clean:
 	$(MAKE) -C sctools_tpu/native clean

@@ -1,0 +1,36 @@
+"""scx-lint: JAX/TPU-aware static analysis + native ABI checking.
+
+The merge gate the reference project got from CircleCI lint (its
+correctness floor) rebuilt for what actually sinks JAX/TPU codebases:
+silent retraces, host-device syncs inside traced code, tracer leaks into
+Python control flow, and drift between the hand-written ctypes tables in
+``native/__init__.py`` and the ``extern "C"`` sources they bind.
+
+Three passes, one CLI (``python -m sctools_tpu.analysis``), all pure
+stdlib — nothing here imports jax, numpy, or the code under analysis:
+
+- :mod:`.jaxlint`  — AST rules SCX101-SCX108 over traced functions;
+- :mod:`.abicheck` — ctypes ABI cross-check, rules SCX201-SCX206;
+- :mod:`.suppaudit` — tsan.supp validity audit, rules SCX301-SCX303.
+
+Findings carry stable rule ids and honor inline
+``# scx-lint: disable=SCXNNN`` escape hatches (:mod:`.findings`).
+``make lint`` runs the CLI after ruff/compileall, making a clean scx-lint
+run part of ``make ci`` mergeability.
+"""
+
+from .abicheck import ABI_RULES, check_abi
+from .findings import Finding, Suppressions
+from .jaxlint import JAX_RULES, lint_file
+from .suppaudit import SUPP_RULES, audit_suppressions
+
+__all__ = [
+    "ABI_RULES",
+    "Finding",
+    "JAX_RULES",
+    "SUPP_RULES",
+    "Suppressions",
+    "audit_suppressions",
+    "check_abi",
+    "lint_file",
+]

@@ -1,0 +1,170 @@
+"""scx-lint CLI: ``python -m sctools_tpu.analysis [paths...]``.
+
+Runs three passes and exits non-zero when any finding survives
+suppressions:
+
+1. JAX lint (SCX1xx) over every ``.py`` file under the given paths;
+2. ctypes ABI check (SCX2xx) over the first ``native/`` package found
+   under the paths (or ``--native-dir``);
+3. tsan.supp audit (SCX3xx) over that package's suppression file.
+
+The module imports nothing heavyweight (no jax, no numpy), so the gate
+adds milliseconds to ``make lint``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .abicheck import ABI_RULES, check_abi
+from .findings import Finding
+from .jaxlint import JAX_RULES, lint_file
+from .suppaudit import SUPP_RULES, audit_suppressions
+
+# directory names never worth walking into
+_SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", "node_modules"}
+
+
+def _collect_py_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [
+                d for d in sorted(dirnames)
+                if d not in _SKIP_DIRS and not d.startswith(".")
+            ]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return out
+
+
+def _find_native_dir(paths: List[str]) -> Optional[str]:
+    """First directory under ``paths`` holding native ctypes bindings."""
+    for path in paths:
+        if os.path.isfile(path):
+            path = os.path.dirname(path) or "."
+        candidate = os.path.join(path, "native")
+        if os.path.exists(os.path.join(candidate, "__init__.py")):
+            return candidate
+        for dirpath, dirnames, _ in os.walk(path):
+            dirnames[:] = [
+                d for d in sorted(dirnames)
+                if d not in _SKIP_DIRS and not d.startswith(".")
+            ]
+            if os.path.basename(dirpath) == "native" and os.path.exists(
+                os.path.join(dirpath, "__init__.py")
+            ):
+                return dirpath
+    return None
+
+
+def _print_rules() -> None:
+    print("scx-lint rule catalog (docs/static_analysis.md):")
+    for title, rules in (
+        ("JAX/TPU lint", JAX_RULES),
+        ("ctypes ABI", ABI_RULES),
+        ("tsan.supp audit", SUPP_RULES),
+    ):
+        print(f"  {title}:")
+        for rule_id, slug in sorted(rules.items()):
+            print(f"    {rule_id}  {slug}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sctools_tpu.analysis",
+        description=(
+            "scx-lint: JAX/TPU static analysis + native ABI checker. "
+            "Exit 0 == clean."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["sctools_tpu"],
+        help="files/directories to lint (default: sctools_tpu)",
+    )
+    parser.add_argument(
+        "--native-dir", default=None,
+        help="native package dir for the ABI/supp passes "
+        "(default: first native/ found under paths)",
+    )
+    parser.add_argument(
+        "--no-jax-lint", action="store_true", help="skip the SCX1xx pass"
+    )
+    parser.add_argument(
+        "--no-abi", action="store_true", help="skip the SCX2xx pass"
+    )
+    parser.add_argument(
+        "--no-supp", action="store_true", help="skip the SCX3xx pass"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="findings only, no summary line",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        _print_rules()
+        return 0
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        # a gate pointed at a path that is not there must fail loudly,
+        # not pass vacuously over zero files
+        for path in missing:
+            print(f"scx-lint: path does not exist: {path}", file=sys.stderr)
+        return 2
+
+    findings: List[Finding] = []
+    checked_files = 0
+
+    if not args.no_jax_lint:
+        for path in _collect_py_files(args.paths):
+            checked_files += 1
+            findings.extend(lint_file(path))
+
+    native_dir = args.native_dir or _find_native_dir(args.paths)
+    if native_dir is not None:
+        if not args.no_abi:
+            findings.extend(check_abi(native_dir))
+        if not args.no_supp:
+            findings.extend(
+                audit_suppressions(
+                    os.path.join(native_dir, "tsan.supp"), native_dir
+                )
+            )
+    elif not (args.no_abi and args.no_supp) and not args.quiet:
+        print(
+            "scx-lint: no native/ package under the given paths; "
+            "ABI + suppression passes skipped",
+            file=sys.stderr,
+        )
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    for finding in findings:
+        print(finding.render())
+    if not args.quiet:
+        passes = [
+            name
+            for name, skipped in (
+                ("jax-lint", args.no_jax_lint),
+                ("abi", args.no_abi or native_dir is None),
+                ("supp", args.no_supp or native_dir is None),
+            )
+            if not skipped
+        ]
+        print(
+            f"scx-lint: {len(findings)} finding(s) across {checked_files} "
+            f"python file(s); passes: {', '.join(passes) or 'none'}"
+        )
+    return 1 if findings else 0

@@ -1,0 +1,708 @@
+"""AST lint for JAX/TPU anti-patterns (rules SCX101-SCX108).
+
+The pass is import-free and pure-stdlib: it never imports jax or the
+module under analysis, so it runs in milliseconds anywhere (CI, pre-TPU
+hosts). Traced contexts are discovered structurally — a function is
+"traced" when it is decorated with ``jax.jit`` / ``jax.shard_map``
+(directly or through ``functools.partial``) or passed by name into a
+``jax.jit(...)`` / ``jax.shard_map(...)`` call in the same module.
+
+Rule catalog (docs/static_analysis.md has the rationale for each):
+
+- SCX101 host-sync-in-traced: ``.item()``/``.tolist()``/
+  ``.block_until_ready()``, ``np.asarray``/``np.array``, ``jax.device_get``
+  or ``float()``/``int()``/``bool()`` on a non-static value inside a
+  traced function. Under jit these either fail at trace time or silently
+  force a device->host transfer per call.
+- SCX102 traced-branch: Python ``if``/``while``/``for`` whose condition
+  or iterable references a traced (non-static) parameter. Control flow on
+  tracers raises ConcretizationTypeError on TPU; on CPU fallbacks it can
+  silently specialize on one branch.
+- SCX103 retrace-hazard: a jit-decorated function taking scalar/shape-like
+  parameters (``n_*``, ``num_*``, ``*_size`` ... or bool-defaulted flags)
+  that are not listed in ``static_argnames``/``static_argnums``. Passing
+  Python scalars as traced args retraces per distinct value.
+- SCX104 jnp-in-host-loop: ``jnp.array``/``jnp.asarray``/``jnp.zeros``/...
+  inside a host-level ``for``/``while`` body. Each call is a separate
+  dispatch + transfer; batch outside the loop instead.
+- SCX105 missing-donate: a jit function functionally updating one of its
+  own array parameters (``param.at[...]``) without ``donate_argnums``/
+  ``donate_argnames`` — the update allocates a second full buffer.
+- SCX106 config-mutation: ``jax.config.update(...)`` (or assignment to a
+  ``jax.config`` attribute) outside ``platform.py``. Scattered config
+  mutation makes process-global numerics/order dependent on import order.
+- SCX107 jit-in-loop: constructing a ``jax.jit``/``jax.shard_map``
+  callable inside a host loop body — a fresh cache (and retrace) per
+  iteration.
+- SCX108 print-in-traced: ``print()`` or ``logging``/``logger`` calls
+  inside a traced function; they run at trace time only (or force a
+  sync). Use ``jax.debug.print``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, Suppressions
+
+JAX_RULES = {
+    "SCX101": "host-sync-in-traced",
+    "SCX102": "traced-branch",
+    "SCX103": "retrace-hazard",
+    "SCX104": "jnp-in-host-loop",
+    "SCX105": "missing-donate",
+    "SCX106": "config-mutation",
+    "SCX107": "jit-in-loop",
+    "SCX108": "print-in-traced",
+}
+
+# files allowed to mutate process-global jax.config (SCX106)
+CONFIG_OWNERS = ("platform.py", "conftest.py")
+
+_JNP_CONSTRUCTORS = {
+    "array", "asarray", "zeros", "ones", "full", "arange", "empty",
+    "linspace", "eye",
+}
+_NP_MATERIALIZERS = {"asarray", "array", "copy", "frombuffer", "ctypeslib"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# attribute reads that stay static under tracing (shape metadata)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+# method calls on a dict-like traced parameter whose result is static
+# pytree *structure*, not a traced value
+_STRUCT_METHODS = {"items", "keys", "values"}
+
+_SCALARISH_EXACT = {
+    "n", "k", "m", "num", "size", "length", "width", "height", "depth",
+    "count", "axis", "ndim", "capacity", "seed", "level", "shape", "dims",
+    "stride", "rank",
+}
+_SCALARISH_PREFIX = ("n_", "num_")
+_SCALARISH_SUFFIX = (
+    "_size", "_len", "_length", "_count", "_shape", "_axis", "_segments",
+    "_shards", "_runs", "_bits", "_level", "_records", "_threads",
+)
+
+
+def _is_scalarish(name: str) -> bool:
+    return (
+        name in _SCALARISH_EXACT
+        or name.startswith(_SCALARISH_PREFIX)
+        or name.endswith(_SCALARISH_SUFFIX)
+    )
+
+
+@dataclass
+class TraceSpec:
+    """How a function is traced: which params escape tracing."""
+
+    kind: str  # "jit" | "shard_map"
+    static_names: Set[str] = field(default_factory=set)
+    static_nums: Set[int] = field(default_factory=set)
+    donates: bool = False
+    line: int = 0
+    direct_jit: bool = False  # carries its own jit wrapper (SCX103/105 scope)
+
+
+class _Aliases:
+    """Names the module binds to jax / numpy / functools entry points."""
+
+    def __init__(self) -> None:
+        self.jax: Set[str] = set()
+        self.jnp: Set[str] = set()
+        self.np: Set[str] = set()
+        self.functools: Set[str] = set()
+        self.jit_names: Set[str] = set()  # from jax import jit
+        self.shard_map_names: Set[str] = set()
+        self.partial_names: Set[str] = set()
+        self.device_get_names: Set[str] = set()
+        self.config_names: Set[str] = set()  # from jax import config
+
+    def collect(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    if alias.name == "jax":
+                        self.jax.add(name)
+                    elif alias.name == "jax.numpy" and alias.asname:
+                        self.jnp.add(alias.asname)
+                    elif alias.name.startswith("jax.") and not alias.asname:
+                        # `import jax.numpy` binds the ROOT package name:
+                        # jax.jit and jax.numpy.* are both reachable
+                        self.jax.add("jax")
+                    elif alias.name == "numpy":
+                        self.np.add(name)
+                    elif alias.name == "functools":
+                        self.functools.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if mod == "jax" and alias.name == "numpy":
+                        self.jnp.add(bound)
+                    elif mod == "jax" and alias.name == "jit":
+                        self.jit_names.add(bound)
+                    elif alias.name == "shard_map" and mod.startswith("jax"):
+                        self.shard_map_names.add(bound)
+                    elif mod == "jax" and alias.name == "config":
+                        self.config_names.add(bound)
+                    elif mod == "jax" and alias.name == "device_get":
+                        self.device_get_names.add(bound)
+                    elif mod == "functools" and alias.name == "partial":
+                        self.partial_names.add(bound)
+                    elif mod == "jax.numpy":
+                        self.jnp.add(bound)  # from jax.numpy import *names
+
+    # -- expression classifiers ------------------------------------------
+
+    def _root_and_chain(self, node: ast.AST) -> Tuple[Optional[str], List[str]]:
+        chain: List[str] = []
+        while isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            return node.id, list(reversed(chain))
+        return None, []
+
+    def is_jax_attr(self, node: ast.AST, *paths: Tuple[str, ...]) -> bool:
+        """Whether ``node`` is ``jax.<path>`` for any of ``paths``."""
+        root, chain = self._root_and_chain(node)
+        if root is None:
+            return False
+        return root in self.jax and tuple(chain) in paths
+
+    def is_jit_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id in self.jit_names:
+            return True
+        return self.is_jax_attr(node, ("jit",))
+
+    def is_shard_map_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id in self.shard_map_names:
+            return True
+        return self.is_jax_attr(
+            node, ("shard_map",), ("experimental", "shard_map", "shard_map")
+        )
+
+    def is_partial_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id in self.partial_names:
+            return True
+        root, chain = self._root_and_chain(node)
+        return root in self.functools and chain == ["partial"]
+
+    def is_np_call(self, func: ast.AST) -> Optional[str]:
+        root, chain = self._root_and_chain(func)
+        if root in self.np and chain:
+            return chain[0]
+        return None
+
+    def is_jnp_call(self, func: ast.AST) -> Optional[str]:
+        root, chain = self._root_and_chain(func)
+        if root in self.jnp and len(chain) == 1:
+            return chain[0]
+        if root in self.jax and chain[:1] == ["numpy"] and len(chain) == 2:
+            return chain[1]  # spelled jax.numpy.<fn>
+        return None
+
+
+def _const_str_tuple(node: ast.AST) -> Set[str]:
+    """Constant string / tuple-of-strings keyword value -> set of names."""
+    out: Set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for element in node.elts:
+            if isinstance(element, ast.Constant) and isinstance(
+                element.value, str
+            ):
+                out.add(element.value)
+    return out
+
+
+def _const_int_tuple(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for element in node.elts:
+            if isinstance(element, ast.Constant) and isinstance(
+                element.value, int
+            ):
+                out.add(element.value)
+    return out
+
+
+class JaxLinter:
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.findings: List[Finding] = []
+        self.aliases = _Aliases()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases.collect(self.tree)
+        # every def in the module, by name (nested included) — the
+        # resolution table for jax.jit(fn) call-wrapping
+        self.defs: Dict[str, List[ast.FunctionDef]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+        self.traced: Dict[ast.FunctionDef, TraceSpec] = {}
+
+    # -- traced-context discovery ----------------------------------------
+
+    def _spec_from_call(self, call: ast.Call) -> Optional[TraceSpec]:
+        """TraceSpec when ``call`` builds a jit / shard_map transform."""
+        func = call.func
+        kind = None
+        if self.aliases.is_jit_expr(func):
+            kind = "jit"
+        elif self.aliases.is_shard_map_expr(func):
+            kind = "shard_map"
+        elif self.aliases.is_partial_expr(func) and call.args:
+            if self.aliases.is_jit_expr(call.args[0]):
+                kind = "jit"
+            elif self.aliases.is_shard_map_expr(call.args[0]):
+                kind = "shard_map"
+        if kind is None:
+            return None
+        spec = TraceSpec(kind=kind, line=call.lineno)
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                spec.static_names |= _const_str_tuple(kw.value)
+            elif kw.arg == "static_argnums":
+                spec.static_nums |= _const_int_tuple(kw.value)
+            elif kw.arg in ("donate_argnums", "donate_argnames"):
+                spec.donates = True
+        return spec
+
+    def _decorator_spec(self, dec: ast.AST) -> Optional[TraceSpec]:
+        if self.aliases.is_jit_expr(dec) or self.aliases.is_shard_map_expr(dec):
+            kind = "jit" if self.aliases.is_jit_expr(dec) else "shard_map"
+            return TraceSpec(kind=kind, line=getattr(dec, "lineno", 0))
+        if isinstance(dec, ast.Call):
+            return self._spec_from_call(dec)
+        return None
+
+    def _discover_traced(self) -> None:
+        # decorator form
+        for defs in self.defs.values():
+            for fn in defs:
+                for dec in fn.decorator_list:
+                    spec = self._decorator_spec(dec)
+                    if spec is not None:
+                        spec.direct_jit = spec.kind == "jit"
+                        self.traced[fn] = spec
+        # call-wrapping form: jax.jit(f) / jax.shard_map(f, ...) /
+        # jax.jit(jax.shard_map(f, ...)) — mark the named inner function
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            spec = self._spec_from_call(node)
+            if spec is None or not node.args:
+                continue
+            target = node.args[0]
+            # unwrap nesting: jit(shard_map(f, ...)) traces f via shard_map
+            while isinstance(target, ast.Call):
+                inner_spec = self._spec_from_call(target)
+                if inner_spec is None or not target.args:
+                    break
+                spec = inner_spec
+                target = target.args[0]
+            if isinstance(target, ast.Name):
+                for fn in self.defs.get(target.id, []):
+                    existing = self.traced.get(fn)
+                    if existing is None:
+                        self.traced[fn] = spec
+                    else:
+                        existing.static_names |= spec.static_names
+                        existing.static_nums |= spec.static_nums
+                        existing.donates |= spec.donates
+                    if spec.kind == "jit":
+                        self.traced[fn].direct_jit = True
+
+    # -- reporting --------------------------------------------------------
+
+    def _report(
+        self,
+        rule: str,
+        node: ast.AST,
+        message: str,
+        span: Optional[ast.AST] = None,
+    ) -> None:
+        """Record a finding at ``node``; ``span`` bounds the suppression
+        window (defaults to ``node``; pass the test/iter expression for
+        block statements so a directive inside the body doesn't count).
+        Function-anchored findings suppress on the def line only."""
+        line = getattr(node, "lineno", 0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # anchor at the first decorator (where static_argnames/donate
+            # belong) and close the window at the def line, so both the
+            # comment-above form and an inline comment on either line work
+            decorators = [d.lineno for d in node.decorator_list]
+            end = line
+            line = min(decorators + [line])
+        else:
+            target = span if span is not None else node
+            end = getattr(target, "end_lineno", line) or line
+        self.findings.append(Finding(rule, self.path, line, message, end))
+
+    # -- per-function traced rules ----------------------------------------
+
+    def _traced_params(self, fn: ast.FunctionDef, spec: TraceSpec) -> Set[str]:
+        args = fn.args
+        ordered = [a.arg for a in args.posonlyargs + args.args]
+        names = set(ordered + [a.arg for a in args.kwonlyargs])
+        names -= spec.static_names
+        names -= {
+            ordered[i] for i in spec.static_nums if i < len(ordered)
+        }
+        return names
+
+    def _value_names(self, expr: ast.AST) -> Set[str]:
+        """Names referenced *as values* (shape/dtype metadata excluded)."""
+        names: Set[str] = set()
+
+        class V(ast.NodeVisitor):
+            def visit_Attribute(self, node: ast.Attribute) -> None:  # noqa: N802
+                if node.attr in _STATIC_ATTRS:
+                    return  # x.shape / x.dtype: static under tracing
+                self.generic_visit(node)
+
+            def visit_Call(self, node: ast.Call) -> None:  # noqa: N802
+                func = node.func
+                if isinstance(func, ast.Name) and func.id in (
+                    "len", "isinstance", "range", "tuple", "list", "set",
+                    "sorted", "dict",
+                ):
+                    # len(x)/isinstance(x, T) are static; range over a
+                    # traced value is caught via its argument names below
+                    if func.id == "range":
+                        for arg in node.args:
+                            self.visit(arg)
+                    return
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _STRUCT_METHODS
+                ):
+                    return  # dict structure iteration is static
+                self.generic_visit(node)
+
+            def visit_Name(self, node: ast.Name) -> None:  # noqa: N802
+                names.add(node.id)
+
+        V().visit(expr)
+        return names
+
+    def _is_none_check(self, test: ast.AST) -> bool:
+        return (
+            isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+        )
+
+    def _check_traced_body(self, fn: ast.FunctionDef, spec: TraceSpec) -> None:
+        traced_params = self._traced_params(fn, spec)
+        donated_updates: List[Tuple[ast.AST, str]] = []
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                func = node.func
+                # SCX101 — host syncs
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _SYNC_METHODS
+                ):
+                    self._report(
+                        "SCX101", node,
+                        f"host sync `.{func.attr}()` inside traced "
+                        f"function `{fn.name}` forces a device->host "
+                        "transfer (or fails to trace)",
+                    )
+                np_fn = self.aliases.is_np_call(func)
+                if np_fn in _NP_MATERIALIZERS:
+                    self._report(
+                        "SCX101", node,
+                        f"`np.{np_fn}` on a traced value inside "
+                        f"`{fn.name}` materializes on host; use jnp or "
+                        "move the conversion outside the traced region",
+                    )
+                if self.aliases.is_jax_attr(func, ("device_get",)) or (
+                    isinstance(func, ast.Name)
+                    and func.id in self.aliases.device_get_names
+                ):
+                    self._report(
+                        "SCX101", node,
+                        f"`jax.device_get` inside traced function "
+                        f"`{fn.name}`",
+                    )
+                if (
+                    isinstance(func, ast.Name)
+                    and func.id in ("float", "int", "bool")
+                    and node.args
+                    and self._value_names(node.args[0]) & traced_params
+                ):
+                    self._report(
+                        "SCX101", node,
+                        f"`{func.id}()` on traced value inside `{fn.name}` "
+                        "concretizes a tracer",
+                    )
+                # SCX108 — trace-time-only side effects
+                if isinstance(func, ast.Name) and func.id == "print":
+                    self._report(
+                        "SCX108", node,
+                        f"`print` inside traced function `{fn.name}` runs "
+                        "at trace time only; use jax.debug.print",
+                    )
+                if (
+                    isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in ("logging", "logger", "log")
+                    # require a logging-method name so an array that
+                    # happens to be called `log` (log-likelihoods...) is
+                    # not mistaken for the logging module
+                    and func.attr in (
+                        "debug", "info", "warning", "warn", "error",
+                        "exception", "critical", "log",
+                    )
+                ):
+                    self._report(
+                        "SCX108", node,
+                        f"logging call inside traced function `{fn.name}` "
+                        "runs at trace time only",
+                    )
+            # SCX102 — control flow on traced values
+            elif isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                if self._is_none_check(test):
+                    continue
+                hot = self._value_names(test) & traced_params
+                if hot:
+                    self._report(
+                        "SCX102", node,
+                        f"Python `{'if' if isinstance(node, ast.If) else 'while'}`"
+                        f" on traced value(s) {sorted(hot)} in `{fn.name}`"
+                        " (ConcretizationTypeError under jit; use jnp.where"
+                        "/lax.cond)",
+                        span=test,
+                    )
+            elif isinstance(node, ast.For):
+                hot = self._value_names(node.iter) & traced_params
+                if hot:
+                    self._report(
+                        "SCX102", node,
+                        f"Python `for` over traced value(s) {sorted(hot)} "
+                        f"in `{fn.name}` (unrolls or fails; use lax.scan/"
+                        "fori_loop)",
+                        span=node.iter,
+                    )
+            elif isinstance(node, ast.IfExp):
+                if not self._is_none_check(node.test):
+                    hot = self._value_names(node.test) & traced_params
+                    if hot:
+                        self._report(
+                            "SCX102", node,
+                            f"ternary on traced value(s) {sorted(hot)} in "
+                            f"`{fn.name}`",
+                        )
+            elif isinstance(node, ast.Attribute) and node.attr == "at":
+                if (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id in traced_params
+                ):
+                    donated_updates.append((node, node.value.id))
+
+        # SCX105 — functional param update without donation (jit only:
+        # shard_map inherits donation from its enclosing jit)
+        if spec.direct_jit and donated_updates and not spec.donates:
+            node, param = donated_updates[0]
+            self._report(
+                "SCX105", fn,
+                f"`{fn.name}` updates parameter `{param}` via `.at[...]` "
+                "but its jit wrapper declares no donate_argnums/"
+                "donate_argnames; the update allocates a second buffer",
+            )
+
+    # -- SCX103 ------------------------------------------------------------
+
+    def _check_retrace(self, fn: ast.FunctionDef, spec: TraceSpec) -> None:
+        if not spec.direct_jit:
+            return  # shard_map params are arrays by construction
+        args = fn.args
+        ordered = [a.arg for a in args.posonlyargs + args.args]
+        defaults: Dict[str, ast.AST] = {}
+        if args.defaults:
+            for name, default in zip(ordered[-len(args.defaults):], args.defaults):
+                defaults[name] = default
+        for kw_arg, kw_default in zip(args.kwonlyargs, args.kw_defaults):
+            if kw_default is not None:
+                defaults[kw_arg.arg] = kw_default
+        static = set(spec.static_names) | {
+            ordered[i] for i in spec.static_nums if i < len(ordered)
+        }
+        for name in ordered + [a.arg for a in args.kwonlyargs]:
+            if name in static or name == "self":
+                continue
+            default = defaults.get(name)
+            bool_default = isinstance(default, ast.Constant) and isinstance(
+                default.value, bool
+            )
+            if _is_scalarish(name) or bool_default:
+                why = (
+                    "bool-defaulted flag" if bool_default
+                    else "scalar/shape-like parameter"
+                )
+                self._report(
+                    "SCX103", fn,
+                    f"jit function `{fn.name}` takes {why} `{name}` "
+                    "without static_argnames/static_argnums — every "
+                    "distinct value retraces (or weak-types the program)",
+                )
+
+    # -- host-level rules --------------------------------------------------
+
+    def _check_host(self) -> None:
+        traced_nodes: Set[ast.AST] = set()
+        for fn in self.traced:
+            traced_nodes.update(ast.walk(fn))
+
+        basename = os.path.basename(self.path)
+        linter = self
+
+        class HostVisitor(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.loop_depth = 0
+                self.func_depth = 0
+
+            def _visit_loop(self, node: ast.AST) -> None:
+                inside = node in traced_nodes
+                if not inside:
+                    self.loop_depth += 1
+                self.generic_visit(node)
+                if not inside:
+                    self.loop_depth -= 1
+
+            visit_For = visit_While = _visit_loop  # noqa: N815
+
+            def _visit_func(self, node: ast.AST) -> None:
+                # a loop *containing* this def doesn't wrap its body
+                outer_loop, self.loop_depth = self.loop_depth, 0
+                self.func_depth += 1
+                self.generic_visit(node)
+                self.func_depth -= 1
+                self.loop_depth = outer_loop
+
+            visit_FunctionDef = visit_AsyncFunctionDef = _visit_func  # noqa: N815
+            visit_Lambda = _visit_func  # noqa: N815
+
+            def _jnp_constructors_in(self, tree: ast.AST):
+                for sub in ast.walk(tree):
+                    if isinstance(sub, ast.Call):
+                        jnp_fn = linter.aliases.is_jnp_call(sub.func)
+                        if jnp_fn in _JNP_CONSTRUCTORS:
+                            yield sub, jnp_fn
+
+            def visit_Call(self, node: ast.Call) -> None:  # noqa: N802
+                if self.loop_depth > 0 and node not in traced_nodes:
+                    # SCX104 fires on the per-record accumulation shape
+                    # (appending device arrays one loop iteration at a
+                    # time) and on module-level script loops; jnp calls in
+                    # loops inside functions are routinely trace-time
+                    # unrolls of device helpers and stay exempt.
+                    jnp_fn = linter.aliases.is_jnp_call(node.func)
+                    if jnp_fn in _JNP_CONSTRUCTORS and self.func_depth == 0:
+                        linter._report(
+                            "SCX104", node,
+                            f"`jnp.{jnp_fn}` inside a module-level loop: "
+                            "one dispatch+transfer per iteration; build "
+                            "the batch with numpy and convert once",
+                        )
+                    if (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("append", "extend", "insert")
+                    ):
+                        for arg in node.args:
+                            for sub, jnp_fn in self._jnp_constructors_in(arg):
+                                linter._report(
+                                    "SCX104", sub,
+                                    f"accumulating `jnp.{jnp_fn}` arrays "
+                                    "in a host loop: one dispatch per "
+                                    "record batch; build the column with "
+                                    "numpy and convert once after the loop",
+                                )
+                    spec = linter._spec_from_call(node)
+                    if spec is not None:
+                        linter._report(
+                            "SCX107", node,
+                            f"constructing a {spec.kind} callable inside a "
+                            "host loop discards the compilation cache each "
+                            "iteration; hoist it (or functools.lru_cache "
+                            "the builder)",
+                        )
+                # SCX106 — config mutation
+                func = node.func
+                if isinstance(func, ast.Attribute) and func.attr == "update":
+                    owner = func.value
+                    if (
+                        linter.aliases.is_jax_attr(owner, ("config",))
+                        or (
+                            isinstance(owner, ast.Name)
+                            and owner.id in linter.aliases.config_names
+                        )
+                    ) and basename not in CONFIG_OWNERS:
+                        linter._report(
+                            "SCX106", node,
+                            "jax.config mutation outside platform.py makes "
+                            "global numerics depend on import order; route "
+                            "it through sctools_tpu.platform",
+                        )
+                self.generic_visit(node)
+
+            def visit_Assign(self, node: ast.Assign) -> None:  # noqa: N802
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute) and (
+                        linter.aliases.is_jax_attr(target.value, ("config",))
+                        or (
+                            isinstance(target.value, ast.Name)
+                            and target.value.id
+                            in linter.aliases.config_names
+                        )
+                    ) and basename not in CONFIG_OWNERS:
+                        linter._report(
+                            "SCX106", node,
+                            "assignment to a jax.config attribute outside "
+                            "platform.py",
+                        )
+                self.generic_visit(node)
+
+        HostVisitor().visit(self.tree)
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        self._discover_traced()
+        for fn, spec in self.traced.items():
+            self._check_traced_body(fn, spec)
+            self._check_retrace(fn, spec)
+        self._check_host()
+        return self.findings
+
+
+def lint_file(path: str) -> List[Finding]:
+    """Lint one Python file; returns suppression-filtered findings."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        linter = JaxLinter(path, source)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                "SCX100", path, exc.lineno or 0,
+                f"file does not parse: {exc.msg}",
+            )
+        ]
+    findings = linter.run()
+    unique: dict = {}
+    for finding in findings:
+        unique.setdefault((finding.rule, finding.line), finding)
+    return Suppressions.from_text(source, "#").apply(unique.values())

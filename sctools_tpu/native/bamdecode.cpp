@@ -139,8 +139,10 @@ struct LocalVocab {
   int32_t last_code = -1;
 
   int32_t code(const char* data, size_t len) {
+    // len == 0 short-circuits before memcmp: a missing tag passes data ==
+    // nullptr, and memcmp's arguments are declared nonnull even for n == 0
     if (last_key && last_key->size() == len &&
-        std::memcmp(last_key->data(), data, len) == 0)
+        (len == 0 || std::memcmp(last_key->data(), data, len) == 0))
       return last_code;
     auto [it, inserted] = map.try_emplace(
         len ? std::string(data, len) : std::string(),

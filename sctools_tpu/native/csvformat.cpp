@@ -17,6 +17,8 @@
 #include <cstring>
 #include <charconv>
 #include <cmath>
+#include <cstdio>
+#include <cstdlib>
 
 namespace {
 
@@ -40,24 +42,52 @@ int format_double_py(double v, char* out) {
     std::memcpy(p, "0.0", 3);
     return int(p - out) + 3;
   }
-  // Shortest round-trip mantissa via scientific to_chars: "d[.ddd]e±XX".
+  // Shortest round-trip mantissa in scientific form: "d[.ddd]e±XX".
   char sci[40];
+  const char* sci_end;
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
   auto res = std::to_chars(sci, sci + sizeof(sci), v,
                            std::chars_format::scientific);
-  // Parse digits and decimal exponent out of the scientific form.
-  char digits[24];
+  sci_end = res.ptr;
+#else
+  // libstdc++ < 11 has no floating-point to_chars. The shortest
+  // correctly-rounded decimal that round-trips is found by precision
+  // search: printf %.*e is correctly rounded, so the first precision
+  // whose output parses back to exactly `v` carries the same digit
+  // string to_chars would produce (both are the unique shortest
+  // round-trip representation).
+  {
+    int prec = 0;
+    for (; prec < 17; ++prec) {
+      std::snprintf(sci, sizeof(sci), "%.*e", prec, v);
+      if (std::strtod(sci, nullptr) == v) break;
+    }
+    if (prec == 17) std::snprintf(sci, sizeof(sci), "%.17e", v);
+    sci_end = sci + std::strlen(sci);
+  }
+#endif
+  // Parse digits and decimal exponent out of the scientific form. The
+  // mantissa scan keeps digit bytes and skips everything else up to the
+  // exponent marker: snprintf's decimal separator is locale-dependent
+  // (possibly multi-byte), and trusting a '.'-shaped parse under a
+  // non-C LC_NUMERIC would corrupt the exponent and overrun `out`.
+  char digits[32];
   int n_digits = 0;
   const char* s = sci;
-  digits[n_digits++] = *s++;  // leading digit (v > 0 here, no sign)
-  if (*s == '.') {
+  while (s != sci_end && *s != 'e' && *s != 'E') {
+    if (*s >= '0' && *s <= '9' &&
+        n_digits < static_cast<int>(sizeof(digits)))
+      digits[n_digits++] = *s;
     ++s;
-    while (*s != 'e') digits[n_digits++] = *s++;
   }
-  ++s;  // 'e'
   int exp10 = 0;
-  bool exp_neg = (*s == '-');
-  ++s;  // sign (to_chars always emits one in scientific form)
-  while (s != res.ptr) exp10 = exp10 * 10 + (*s++ - '0');
+  bool exp_neg = false;
+  if (s != sci_end) {
+    ++s;  // exponent marker
+    if (s != sci_end && (*s == '-' || *s == '+')) exp_neg = (*s++ == '-');
+    while (s != sci_end && *s >= '0' && *s <= '9')
+      exp10 = exp10 * 10 + (*s++ - '0');
+  }
   if (exp_neg) exp10 = -exp10;
 
   if (exp10 >= -4 && exp10 < 16) {

@@ -235,7 +235,9 @@ class BasicByteStream {
     for (;;) {
       const uint8_t* base = buffer_.data() + offset_;
       size_t avail = buffer_.size() - offset_;
-      const void* nl = std::memchr(base, '\n', avail);
+      // avail == 0 short-circuits: an empty vector's data() may be null,
+      // and memchr's pointer is declared nonnull even for n == 0
+      const void* nl = avail ? std::memchr(base, '\n', avail) : nullptr;
       if (nl) {
         size_t len = static_cast<const uint8_t*>(nl) - base;
         line.assign(reinterpret_cast<const char*>(base), len);

@@ -33,7 +33,12 @@ def enable_compilation_cache(path: str = "") -> None:
         os.makedirs(path, exist_ok=True)
     except OSError:
         return
+    # scx-lint: disable=SCX106 -- this module IS the sanctioned central
+    # cache policy (idempotent, respects prior config); platform-level
+    # entry points route here rather than touching jax.config themselves
     jax.config.update("jax_compilation_cache_dir", path)
     # cache everything that takes meaningful time; tiny programs stay in
     # the in-memory cache only
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update(  # scx-lint: disable=SCX106 -- same policy as above
+        "jax_persistent_cache_min_compile_time_secs", 0.5
+    )

@@ -1,0 +1,17 @@
+"""ABI-checker bad fixture: one of each drift class vs fake.cpp."""
+
+import ctypes
+
+
+def bind(lib):
+    lib.scx_bad_count.restype = ctypes.c_long
+    lib.scx_bad_count.argtypes = [ctypes.c_void_p]  # SCX203: C takes 2
+
+    lib.scx_bad_width.restype = ctypes.c_long
+    lib.scx_bad_width.argtypes = [ctypes.c_void_p, ctypes.c_int]  # SCX204
+
+    lib.scx_bad_ret.restype = ctypes.c_int  # SCX205: C returns const char*
+    lib.scx_bad_ret.argtypes = [ctypes.c_void_p]
+
+    lib.scx_ghost.restype = ctypes.c_long  # SCX201: no such export
+    lib.scx_ghost.argtypes = [ctypes.c_void_p]

@@ -1,0 +1,30 @@
+// ABI-checker bad fixture: every SCX2xx failure mode in one pair.
+#include <cstdint>
+
+extern "C" {
+
+// bindings.py lists only one argtype for this (SCX203)
+long scx_bad_count(void* handle, long offset) {
+  (void)handle;
+  return offset;
+}
+
+// bindings.py declares c_int for the 64-bit `long value` (SCX204)
+long scx_bad_width(void* handle, long value) {
+  (void)handle;
+  return value;
+}
+
+// bindings.py declares restype c_int for this const char* (SCX205)
+const char* scx_bad_ret(void* handle) {
+  (void)handle;
+  return nullptr;
+}
+
+// never bound in bindings.py (SCX202)
+void scx_orphan(void* handle) { (void)handle; }
+
+}  // extern "C"
+
+// outside the extern "C" block: C++-mangled, invisible to dlsym (SCX206)
+int scx_mangled(int value) { return value; }

@@ -1,0 +1,16 @@
+"""ABI-checker clean fixture: bindings matching fake.cpp exactly."""
+
+import ctypes
+
+
+def bind(lib):
+    lib.scx_demo_open.restype = ctypes.c_void_p
+    lib.scx_demo_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.scx_demo_count.restype = ctypes.c_long
+    lib.scx_demo_count.argtypes = [ctypes.c_void_p]
+    lib.scx_demo_col.restype = ctypes.POINTER(ctypes.c_int32)
+    lib.scx_demo_col.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.scx_demo_free.restype = None
+    lib.scx_demo_free.argtypes = [ctypes.c_void_p]

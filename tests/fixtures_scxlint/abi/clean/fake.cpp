@@ -1,0 +1,28 @@
+// ABI-checker clean fixture: every export matches bindings.py exactly.
+#include <cstdint>
+
+extern "C" {
+
+void* scx_demo_open(const char* path, int n_threads, char* errbuf,
+                    int errbuf_len) {
+  (void)path;
+  (void)n_threads;
+  (void)errbuf;
+  (void)errbuf_len;
+  return nullptr;
+}
+
+long scx_demo_count(void* handle) {
+  (void)handle;
+  return 0;
+}
+
+const int32_t* scx_demo_col(void* handle, const char* name) {
+  (void)handle;
+  (void)name;
+  return nullptr;
+}
+
+void scx_demo_free(void* handle) { (void)handle; }
+
+}  // extern "C"

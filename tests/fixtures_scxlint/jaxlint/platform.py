@@ -1,0 +1,5 @@
+"""SCX106 negative: platform.py owns process-global jax config."""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

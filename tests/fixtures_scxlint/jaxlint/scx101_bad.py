@@ -1,0 +1,14 @@
+"""SCX101 positive: host syncs inside a traced function."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_sync(x):
+    total = x.sum().item()
+    host = np.asarray(x)
+    scale = float(x)
+    pulled = jax.device_get(x)
+    listed = x.tolist()
+    return total + host.mean() + scale + pulled + len(listed)

@@ -1,0 +1,16 @@
+"""SCX101 negative: device math in traced code, host syncs outside it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean_sync(x):
+    return jnp.sum(x) * 2
+
+
+def host_side(x):
+    # outside any traced function these are ordinary host operations
+    arr = np.asarray(x)
+    return float(arr.sum().item())

@@ -1,0 +1,18 @@
+"""SCX102 positive: Python control flow on traced values."""
+
+import jax
+
+
+@jax.jit
+def branchy(x):
+    if x.sum() > 0:
+        return x * 2
+    return x
+
+
+@jax.jit
+def loopy(xs):
+    total = 0
+    for value in xs:
+        total = total + value
+    return total

@@ -1,0 +1,27 @@
+"""SCX102 negative: branches on static args, None checks, shape reads."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def gated(x, flag):
+    if flag:  # static argument: resolved at trace time
+        return x * 2
+    return x
+
+
+@jax.jit
+def none_checked(x, y=None):
+    if y is None:  # structural check, not a value branch
+        return x
+    return x + y
+
+
+@jax.jit
+def shape_branch(x):
+    if x.ndim == 2:  # shape metadata is static under tracing
+        return jnp.sum(x, axis=1)
+    return x

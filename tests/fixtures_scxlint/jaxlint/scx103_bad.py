@@ -1,0 +1,13 @@
+"""SCX103 positive: scalar/shape params traced instead of static."""
+
+import jax
+
+
+@jax.jit
+def resize(x, n_segments):
+    return x[:n_segments]
+
+
+@jax.jit
+def toggle(x, fancy=True):
+    return x

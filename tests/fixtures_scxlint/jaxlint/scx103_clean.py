@@ -1,0 +1,15 @@
+"""SCX103 negative: scalar/shape params declared static."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("n_segments", "fancy"))
+def resize(x, n_segments, fancy=True):
+    return x[:n_segments]
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def resize_by_num(x, n_segments):
+    return x[:n_segments]

@@ -1,0 +1,16 @@
+"""SCX104 positive: per-record jnp construction in host loops."""
+
+import jax.numpy as jnp
+
+RECORDS = [[1, 2], [3, 4]]
+
+module_level = []
+for rec in RECORDS:
+    module_level.append(jnp.asarray(rec))
+
+
+def gather(records):
+    out = []
+    for rec in records:
+        out.append(jnp.asarray(rec))
+    return out

@@ -1,0 +1,17 @@
+"""SCX104 negative: one conversion after the loop; trace-time unrolls."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def gather(records):
+    return jnp.asarray(np.asarray(records))
+
+
+def unrolled_helper(keys):
+    # a host loop in a device helper that runs under tracing: the jnp
+    # constructors here are trace-time constants, not per-record dispatches
+    total = jnp.zeros(4)
+    for _ in keys:
+        total = total + jnp.ones(4)
+    return total

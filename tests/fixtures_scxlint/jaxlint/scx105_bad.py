@@ -1,0 +1,8 @@
+"""SCX105 positive: functional param update without donation."""
+
+import jax
+
+
+@jax.jit
+def update(buffer, idx, value):
+    return buffer.at[idx].set(value)

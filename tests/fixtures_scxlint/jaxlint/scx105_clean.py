@@ -1,0 +1,10 @@
+"""SCX105 negative: the updated buffer is donated."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update(buffer, idx, value):
+    return buffer.at[idx].set(value)

@@ -1,0 +1,5 @@
+"""SCX106 positive: jax.config mutation outside platform.py."""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,11 @@
+"""SCX107 positive: jit construction inside a host loop."""
+
+import jax
+
+
+def run_all(fns, x):
+    outs = []
+    for fn in fns:
+        jitted = jax.jit(fn)
+        outs.append(jitted(x))
+    return outs

@@ -1,0 +1,8 @@
+"""SCX107 negative: the jit callable is hoisted out of the loop."""
+
+import jax
+
+
+def run_all(fns, x):
+    jitted = [jax.jit(fn) for fn in fns]
+    return [fn(x) for fn in jitted]

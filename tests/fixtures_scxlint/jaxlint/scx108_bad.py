@@ -1,0 +1,9 @@
+"""SCX108 positive: print inside a traced function."""
+
+import jax
+
+
+@jax.jit
+def noisy(x):
+    print("tracing", x)
+    return x * 2

@@ -1,0 +1,14 @@
+"""SCX108 negative: jax.debug.print traces correctly."""
+
+import jax
+
+
+@jax.jit
+def noisy(x):
+    jax.debug.print("value {v}", v=x)
+    return x * 2
+
+
+def host_report(x):
+    print("host-side reporting is fine", x)
+    return x

@@ -1,0 +1,21 @@
+"""Suppression escape hatches: every violation here is annotated."""
+
+import jax
+
+# scx-lint: disable-file=SCX103
+
+
+@jax.jit
+def sync_ok(x):
+    return x.sum().item()  # scx-lint: disable=SCX101 -- scalar needed host-side
+
+
+# scx-lint: disable=SCX101 -- comment-only directive covers the next code line
+@jax.jit
+def sync_ok_above(x):
+    return x.sum()
+
+
+@jax.jit
+def sized(x, n_records):  # covered by the disable-file above
+    return x[:n_records]

@@ -1,0 +1,302 @@
+"""scx-lint: every rule against its fixture corpus + the real tree.
+
+The acceptance contract of the analysis subsystem:
+
+- each SCX1xx rule fires on its known-bad fixture and stays silent on its
+  known-clean twin;
+- the ABI checker passes on the real native package and on the clean
+  fixture pair, and catches every drift class on the bad pair — including
+  a deliberately corrupted copy of the *real* bindings;
+- the tsan.supp audit passes on the real suppression file and flags the
+  bad fixture;
+- the CLI exits 0 on the repository's own tree (the merge gate) and
+  non-zero on the bad corpus.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sctools_tpu.analysis import (
+    audit_suppressions,
+    check_abi,
+    lint_file,
+)
+from sctools_tpu.analysis.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures_scxlint")
+JAXLINT = os.path.join(FIXTURES, "jaxlint")
+ABI_CLEAN = os.path.join(FIXTURES, "abi", "clean")
+ABI_BAD = os.path.join(FIXTURES, "abi", "bad")
+SUPP = os.path.join(FIXTURES, "supp")
+NATIVE = os.path.join(REPO, "sctools_tpu", "native")
+
+JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 9)]
+
+
+# --------------------------------------------------------------- jax lint
+
+@pytest.mark.parametrize("rule", JAX_RULE_IDS)
+def test_rule_fires_on_bad_fixture(rule):
+    path = os.path.join(JAXLINT, f"{rule.lower()}_bad.py")
+    findings = lint_file(path)
+    assert findings, f"{rule} bad fixture produced no findings"
+    assert {f.rule for f in findings} == {rule}
+    assert all(f.line > 0 and f.path == path for f in findings)
+
+
+@pytest.mark.parametrize("rule", JAX_RULE_IDS)
+def test_rule_silent_on_clean_fixture(rule):
+    # SCX106's negative fixture is a file *named* platform.py: the rule is
+    # about ownership, not syntax
+    name = "platform.py" if rule == "SCX106" else f"{rule.lower()}_clean.py"
+    findings = lint_file(os.path.join(JAXLINT, name))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_inline_and_file_suppressions():
+    findings = lint_file(os.path.join(JAXLINT, "suppressed_bad.py"))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    # suppressing a DIFFERENT rule must not silence the finding
+    src = (
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.sum().item()  # scx-lint: disable=SCX999\n"
+    )
+    path = tmp_path / "wrong_rule.py"
+    path.write_text(src)
+    findings = lint_file(str(path))
+    assert [f.rule for f in findings] == ["SCX101"]
+
+
+def test_import_jax_numpy_binds_root_package(tmp_path):
+    # `import jax.numpy` binds the ROOT name: jax.jit must still be seen
+    src = (
+        "import jax.numpy\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.sum().item()\n"
+    )
+    path = tmp_path / "root_bind.py"
+    path.write_text(src)
+    assert [f.rule for f in lint_file(str(path))] == ["SCX101"]
+
+
+def test_comment_above_decorator_suppresses_function_finding(tmp_path):
+    src = (
+        "import jax\n\n"
+        "# scx-lint: disable=SCX103 -- shape param is deliberately traced\n"
+        "@jax.jit\n"
+        "def f(x, n_records):\n"
+        "    return x[:n_records]\n"
+    )
+    path = tmp_path / "deco_supp.py"
+    path.write_text(src)
+    assert lint_file(str(path)) == []
+
+
+def test_log_named_array_is_not_a_logging_call(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    log = jnp.log(x)\n"
+        "    return log.sum()\n"
+    )
+    path = tmp_path / "log_array.py"
+    path.write_text(src)
+    assert lint_file(str(path)) == []
+
+
+def test_config_assignment_through_from_import(tmp_path):
+    src = "from jax import config\nconfig.jax_enable_x64 = True\n"
+    path = tmp_path / "cfg_assign.py"
+    path.write_text(src)
+    assert [f.rule for f in lint_file(str(path))] == ["SCX106"]
+
+
+# ------------------------------------------------------------ ABI checker
+
+def test_abi_clean_fixture():
+    findings = check_abi(
+        ABI_CLEAN, os.path.join(ABI_CLEAN, "bindings.py")
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_abi_bad_fixture_catches_every_drift_class():
+    findings = check_abi(ABI_BAD, os.path.join(ABI_BAD, "bindings.py"))
+    rules = sorted(f.rule for f in findings)
+    # one of each drift class; scx_mangled is both unbound and mangled
+    assert rules == [
+        "SCX201", "SCX202", "SCX202", "SCX203", "SCX204", "SCX205", "SCX206",
+    ]
+
+
+def test_abi_real_tree_is_clean():
+    findings = check_abi(NATIVE)
+    assert findings == [], [f.render() for f in findings]
+
+
+def _corrupt_real_bindings(tmp_path, old: str, new: str) -> str:
+    source_path = os.path.join(NATIVE, "__init__.py")
+    with open(source_path) as f:
+        source = f.read()
+    assert old in source, f"expected binding text changed: {old!r}"
+    out = tmp_path / "corrupted_bindings.py"
+    out.write_text(source.replace(old, new, 1))
+    return str(out)
+
+
+def test_abi_catches_corrupted_argtypes_entry(tmp_path):
+    # narrow one 64-bit seed argument to 32 bits
+    path = _corrupt_real_bindings(
+        tmp_path, "ctypes.c_ulonglong", "ctypes.c_uint32"
+    )
+    findings = check_abi(NATIVE, path)
+    assert any(
+        f.rule == "SCX204" and "scx_synth_bam" in f.message for f in findings
+    ), [f.render() for f in findings]
+
+
+def test_abi_catches_dropped_argument(tmp_path):
+    path = _corrupt_real_bindings(
+        tmp_path,
+        "lib.scx_stream_next.argtypes = [ctypes.c_void_p, ctypes.c_long]",
+        "lib.scx_stream_next.argtypes = [ctypes.c_void_p]",
+    )
+    findings = check_abi(NATIVE, path)
+    assert any(
+        f.rule == "SCX203" and "scx_stream_next" in f.message
+        for f in findings
+    ), [f.render() for f in findings]
+
+
+def test_abi_catches_corrupted_restype(tmp_path):
+    path = _corrupt_real_bindings(
+        tmp_path,
+        "lib.scx_n_records.restype = ctypes.c_long",
+        "lib.scx_n_records.restype = ctypes.c_int",
+    )
+    findings = check_abi(NATIVE, path)
+    assert any(
+        f.rule == "SCX205" and "scx_n_records" in f.message for f in findings
+    ), [f.render() for f in findings]
+
+
+def test_abi_brace_inside_string_literal(tmp_path):
+    # a `{` inside a string literal must not truncate the extern "C" range
+    (tmp_path / "fake.cpp").write_text(
+        '#include <cstdio>\n'
+        'extern "C" {\n'
+        'long scx_lit(char* out, long n) {\n'
+        '  return snprintf(out, n, "{\\"k\\": %ld}", n);\n'
+        '}\n'
+        'void scx_after(void* h) { (void)h; }\n'
+        '}\n'
+    )
+    (tmp_path / "bindings.py").write_text(
+        "import ctypes\n"
+        "def bind(lib):\n"
+        "    lib.scx_lit.restype = ctypes.c_long\n"
+        "    lib.scx_lit.argtypes = [ctypes.c_char_p, ctypes.c_long]\n"
+        "    lib.scx_after.restype = None\n"
+        "    lib.scx_after.argtypes = [ctypes.c_void_p]\n"
+    )
+    findings = check_abi(str(tmp_path), str(tmp_path / "bindings.py"))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_abi_comment_marker_inside_string_literal(tmp_path):
+    # a `//` inside a string literal is not a comment opener: the literal
+    # (and everything after it) must keep parsing
+    (tmp_path / "fake.cpp").write_text(
+        'extern "C" {\n'
+        'const char* scx_url(void* h) {\n'
+        '  (void)h;\n'
+        '  return "https://example.com/*not-a-comment*/";\n'
+        '}\n'
+        'void scx_after(void* h) { (void)h; }\n'
+        '}\n'
+    )
+    (tmp_path / "bindings.py").write_text(
+        "import ctypes\n"
+        "def bind(lib):\n"
+        "    lib.scx_url.restype = ctypes.c_char_p\n"
+        "    lib.scx_url.argtypes = [ctypes.c_void_p]\n"
+        "    lib.scx_after.restype = None\n"
+        "    lib.scx_after.argtypes = [ctypes.c_void_p]\n"
+    )
+    findings = check_abi(str(tmp_path), str(tmp_path / "bindings.py"))
+    assert findings == [], [f.render() for f in findings]
+
+
+# ------------------------------------------------------------- supp audit
+
+def test_supp_clean_fixture():
+    findings = audit_suppressions(
+        os.path.join(SUPP, "clean.supp"), ABI_CLEAN
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_supp_bad_fixture():
+    findings = audit_suppressions(os.path.join(SUPP, "bad.supp"), ABI_CLEAN)
+    assert sorted(f.rule for f in findings) == [
+        "SCX301", "SCX301", "SCX301", "SCX302", "SCX303",
+    ]
+
+
+def test_supp_wildcard_matches_identifier_prefix(tmp_path):
+    supp = tmp_path / "wild.supp"
+    supp.write_text("race:scx_demo*\nrace:scx_nothing_like_this*\n")
+    findings = audit_suppressions(str(supp), ABI_CLEAN)
+    # the first entry prefixes real symbols; the second matches nothing
+    assert [f.rule for f in findings] == ["SCX302"]
+    assert findings[0].line == 2
+
+
+def test_supp_real_tree_is_clean():
+    findings = audit_suppressions(os.path.join(NATIVE, "tsan.supp"), NATIVE)
+    assert findings == [], [f.render() for f in findings]
+
+
+# -------------------------------------------------------------------- CLI
+
+def test_cli_repo_tree_is_clean(capsys):
+    rc = cli_main([os.path.join(REPO, "sctools_tpu")])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 finding(s)" in out
+
+
+def test_cli_bad_corpus_fails(capsys):
+    rc = cli_main(["-q", JAXLINT])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "SCX101" in out and "SCX108" in out
+
+
+def test_cli_native_dir_flag(capsys):
+    rc = cli_main(
+        ["-q", "--no-jax-lint", "--no-supp", "--native-dir", NATIVE,
+         os.path.join(REPO, "sctools_tpu")]
+    )
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_cli_module_invocation():
+    result = subprocess.run(
+        [sys.executable, "-m", "sctools_tpu.analysis", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert result.returncode == 0, result.stderr
+    assert "SCX101" in result.stdout and "SCX303" in result.stdout
