@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke docs clean
 
-ci: native lint test
+ci: native lint test obs-smoke
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -37,6 +37,17 @@ tpu-test:
 # forced-thread tier on its own (also part of the main suite)
 test-threads:
 	$(PY) -m pytest tests/test_native_threads.py -q
+
+# observability gate: a small synthetic pipeline with SCTOOLS_TPU_TRACE
+# set; asserts the JSONL trace parses, contains the expected stage spans
+# with record counts matching the input, and that render_metrics() emits
+# valid Prometheus exposition (tests/obs_smoke.py; docs/observability.md).
+# The capture dir is recreated per run — the sink appends, and a stale
+# trace would double the asserted record counts.
+obs-smoke:
+	rm -rf /tmp/sctools_tpu_obs_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_TRACE=/tmp/sctools_tpu_obs_smoke \
+	$(PY) tests/obs_smoke.py
 
 native-tsan:
 	$(MAKE) -C sctools_tpu/native tsan

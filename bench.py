@@ -27,7 +27,8 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
+
+from sctools_tpu import obs
 
 # device workload size
 N_CELLS = 1 << 16  # 65k cells
@@ -65,7 +66,9 @@ def ensure_bench_bam() -> str:
     from sctools_tpu import native
 
     path = _bench_bam_path()
-    if not os.path.exists(path):
+    if os.path.exists(path):
+        obs.count("bench_bam_cache_hits")
+    else:
         n = native.synth_bam_native(
             path + ".tmp",
             n_cells=N_CELLS,
@@ -81,9 +84,12 @@ def ensure_bench_bam() -> str:
 
 
 def bench_end_to_end(bam_path: str, profile: bool = False) -> dict:
-    """Wall-clock the full device pipeline; returns timing dict."""
-    import jax
+    """Wall-clock the full device pipeline; returns timing dict.
 
+    Timing is the obs span's own measurement: the benchmark reads the same
+    clock the library's tracing reports, so a span capture of a bench run
+    and the printed JSON cannot disagree.
+    """
     from sctools_tpu.metrics.gatherer import GatherCellMetrics
 
     out = "/tmp/sctools_tpu_bench_out.csv.gz"
@@ -91,21 +97,20 @@ def bench_end_to_end(bam_path: str, profile: bool = False) -> dict:
     bytes_moved = {}
 
     def run() -> float:
-        start = time.perf_counter()
-        gatherer = GatherCellMetrics(
-            bam_path, out, backend="device", batch_records=BATCH_RECORDS
-        )
-        gatherer.extract_metrics()
-        elapsed = time.perf_counter() - start
+        with obs.span("bench:end_to_end") as timer:
+            gatherer = GatherCellMetrics(
+                bam_path, out, backend="device", batch_records=BATCH_RECORDS
+            )
+            gatherer.extract_metrics()
         bytes_moved["h2d"] = gatherer.bytes_h2d
         bytes_moved["d2h"] = gatherer.bytes_d2h
-        return elapsed
+        return timer.duration
 
     import statistics
 
     warm = run()  # includes jit compilation
     if profile:
-        with jax.profiler.trace("/tmp/sctools_tpu_profile"):
+        with obs.xla_trace("/tmp/sctools_tpu_profile"):
             timed = run()
     else:
         # median of 3: the tunneled link's bandwidth swings ~3x between
@@ -119,13 +124,15 @@ def bench_decode_only(bam_path: str) -> float:
     """Decode + pack only (no device work): the ingest ceiling."""
     from sctools_tpu.io.packed import iter_frames_from_bam
 
-    start = time.perf_counter()
     total = 0
-    for frame in iter_frames_from_bam(bam_path, batch_records=BATCH_RECORDS):
-        total += frame.n_records
-    elapsed = time.perf_counter() - start
+    with obs.span("bench:decode_only") as timer:
+        for frame in iter_frames_from_bam(
+            bam_path, batch_records=BATCH_RECORDS
+        ):
+            total += frame.n_records
+        timer.add(records=total)
     assert total == N_CELLS * MOLECULES_PER_CELL * READS_PER_MOLECULE
-    return elapsed
+    return timer.duration
 
 
 def bench_compute_only() -> float:
@@ -153,9 +160,9 @@ def bench_compute_only() -> float:
     run()  # compile + warm
     times = []
     for _ in range(3):
-        start = time.perf_counter()
-        run()
-        times.append(time.perf_counter() - start)
+        with obs.span("bench:compute_only") as timer:
+            run()
+        times.append(timer.duration)
     return float(np.median(times))
 
 
@@ -178,19 +185,19 @@ def bench_link_bandwidth() -> dict:
     mb = buf.nbytes / 1e6
 
     def up() -> float:
-        start = time.perf_counter()
-        device = jax.device_put(buf)
-        # pull one scalar: block_until_ready alone under-reports on
-        # tunneled backends
-        float(device[0])
-        return mb / (time.perf_counter() - start)
+        with obs.span("bench:h2d_probe", bytes=buf.nbytes) as timer:
+            device = jax.device_put(buf)
+            # pull one scalar: block_until_ready alone under-reports on
+            # tunneled backends
+            float(device[0])
+        return mb / timer.duration
 
     def down() -> float:
         device = jax.device_put(buf)
         float(device[0])
-        start = time.perf_counter()
-        np.asarray(device)
-        return mb / (time.perf_counter() - start)
+        with obs.span("bench:d2h_probe", bytes=buf.nbytes) as timer:
+            np.asarray(device)
+        return mb / timer.duration
 
     up()  # first transfer can include backend setup
     return {
@@ -229,16 +236,19 @@ def bench_cpu_baseline(bam_path: str) -> float:
     import statistics
 
     def one_run() -> float:
-        start = time.perf_counter()
         n_cells = 0
-        for cb, molecules in groups:
-            agg = CellMetrics()
-            for ub, genes in molecules.items():
-                for ge, records in genes.items():
-                    agg.parse_molecule(tags=(cb, ub, ge), records=iter(records))
-            agg.finalize(mitochondrial_genes=set())
-            n_cells += 1
-        return n_cells / (time.perf_counter() - start)
+        with obs.span("bench:cpu_baseline") as timer:
+            for cb, molecules in groups:
+                agg = CellMetrics()
+                for ub, genes in molecules.items():
+                    for ge, records in genes.items():
+                        agg.parse_molecule(
+                            tags=(cb, ub, ge), records=iter(records)
+                        )
+                agg.finalize(mitochondrial_genes=set())
+                n_cells += 1
+            timer.add(records=n_cells)
+        return n_cells / timer.duration
 
     # median of 3: the shared 1-core VM's load swings the Python loop too,
     # and baseline noise moves the reported ratio directly
@@ -248,6 +258,11 @@ def bench_cpu_baseline(bam_path: str) -> float:
 def main():
     profile = "--profile" in sys.argv
     breakdown = "--breakdown" in sys.argv or profile
+
+    # timings come from obs spans, so recording must be on; the library's
+    # own pipeline spans ride along at negligible cost (a few dozen spans
+    # per run). SCTOOLS_TPU_TRACE additionally captures them to JSONL.
+    obs.enable()
 
     bam_path = ensure_bench_bam()
     cpu_cells_per_sec = bench_cpu_baseline(bam_path)

@@ -38,6 +38,7 @@ __all__ = [
     "gtf",
     "io",
     "metrics",
+    "obs",
     "ops",
     "parallel",
     "platform",

@@ -37,6 +37,11 @@ Rule catalog (docs/static_analysis.md has the rationale for each):
 - SCX108 print-in-traced: ``print()`` or ``logging``/``logger`` calls
   inside a traced function; they run at trace time only (or force a
   sync). Use ``jax.debug.print``.
+- SCX109 wallclock-duration: ``time.time()`` / ``datetime.now()`` /
+  ``datetime.utcnow()`` anywhere in the library. Wall clocks step under
+  NTP and never belong in duration math; durations go through
+  ``time.perf_counter()`` or (preferably) an ``obs.span``, which also
+  records them.
 """
 
 from __future__ import annotations
@@ -57,6 +62,7 @@ JAX_RULES = {
     "SCX106": "config-mutation",
     "SCX107": "jit-in-loop",
     "SCX108": "print-in-traced",
+    "SCX109": "wallclock-duration",
 }
 
 # files allowed to mutate process-global jax.config (SCX106)
@@ -119,6 +125,10 @@ class _Aliases:
         self.partial_names: Set[str] = set()
         self.device_get_names: Set[str] = set()
         self.config_names: Set[str] = set()  # from jax import config
+        self.time_mod: Set[str] = set()  # import time [as t]
+        self.time_fn: Set[str] = set()  # from time import time [as t]
+        self.datetime_mod: Set[str] = set()  # import datetime [as dt]
+        self.datetime_cls: Set[str] = set()  # from datetime import datetime
 
     def collect(self, tree: ast.Module) -> None:
         for node in ast.walk(tree):
@@ -137,6 +147,10 @@ class _Aliases:
                         self.np.add(name)
                     elif alias.name == "functools":
                         self.functools.add(name)
+                    elif alias.name == "time":
+                        self.time_mod.add(name)
+                    elif alias.name == "datetime":
+                        self.datetime_mod.add(name)
             elif isinstance(node, ast.ImportFrom):
                 mod = node.module or ""
                 for alias in node.names:
@@ -155,6 +169,10 @@ class _Aliases:
                         self.partial_names.add(bound)
                     elif mod == "jax.numpy":
                         self.jnp.add(bound)  # from jax.numpy import *names
+                    elif mod == "time" and alias.name == "time":
+                        self.time_fn.add(bound)
+                    elif mod == "datetime" and alias.name == "datetime":
+                        self.datetime_cls.add(bound)
 
     # -- expression classifiers ------------------------------------------
 
@@ -196,6 +214,25 @@ class _Aliases:
         root, chain = self._root_and_chain(func)
         if root in self.np and chain:
             return chain[0]
+        return None
+
+    def wallclock_call(self, func: ast.AST) -> Optional[str]:
+        """The spelling (e.g. ``time.time``) when ``func`` reads a wall
+        clock unfit for duration math; None otherwise."""
+        if isinstance(func, ast.Name) and func.id in self.time_fn:
+            return "time.time"
+        root, chain = self._root_and_chain(func)
+        if root in self.time_mod and chain == ["time"]:
+            return "time.time"
+        if root in self.datetime_cls and chain in (["now"], ["utcnow"]):
+            return f"datetime.{chain[0]}"
+        if (
+            root in self.datetime_mod
+            and len(chain) == 2
+            and chain[0] == "datetime"
+            and chain[1] in ("now", "utcnow")
+        ):
+            return f"datetime.datetime.{chain[1]}"
         return None
 
     def is_jnp_call(self, func: ast.AST) -> Optional[str]:
@@ -639,6 +676,15 @@ class JaxLinter:
                             "iteration; hoist it (or functools.lru_cache "
                             "the builder)",
                         )
+                # SCX109 — wall-clock reads (anywhere: host or traced)
+                wallclock = linter.aliases.wallclock_call(node.func)
+                if wallclock is not None:
+                    linter._report(
+                        "SCX109", node,
+                        f"`{wallclock}()` reads the wall clock, which steps "
+                        "under NTP and must not time durations; use "
+                        "time.perf_counter() or an obs.span",
+                    )
                 # SCX106 — config mutation
                 func = node.func
                 if isinstance(func, ast.Attribute) and func.attr == "update":

@@ -18,6 +18,8 @@ import struct
 import zlib
 from typing import BinaryIO, Iterator, Union
 
+from .. import obs
+
 # Standard BGZF end-of-file marker block (an empty payload block).
 BGZF_EOF = bytes.fromhex(
     "1f8b08040000000000ff0600424302001b0003000000000000000000"
@@ -83,6 +85,8 @@ def iter_blocks(fileobj: BinaryIO) -> Iterator[bytes]:
         d = zlib.decompressobj(wbits=-15)
         payload = d.decompress(data[pos:])
         consumed = len(data[pos:]) - len(d.unused_data)
+        obs.count("bgzf_blocks_inflated")
+        obs.count("bgzf_bytes_inflated", len(payload))
         yield payload
         offset = pos + consumed + 8  # skip CRC32 + ISIZE
 
@@ -131,6 +135,8 @@ class BgzfWriter:
         while pos < limit:
             chunk = data[pos : pos + MAX_BLOCK_PAYLOAD]
             self._fh.write(compress_block(chunk, self._level))
+            obs.count("bgzf_blocks_written")
+            obs.count("bgzf_bytes_compressed", len(chunk))
             pos += len(chunk)
         self._buffer = io.BytesIO()
         self._buffer.write(data[pos:])

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
+from .. import obs
 from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
 from ..io.packed import (
     FLAG_RUN_START,
@@ -365,6 +366,7 @@ class MetricGatherer:
         from . import device as device_engine  # deferred jax import
 
         enable_compilation_cache()
+        obs.install_jax_hooks()  # compile/retrace events surface as spans
         # wire-schema decisions that must not flip mid-stream: the u8 m_ref
         # column is chosen from the header's reference count (fixed for the
         # whole file), and wide_genomic ratchets one-way in the dispatch
@@ -376,15 +378,19 @@ class MetricGatherer:
         self._wide_genomic = False
         self._runs_bucket = 0  # run-table high-water (one-way, like above)
         if self._frame_source is not None:
-            frames = prefetch_iterator(self._frame_source())
+            source = self._frame_source()
         else:
-            frames = prefetch_iterator(
-                iter_frames_from_bam(
-                    self._bam_file,
-                    self._batch_records,
-                    mode if mode != "rb" else None,
-                )
+            source = iter_frames_from_bam(
+                self._bam_file,
+                self._batch_records,
+                mode if mode != "rb" else None,
             )
+        # decode spans wrap the SOURCE side of the prefetch queue, so they
+        # run on the producer thread and time actual decode work, not the
+        # consumer's wait
+        frames = prefetch_iterator(
+            obs.iter_spans("decode", source, records=lambda f: f.n_records)
+        )
         out = MetricCSVWriter(self._output_stem, self._compress)
         try:
             with closing(out):
@@ -415,6 +421,7 @@ class MetricGatherer:
         next_progress = 10_000_000  # reference cadence (fastq_common.cpp:340)
         for frame in frames:
             processed += frame.n_records
+            obs.count("records_decoded", frame.n_records)
             if processed >= next_progress:
                 print(
                     f"[{type(self).__name__}] {processed} records decoded",
@@ -574,57 +581,79 @@ class MetricGatherer:
             if self._runs_bucket <= padded // 2:
                 run_keys_bucket = self._runs_bucket
                 self.run_keyed_batches += 1
-        cols, static_flags, prepacked = self._prepare_batch(
-            frame, presorted, pad_to=pad_to,
-            run_keys_bucket=run_keys_bucket, run_starts=run_starts,
-        )
-        num_segments = len(cols["flags"])
-        if prepacked:
-            # monoblock transport: one upload per batch instead of nine
-            # (each buffer pays fixed tunnel overhead; _pack_wire docs)
-            cols = {"wire": _pack_wire(cols, static_flags)}
-            self.bytes_h2d += cols["wire"].nbytes
-        else:
-            self.bytes_h2d += sum(np.asarray(v).nbytes for v in cols.values())
-        result = device_engine.compute_entity_metrics(
-            {k: np.asarray(v) for k, v in cols.items()},
-            num_segments=num_segments,
-            kind=self.entity_kind,
-            presorted=presorted,
-            prepacked=prepacked,
-            **static_flags,
-        )
-        # the entity count is host-knowable (distinct outer keys in the
-        # slice), so the compacting pull dispatches HERE, async with the
-        # batch's compute — finalize then blocks on exactly one transfer
-        # instead of a round trip for n_entities plus a second for the rows
-        # (each round trip costs ~100 ms on the tunneled link)
-        key = frame.cell if self.entity_kind == "cell" else frame.gene
-        if presorted:
-            n_entities = int(np.count_nonzero(key[1:] != key[:-1])) + 1
-        else:
-            n_entities = int(np.unique(key).size)
-        k = min(bucket_size(n_entities, minimum=1024), num_segments)
-        int_names, float_names = wire_result_names(self.columns)
-        block = device_engine.compact_results_wire(
-            result, int_names, float_names, k
-        )
+                obs.count("run_keyed_batches")
+        with obs.span("upload", records=frame.n_records) as up:
+            cols, static_flags, prepacked = self._prepare_batch(
+                frame, presorted, pad_to=pad_to,
+                run_keys_bucket=run_keys_bucket, run_starts=run_starts,
+            )
+            up.add(prepacked=int(prepacked))
+            num_segments = len(cols["flags"])
+            if prepacked:
+                # monoblock transport: one upload per batch instead of nine
+                # (each buffer pays fixed tunnel overhead; _pack_wire docs)
+                cols = {"wire": _pack_wire(cols, static_flags)}
+                batch_h2d = cols["wire"].nbytes
+            else:
+                batch_h2d = sum(np.asarray(v).nbytes for v in cols.values())
+            self.bytes_h2d += batch_h2d
+            up.add(bytes=batch_h2d)
+        obs.count("batches_uploaded")
+        obs.count("h2d_bytes", batch_h2d)
+        with obs.span("compute", records=frame.n_records):
+            result = device_engine.compute_entity_metrics(
+                {k: np.asarray(v) for k, v in cols.items()},
+                num_segments=num_segments,
+                kind=self.entity_kind,
+                presorted=presorted,
+                prepacked=prepacked,
+                **static_flags,
+            )
+            # the entity count is host-knowable (distinct outer keys in the
+            # slice), so the compacting pull dispatches HERE, async with the
+            # batch's compute — finalize then blocks on exactly one transfer
+            # instead of a round trip for n_entities plus a second for the
+            # rows (each round trip costs ~100 ms on the tunneled link)
+            key = frame.cell if self.entity_kind == "cell" else frame.gene
+            if presorted:
+                n_entities = int(np.count_nonzero(key[1:] != key[:-1])) + 1
+            else:
+                n_entities = int(np.unique(key).size)
+            k = min(bucket_size(n_entities, minimum=1024), num_segments)
+            int_names, float_names = wire_result_names(self.columns)
+            block = device_engine.compact_results_wire(
+                result, int_names, float_names, k
+            )
         # keep only what finalize reads: pinning the whole frame or the full
         # result dict would hold ~40 MB of arrays per in-flight batch
         return (
             self._entity_names(frame), block, n_entities,
-            int_names, float_names,
+            int_names, float_names, frame.n_records,
         )
 
     def _finalize_device_batch(
         self, entity_names, block, n_entities: int, int_names, float_names,
-        out,
+        n_records: int, out,
     ) -> None:
         # ONE blocking pull per batch: entity rows already compacted on
         # device into a fused [k, ints+floats] int32 block (float32 bits
         # bitcast onto the int lanes; viewed back exactly below)
-        block = np.asarray(block)
-        self.bytes_d2h += block.nbytes
+        with obs.span(
+            "writeback", records=n_records, entities=n_entities
+        ) as wb:
+            block = np.asarray(block)
+            self.bytes_d2h += block.nbytes
+            wb.add(bytes=block.nbytes)
+            obs.count("d2h_bytes", block.nbytes)
+            obs.count("entities_written", n_entities)
+            self._do_finalize_device_batch(
+                entity_names, block, n_entities, int_names, float_names, out
+            )
+
+    def _do_finalize_device_batch(
+        self, entity_names, block, n_entities: int, int_names, float_names,
+        out,
+    ) -> None:
         ints = block[:, : len(int_names)]
         floats = np.ascontiguousarray(
             block[:, len(int_names):]

@@ -21,6 +21,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import obs
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 # SCTOOLS_TPU_NATIVE_LIB points the loader at an alternate build (the
 # ThreadSanitizer library `make ci-deep` produces); default is the
@@ -321,17 +323,21 @@ def frame_from_bam_native(path: str, n_threads: Optional[int] = None):
     if n_threads is None:
         n_threads = _default_threads()
     errbuf = ctypes.create_string_buffer(512)
-    handle = lib.scx_decode_bam(
-        path.encode(), n_threads, errbuf, ctypes.sizeof(errbuf)
-    )
-    if not handle:
-        raise RuntimeError(
-            f"native BAM decode failed: {errbuf.value.decode(errors='replace')}"
+    with obs.span("native:decode_bam") as sp:
+        handle = lib.scx_decode_bam(
+            path.encode(), n_threads, errbuf, ctypes.sizeof(errbuf)
         )
-    try:
-        return _frame_from_handle(lib, handle, want_qname=True)
-    finally:
-        lib.scx_free(handle)
+        if not handle:
+            raise RuntimeError(
+                f"native BAM decode failed: "
+                f"{errbuf.value.decode(errors='replace')}"
+            )
+        try:
+            frame = _frame_from_handle(lib, handle, want_qname=True)
+        finally:
+            lib.scx_free(handle)
+        sp.add(records=frame.n_records)
+    return frame
 
 
 def stream_frames_native(
@@ -366,15 +372,19 @@ def stream_frames_native(
         )
     try:
         while True:
-            n = lib.scx_stream_next(handle, batch_records)
-            if n < 0:
-                raise RuntimeError(
-                    "native BAM stream failed: "
-                    f"{lib.scx_stream_error(handle).decode(errors='replace')}"
-                )
-            if n == 0:
-                break
-            yield _frame_from_handle(lib, handle, want_qname)
+            with obs.span("native:stream_batch") as sp:
+                n = lib.scx_stream_next(handle, batch_records)
+                if n < 0:
+                    raise RuntimeError(
+                        "native BAM stream failed: "
+                        f"{lib.scx_stream_error(handle).decode(errors='replace')}"
+                    )
+                if n == 0:
+                    sp.add(eof=1)  # the terminating poll, not a batch
+                    break
+                sp.add(records=int(n))
+                frame = _frame_from_handle(lib, handle, want_qname)
+            yield frame
     finally:
         lib.scx_stream_close(handle)
 
@@ -400,14 +410,17 @@ def synth_bam_native(
     if lib is None:
         raise RuntimeError("native layer unavailable")
     errbuf = ctypes.create_string_buffer(256)
-    written = lib.scx_synth_bam(
-        path.encode(), n_cells, molecules_per_cell, reads_per_molecule,
-        n_genes, seq_len, seed, compress_level, errbuf, ctypes.sizeof(errbuf),
-    )
-    if written < 0:
-        raise RuntimeError(
-            f"synth bam failed: {errbuf.value.decode(errors='replace')}"
+    with obs.span("native:synth_bam") as sp:
+        written = lib.scx_synth_bam(
+            path.encode(), n_cells, molecules_per_cell, reads_per_molecule,
+            n_genes, seq_len, seed, compress_level,
+            errbuf, ctypes.sizeof(errbuf),
         )
+        if written < 0:  # raise inside the span so it carries the error
+            raise RuntimeError(
+                f"synth bam failed: {errbuf.value.decode(errors='replace')}"
+            )
+        sp.add(records=int(written))
     return written
 
 
@@ -432,15 +445,18 @@ def tagsort_native(
     if len(keys) != 3 or any(len(k) != 2 for k in keys):
         raise RuntimeError("native tagsort requires exactly three 2-char tags")
     errbuf = ctypes.create_string_buffer(512)
-    n = lib.scx_tagsort(
-        input_bam.encode(), output_bam.encode(),
-        keys[0].encode(), keys[1].encode(), keys[2].encode(),
-        batch_records, compress_level, errbuf, ctypes.sizeof(errbuf),
-    )
-    if n < 0:
-        raise RuntimeError(
-            f"native tagsort failed: {errbuf.value.decode(errors='replace')}"
+    with obs.span("native:tagsort") as sp:
+        n = lib.scx_tagsort(
+            input_bam.encode(), output_bam.encode(),
+            keys[0].encode(), keys[1].encode(), keys[2].encode(),
+            batch_records, compress_level, errbuf, ctypes.sizeof(errbuf),
         )
+        if n < 0:  # raise inside the span so it carries the error
+            raise RuntimeError(
+                f"native tagsort failed: "
+                f"{errbuf.value.decode(errors='replace')}"
+            )
+        sp.add(records=int(n))
     return n
 
 
@@ -493,16 +509,19 @@ def format_csv_block(index, columns) -> Optional[bytes]:
     )
     capacity = len(blob) + n * (33 * len(columns) + 1) + 64
     out = ctypes.create_string_buffer(capacity)
-    written = lib.scx_format_csv_block(
-        blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
-        ints.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), ints.shape[1],
-        floats.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), floats.shape[1],
-        is_float.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
-        col_src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        len(columns), out, capacity,
-    )
-    if written < 0:
-        raise RuntimeError("csv block formatting overflowed its buffer")
+    with obs.span("native:csv_format", records=n) as sp:
+        written = lib.scx_format_csv_block(
+            blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+            ints.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), ints.shape[1],
+            floats.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            floats.shape[1],
+            is_float.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            col_src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(columns), out, capacity,
+        )
+        if written < 0:  # raise inside the span so it carries the error
+            raise RuntimeError("csv block formatting overflowed its buffer")
+        sp.add(bytes=int(written))
     # copy only the written prefix (.raw would materialize all of capacity)
     return ctypes.string_at(out, written)
 
@@ -573,16 +592,20 @@ def tagsort_stream_frames(
             )
         total = 0
         while True:
-            n = lib.scx_stream_next(stream, batch_records)
-            if n < 0:
-                raise RuntimeError(
-                    "tagsort stream failed: "
-                    f"{lib.scx_stream_error(stream).decode(errors='replace')}"
-                )
-            if n == 0:
-                break
-            total += n
-            yield _frame_from_handle(lib, stream, want_qname)
+            with obs.span("native:tagsort_stream_batch") as sp:
+                n = lib.scx_stream_next(stream, batch_records)
+                if n < 0:
+                    raise RuntimeError(
+                        "tagsort stream failed: "
+                        f"{lib.scx_stream_error(stream).decode(errors='replace')}"
+                    )
+                if n == 0:
+                    sp.add(eof=1)  # the terminating poll, not a batch
+                    break
+                total += n
+                sp.add(records=int(n))
+                frame = _frame_from_handle(lib, stream, want_qname)
+            yield frame
         # close OUR read descriptors before joining the worker, so a
         # failed/blocked writer cannot deadlock the join
         lib.scx_stream_close(stream)

@@ -1,0 +1,561 @@
+"""scx-trace: span tracing, runtime counters, and profiling hooks.
+
+The pipeline's built-in observability layer: nested, thread-safe spans over
+the decode -> prefetch -> H2D -> compiled-gather -> D2H -> CSV stages,
+Prometheus-style counters/gauges, and JAX hooks (compile/retrace events as
+spans, ``xla_trace`` around ``jax.profiler.trace``). The role Dapper-style
+tracing plays for multi-stage host/device pipelines, built once into the
+library so regressions (e.g. the bandwidth-variable tunneled link,
+BENCH_r05) diagnose from a trace instead of a rewritten benchmark.
+
+Zero dependencies (pure stdlib, no jax/numpy import at module load) and
+disabled by default with near-zero overhead: ``span()`` returns a cached
+no-op singleton after one module-global bool check, so instrumentation is
+safe on serving paths.
+
+Enabling:
+
+- ``obs.enable()`` — in-process recording (ring buffer + counters).
+- ``obs.enable(sink_path=...)`` — additionally append one JSON object per
+  finished span to a JSON-lines file.
+- ``SCTOOLS_TPU_TRACE=dir`` (env) — full capture: spans to
+  ``dir/trace.jsonl``, counters snapshot to ``dir/metrics.prom`` at exit,
+  and ``xla_trace()`` wraps ``jax.profiler.trace(dir/xla)``.
+- ``SCTOOLS_TPU_OBS=1`` (env) — in-process recording only.
+
+Reading a capture: ``python -m sctools_tpu.obs summarize trace.jsonl``
+prints the per-stage time/records/bytes/throughput table
+(docs/observability.md walks through one).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+__all__ = [
+    "span",
+    "iter_spans",
+    "count",
+    "gauge",
+    "counters",
+    "spans",
+    "render_metrics",
+    "enable",
+    "disable",
+    "enabled",
+    "reset",
+    "install_jax_hooks",
+    "xla_trace",
+    "configured_trace_dir",
+    "summarize_records",
+    "render_summary",
+]
+
+# span records kept in process (oldest evicted); a full north-star run emits
+# a few spans per batch, so 64k covers days of serving before eviction
+RING_CAPACITY = 1 << 16
+
+_T0 = time.perf_counter()
+
+_enabled = False
+_lock = threading.RLock()
+_ring: "deque[dict]" = deque(maxlen=RING_CAPACITY)
+_counters: Dict[str, float] = {}
+_gauges: Dict[str, float] = {}
+# per-span-name aggregates (count, total seconds) updated at span exit so
+# render_metrics() needs no ring scan
+_span_totals: Dict[str, List[float]] = {}
+_sink_path: Optional[str] = None
+_sink_file = None
+_sink_lock = threading.Lock()
+_tls = threading.local()
+_jax_hooks_installed = False
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class Span:
+    """One recording span. Use via ``with obs.span("decode") as sp:``.
+
+    ``sp.add(records=n, bytes=b)`` attaches/accumulates numeric attrs
+    mid-span; ``sp.duration`` holds the elapsed seconds after exit.
+    """
+
+    __slots__ = ("name", "attrs", "duration", "_start", "_ts", "_depth")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        self.duration = 0.0
+        self._start = 0.0
+        self._ts = 0.0
+        self._depth = 0
+
+    def add(self, **attrs) -> "Span":
+        for key, value in attrs.items():
+            if key in self.attrs and isinstance(value, (int, float)):
+                self.attrs[key] += value
+            else:
+                self.attrs[key] = value
+        return self
+
+    def __enter__(self) -> "Span":
+        stack = _stack()
+        self._depth = len(stack)
+        stack.append(self.name)
+        self._start = time.perf_counter()
+        self._ts = self._start - _T0
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.duration = time.perf_counter() - self._start
+        stack = _stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        record = {
+            "name": self.name,
+            "ts": round(self._ts, 6),
+            "dur": self.duration,
+            "thread": threading.current_thread().name,
+            "depth": self._depth,
+        }
+        if exc_type is not None:
+            record["error"] = exc_type.__name__
+        if self.attrs:
+            record["attrs"] = self.attrs
+        _record_span(record)
+
+
+class _NoopSpan:
+    """Cached do-nothing span handed out while observability is off."""
+
+    __slots__ = ()
+    name = ""
+    attrs: Dict[str, Any] = {}
+    duration = 0.0
+
+    def add(self, **attrs) -> "_NoopSpan":
+        return self
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        return None
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str, **attrs):
+    """A context-managed span named ``name`` with optional numeric attrs.
+
+    When observability is disabled this returns a cached no-op singleton:
+    one global bool check, no allocation.
+    """
+    if not _enabled:
+        return _NOOP
+    return Span(name, attrs)
+
+
+def iter_spans(
+    name: str,
+    iterable: Iterable,
+    records: Optional[Callable[[Any], int]] = None,
+    bytes_of: Optional[Callable[[Any], int]] = None,
+) -> Iterator:
+    """Yield from ``iterable``, timing the production of each item.
+
+    Each ``next()`` gets its own span (so producer time is measured, not
+    consumer time) carrying ``records``/``bytes`` attrs when the callables
+    are given. Disabled -> yields straight through with zero wrapping.
+    """
+    if not _enabled:
+        yield from iterable
+        return
+    iterator = iter(iterable)
+    try:
+        while True:
+            with span(name) as current:
+                try:
+                    item = next(iterator)
+                except StopIteration:
+                    current.add(eof=1)
+                    return
+                if records is not None:
+                    current.add(records=int(records(item)))
+                if bytes_of is not None:
+                    current.add(bytes=int(bytes_of(item)))
+            yield item
+    finally:
+        # chain close() to the source: abandonment must release e.g. a
+        # native stream handle deterministically (prefetch_iterator docs)
+        close = getattr(iterator, "close", None)
+        if close is not None:
+            close()
+
+
+def _record_span(record: dict) -> None:
+    with _lock:
+        _ring.append(record)
+        total = _span_totals.setdefault(record["name"], [0.0, 0.0])
+        total[0] += 1
+        total[1] += record["dur"]
+    sink = _sink_file
+    if sink is not None:
+        line = json.dumps(record, separators=(",", ":")) + "\n"
+        with _sink_lock:
+            if _sink_file is not None:  # disable() may race the write
+                _sink_file.write(line)
+                _sink_file.flush()
+
+
+# ----------------------------------------------------------- counters
+
+def count(name: str, value: float = 1) -> None:
+    """Increment counter ``name`` (monotonic; no-op while disabled)."""
+    if not _enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + value
+
+
+def gauge(name: str, value: float) -> None:
+    """Set gauge ``name`` to ``value`` (last-write-wins; no-op disabled)."""
+    if not _enabled:
+        return
+    with _lock:
+        _gauges[name] = value
+
+
+def counters() -> Dict[str, float]:
+    """Snapshot of the counter values."""
+    with _lock:
+        return dict(_counters)
+
+
+def spans() -> List[dict]:
+    """Snapshot of the in-process span ring (oldest first)."""
+    with _lock:
+        return list(_ring)
+
+
+_PROM_PREFIX = "sctools_tpu_"
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name
+    )
+    return _PROM_PREFIX + out
+
+
+def render_metrics() -> str:
+    """Counters + gauges + span aggregates in Prometheus text exposition.
+
+    Counter samples get a ``_total`` suffix; per-span aggregates export as
+    ``sctools_tpu_span_count_total{span="..."}`` and
+    ``sctools_tpu_span_seconds_total{span="..."}``.
+    """
+    with _lock:
+        counter_items = sorted(_counters.items())
+        gauge_items = sorted(_gauges.items())
+        totals = sorted((k, v[0], v[1]) for k, v in _span_totals.items())
+    lines: List[str] = []
+    for name, value in counter_items:
+        metric = _prom_name(name)
+        if not metric.endswith("_total"):
+            metric += "_total"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {_prom_value(value)}")
+    for name, value in gauge_items:
+        metric = _prom_name(name)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {_prom_value(value)}")
+    if totals:
+        lines.append(f"# TYPE {_PROM_PREFIX}span_count_total counter")
+        for name, n, _ in totals:
+            lines.append(
+                f'{_PROM_PREFIX}span_count_total{{span="{name}"}} '
+                f"{_prom_value(n)}"
+            )
+        lines.append(f"# TYPE {_PROM_PREFIX}span_seconds_total counter")
+        for name, _, seconds in totals:
+            lines.append(
+                f'{_PROM_PREFIX}span_seconds_total{{span="{name}"}} '
+                f"{seconds:.6f}"
+            )
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def _prom_value(value: float) -> str:
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+# ------------------------------------------------------ enable/disable
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(sink_path: Optional[str] = None) -> None:
+    """Turn recording on (idempotent); optionally attach a JSONL sink."""
+    global _enabled, _sink_path, _sink_file
+    with _lock:
+        if sink_path is not None and sink_path != _sink_path:
+            _close_sink()
+            directory = os.path.dirname(os.path.abspath(sink_path))
+            os.makedirs(directory, exist_ok=True)
+            _sink_file = open(sink_path, "a", encoding="utf-8")
+            _sink_path = sink_path
+        _enabled = True
+    if "jax" in sys.modules:
+        install_jax_hooks()
+
+
+def disable() -> None:
+    """Stop recording and detach the sink (recorded data stays readable)."""
+    global _enabled
+    with _lock:
+        _enabled = False
+        _close_sink()
+
+
+def _close_sink() -> None:
+    global _sink_file, _sink_path
+    with _sink_lock:
+        if _sink_file is not None:
+            try:
+                _sink_file.close()
+            except OSError:
+                pass
+        _sink_file = None
+        _sink_path = None
+
+
+def reset() -> None:
+    """Clear the ring, counters, gauges, and span aggregates."""
+    with _lock:
+        _ring.clear()
+        _counters.clear()
+        _gauges.clear()
+        _span_totals.clear()
+
+
+# ------------------------------------------------------------ JAX hooks
+
+def install_jax_hooks() -> bool:
+    """Surface jax.monitoring events through obs (idempotent).
+
+    Duration events (compiles, trace-dispatch, backend init) record as
+    synthetic ``jax:<event>`` spans; plain events count under
+    ``jax_events``. Requires jax to be importable; returns whether the
+    hooks are active. Never imports jax before the caller does at module
+    scope — callers on the device path invoke this after their own
+    deferred jax import.
+    """
+    global _jax_hooks_installed
+    if _jax_hooks_installed:
+        return True
+    try:
+        import jax.monitoring as monitoring
+    except Exception:  # jax absent/broken: observability stays host-only
+        return False
+
+    def _on_duration(event: str, duration: float, **kwargs) -> None:
+        if not _enabled:
+            return
+        _record_span(
+            {
+                "name": "jax:" + event.strip("/").replace("/", "."),
+                "ts": round(time.perf_counter() - _T0 - duration, 6),
+                "dur": duration,
+                "thread": threading.current_thread().name,
+                "depth": len(_stack()),
+            }
+        )
+
+    def _on_event(event: str, **kwargs) -> None:
+        count("jax_event." + event.strip("/").replace("/", "."))
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+    _jax_hooks_installed = True
+    return True
+
+
+class _NullContext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return None
+
+
+def configured_trace_dir() -> Optional[str]:
+    """The SCTOOLS_TPU_TRACE capture directory, if set."""
+    value = os.environ.get("SCTOOLS_TPU_TRACE", "").strip()
+    return value or None
+
+
+def xla_trace(path: Optional[str] = None):
+    """Context wrapping ``jax.profiler.trace`` when capture is configured.
+
+    ``path`` overrides the destination; otherwise SCTOOLS_TPU_TRACE's
+    ``<dir>/xla`` is used. With neither, or with jax unavailable, this is
+    a no-op context — call sites need no conditionals.
+    """
+    target = path
+    if target is None:
+        base = configured_trace_dir()
+        if base is None:
+            return _NullContext()
+        target = os.path.join(base, "xla")
+    try:
+        import jax
+    except Exception:
+        return _NullContext()
+    return jax.profiler.trace(target)
+
+
+# ------------------------------------------------------------ summarize
+
+def summarize_records(records: Iterable[dict]) -> List[dict]:
+    """Aggregate span records into per-stage rows (sorted by total time).
+
+    Each row: name, count, total_s, mean_ms, records, bytes, and derived
+    rec_per_s / MB_per_s throughputs (None when the attr never appeared).
+    """
+    stages: Dict[str, dict] = {}
+    for record in records:
+        name = record.get("name")
+        if not isinstance(name, str):
+            continue
+        row = stages.setdefault(
+            name,
+            {
+                "name": name,
+                "count": 0,
+                "total_s": 0.0,
+                "records": 0,
+                "bytes": 0,
+                "has_records": False,
+                "has_bytes": False,
+                "errors": 0,
+            },
+        )
+        row["count"] += 1
+        row["total_s"] += float(record.get("dur", 0.0))
+        attrs = record.get("attrs") or {}
+        if "records" in attrs:
+            row["records"] += int(attrs["records"])
+            row["has_records"] = True
+        if "bytes" in attrs:
+            row["bytes"] += int(attrs["bytes"])
+            row["has_bytes"] = True
+        if record.get("error"):
+            row["errors"] += 1
+    out = []
+    for row in stages.values():
+        total = row["total_s"]
+        row["mean_ms"] = total / row["count"] * 1e3 if row["count"] else 0.0
+        row["rec_per_s"] = (
+            row["records"] / total if row["has_records"] and total > 0 else None
+        )
+        row["MB_per_s"] = (
+            row["bytes"] / total / 1e6 if row["has_bytes"] and total > 0 else None
+        )
+        if not row.pop("has_records"):
+            row["records"] = None
+        if not row.pop("has_bytes"):
+            row["bytes"] = None
+        out.append(row)
+    out.sort(key=lambda r: -r["total_s"])
+    return out
+
+
+def render_summary(rows: List[dict]) -> str:
+    """The per-stage table ``python -m sctools_tpu.obs summarize`` prints."""
+    headers = (
+        "stage", "count", "total_s", "mean_ms", "records", "rec/s",
+        "bytes", "MB/s",
+    )
+
+    def fmt(value, kind: str) -> str:
+        if value is None:
+            return "-"
+        if kind == "f3":
+            return f"{value:.3f}"
+        if kind == "f1":
+            return f"{value:.1f}"
+        if kind == "i":
+            return str(int(value))
+        return str(value)
+
+    table = [headers]
+    for row in rows:
+        table.append(
+            (
+                row["name"],
+                fmt(row["count"], "i"),
+                fmt(row["total_s"], "f3"),
+                fmt(row["mean_ms"], "f3"),
+                fmt(row["records"], "i"),
+                fmt(row["rec_per_s"], "f1"),
+                fmt(row["bytes"], "i"),
+                fmt(row["MB_per_s"], "f1"),
+            )
+        )
+    widths = [max(len(r[i]) for r in table) for i in range(len(headers))]
+    lines = []
+    for index, row in enumerate(table):
+        lines.append(
+            "  ".join(
+                cell.ljust(widths[i]) if i == 0 else cell.rjust(widths[i])
+                for i, cell in enumerate(row)
+            )
+        )
+        if index == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+# ----------------------------------------------- env-driven activation
+
+def _activate_from_env() -> None:
+    trace_dir = configured_trace_dir()
+    if trace_dir is not None:
+        os.makedirs(trace_dir, exist_ok=True)
+        enable(sink_path=os.path.join(trace_dir, "trace.jsonl"))
+
+        def _dump_metrics() -> None:
+            text = render_metrics()
+            if text:
+                try:
+                    with open(
+                        os.path.join(trace_dir, "metrics.prom"), "w"
+                    ) as f:
+                        f.write(text)
+                except OSError:
+                    pass
+
+        atexit.register(_dump_metrics)
+    elif os.environ.get("SCTOOLS_TPU_OBS", "") not in ("", "0"):
+        enable()
+
+
+_activate_from_env()

@@ -1,0 +1,85 @@
+"""scx-trace CLI: ``python -m sctools_tpu.obs summarize trace.jsonl``.
+
+Reads a span capture (the JSON-lines file SCTOOLS_TPU_TRACE writes) and
+prints the per-stage time/records/bytes/throughput table. Pure stdlib —
+usable on any host with the capture file, no jax required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import render_summary, summarize_records
+
+
+def _load_records(path: str) -> tuple:
+    """(records, bad_line_count) from a trace JSONL file."""
+    records = []
+    bad = 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(record, dict):
+                records.append(record)
+            else:
+                bad += 1
+    return records, bad
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sctools_tpu.obs",
+        description="scx-trace capture tools (docs/observability.md)",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    summarize = sub.add_parser(
+        "summarize", help="per-stage table from a trace JSONL file"
+    )
+    summarize.add_argument("trace", help="path to trace.jsonl")
+    summarize.add_argument(
+        "--top", type=int, default=0,
+        help="only the N most expensive stages (default: all)",
+    )
+    summarize.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable rows instead of the table",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        records, bad = _load_records(args.trace)
+    except OSError as exc:
+        print(f"obs summarize: cannot read {args.trace}: {exc}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"obs summarize: no span records in {args.trace}", file=sys.stderr)
+        return 1
+    rows = summarize_records(records)
+    if args.top:
+        rows = rows[: args.top]
+    if args.as_json:
+        for row in rows:
+            print(json.dumps(row, separators=(",", ":")))
+    else:
+        print(render_summary(rows))
+        total = sum(r["total_s"] for r in rows)
+        print(
+            f"\n{len(records)} spans, {len(rows)} stages, "
+            f"{total:.3f} span-seconds"
+            + (f" ({bad} malformed line(s) skipped)" if bad else "")
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import obs
 from ..io.packed import KEY_HI_SHIFT
 from ..metrics.gatherer import (
     GatherCellMetrics,
@@ -52,76 +53,101 @@ class _ShardedMixin:
         # prologue): byte-identical CSVs require both paths to derive the
         # per-record quality floats the same way. The run-keyed wire is a
         # tunnel-transport concern and does not apply here.
-        cols, static_flags, prepacked = self._prepare_batch(frame, presorted)
-        if prepacked:
-            # partition routes by the outer entity code recovered from the
-            # packed key; the per-shard valid prefix count replaces the mask
-            n = len(cols["flags"])
-            valid = np.arange(n) < cols.pop("n_valid")[0]
-            outer = (cols["key_hi"] >> KEY_HI_SHIFT).astype(np.int32)
-            cols["valid"] = valid
-            cols["_outer"] = outer
-            stacked = partition_columns(cols, self._n_shards, key="_outer")
-            del stacked["_outer"]
-            stacked["n_valid"] = (
-                stacked.pop("valid").sum(axis=1).astype(np.int32)[:, None]
+        with obs.span(
+            "upload", records=frame.n_records, shards=self._n_shards
+        ) as up:
+            cols, static_flags, prepacked = self._prepare_batch(
+                frame, presorted
             )
-            engine_flags = dict(presorted=True, prepacked=True, **static_flags)
-            outer_codes = outer[valid]
-        else:
-            # plain named-column schema; partitioning preserves record
-            # order, so per-shard groups stay ascending and presorted
-            # passes straight through (no per-shard re-sort)
-            stacked = partition_columns(
-                cols, self._n_shards, key=self.entity_kind
-            )
-            engine_flags = dict(presorted=presorted)
-            outer_codes = np.asarray(cols[self.entity_kind])[
-                np.asarray(cols["valid"], dtype=bool)
-            ]
-        self.bytes_h2d += sum(v.nbytes for v in stacked.values())
+            if prepacked:
+                # partition routes by the outer entity code recovered from
+                # the packed key; the per-shard valid prefix count replaces
+                # the mask
+                n = len(cols["flags"])
+                valid = np.arange(n) < cols.pop("n_valid")[0]
+                outer = (cols["key_hi"] >> KEY_HI_SHIFT).astype(np.int32)
+                cols["valid"] = valid
+                cols["_outer"] = outer
+                stacked = partition_columns(cols, self._n_shards, key="_outer")
+                del stacked["_outer"]
+                stacked["n_valid"] = (
+                    stacked.pop("valid").sum(axis=1).astype(np.int32)[:, None]
+                )
+                engine_flags = dict(
+                    presorted=True, prepacked=True, **static_flags
+                )
+                outer_codes = outer[valid]
+            else:
+                # plain named-column schema; partitioning preserves record
+                # order, so per-shard groups stay ascending and presorted
+                # passes straight through (no per-shard re-sort)
+                stacked = partition_columns(
+                    cols, self._n_shards, key=self.entity_kind
+                )
+                engine_flags = dict(presorted=presorted)
+                outer_codes = np.asarray(cols[self.entity_kind])[
+                    np.asarray(cols["valid"], dtype=bool)
+                ]
+            batch_h2d = sum(v.nbytes for v in stacked.values())
+            self.bytes_h2d += batch_h2d
+            up.add(bytes=batch_h2d, prepacked=int(prepacked))
+        obs.count("batches_uploaded")
+        obs.count("h2d_bytes", batch_h2d)
         shard_size = max(v.shape[1] for v in stacked.values())
-        # per-shard entity counts are host-knowable (distinct codes routed
-        # to each shard), so each shard compacts its rows ON DEVICE into
-        # the same fused int32 block the single-device path pulls —
-        # record-scale result arrays never cross the host link
-        unique_codes = np.unique(outer_codes)
-        per_shard = np.bincount(
-            unique_codes % self._n_shards, minlength=self._n_shards
-        )
-        k = min(
-            bucket_size(int(per_shard.max(initial=1)), minimum=1024),
-            shard_size,
-        )
-        int_names, float_names = wire_result_names(self.columns)
-        blocks, n_entities = sharded_entity_metrics(
-            stacked, self._mesh, kind=self.entity_kind,
-            compact=(int_names, float_names, k), **engine_flags,
-        )
+        with obs.span("compute", records=frame.n_records):
+            # per-shard entity counts are host-knowable (distinct codes
+            # routed to each shard), so each shard compacts its rows ON
+            # DEVICE into the same fused int32 block the single-device path
+            # pulls — record-scale result arrays never cross the host link
+            unique_codes = np.unique(outer_codes)
+            per_shard = np.bincount(
+                unique_codes % self._n_shards, minlength=self._n_shards
+            )
+            k = min(
+                bucket_size(int(per_shard.max(initial=1)), minimum=1024),
+                shard_size,
+            )
+            int_names, float_names = wire_result_names(self.columns)
+            blocks, n_entities = sharded_entity_metrics(
+                stacked, self._mesh, kind=self.entity_kind,
+                compact=(int_names, float_names, k), **engine_flags,
+            )
         return (
             self._entity_names(frame), blocks, n_entities,
-            int_names, float_names,
+            int_names, float_names, frame.n_records,
         )
 
     def _finalize_device_batch(
-        self, entity_names, blocks, n_entities, int_names, float_names, out
+        self, entity_names, blocks, n_entities, int_names, float_names,
+        n_records, out,
     ) -> None:
-        blocks = np.asarray(blocks)
-        n_entities = np.asarray(n_entities).reshape(-1)
-        self.bytes_d2h += blocks.nbytes + n_entities.nbytes
-        rows = np.concatenate(
-            [blocks[s, : int(n_entities[s])] for s in range(len(n_entities))]
-        )
-        # entity vocabulary order == ascending codes == the single-device
-        # row order (codes preserve string order); shards are disjoint so
-        # this sort is the whole merge
-        rows = rows[np.argsort(rows[:, 0])]
-        ints = rows[:, : len(int_names)]
-        floats = np.ascontiguousarray(rows[:, len(int_names):]).view(np.float32)
-        self._write_device_rows(
-            entity_names, rows.shape[0], int_names, float_names,
-            ints, floats, out,
-        )
+        with obs.span("writeback", records=n_records) as wb:
+            blocks = np.asarray(blocks)
+            n_entities = np.asarray(n_entities).reshape(-1)
+            batch_d2h = blocks.nbytes + n_entities.nbytes
+            self.bytes_d2h += batch_d2h
+            wb.add(bytes=batch_d2h)
+            obs.count("d2h_bytes", batch_d2h)
+            rows = np.concatenate(
+                [
+                    blocks[s, : int(n_entities[s])]
+                    for s in range(len(n_entities))
+                ]
+            )
+            # entity vocabulary order == ascending codes == the
+            # single-device row order (codes preserve string order); shards
+            # are disjoint so this sort is the whole merge
+            rows = rows[np.argsort(rows[:, 0])]
+            ints = rows[:, : len(int_names)]
+            floats = np.ascontiguousarray(
+                rows[:, len(int_names):]
+            ).view(np.float32)
+            wb.add(entities=int(rows.shape[0]))
+            obs.count("entities_written", int(rows.shape[0]))
+            self._write_device_rows(
+                entity_names, rows.shape[0], int_names, float_names,
+                ints, floats, out,
+            )
 
 
 class ShardedCellMetrics(_ShardedMixin, GatherCellMetrics):

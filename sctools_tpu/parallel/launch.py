@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .. import obs
 from .mesh import DEFAULT_AXIS
 
 
@@ -52,11 +53,17 @@ def initialize_distributed(
     """
     import jax
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
+    with obs.span(
+        "distributed:initialize",
         process_id=process_id,
-    )
+        num_processes=num_processes,
+    ):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    obs.install_jax_hooks()
 
 
 def global_mesh(axis_name: str = DEFAULT_AXIS):
@@ -138,9 +145,13 @@ def run_process_cell_metrics(
     parts = []
     for index, chunk in process_chunks(chunks, num_processes, process_id):
         part = f"{part_stem}.part{index:04d}"
-        ShardedCellMetrics(
-            chunk, part, set(mitochondrial_gene_ids), mesh=mesh
-        ).extract_metrics()
+        with obs.span(
+            "distributed:chunk_metrics", chunk=index, process=process_id
+        ):
+            ShardedCellMetrics(
+                chunk, part, set(mitochondrial_gene_ids), mesh=mesh
+            ).extract_metrics()
+        obs.count("chunks_processed")
         parts.append(part + ".csv.gz")
     return parts
 
@@ -166,7 +177,8 @@ def merge_sorted_csv_parts(
     # is a k-way streaming merge — O(parts) memory on the rank-0 host, the
     # same shape as the native tag sort's partial-file merge
     n_rows = 0
-    with ExitStack() as stack:
+    merge_span = obs.span("distributed:merge_parts", parts=len(paths))
+    with merge_span, ExitStack() as stack:
         header: Optional[str] = None
         streams = []
         for path in paths:
@@ -185,4 +197,5 @@ def merge_sorted_csv_parts(
         ):
             out.write(line)
             n_rows += 1
+        merge_span.add(records=n_rows)
     return n_rows

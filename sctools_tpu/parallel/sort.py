@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..ops import segments as seg
 from .metrics import P, _check_shard_count, reshard_by_key
 
@@ -245,29 +246,52 @@ def distributed_sort(
     concrete = not isinstance(
         stacked_cols[key_names[0]], jax.core.Tracer
     )
-    if concrete:
-        required = required_sort_capacity(stacked_cols, key_names, n_shards)
-        if capacity is None:
-            # bucketed so streaming batches of similar skew reuse one
-            # compiled program instead of recompiling per exact capacity
-            capacity = seg.bucket_size(max(required, 1), minimum=8)
-        elif capacity < required:
-            raise ValueError(
-                f"sort capacity={capacity} too small: a (src,dst) bucket "
-                f"holds {required} records"
-            )
-    elif capacity is None:
-        capacity = shard_size
-    out, dropped = _build_sample_sort(
-        mesh, tuple(key_names), n_shards, axis_name, capacity
-    )(stacked_cols)
-    if not isinstance(dropped, jax.core.Tracer):
-        n_dropped = int(np.asarray(dropped).sum())
-        if n_dropped:
-            raise RuntimeError(
-                f"distributed sort dropped {n_dropped} records: raise "
-                "capacity (the tiebreaker balances key skew, so this "
-                "indicates a sampling-slack shortfall; required_sort_capacity "
-                "gives the tight bound)"
-            )
+    # under tracing the body runs at trace time, not sort time: record that
+    # under its own stage name so summarize never ranks the sort stage by
+    # compile cost (and never under-counts real executions)
+    with obs.span(
+        "distributed:sample_sort" if concrete else
+        "distributed:sample_sort.trace",
+        shards=n_shards,
+    ) as sort_span:
+        if concrete:
+            if obs.enabled():
+                # actual record count, not padded shard capacity — keeps
+                # this span's rec/s comparable with the other stages'.
+                # Computed only while recording: the scan (and a possible
+                # device pull of the valid column) must not ride the
+                # disabled serving path.
+                sort_span.add(
+                    records=int(
+                        np.count_nonzero(np.asarray(stacked_cols["valid"]))
+                    )
+                )
+            with obs.span("distributed:sort_capacity"):
+                required = required_sort_capacity(
+                    stacked_cols, key_names, n_shards
+                )
+            if capacity is None:
+                # bucketed so streaming batches of similar skew reuse one
+                # compiled program instead of recompiling per exact capacity
+                capacity = seg.bucket_size(max(required, 1), minimum=8)
+            elif capacity < required:
+                raise ValueError(
+                    f"sort capacity={capacity} too small: a (src,dst) bucket "
+                    f"holds {required} records"
+                )
+        elif capacity is None:
+            capacity = shard_size
+        sort_span.add(capacity=capacity)
+        out, dropped = _build_sample_sort(
+            mesh, tuple(key_names), n_shards, axis_name, capacity
+        )(stacked_cols)
+        if not isinstance(dropped, jax.core.Tracer):
+            n_dropped = int(np.asarray(dropped).sum())
+            if n_dropped:
+                raise RuntimeError(
+                    f"distributed sort dropped {n_dropped} records: raise "
+                    "capacity (the tiebreaker balances key skew, so this "
+                    "indicates a sampling-slack shortfall; "
+                    "required_sort_capacity gives the tight bound)"
+                )
     return out

@@ -20,6 +20,7 @@ import os
 import tempfile
 from typing import Iterator, List, Sequence
 
+from . import obs
 from .bam import TagSortableRecord, sort_by_tags_and_queryname
 from .io.sam import AlignmentReader, AlignmentWriter
 
@@ -36,9 +37,10 @@ def _sort_key(tag_keys):
 
 def _write_partial(records, header, tag_keys, directory, index) -> str:
     path = os.path.join(directory, f"partial_{index:05d}.bam")
-    with AlignmentWriter(path, header, "wb") as writer:
-        for record in sort_by_tags_and_queryname(iter(records), tag_keys):
-            writer.write(record)
+    with obs.span("tagsort:chunk_sort", records=len(records)):
+        with AlignmentWriter(path, header, "wb") as writer:
+            for record in sort_by_tags_and_queryname(iter(records), tag_keys):
+                writer.write(record)
     return path
 
 
@@ -128,8 +130,10 @@ def tag_sort_bam_out_of_core(
         n = 0
         key = _sort_key(tag_keys)
         streams = [_iter_partial(p) for p in partials]
-        with AlignmentWriter(output_bam, header, "wb") as writer:
-            for record in heapq.merge(*streams, key=key):
-                writer.write(record)
-                n += 1
+        with obs.span("tagsort:merge", partials=len(partials)) as sp:
+            with AlignmentWriter(output_bam, header, "wb") as writer:
+                for record in heapq.merge(*streams, key=key):
+                    writer.write(record)
+                    n += 1
+            sp.add(records=n)
         return n

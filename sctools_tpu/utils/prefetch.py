@@ -6,37 +6,58 @@ releases the GIL inside ctypes calls, and the TPU works independently of the
 host either way). The role the reference's reader/writer thread pools play
 around its processing loops (fastq_common.cpp:30-40), reduced to one
 bounded-queue producer.
+
+Failure handling contract (regression-tested in tests/test_prefetch.py):
+
+- an exception in the producer re-raises in the consumer at the point of
+  the failed item, and cannot be lost or hang the consumer — the consumer
+  never blocks forever on a queue the producer stopped feeding (a dead
+  producer thread without a sentinel raises RuntimeError instead);
+- abandoning the iterator early (break / close / GC) stops the producer
+  promptly: the consumer drains the queue to unblock a producer stuck in
+  ``put``, the producer closes the underlying iterable (releasing e.g. a
+  native stream handle), and the thread joins with a bounded wait so a
+  source blocked in I/O cannot hang generator close (the daemon thread is
+  abandoned in that pathological case, never the consumer).
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, Iterator, TypeVar
+
+from .. import obs
 
 T = TypeVar("T")
 
 _SENTINEL = object()
 
+# consumer-side poll period: bounds how late a producer death without a
+# sentinel (interpreter teardown, native crash unwinding the thread) is
+# noticed; items arriving normally are handed over immediately by the queue
+_GET_POLL_S = 0.5
+# bounded wait for the producer to finish after abandonment; past this the
+# source is considered stuck in I/O and the daemon thread is left behind
+_ABANDON_JOIN_S = 10.0
+
 
 def prefetch_iterator(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
-    """Yield from ``iterable``, producing up to ``depth`` items ahead.
-
-    Exceptions raised by the producer re-raise in the consumer at the point
-    of the failed item. When the consumer abandons the iterator (exception,
-    generator close), the producer notices via a stop event, closes the
-    underlying iterable if it is a generator (releasing e.g. a native stream
-    handle), and exits — nothing stays pinned for the process lifetime.
-    """
+    """Yield from ``iterable``, producing up to ``depth`` items ahead."""
     items: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
 
     def put_until_stopped(item) -> bool:
+        blocked = False
         while not stop.is_set():
             try:
-                items.put(item, timeout=0.1)
+                items.put(item, timeout=0.05)
                 return True
             except queue.Full:
+                if not blocked:
+                    blocked = True
+                    obs.count("prefetch_producer_blocked")
                 continue
         return False
 
@@ -56,11 +77,37 @@ def prefetch_iterator(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
                 if close is not None:
                     close()
 
-    thread = threading.Thread(target=produce, daemon=True)
+    thread = threading.Thread(
+        target=produce, name="sctools-prefetch", daemon=True
+    )
     thread.start()
+
+    def get_item():
+        """Next queue item; never hangs on a dead producer."""
+        waited = 0.0
+        while True:
+            try:
+                return items.get(timeout=_GET_POLL_S)
+            except queue.Empty:
+                waited += _GET_POLL_S
+                if not thread.is_alive():
+                    # one last non-blocking look: the producer may have
+                    # enqueued its final item between the timeout and the
+                    # liveness check
+                    try:
+                        return items.get_nowait()
+                    except queue.Empty:
+                        raise RuntimeError(
+                            "prefetch producer thread died without "
+                            "delivering a result"
+                        ) from None
+                if waited >= 5.0:
+                    obs.count("prefetch_consumer_wait_seconds", waited)
+                    waited = 0.0
+
     try:
         while True:
-            item = items.get()
+            item = get_item()
             if (
                 isinstance(item, tuple)
                 and len(item) == 2
@@ -70,7 +117,18 @@ def prefetch_iterator(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
                 if error is not None:
                     raise error
                 return
+            obs.count("prefetch_items")
             yield item
     finally:
         stop.set()
-        thread.join()
+        # unblock a producer stuck in put() by draining, then join with a
+        # bounded wait: a source stuck in I/O must not hang generator close
+        deadline = time.perf_counter() + _ABANDON_JOIN_S
+        while thread.is_alive() and time.perf_counter() < deadline:
+            try:
+                items.get_nowait()
+            except queue.Empty:
+                pass
+            thread.join(timeout=0.05)
+        if thread.is_alive():
+            obs.count("prefetch_abandoned_threads")

@@ -1,0 +1,22 @@
+"""SCX109 bad: wall-clock reads timing pipeline stages."""
+
+import datetime
+import time
+from datetime import datetime as dt
+from time import time as now
+
+
+def decode_elapsed(frames):
+    start = time.time()
+    total = sum(frame.n_records for frame in frames)
+    return total, time.time() - start
+
+
+def stamp_batch():
+    started = datetime.datetime.now()
+    finished = dt.utcnow()
+    return (finished - started).total_seconds()
+
+
+def bare_bound_name():
+    return now()

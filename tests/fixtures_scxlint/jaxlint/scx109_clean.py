@@ -1,0 +1,22 @@
+"""SCX109 clean: monotonic clocks / obs spans for durations."""
+
+import time
+
+from sctools_tpu import obs
+
+
+def decode_elapsed(frames):
+    start = time.perf_counter()
+    total = sum(frame.n_records for frame in frames)
+    return total, time.perf_counter() - start
+
+
+def spanned(frames):
+    with obs.span("decode") as sp:
+        for frame in frames:
+            sp.add(records=frame.n_records)
+    return sp.duration
+
+
+def monotonic_deadline(seconds):
+    return time.monotonic() + seconds

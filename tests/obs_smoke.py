@@ -1,0 +1,168 @@
+"""CI leg: a small synthetic pipeline under SCTOOLS_TPU_TRACE.
+
+Run by ``make obs-smoke`` (part of ``make ci``); exits non-zero unless:
+
+- the trace JSONL parses line-by-line,
+- it contains decode/upload/compute/writeback spans whose summed record
+  counts each equal the input record count,
+- ``obs.render_metrics()`` output is valid Prometheus text exposition,
+- ``python -m sctools_tpu.obs summarize`` renders the capture.
+
+Not a pytest module (no ``test_`` prefix): it must observe a whole
+process whose trace env var was set before import, which an in-suite test
+cannot guarantee.
+"""
+
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+
+# the sink appends: a stale trace from a previous run would double the
+# record-conservation sums asserted below, so the capture dir is recreated
+# BEFORE sctools_tpu.obs is imported (import opens the sink). Only the
+# script's OWN default is ever deleted — an inherited SCTOOLS_TPU_TRACE may
+# point at a user's real capture (the Makefile leg does its own rm -rf).
+_INHERITED_TRACE = "SCTOOLS_TPU_TRACE" in os.environ
+_TRACE_DIR = os.environ.setdefault(
+    "SCTOOLS_TPU_TRACE",
+    os.path.join(tempfile.gettempdir(), "sctools_tpu_obs_smoke"),
+)
+if not _INHERITED_TRACE:
+    shutil.rmtree(_TRACE_DIR, ignore_errors=True)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from sctools_tpu import obs  # noqa: E402
+
+import helpers  # noqa: E402
+
+N_CELLS = 32
+MOLECULES = 2
+READS = 2
+N_RECORDS = N_CELLS * MOLECULES * READS
+BATCH_RECORDS = 48  # several batches, so per-stage spans repeat
+
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\"(,[a-zA-Z0-9_]+="
+    r"\"[^\"]*\")*\})? [-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|inf|nan)$"
+)
+_TYPE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram|summary)$"
+)
+
+
+def fail(message: str) -> None:
+    print(f"obs-smoke: FAIL: {message}", file=sys.stderr)
+    sys.exit(1)
+
+
+def build_bam(path: str) -> None:
+    records = []
+    for c in range(N_CELLS):
+        for m in range(MOLECULES):
+            for r in range(READS):
+                records.append(
+                    helpers.make_record(
+                        name=f"q{c}_{m}_{r}",
+                        cb=f"CB{c:04d}",
+                        ub=f"UB{m:02d}",
+                        ge=f"GENE{(c + m) % 7:02d}",
+                        xf="25",
+                        nh=1,
+                        pos=100 + 10 * r,
+                        duplicate=r > 0,
+                    )
+                )
+    helpers.write_bam(path, records)
+
+
+def main() -> None:
+    if not obs.enabled():
+        fail("SCTOOLS_TPU_TRACE did not enable recording at import")
+    stale = os.path.join(_TRACE_DIR, "trace.jsonl")
+    if (
+        _INHERITED_TRACE
+        and os.path.exists(stale)
+        and os.path.getsize(stale) > 0
+    ):
+        fail(
+            f"{stale} already holds spans; the sink appends and the "
+            "record-conservation sums below would double. Point "
+            "SCTOOLS_TPU_TRACE at a fresh directory (or unset it)."
+        )
+
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    workdir = tempfile.mkdtemp(prefix="obs_smoke_")
+    bam = os.path.join(workdir, "smoke.bam")
+    build_bam(bam)
+    GatherCellMetrics(
+        bam, os.path.join(workdir, "cell_metrics"),
+        backend="device", batch_records=BATCH_RECORDS,
+    ).extract_metrics()
+
+    trace_path = os.path.join(_TRACE_DIR, "trace.jsonl")
+    if not os.path.exists(trace_path):
+        fail(f"no trace file at {trace_path}")
+    spans = []
+    with open(trace_path) as f:
+        for lineno, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError as exc:
+                fail(f"trace line {lineno} is not JSON: {exc}")
+            if not isinstance(record, dict) or "name" not in record:
+                fail(f"trace line {lineno} is not a span record")
+            spans.append(record)
+
+    for stage in ("decode", "upload", "compute", "writeback"):
+        stage_records = sum(
+            (s.get("attrs") or {}).get("records", 0)
+            for s in spans
+            if s["name"] == stage
+        )
+        if stage_records != N_RECORDS:
+            fail(
+                f"{stage} spans sum to {stage_records} records, "
+                f"input has {N_RECORDS}"
+            )
+
+    exposition = obs.render_metrics()
+    if not exposition:
+        fail("render_metrics() returned nothing")
+    for lineno, line in enumerate(exposition.splitlines(), 1):
+        if line.startswith("# TYPE"):
+            if not _TYPE.match(line):
+                fail(f"bad TYPE line {lineno}: {line!r}")
+        elif line.startswith("#"):
+            continue
+        elif not _SAMPLE.match(line):
+            fail(f"bad exposition sample line {lineno}: {line!r}")
+    for needed in (
+        "sctools_tpu_records_decoded_total",
+        "sctools_tpu_h2d_bytes_total",
+        "sctools_tpu_span_seconds_total",
+    ):
+        if needed not in exposition:
+            fail(f"exposition lacks {needed}")
+
+    from sctools_tpu.obs.__main__ import main as obs_cli
+
+    if obs_cli(["summarize", trace_path]) != 0:
+        fail("obs summarize CLI exited non-zero")
+
+    print(
+        f"obs-smoke: OK ({len(spans)} spans, "
+        f"{len(exposition.splitlines())} exposition lines)"
+    )
+
+
+if __name__ == "__main__":
+    main()

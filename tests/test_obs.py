@@ -1,0 +1,294 @@
+"""scx-trace acceptance: spans, counters, sink, CLI, and overhead.
+
+The observability subsystem's contract (docs/observability.md):
+
+- spans nest per-thread and record name/duration/depth/attrs;
+- counters/gauges render as valid Prometheus text exposition;
+- the JSONL sink round-trips through ``summarize_records`` and the
+  ``python -m sctools_tpu.obs summarize`` CLI;
+- disabled-by-default behavior is a cached no-op singleton (the serving
+  path's overhead budget).
+"""
+
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from sctools_tpu import obs
+from sctools_tpu.obs.__main__ import main as obs_cli
+
+
+@pytest.fixture()
+def recording():
+    """Enable recording for one test, restoring the disabled default."""
+    obs.reset()
+    obs.enable()
+    try:
+        yield obs
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+# ------------------------------------------------------------------ spans
+
+def test_disabled_span_is_cached_noop_singleton():
+    assert not obs.enabled()
+    first = obs.span("a", records=1)
+    second = obs.span("b")
+    assert first is second
+    with first as sp:
+        assert sp.add(records=10) is sp
+    assert first.duration == 0.0
+    assert obs.spans() == []
+
+
+def test_span_nesting_records_depth_and_order(recording):
+    with obs.span("outer"):
+        with obs.span("inner", records=3):
+            pass
+        with obs.span("inner", records=2):
+            pass
+    spans = obs.spans()
+    assert [s["name"] for s in spans] == ["inner", "inner", "outer"]
+    assert [s["depth"] for s in spans] == [1, 1, 0]
+    assert spans[2]["depth"] == 0
+    assert all(s["dur"] >= 0 for s in spans)
+    assert spans[0]["attrs"] == {"records": 3}
+
+
+def test_span_attrs_accumulate_and_duration_populates(recording):
+    with obs.span("stage", bytes=10) as sp:
+        sp.add(bytes=5, records=7)
+        time.sleep(0.01)
+    assert sp.attrs == {"bytes": 15, "records": 7}
+    assert sp.duration >= 0.01
+    (record,) = obs.spans()
+    assert record["attrs"] == {"bytes": 15, "records": 7}
+
+
+def test_span_error_annotation(recording):
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("x")
+    (record,) = obs.spans()
+    assert record["error"] == "ValueError"
+
+
+def test_spans_are_per_thread_nested(recording):
+    barrier = threading.Barrier(2)
+
+    def work(name):
+        with obs.span(name):
+            barrier.wait(timeout=5)
+            with obs.span(name + ":inner"):
+                pass
+
+    threads = [
+        threading.Thread(target=work, args=(n,)) for n in ("t1", "t2")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = obs.spans()
+    assert len(spans) == 4
+    # each thread's inner span is depth 1 under ITS OWN outer span — the
+    # stacks do not interleave across threads
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["t1:inner"]["depth"] == 1
+    assert by_name["t2:inner"]["depth"] == 1
+    assert by_name["t1"]["depth"] == 0 and by_name["t2"]["depth"] == 0
+    assert by_name["t1:inner"]["thread"] != by_name["t2:inner"]["thread"] or (
+        by_name["t1"]["thread"] != by_name["t2"]["thread"]
+    )
+
+
+def test_iter_spans_times_production_and_chains_close(recording):
+    closed = []
+
+    def source():
+        try:
+            yield from range(3)
+        finally:
+            closed.append(True)
+
+    out = list(obs.iter_spans("produce", source(), records=lambda x: x + 1))
+    assert out == [0, 1, 2]
+    assert closed == [True]
+    produced = [s for s in obs.spans() if s["name"] == "produce"]
+    assert len(produced) == 4  # 3 items + the EOF probe
+    assert sum(s.get("attrs", {}).get("records", 0) for s in produced) == 6
+
+    # abandonment: closing the wrapper closes the source
+    closed.clear()
+    it = obs.iter_spans("produce", source())
+    assert next(it) == 0
+    it.close()
+    assert closed == [True]
+
+
+# --------------------------------------------------------------- counters
+
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\"(,[a-zA-Z0-9_]+="
+    r"\"[^\"]*\")*\})? [-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|inf|nan)$"
+)
+
+
+def test_counters_and_exposition_format(recording):
+    obs.count("records_decoded", 100)
+    obs.count("records_decoded", 28)
+    obs.count("h2d_bytes", 1 << 20)
+    obs.gauge("prefetch_depth", 2)
+    with obs.span("decode"):
+        pass
+    text = obs.render_metrics()
+    lines = text.splitlines()
+    assert text.endswith("\n")
+    for line in lines:
+        if line.startswith("# TYPE "):
+            assert line.split()[-1] in ("counter", "gauge"), line
+        else:
+            assert _SAMPLE.match(line), line
+    assert "sctools_tpu_records_decoded_total 128" in lines
+    assert "sctools_tpu_h2d_bytes_total 1048576" in lines
+    assert "sctools_tpu_prefetch_depth 2" in lines
+    assert 'sctools_tpu_span_count_total{span="decode"} 1' in lines
+    # TYPE declared before the first sample of each metric
+    seen_type = set()
+    for line in lines:
+        if line.startswith("# TYPE "):
+            seen_type.add(line.split()[2])
+        elif line and not line.startswith("#"):
+            assert line.split("{")[0].split(" ")[0] in seen_type, line
+
+
+def test_counting_disabled_is_silent():
+    assert not obs.enabled()
+    obs.count("never", 5)
+    obs.gauge("never_gauge", 5)
+    assert obs.counters() == {}
+    assert obs.render_metrics() == ""
+
+
+# ------------------------------------------------------------------- sink
+
+def test_jsonl_sink_roundtrip(tmp_path):
+    sink = tmp_path / "trace.jsonl"
+    obs.reset()
+    obs.enable(sink_path=str(sink))
+    try:
+        with obs.span("decode", records=10, bytes=100):
+            pass
+        with obs.span("upload", records=10):
+            pass
+    finally:
+        obs.disable()
+        obs.reset()
+    records = [
+        json.loads(line) for line in sink.read_text().splitlines() if line
+    ]
+    assert [r["name"] for r in records] == ["decode", "upload"]
+    assert records[0]["attrs"] == {"records": 10, "bytes": 100}
+    rows = obs.summarize_records(records)
+    assert {r["name"] for r in rows} == {"decode", "upload"}
+    decode = next(r for r in rows if r["name"] == "decode")
+    assert decode["records"] == 10 and decode["bytes"] == 100
+    assert decode["count"] == 1
+
+
+# -------------------------------------------------------------------- CLI
+
+def test_summarize_cli_on_recorded_fixture(tmp_path, capsys):
+    trace = tmp_path / "trace.jsonl"
+    spans = [
+        {"name": "decode", "ts": 0.0, "dur": 0.5, "thread": "p",
+         "depth": 0, "attrs": {"records": 1000, "bytes": 4000}},
+        {"name": "decode", "ts": 0.6, "dur": 0.5, "thread": "p",
+         "depth": 0, "attrs": {"records": 1000, "bytes": 4000}},
+        {"name": "compute", "ts": 0.2, "dur": 2.0, "thread": "m",
+         "depth": 0, "attrs": {"records": 2000}},
+    ]
+    trace.write_text("".join(json.dumps(s) + "\n" for s in spans))
+    assert obs_cli(["summarize", str(trace)]) == 0
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    assert lines[0].split()[:4] == ["stage", "count", "total_s", "mean_ms"]
+    compute_row, decode_row = None, None
+    for line in lines:
+        if line.startswith("compute"):
+            compute_row = line.split()
+        if line.startswith("decode"):
+            decode_row = line.split()
+    assert compute_row and decode_row
+    # sorted by total time: compute (2.0s) above decode (1.0s)
+    compute_at = next(i for i, l in enumerate(lines) if l.startswith("compute"))
+    decode_at = next(i for i, l in enumerate(lines) if l.startswith("decode"))
+    assert compute_at < decode_at
+    assert decode_row[1] == "2"  # count
+    assert decode_row[4] == "2000"  # records
+    assert float(decode_row[5]) == pytest.approx(2000.0, rel=0.01)  # rec/s
+    assert "3 spans" in out
+
+
+def test_summarize_cli_json_mode(tmp_path, capsys):
+    trace = tmp_path / "trace.jsonl"
+    trace.write_text(
+        json.dumps({"name": "x", "dur": 1.0, "attrs": {"records": 5}}) + "\n"
+        + "not json\n"
+    )
+    assert obs_cli(["summarize", str(trace), "--json"]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows[0]["name"] == "x" and rows[0]["records"] == 5
+
+
+def test_summarize_cli_missing_and_empty(tmp_path, capsys):
+    assert obs_cli(["summarize", str(tmp_path / "absent.jsonl")]) == 2
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert obs_cli(["summarize", str(empty)]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------- overhead
+
+def test_noop_overhead_smoke():
+    """Disabled spans must be allocation-free and effectively free.
+
+    Smoke bound, deliberately loose (shared CI hosts): 200k disabled
+    span+count pairs in well under a second — ~µs each would already be
+    10x slower than this asserts.
+    """
+    assert not obs.enabled()
+    n = 200_000
+    start = time.perf_counter()
+    for _ in range(n):
+        with obs.span("hot", records=1):
+            pass
+        obs.count("hot", 1)
+    elapsed = time.perf_counter() - start
+    assert elapsed < 2.0, f"{n} disabled spans took {elapsed:.3f}s"
+    assert obs.spans() == [] and obs.counters() == {}
+
+
+# ----------------------------------------------------------------- hooks
+
+def test_xla_trace_noop_without_configuration(monkeypatch):
+    monkeypatch.delenv("SCTOOLS_TPU_TRACE", raising=False)
+    with obs.xla_trace():
+        pass  # must not require jax state or a destination
+
+
+def test_install_jax_hooks_idempotent_and_records(recording):
+    if not obs.install_jax_hooks():
+        pytest.skip("jax unavailable")
+    assert obs.install_jax_hooks()  # second call: already installed
+    import jax
+
+    jax.jit(lambda x: x + 1)(1)  # triggers compile duration events
+    names = {s["name"] for s in obs.spans()}
+    assert any(n.startswith("jax:") for n in names), names
