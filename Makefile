@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke sched-smoke docs clean
 
-ci: native lint test obs-smoke
+ci: native lint test obs-smoke sched-smoke
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -48,6 +48,17 @@ obs-smoke:
 	rm -rf /tmp/sctools_tpu_obs_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_TRACE=/tmp/sctools_tpu_obs_smoke \
 	$(PY) tests/obs_smoke.py
+
+# scheduler gate: a synthetic 2-process run with injected crash + delay
+# faults must converge (lease steal), resume cleanly (zero new attempts),
+# and leave a journal whose committed set matches the output parts, with
+# the merge byte-identical to a single-process run (tests/sched_smoke.py;
+# docs/scheduler.md). A fresh workdir per run: the journal is durable by
+# design, and a stale one would turn the run into a no-op resume.
+sched-smoke:
+	rm -rf /tmp/sctools_tpu_sched_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_SCHED_SMOKE_DIR=/tmp/sctools_tpu_sched_smoke \
+	$(PY) tests/sched_smoke.py
 
 native-tsan:
 	$(MAKE) -C sctools_tpu/native tsan

@@ -20,6 +20,8 @@ duplicates, XF mix.
 Prints ONE JSON line. Flags:
   --profile   write a jax.profiler trace to /tmp/sctools_tpu_profile
   --breakdown include decode-only and compute-only timings in the JSON
+  --sched     include the scx-sched overhead microbench (no-op tasks/sec
+              through a WorkQueue: journal + lease cost per task)
 """
 
 from __future__ import annotations
@@ -255,9 +257,44 @@ def bench_cpu_baseline(bam_path: str) -> float:
     return statistics.median(one_run() for _ in range(3))
 
 
+def bench_sched_overhead(n_tasks: int = 200) -> dict:
+    """Scheduler bookkeeping cost: no-op tasks/sec through a WorkQueue.
+
+    Bounds what scx-sched adds per chunk (journal fsyncs, lease create/
+    release, replay): with real chunks taking seconds each, overhead in
+    the hundreds of tasks/sec means the scheduler is invisible in the
+    headline number.
+    """
+    import shutil
+    import tempfile
+
+    from sctools_tpu.sched import WorkQueue, make_task
+
+    root = tempfile.mkdtemp(prefix="sctools_tpu_bench_sched.")
+    try:
+        queue = WorkQueue(
+            os.path.join(root, "journal"), worker_id="bench", lease_ttl=30
+        )
+        queue.register(
+            [make_task("noop", f"t{i:05d}", {"i": i}) for i in range(n_tasks)]
+        )
+        with obs.span("bench:sched_overhead", tasks=n_tasks) as span:
+            queue.run(lambda task: None)
+        elapsed = span.duration
+        queue.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "tasks": n_tasks,
+        "tasks_per_s": round(n_tasks / elapsed, 1) if elapsed else None,
+        "overhead_ms_per_task": round(elapsed / n_tasks * 1e3, 3),
+    }
+
+
 def main():
     profile = "--profile" in sys.argv
     breakdown = "--breakdown" in sys.argv or profile
+    sched = "--sched" in sys.argv
 
     # timings come from obs spans, so recording must be on; the library's
     # own pipeline spans ride along at negligible cost (a few dozen spans
@@ -306,6 +343,8 @@ def main():
                 max(0.0, timings["end_to_end_s"] - floor_h2d - floor_d2h), 3
             ),
         }
+    if sched:
+        result["sched_overhead"] = bench_sched_overhead()
     print(json.dumps(result))
 
 

@@ -42,6 +42,12 @@ Rule catalog (docs/static_analysis.md has the rationale for each):
   NTP and never belong in duration math; durations go through
   ``time.perf_counter()`` or (preferably) an ``obs.span``, which also
   records them.
+- SCX110 shardmap-shim: bare ``jax.shard_map`` attribute access, a
+  ``jax.experimental.shard_map`` spelling, or a ``from jax... import
+  shard_map`` outside ``platform.py``. The attribute moved across jax
+  releases (and renamed ``check_rep`` -> ``check_vma``); every call site
+  must go through the version-portable ``sctools_tpu.platform.shard_map``
+  shim or the library breaks on half the installed jax range.
 """
 
 from __future__ import annotations
@@ -63,10 +69,14 @@ JAX_RULES = {
     "SCX107": "jit-in-loop",
     "SCX108": "print-in-traced",
     "SCX109": "wallclock-duration",
+    "SCX110": "shardmap-shim",
 }
 
 # files allowed to mutate process-global jax.config (SCX106)
 CONFIG_OWNERS = ("platform.py", "conftest.py")
+# the one module allowed to touch jax.shard_map directly (SCX110): it IS
+# the version-portability shim every other call site must import
+SHARD_MAP_OWNERS = ("platform.py",)
 
 _JNP_CONSTRUCTORS = {
     "array", "asarray", "zeros", "ones", "full", "arange", "empty",
@@ -159,7 +169,12 @@ class _Aliases:
                         self.jnp.add(bound)
                     elif mod == "jax" and alias.name == "jit":
                         self.jit_names.add(bound)
-                    elif alias.name == "shard_map" and mod.startswith("jax"):
+                    elif alias.name == "shard_map" and (
+                        mod.startswith("jax")
+                        # the sanctioned shim (SCX110): traced-context
+                        # discovery must keep seeing it as shard_map
+                        or mod.split(".")[-1] == "platform"
+                    ):
                         self.shard_map_names.add(bound)
                     elif mod == "jax" and alias.name == "config":
                         self.config_names.add(bound)
@@ -723,6 +738,45 @@ class JaxLinter:
 
         HostVisitor().visit(self.tree)
 
+    # -- SCX110 ------------------------------------------------------------
+
+    def _check_shardmap_shim(self) -> None:
+        """Bare jax shard_map spellings outside the platform shim."""
+        if os.path.basename(self.path) in SHARD_MAP_OWNERS:
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute):
+                if self.aliases.is_jax_attr(
+                    node, ("shard_map",),
+                    ("experimental", "shard_map", "shard_map"),
+                ):
+                    self._report(
+                        "SCX110", node,
+                        "bare `jax.shard_map` access: the attribute moved "
+                        "across jax releases (and check_rep became "
+                        "check_vma); use sctools_tpu.platform.shard_map",
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.startswith("jax") and any(
+                    alias.name == "shard_map" for alias in node.names
+                ):
+                    self._report(
+                        "SCX110", node,
+                        f"importing shard_map from `{mod}` pins one jax "
+                        "release's spelling; import the "
+                        "sctools_tpu.platform shim instead",
+                    )
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax.experimental.shard_map":
+                        self._report(
+                            "SCX110", node,
+                            "importing jax.experimental.shard_map pins one "
+                            "jax release's spelling; use the "
+                            "sctools_tpu.platform shim",
+                        )
+
     # -- driver ------------------------------------------------------------
 
     def run(self) -> List[Finding]:
@@ -731,6 +785,7 @@ class JaxLinter:
             self._check_traced_body(fn, spec)
             self._check_retrace(fn, spec)
         self._check_host()
+        self._check_shardmap_shim()
         return self.findings
 
 

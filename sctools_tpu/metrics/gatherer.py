@@ -11,8 +11,6 @@ streaming host aggregators instead (exact reference semantics, no device).
 
 from __future__ import annotations
 
-import os
-from contextlib import closing
 from typing import Dict, List, Optional, Set
 
 import numpy as np
@@ -393,17 +391,16 @@ class MetricGatherer:
         )
         out = MetricCSVWriter(self._output_stem, self._compress)
         try:
-            with closing(out):
-                out.write_header({c: None for c in self.columns})
-                self._stream_device_batches(frames, device_engine, out)
+            out.write_header({c: None for c in self.columns})
+            self._stream_device_batches(frames, device_engine, out)
         except BaseException:
-            # never leave a partial, valid-looking CSV behind (mirrors the
-            # native attach path's unlink-on-error)
-            try:
-                os.remove(out.filename)
-            except OSError:
-                pass
+            # never publish a partial, valid-looking CSV: abandon the
+            # writer's in-flight temp (atomic-commit analog of the old
+            # unlink-on-error)
+            out.discard()
             raise
+        else:
+            out.close()
 
     # batches in flight on the device before the oldest result is pulled.
     # Depth 2 lets the main thread prep + dispatch batch k+2 while k's pull
@@ -763,24 +760,33 @@ class GatherCellMetrics(MetricGatherer):
     columns = CELL_COLUMNS
 
     def _extract_cpu(self, mode: str = "rb") -> None:
-        with AlignmentReader(self._bam_file, mode if mode != "rb" else None) as bam_iterator, closing(
-            MetricCSVWriter(self._output_stem, self._compress)
-        ) as cell_metrics_output:
-            cell_metrics_output.write_header(vars(CellMetrics()))
-            for cell_iterator, cell_tag in iter_cell_barcodes(bam_iterator=iter(bam_iterator)):
-                metric_aggregator = CellMetrics()
-                for molecule_iterator, molecule_tag in iter_molecule_barcodes(
-                    bam_iterator=cell_iterator
-                ):
-                    for gene_iterator, gene_tag in iter_genes(bam_iterator=molecule_iterator):
-                        metric_aggregator.parse_molecule(
-                            tags=(cell_tag, molecule_tag, gene_tag),
-                            records=gene_iterator,
-                        )
-                metric_aggregator.finalize(
-                    mitochondrial_genes=self._mitochondrial_gene_ids
-                )
-                cell_metrics_output.write(cell_tag, vars(metric_aggregator))
+        cell_metrics_output = MetricCSVWriter(self._output_stem, self._compress)
+        try:
+            with AlignmentReader(
+                self._bam_file, mode if mode != "rb" else None
+            ) as bam_iterator:
+                cell_metrics_output.write_header(vars(CellMetrics()))
+                for cell_iterator, cell_tag in iter_cell_barcodes(bam_iterator=iter(bam_iterator)):
+                    metric_aggregator = CellMetrics()
+                    for molecule_iterator, molecule_tag in iter_molecule_barcodes(
+                        bam_iterator=cell_iterator
+                    ):
+                        for gene_iterator, gene_tag in iter_genes(bam_iterator=molecule_iterator):
+                            metric_aggregator.parse_molecule(
+                                tags=(cell_tag, molecule_tag, gene_tag),
+                                records=gene_iterator,
+                            )
+                    metric_aggregator.finalize(
+                        mitochondrial_genes=self._mitochondrial_gene_ids
+                    )
+                    cell_metrics_output.write(cell_tag, vars(metric_aggregator))
+        except BaseException:
+            # mid-stream failure must not atomically publish a truncated
+            # CSV (same contract as the device path)
+            cell_metrics_output.discard()
+            raise
+        else:
+            cell_metrics_output.close()
 
 
 class GatherGeneMetrics(MetricGatherer):
@@ -795,21 +801,30 @@ class GatherGeneMetrics(MetricGatherer):
         return np.char.find(names.astype(str), ",") < 0
 
     def _extract_cpu(self, mode: str = "rb") -> None:
-        with AlignmentReader(self._bam_file, mode if mode != "rb" else None) as bam_iterator, closing(
-            MetricCSVWriter(self._output_stem, self._compress)
-        ) as gene_metrics_output:
-            gene_metrics_output.write_header(vars(GeneMetrics()))
-            for gene_iterator, gene_tag in iter_genes(bam_iterator=iter(bam_iterator)):
-                metric_aggregator = GeneMetrics()
-                if gene_tag and len(gene_tag.split(",")) > 1:
-                    continue
-                for cell_iterator, cell_tag in iter_cell_barcodes(bam_iterator=gene_iterator):
-                    for molecule_iterator, molecule_tag in iter_molecule_barcodes(
-                        bam_iterator=cell_iterator
-                    ):
-                        metric_aggregator.parse_molecule(
-                            tags=(gene_tag, cell_tag, molecule_tag),
-                            records=molecule_iterator,
-                        )
-                metric_aggregator.finalize()
-                gene_metrics_output.write(gene_tag, vars(metric_aggregator))
+        gene_metrics_output = MetricCSVWriter(self._output_stem, self._compress)
+        try:
+            with AlignmentReader(
+                self._bam_file, mode if mode != "rb" else None
+            ) as bam_iterator:
+                gene_metrics_output.write_header(vars(GeneMetrics()))
+                for gene_iterator, gene_tag in iter_genes(bam_iterator=iter(bam_iterator)):
+                    metric_aggregator = GeneMetrics()
+                    if gene_tag and len(gene_tag.split(",")) > 1:
+                        continue
+                    for cell_iterator, cell_tag in iter_cell_barcodes(bam_iterator=gene_iterator):
+                        for molecule_iterator, molecule_tag in iter_molecule_barcodes(
+                            bam_iterator=cell_iterator
+                        ):
+                            metric_aggregator.parse_molecule(
+                                tags=(gene_tag, cell_tag, molecule_tag),
+                                records=molecule_iterator,
+                            )
+                    metric_aggregator.finalize()
+                    gene_metrics_output.write(gene_tag, vars(metric_aggregator))
+        except BaseException:
+            # mid-stream failure must not atomically publish a truncated
+            # CSV (same contract as the device path)
+            gene_metrics_output.discard()
+            raise
+        else:
+            gene_metrics_output.close()

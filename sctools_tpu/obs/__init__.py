@@ -26,6 +26,12 @@ Enabling:
 Reading a capture: ``python -m sctools_tpu.obs summarize trace.jsonl``
 prints the per-stage time/records/bytes/throughput table
 (docs/observability.md walks through one).
+
+The scheduler (sctools_tpu.sched) reports through this layer too:
+``sched:task``/``sched:wait`` spans and the ``sched_*`` counters
+(attempts, commits, steals, failures, quarantines, lease losses, backoff
+seconds) make a fault-injected run's recovery story readable straight
+from a trace capture (docs/scheduler.md).
 """
 
 from __future__ import annotations

@@ -11,12 +11,15 @@ metric passes run under ``shard_map``, and re-keying between entity axes is an
 
 from .gatherer import ShardedCellMetrics, ShardedGeneMetrics
 from .launch import (
+    default_journal_dir,
     global_mesh,
     host_local_to_global,
     initialize_distributed,
     local_mesh,
+    make_cell_metric_tasks,
     merge_sorted_csv_parts,
     process_chunks,
+    run_cell_metrics_task,
     run_process_cell_metrics,
     sync_processes,
 )
@@ -41,6 +44,9 @@ __all__ = [
     "local_mesh",
     "host_local_to_global",
     "process_chunks",
+    "default_journal_dir",
+    "make_cell_metric_tasks",
+    "run_cell_metrics_task",
     "run_process_cell_metrics",
     "merge_sorted_csv_parts",
     "sync_processes",

@@ -20,6 +20,7 @@ import jax
 import numpy as np
 
 from ..ops.counting import count_molecules
+from ..platform import shard_map
 from .mesh import DEFAULT_AXIS
 from .metrics import _check_shard_count, _expand_local, _squeeze_local
 
@@ -47,7 +48,7 @@ def sharded_count_molecules(
 @functools.lru_cache(maxsize=64)
 def _build_sharded_count(mesh, axis_name: str, shard_size: int):
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis_name),),
         out_specs=P(axis_name),

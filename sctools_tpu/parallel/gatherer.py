@@ -23,6 +23,7 @@ import numpy as np
 
 from .. import obs
 from ..io.packed import KEY_HI_SHIFT
+from ..sched import faults
 from ..metrics.gatherer import (
     GatherCellMetrics,
     GatherGeneMetrics,
@@ -49,6 +50,10 @@ class _ShardedMixin:
         self._n_shards = int(np.prod(list(mesh.shape.values())))
 
     def _dispatch_device_batch(self, frame, device_engine, pad_to, presorted=True):
+        # fault site for the crash/resume tests: killing here is a worker
+        # dying MID-CHUNK, with earlier batches already in the in-flight
+        # CSV — exactly the partial-part window atomic commit must cover
+        faults.fire("gatherer.batch", name=str(self._bam_file))
         # the SAME schema decision as the single-device path (shared
         # prologue): byte-identical CSVs require both paths to derive the
         # per-record quality floats the same way. The run-keyed wire is a

@@ -7,13 +7,18 @@ is JAX's: one Python process per host, ``jax.distributed.initialize``
 forming one global device mesh, and two complementary data paths:
 
 1. **Per-process chunk ingest** (this module's drivers): SplitBam's
-   cell-disjoint invariant assigns chunk files to processes round-robin;
-   each process decodes ONLY its own chunks and computes their metrics on
-   its LOCAL devices (no cross-process traffic at all — the cell axis is
-   embarrassingly parallel under the disjointness invariant). The final
-   CSV is a text-level sorted merge of the per-process parts, byte-equal
-   to a single-process run because the engine's per-entity rows do not
-   depend on batch placement (metrics.device module docs).
+   cell-disjoint invariant makes chunks independent tasks; each process
+   pulls chunks from the shared scx-sched work queue (sched module docs)
+   and computes their metrics on its LOCAL devices (no cross-process
+   traffic at all — the cell axis is embarrassingly parallel under the
+   disjointness invariant). The queue replaces the old static round-robin
+   assignment: workers steal expired leases from dead or straggling
+   peers, failed chunks retry with backoff, and a re-launch resumes from
+   the journal instead of recomputing committed parts. The final CSV is
+   a text-level sorted merge of the per-process parts, byte-equal to a
+   single-process run because the engine's per-entity rows do not depend
+   on batch placement (metrics.device module docs) — and each part is
+   computed exactly once regardless of which worker ran it.
 2. **Global-mesh collectives** (``host_local_to_global`` feeding
    parallel.metrics.distributed_metrics_step): every process contributes
    its local shards to one global [n_shards, S] batch; the gene rekey's
@@ -32,6 +37,7 @@ from __future__ import annotations
 import glob
 import gzip
 import os
+import re
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -83,11 +89,13 @@ def local_mesh(axis_name: str = DEFAULT_AXIS):
 def process_chunks(
     chunks: Sequence[str], num_processes: int, process_id: int
 ) -> List[tuple]:
-    """This process's share of the chunk files as (global_index, path).
+    """STATIC round-robin share of the chunk files as (global_index, path).
 
-    Round-robin over the sorted paths, like the reference's barcode->bin
-    assignment (src/sctools/bam.py:442-448); the global index names the
-    output part so rank 0 can glob every process's parts in order.
+    The pre-scheduler assignment (like the reference's barcode->bin
+    round-robin, src/sctools/bam.py:442-448), kept for callers that need
+    a fixed partition with no shared filesystem; the metrics driver now
+    pulls from the scx-sched work queue instead (dynamic balance, steal,
+    resume — see run_process_cell_metrics).
     """
     return [
         (index, chunk)
@@ -124,6 +132,95 @@ def sync_processes(name: str) -> None:
     multihost_utils.sync_global_devices(name)
 
 
+def default_journal_dir(part_stem: str) -> str:
+    """The shared journal directory for a run writing ``part_stem`` parts.
+
+    Derived from the *directory* of the stem (shared storage), not the
+    per-process stem itself, so every worker of a run resolves the same
+    journal without extra plumbing.
+    """
+    return os.path.join(
+        os.path.dirname(os.path.abspath(part_stem)), "sched-journal"
+    )
+
+
+def make_cell_metric_tasks(
+    chunks: Sequence[str],
+    out_dir: str,
+    mitochondrial_gene_ids: frozenset = frozenset(),
+) -> List:
+    """The chunk-metrics task list (content-hashed ids, shared by workers).
+
+    Payloads are self-contained (chunk path, global part index, output
+    directory, mito gene set) so ``python -m sctools_tpu.sched resume``
+    can re-run any task in a fresh process (sched.runners).
+    """
+    from ..sched import make_task
+
+    def signature(path: str) -> str:
+        # binds task identity to the chunk's CONTENT generation, not just
+        # its path: re-splitting into same-named chunk files yields new
+        # task ids, so a stale journal can never whitelist skipping the
+        # recompute of changed input (rsync-style size:mtime check)
+        stat = os.stat(path)
+        return f"{stat.st_size}:{stat.st_mtime_ns}"
+
+    return [
+        make_task(
+            "cell_metrics",
+            f"chunk{index:04d}",
+            {
+                "chunk": os.path.abspath(chunk),
+                "chunk_sig": signature(chunk),
+                "index": index,
+                "out_dir": os.path.abspath(out_dir),
+                "mito": sorted(mitochondrial_gene_ids),
+            },
+        )
+        for index, chunk in enumerate(sorted(chunks))
+    ]
+
+
+def run_cell_metrics_task(task, mesh=None):
+    """Execute ONE chunk-metrics task; returns the committed part path.
+
+    The runner behind both the in-driver queue loop and the CLI
+    ``resume`` command (sched.runners registry). The part path is
+    CANONICAL — derived from the payload alone (``out_dir`` + global
+    chunk index), never from the worker — so a task stolen from a live
+    straggler that finishes anyway re-publishes the byte-identical file
+    onto the SAME path (idempotent ``os.replace``) instead of leaving a
+    duplicate part under a second name. Publication is atomic via the
+    CSV writer, so a crash at any instant leaves no partial part.
+    """
+    from ..sched import faults
+    from .gatherer import ShardedCellMetrics
+
+    payload = task.payload
+    index = int(payload["index"])
+    chunk = payload["chunk"]
+    stem = os.path.join(payload["out_dir"], "metrics")
+    part = f"{stem}.part{index:04d}"
+    if faults.should_corrupt("task.input", name=task.name):
+        # poison-task injection: process a garbled copy of the chunk so
+        # the decode fails deterministically on every attempt
+        from ..sched.faults import mangle
+
+        poisoned = f"{part}.poison.bam"
+        with open(chunk, "rb") as f:
+            data = f.read()
+        with open(poisoned, "wb") as f:
+            f.write(mangle(data))
+        chunk = poisoned
+    with obs.span("distributed:chunk_metrics", chunk=index):
+        ShardedCellMetrics(
+            chunk, part, set(payload.get("mito", ())),
+            mesh=mesh if mesh is not None else local_mesh(),
+        ).extract_metrics()
+    obs.count("chunks_processed")
+    return part + ".csv.gz"
+
+
 def run_process_cell_metrics(
     chunks: Sequence[str],
     part_stem: str,
@@ -131,33 +228,177 @@ def run_process_cell_metrics(
     process_id: int,
     mitochondrial_gene_ids: frozenset = frozenset(),
     mesh=None,
+    journal_dir: Optional[str] = None,
+    lease_ttl: float = 30.0,
+    max_attempts: int = 3,
+    backoff_base: float = 0.25,
+    raise_on_quarantine: bool = True,
 ) -> List[str]:
-    """Tier-1 driver: this process's chunk files -> per-chunk CSV parts.
+    """Tier-1 driver: work the shared chunk queue -> per-chunk CSV parts.
 
-    ``mesh`` defaults to this process's local devices; pass an explicit
-    mesh (or None with one local device) as needed. Returns the part paths
-    this process wrote (named by global chunk index, so rank 0 can glob
-    every process's parts from shared storage for the merge).
+    Chunks are no longer assigned round-robin: every worker pulls from
+    the scx-sched queue under ``journal_dir`` (default: a shared
+    ``sched-journal/`` next to the parts), so a dead or straggling peer's
+    chunks are stolen after its lease TTL, transient failures retry with
+    backoff, and a re-launch skips committed parts — the run is
+    resumable after any crash. ``num_processes``/``process_id`` only name
+    this worker now (API-compatible with the round-robin era).
+
+    ``mesh`` defaults to this process's local devices. Returns the part
+    paths THIS worker committed. Parts are canonically named
+    ``<dir(part_stem)>/metrics.partNNNN.csv.gz`` by global chunk index —
+    worker-independent, so rank 0 globs one pattern for the merge and a
+    straggler's late duplicate write lands on the same path (idempotent).
+    Raises :class:`sched.QuarantinedTasksError` after the queue drains if
+    poison chunks were quarantined (the rest of the run still completes
+    and commits first).
     """
-    from .gatherer import ShardedCellMetrics
+    from ..sched import QuarantinedTasksError, WorkQueue
 
     mesh = mesh if mesh is not None else local_mesh()
-    parts = []
-    for index, chunk in process_chunks(chunks, num_processes, process_id):
-        part = f"{part_stem}.part{index:04d}"
+    tasks = make_cell_metric_tasks(
+        chunks,
+        os.path.dirname(os.path.abspath(part_stem)),
+        mitochondrial_gene_ids,
+    )
+    queue = WorkQueue(
+        journal_dir or default_journal_dir(part_stem),
+        worker_id=f"proc{process_id}-of-{num_processes}-{os.getpid()}",
+        lease_ttl=lease_ttl,
+        max_attempts=max_attempts,
+        backoff_base=backoff_base,
+    )
+    with queue:
+        queue.register(tasks)
         with obs.span(
-            "distributed:chunk_metrics", chunk=index, process=process_id
+            "distributed:chunk_queue", chunks=len(tasks), process=process_id
         ):
-            ShardedCellMetrics(
-                chunk, part, set(mitochondrial_gene_ids), mesh=mesh
-            ).extract_metrics()
-        obs.count("chunks_processed")
-        parts.append(part + ".csv.gz")
-    return parts
+            summary = queue.run(
+                lambda task: run_cell_metrics_task(task, mesh=mesh),
+                only_ids=[t.id for t in tasks],
+            )
+    if summary.quarantined and raise_on_quarantine:
+        raise QuarantinedTasksError(summary.quarantined)
+    return summary.committed
+
+
+_PART_INDEX = re.compile(r"\.part(\d+)\.csv(?:\.gz)?$")
+
+
+def _check_part_sequence(
+    paths: Sequence[str],
+    part_pattern: str,
+    expected_parts: Optional[int] = None,
+) -> None:
+    """Missing, duplicated, or out-of-range part indices must fail loudly.
+
+    Before this check a missing part (worker died after the glob's
+    neighbors committed, stale journal, fat-fingered pattern) silently
+    produced a truncated — wrong — merged CSV. Parts are named by global
+    chunk index, so the committed sequence must be exactly 0..max — or
+    exactly ``0..expected_parts-1`` when the caller knows the chunk
+    count, which additionally catches stale HIGHER-indexed parts left by
+    an earlier larger run in a reused output directory (those would pass
+    the journal's committed-set check: they really were committed — by
+    the wrong run).
+    """
+    by_index: Dict[int, List[str]] = {}
+    for path in paths:
+        match = _PART_INDEX.search(os.path.basename(path))
+        if match is not None:
+            by_index.setdefault(int(match.group(1)), []).append(path)
+    if not by_index:
+        return  # pattern names no .partNNNN files; nothing to validate
+    duplicates = {i: p for i, p in by_index.items() if len(p) > 1}
+    if duplicates:
+        listing = "; ".join(
+            f"part {index}: {', '.join(sorted(paths_))}"
+            for index, paths_ in sorted(duplicates.items())
+        )
+        raise ValueError(
+            f"duplicate part indices under {part_pattern!r} ({listing}); "
+            "two runs are writing the same output directory"
+        )
+    if expected_parts is not None:
+        stale = sorted(set(by_index) - set(range(expected_parts)))
+        if stale:
+            raise ValueError(
+                f"part indices {stale} under {part_pattern!r} exceed this "
+                f"run's {expected_parts} chunk(s): stale parts from an "
+                "earlier, larger run share the output directory and must "
+                "be removed before the merge"
+            )
+    top = expected_parts if expected_parts is not None else max(by_index) + 1
+    missing = sorted(set(range(top)) - set(by_index))
+    if missing:
+        raise ValueError(
+            f"part sequence under {part_pattern!r} has gaps: missing "
+            f"indices {missing} (found {sorted(by_index)}); a merged CSV "
+            "would be silently truncated. Re-run the workers or `python "
+            "-m sctools_tpu.sched resume <journal>` to materialize them"
+        )
+
+
+def _check_journal_parts(paths: Sequence[str], journal_dir: str) -> None:
+    """The globbed parts must be exactly the journal's committed set.
+
+    Catches both directions of drift: a part on disk the journal never
+    committed (debris from an aborted earlier run — its rows could
+    duplicate or contradict a committed part's) and a committed part the
+    glob missed (deleted, or a too-narrow pattern). Content hashes are
+    verified so a stale same-named file from a previous run cannot slip
+    through, and quarantined tasks block the merge outright.
+    """
+    from ..sched import COMMITTED, QUARANTINED, Journal, sha256_file
+
+    journal = Journal(journal_dir, worker_id="merge-validate")
+    tasks, states = journal.replay()
+    quarantined = sorted(
+        tasks[tid].name if tid in tasks else tid
+        for tid, st in states.items()
+        if st.state == QUARANTINED
+    )
+    if quarantined:
+        raise ValueError(
+            f"journal {journal_dir} holds quarantined task(s) "
+            f"{quarantined}; the merge would be missing their rows. "
+            "Inspect, `retry-quarantined`, and resume first"
+        )
+    committed = {
+        os.path.abspath(st.part): st
+        for st in states.values()
+        if st.state == COMMITTED and st.part
+    }
+    globbed = {os.path.abspath(p) for p in paths}
+    stale = sorted(globbed - set(committed))
+    if stale:
+        raise ValueError(
+            f"part file(s) not committed in journal {journal_dir}: "
+            f"{stale}; stale debris from an earlier run must be removed "
+            "before the merge"
+        )
+    lost = sorted(set(committed) - globbed)
+    if lost:
+        raise ValueError(
+            f"journal-committed part(s) missing from glob: {lost}; "
+            "widen the pattern or restore the files"
+        )
+    for path, st in sorted(committed.items()):
+        digest = sha256_file(path)
+        if st.sha256 and digest != st.sha256:
+            raise ValueError(
+                f"part {path} content hash {digest} does not match the "
+                f"journal's committed hash {st.sha256}; the file was "
+                "modified or replaced after commit"
+            )
 
 
 def merge_sorted_csv_parts(
-    part_pattern: str, output_path: str, compress: bool = True
+    part_pattern: str,
+    output_path: str,
+    compress: bool = True,
+    journal_dir: Optional[str] = None,
+    expected_parts: Optional[int] = None,
 ) -> int:
     """Join per-process CSV parts into the single-run CSV (rank-0 step).
 
@@ -166,19 +407,35 @@ def merge_sorted_csv_parts(
     single-process row order IS sorted entity name order, so re-sorting
     the unmodified text rows reproduces the single-process file byte for
     byte. Returns the number of entity rows written.
+
+    Validation before any byte is merged: the ``.partNNNN`` sequence must
+    be gap-free and duplicate-free (and exactly ``0..expected_parts-1``
+    when the caller passes its chunk count — pass it when merging a run
+    you just drove: it is the only check that catches committed leftovers
+    of an earlier, larger run in a reused directory), and with
+    ``journal_dir`` the globbed set must equal the journal's committed
+    set (hash-verified), so a stale part from an aborted earlier run can
+    never corrupt the output. The merged CSV itself publishes atomically
+    (tmp + rename).
     """
     import heapq
     from contextlib import ExitStack
 
+    from ..sched import atomic_output
+
     paths = sorted(glob.glob(part_pattern))
     if not paths:
         raise FileNotFoundError(f"no parts match {part_pattern}")
+    _check_part_sequence(paths, part_pattern, expected_parts)
+    if journal_dir is not None:
+        _check_journal_parts(paths, journal_dir)
     # each part is already written in sorted entity-name order, so the join
     # is a k-way streaming merge — O(parts) memory on the rank-0 host, the
     # same shape as the native tag sort's partial-file merge
     n_rows = 0
     merge_span = obs.span("distributed:merge_parts", parts=len(paths))
-    with merge_span, ExitStack() as stack:
+    with merge_span, atomic_output(output_path) as tmp_path, \
+            ExitStack() as stack:
         header: Optional[str] = None
         streams = []
         for path in paths:
@@ -190,7 +447,7 @@ def merge_sorted_csv_parts(
                 raise ValueError(f"part {path} header differs")
             streams.append(line for line in f if line.strip())
         opener = gzip.open if compress else open
-        out = stack.enter_context(opener(output_path, "wt"))
+        out = stack.enter_context(opener(tmp_path, "wt"))
         out.write(header)
         for line in heapq.merge(
             *streams, key=lambda line: line.split(",", 1)[0]

@@ -28,6 +28,7 @@ import numpy as np
 
 from ..metrics.device import compute_entity_metrics
 from ..ops import segments as seg
+from ..platform import shard_map
 from .mesh import DEFAULT_AXIS
 
 _I32_MAX = np.iinfo(np.int32).max
@@ -201,7 +202,7 @@ def _build_sharded_metrics(
 
     out_specs = P(axis_name) if compact is None else (P(axis_name), P(axis_name))
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             run,
             mesh=mesh,
             in_specs=(P(axis_name),),
@@ -355,7 +356,7 @@ def _build_distributed_step(
     collective_axes = axes if len(axes) > 1 else axes[0]
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec,),
         out_specs=(spec, spec, spec),

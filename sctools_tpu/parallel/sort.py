@@ -39,6 +39,7 @@ import numpy as np
 
 from .. import obs
 from ..ops import segments as seg
+from ..platform import shard_map
 from .metrics import P, _check_shard_count, reshard_by_key
 
 _I32_MAX = np.iinfo(np.int32).max
@@ -165,7 +166,7 @@ def _build_sample_sort(
     """Compiled sample-sort step, cached per (mesh, shape, capacity)."""
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis_name),),
         out_specs=(P(axis_name), P(axis_name)),

@@ -1,0 +1,140 @@
+"""scx-sched CLI: inspect and drive a journal from the shell.
+
+``python -m sctools_tpu.sched <command> <journal_dir>``:
+
+- ``status`` — the folded per-task table (state, attempts, steals, worker,
+  error) plus a one-line totals summary. Exit 0 when every task is
+  committed, 2 when quarantined tasks remain, 1 when work is still open.
+- ``resume`` — re-enter the worker loop over every non-terminal task,
+  resolving each task's runner by kind (:mod:`.runners`). The command any
+  operator (or cron) runs after a crash; committed tasks are skipped by
+  replay, so it is idempotent.
+- ``retry-quarantined`` — record a ``requeued`` event for each quarantined
+  task, zeroing its attempt count so the next ``resume`` (or pipeline
+  re-launch) retries it. Journal-only: nothing executes here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .journal import COMMITTED, QUARANTINED, Journal
+from .scheduler import WorkQueue
+
+
+def _status(journal_dir: str, out) -> int:
+    journal = Journal(journal_dir, worker_id="cli-status")
+    tasks, states = journal.replay()
+    if not tasks:
+        print(f"no tasks registered under {journal_dir}", file=out)
+        return 1
+    rows = [("task", "state", "attempts", "steals", "worker", "detail")]
+    totals = {}
+    for tid in sorted(tasks, key=lambda t: tasks[t].name):
+        task, st = tasks[tid], states.get(tid)
+        state = st.state if st else "pending"
+        totals[state] = totals.get(state, 0) + 1
+        detail = ""
+        if st and st.state == COMMITTED and st.part:
+            detail = st.part
+        elif st and st.error:
+            detail = st.error
+        rows.append(
+            (
+                task.name, state, str(st.attempts if st else 0),
+                str(st.steals if st else 0), st.worker or "-" if st else "-",
+                detail,
+            )
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(5)]
+    for index, row in enumerate(rows):
+        line = "  ".join(
+            cell.ljust(widths[i]) for i, cell in enumerate(row[:5])
+        )
+        print(f"{line}  {row[5]}", file=out)
+        if index == 0:
+            print("  ".join("-" * w for w in widths), file=out)
+    summary = ", ".join(f"{k}={v}" for k, v in sorted(totals.items()))
+    print(f"total={len(tasks)} ({summary})", file=out)
+    if totals.get(QUARANTINED):
+        return 2
+    return 0 if totals.get(COMMITTED, 0) == len(tasks) else 1
+
+
+def _resume(
+    journal_dir: str, lease_ttl: float, max_attempts: int, out
+) -> int:
+    from .runners import resolve
+
+    queue = WorkQueue(
+        journal_dir, lease_ttl=lease_ttl, max_attempts=max_attempts
+    )
+    tasks, states = queue.journal.replay()
+    open_ids = [
+        tid for tid in tasks
+        if not (states.get(tid) and states[tid].terminal)
+    ]
+    if not open_ids:
+        print("nothing to resume: every task is terminal", file=out)
+        return _status(journal_dir, out)
+
+    # resolve every runner BEFORE entering the loop: an unknown kind is a
+    # registry/version mismatch, not a task failure — hitting it inside
+    # the loop would burn attempts and falsely quarantine healthy tasks
+    runner_by_kind = {}
+    for kind in sorted({tasks[tid].kind for tid in open_ids}):
+        try:
+            runner_by_kind[kind] = resolve(kind)
+        except KeyError as error:
+            print(f"cannot resume: {error.args[0]}", file=out)
+            return 1
+
+    def run_task(task):
+        return runner_by_kind[task.kind](task)
+
+    summary = queue.run(run_task, only_ids=open_ids)
+    print(
+        f"resumed: {summary.attempts} attempt(s), "
+        f"{len(summary.committed)} committed here, "
+        f"{summary.steals} steal(s), "
+        f"{len(summary.quarantined)} quarantined",
+        file=out,
+    )
+    return 2 if summary.quarantined else 0
+
+
+def _retry_quarantined(journal_dir: str, out) -> int:
+    journal = Journal(journal_dir, worker_id="cli-requeue")
+    tasks, states = journal.replay()
+    requeued = 0
+    for tid, st in states.items():
+        if st.state == QUARANTINED:
+            journal.record(tid, "requeued")
+            name = tasks[tid].name if tid in tasks else tid
+            print(f"requeued {name}", file=out)
+            requeued += 1
+    print(f"{requeued} task(s) requeued", file=out)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    parser = argparse.ArgumentParser(
+        prog="python -m sctools_tpu.sched",
+        description="inspect and drive an scx-sched journal",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name in ("status", "resume", "retry-quarantined"):
+        p = sub.add_parser(name)
+        p.add_argument("journal", help="journal directory")
+        if name == "resume":
+            p.add_argument("--lease-ttl", type=float, default=30.0)
+            p.add_argument("--max-attempts", type=int, default=3)
+    args = parser.parse_args(argv)
+    if args.command == "status":
+        return _status(args.journal, out)
+    if args.command == "resume":
+        return _resume(args.journal, args.lease_ttl, args.max_attempts, out)
+    return _retry_quarantined(args.journal, out)

@@ -1,0 +1,38 @@
+"""Task-kind -> runner resolution for ``python -m sctools_tpu.sched resume``.
+
+A journal outlives the process that created it, so resuming from the CLI
+needs a way to turn a task spec back into executable work. Runners are
+registered by task ``kind`` as ``"module:function"`` strings and imported
+lazily — the CLI stays importable (and ``status`` instant) on hosts
+without jax.
+
+A runner has the signature ``run(task) -> Optional[str]`` (the committed
+artifact path), and must publish its artifact atomically like any other
+task body. Payloads must carry everything the runner needs
+(journal module docs).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict, Optional
+
+from .journal import Task
+
+RUNNERS: Dict[str, str] = {
+    "cell_metrics": "sctools_tpu.parallel.launch:run_cell_metrics_task",
+}
+
+
+def resolve(kind: str) -> Callable[[Task], Optional[str]]:
+    """The runner callable for ``kind``; raises KeyError when unknown."""
+    try:
+        target = RUNNERS[kind]
+    except KeyError:
+        raise KeyError(
+            f"no runner registered for task kind {kind!r}; known kinds: "
+            f"{sorted(RUNNERS)}"
+        ) from None
+    module_name, _, attr = target.partition(":")
+    module = importlib.import_module(module_name)
+    return getattr(module, attr)

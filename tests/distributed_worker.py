@@ -25,6 +25,10 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # cross-process collectives on the CPU backend need the gloo transport
+    # (the default "none" raises "Multiprocess computations aren't
+    # implemented on the CPU backend" at the first barrier)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     import glob
 
@@ -58,8 +62,9 @@ def main() -> int:
     sync_processes("parts-written")
     if process_id == 0:
         n_rows = merge_sorted_csv_parts(
-            os.path.join(workdir, "proc*.part*.csv.gz"),
+            os.path.join(workdir, "metrics.part*.csv.gz"),
             os.path.join(workdir, "merged.csv.gz"),
+            expected_parts=len(chunks),
         )
         print(f"[p0] merged {n_rows} rows", flush=True)
 

@@ -1,0 +1,17 @@
+"""SCX110 positive fixture: bare jax shard_map spellings outside the shim."""
+import jax
+from jax.experimental.shard_map import shard_map as esm  # noqa: F401
+
+
+def build(mesh, spec):
+    return jax.shard_map(
+        lambda local: local,
+        mesh=mesh, in_specs=(spec,), out_specs=spec,
+    )
+
+
+def build_experimental(mesh, spec):
+    return jax.experimental.shard_map.shard_map(
+        lambda local: local,
+        mesh=mesh, in_specs=(spec,), out_specs=spec,
+    )

@@ -1,0 +1,17 @@
+"""SCX110 negative fixture: every call site uses the platform shim."""
+import functools
+
+from sctools_tpu.platform import shard_map
+
+
+def build(mesh, spec):
+    return functools.partial(
+        shard_map,
+        mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False,
+    )
+
+
+def build_direct(run, mesh, spec):
+    return shard_map(
+        run, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False,
+    )

@@ -1,0 +1,156 @@
+"""sched-smoke: the CI gate for the scx-sched subsystem (`make sched-smoke`).
+
+A synthetic 2-process run with injected crash + delay faults must:
+
+- converge (worker A is killed mid-chunk; worker B — a delayed straggler —
+  steals the expired lease and drains the queue);
+- resume cleanly (a relaunched clean worker finds only terminal tasks and
+  performs zero new attempts);
+- leave a journal whose committed part set matches the output parts on
+  disk exactly (hash-verified by the journal-validating merge), with the
+  merged CSV byte-identical to a clean single-process run.
+
+Exit 0 on success; any assertion failure is a gate failure.
+"""
+
+import glob
+import gzip
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "sched_worker.py")
+
+LEASE_TTL = "2.0"
+
+
+def make_input(path: str, n_cells: int = 32) -> None:
+    import random
+
+    from helpers import make_record, write_bam
+
+    rng = random.Random(7)
+    records = []
+    for cb in sorted(
+        "".join(rng.choice("ACGT") for _ in range(12)) for _ in range(n_cells)
+    ):
+        for ub in sorted(
+            "".join(rng.choice("ACGT") for _ in range(6)) for _ in range(3)
+        ):
+            ge = rng.choice(["G1", "G2"])
+            for i in range(2):
+                records.append(
+                    make_record(
+                        name=f"{cb}{ub}{i}", cb=cb, cr=cb, cy="IIII",
+                        ub=ub, ur=ub, uy="IIII", ge=ge, xf="CODING",
+                        nh=1, pos=rng.randrange(1000),
+                    )
+                )
+    write_bam(path, records)
+
+
+def launch(workdir: str, process_id: int, fault_spec: str):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    if fault_spec:
+        env["SCTOOLS_TPU_FAULTS"] = fault_spec
+    else:
+        env.pop("SCTOOLS_TPU_FAULTS", None)
+    return subprocess.Popen(
+        [
+            sys.executable, WORKER, workdir, str(process_id), "2",
+            LEASE_TTL, "3", "0.1",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def main() -> int:
+    workdir = os.environ.get("SCTOOLS_TPU_SCHED_SMOKE_DIR") or tempfile.mkdtemp(
+        prefix="sctools_tpu_sched_smoke."
+    )
+    os.makedirs(workdir, exist_ok=True)
+    bam = os.path.join(workdir, "input.bam")
+    make_input(bam)
+
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+    from sctools_tpu.platform import GenericPlatform
+    from sctools_tpu.sched import COMMITTED, Journal
+
+    single = os.path.join(workdir, "single.csv.gz")
+    GatherCellMetrics(bam, single, backend="device").extract_metrics()
+
+    chunk_dir = os.path.join(workdir, "chunks")
+    os.makedirs(chunk_dir, exist_ok=True)
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", os.path.join(chunk_dir, "chunk"), "-s", "0.002",
+         "-t", "CB"]
+    )
+    n_chunks = len(glob.glob(os.path.join(chunk_dir, "*.bam")))
+    assert n_chunks >= 2, f"need >=2 chunks, got {n_chunks}"
+
+    # phase 1: A crashes mid-chunk on its FIRST claim (whatever chunk that
+    # is), leaving a leased journal entry and a held lock; B — a delayed
+    # straggler launched into A's wreckage — must wait out the lease TTL,
+    # steal the dead worker's chunk, and drain the queue
+    proc_a = launch(workdir, 0, "crash@gatherer.batch:times=1")
+    out_a, _ = proc_a.communicate(timeout=300)
+    assert proc_a.returncode == 86, f"A should crash (86):\n{out_a[-2000:]}"
+    tasks, states = Journal(
+        os.path.join(workdir, "sched-journal"), worker_id="smoke-probe"
+    ).replay()
+    assert sum(st.state == "leased" for st in states.values()) == 1
+    proc_b = launch(workdir, 1, "delay@task.claimed:secs=0.4")
+    out_b, _ = proc_b.communicate(timeout=300)
+    assert proc_b.returncode == 0, f"B should converge:\n{out_b[-2000:]}"
+
+    journal_dir = os.path.join(workdir, "sched-journal")
+    tasks, states = Journal(journal_dir, worker_id="smoke-probe").replay()
+    assert len(tasks) == n_chunks, (len(tasks), n_chunks)
+    assert all(st.state == COMMITTED for st in states.values()), {
+        tasks[t].name: states[t].state for t in tasks
+    }
+    total_attempts = sum(st.attempts for st in states.values())
+    steals = sum(st.steals for st in states.values())
+    assert steals >= 1, "B never stole the crashed worker's lease"
+
+    # resume cleanly: a relaunched clean worker must do zero new attempts
+    proc_r = launch(workdir, 0, "")
+    out_r, _ = proc_r.communicate(timeout=300)
+    assert proc_r.returncode == 0, f"resume failed:\n{out_r[-2000:]}"
+    _, states2 = Journal(journal_dir, worker_id="smoke-probe").replay()
+    assert sum(st.attempts for st in states2.values()) == total_attempts
+
+    # committed set == parts on disk (hash-verified), merge byte-identical
+    pattern = os.path.join(workdir, "metrics.part*.csv.gz")
+    parts = {os.path.abspath(p) for p in glob.glob(pattern)}
+    committed = {
+        os.path.abspath(st.part) for st in states2.values() if st.part
+    }
+    assert parts == committed, (parts, committed)
+    merged = os.path.join(workdir, "merged.csv.gz")
+    n_rows = merge_sorted_csv_parts(
+        pattern, merged, journal_dir=journal_dir, expected_parts=n_chunks
+    )
+    with gzip.open(single, "rb") as f:
+        expected = f.read()
+    with gzip.open(merged, "rb") as f:
+        assert f.read() == expected, "merged CSV differs from single-process run"
+
+    print(
+        f"sched-smoke OK: {n_chunks} chunk(s), {total_attempts} attempt(s), "
+        f"{steals} steal(s), crash+delay injected, resume clean, "
+        f"{n_rows} merged row(s) byte-identical"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Worker process for the scx-sched crash/resume tests and smoke gate.
+
+Runs the REAL chunk-metrics pipeline (run_process_cell_metrics) against a
+shared journal with no jax.distributed runtime: scx-sched coordinates
+through the filesystem alone, so plain processes exercise the whole
+lease/steal/retry/resume story. Faults are armed via SCTOOLS_TPU_FAULTS
+in the caller's environment.
+
+Invoked as: python sched_worker.py <workdir> <process_id> <num_processes>
+  [lease_ttl] [max_attempts] [backoff_base]
+
+Chunks are globbed from <workdir>/chunks/*.bam; parts get the driver's
+CANONICAL names <workdir>/metrics.partNNNN.csv.gz regardless of which
+worker computes them (the part_stem argument contributes only its
+directory); the journal lives at the driver default
+(<workdir>/sched-journal). Exit 0 on success, 3 when the queue converged
+but quarantined tasks remain, 86 on an injected crash.
+"""
+
+import glob
+import os
+import sys
+
+
+def main() -> int:
+    workdir = sys.argv[1]
+    process_id = int(sys.argv[2])
+    num_processes = int(sys.argv[3])
+    lease_ttl = float(sys.argv[4]) if len(sys.argv) > 4 else 2.0
+    max_attempts = int(sys.argv[5]) if len(sys.argv) > 5 else 3
+    backoff_base = float(sys.argv[6]) if len(sys.argv) > 6 else 0.1
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from sctools_tpu.parallel.launch import run_process_cell_metrics
+    from sctools_tpu.sched import QuarantinedTasksError
+
+    chunks = sorted(glob.glob(os.path.join(workdir, "chunks", "*.bam")))
+    assert chunks, "no chunk files prepared"
+    try:
+        parts = run_process_cell_metrics(
+            chunks,
+            os.path.join(workdir, f"proc{process_id}"),
+            num_processes,
+            process_id,
+            mesh=None,
+            lease_ttl=lease_ttl,
+            max_attempts=max_attempts,
+            backoff_base=backoff_base,
+        )
+    except QuarantinedTasksError as error:
+        print(f"[p{process_id}] QUARANTINED: {error}", flush=True)
+        return 3
+    print(f"[p{process_id}] committed {len(parts)} part(s)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

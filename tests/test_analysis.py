@@ -34,7 +34,7 @@ ABI_BAD = os.path.join(FIXTURES, "abi", "bad")
 SUPP = os.path.join(FIXTURES, "supp")
 NATIVE = os.path.join(REPO, "sctools_tpu", "native")
 
-JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)]
+JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)] + ["SCX110"]
 
 
 # --------------------------------------------------------------- jax lint

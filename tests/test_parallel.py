@@ -153,12 +153,13 @@ def test_reshard_overflow_counter_counts_drops(padded_cols, mesh):
     import jax
     from sctools_tpu.parallel import reshard_by_key
     from sctools_tpu.parallel.metrics import P
+    from sctools_tpu.platform import shard_map
 
     stacked = partition_columns(padded_cols, N_DEVICES, key="cell")
     for capacity in (1, None):
 
         @functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(P("shard"),),
             out_specs=(P("shard"), P("shard")),

@@ -1,0 +1,661 @@
+"""scx-sched: journal, leases, faults, queue, CLI, and crash/resume.
+
+The acceptance contract of the scheduler subsystem (ISSUE 3):
+
+- journal replay folds events deterministically (commit is terminal and
+  first-write-wins; requeue resets quarantine);
+- leases are exclusive, renewable, stealable after TTL, and a steal race
+  has exactly one winner;
+- the queue retries transient failures with backoff, quarantines poison
+  tasks without failing the run, and a re-launch recomputes ONLY what
+  the journal shows uncommitted;
+- the merge refuses gapped/duplicated part sequences and journal drift;
+- end to end, a 2-phase fault-injected run (worker killed mid-chunk, one
+  chunk transiently failing twice) resumes to a merged CSV byte-identical
+  to a clean single-process run, with attempts exactly as journaled.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from helpers import make_record, write_bam
+from sctools_tpu.sched import (
+    COMMITTED,
+    QUARANTINED,
+    Journal,
+    LeaseBroker,
+    LeaseLost,
+    QuarantinedTasksError,
+    WorkQueue,
+    atomic_output,
+    backoff_delay,
+    make_task,
+    sha256_file,
+    task_id,
+)
+from sctools_tpu.sched import cli as sched_cli
+from sctools_tpu.sched import faults
+from sctools_tpu.sched.faults import FaultSpecError, InjectedFault, parse_spec
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "sched_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure("")
+    yield
+    faults.reset()
+
+
+def _touch_runner(path: str, text: str = "done") -> str:
+    with atomic_output(path) as tmp:
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+    return path
+
+
+def _simple_tasks(tmp_path, n=3, kind="touch"):
+    return [
+        make_task(kind, f"t{i:02d}", {"out": str(tmp_path / f"t{i:02d}.out")})
+        for i in range(n)
+    ]
+
+
+# ------------------------------------------------------------------ journal
+
+def test_task_ids_are_content_hashed_and_stable():
+    a = task_id("k", "n", {"x": 1})
+    assert a == task_id("k", "n", {"x": 1})
+    assert a != task_id("k", "n", {"x": 2})
+    assert a != task_id("k", "m", {"x": 1})
+    assert len(a) == 16
+
+
+def test_journal_register_is_idempotent(tmp_path):
+    journal = Journal(str(tmp_path / "j"), worker_id="w1")
+    tasks = _simple_tasks(tmp_path)
+    assert len(journal.register(tasks)) == 3
+    assert journal.register(tasks) == []
+    # a second worker registering the same specs adds nothing on replay
+    other = Journal(str(tmp_path / "j"), worker_id="w2")
+    assert other.register(tasks) == []
+    known, states = other.replay()
+    assert sorted(known) == sorted(t.id for t in tasks)
+    assert all(st.state == "pending" for st in states.values())
+
+
+def test_journal_fold_and_commit_precedence(tmp_path):
+    journal = Journal(str(tmp_path / "j"), worker_id="w1")
+    (task,) = journal.register(_simple_tasks(tmp_path, n=1))
+    journal.record(task.id, "leased", attempt=1)
+    journal.record(task.id, "failed", error="boom", not_before=0.0)
+    journal.record(task.id, "leased", attempt=2, stolen=1)
+    journal.record(task.id, "committed", part="p.csv.gz", sha256="abc")
+    # late events after commit are ignored (first-commit-wins)
+    journal.record(task.id, "failed", error="late straggler")
+    _, states = journal.replay()
+    st = states[task.id]
+    assert st.state == COMMITTED
+    assert st.attempts == 2
+    assert st.steals == 1
+    assert st.part == "p.csv.gz"
+
+
+def test_journal_requeue_resets_quarantine(tmp_path):
+    journal = Journal(str(tmp_path / "j"), worker_id="w1")
+    (task,) = journal.register(_simple_tasks(tmp_path, n=1))
+    journal.record(task.id, "leased", attempt=1)
+    journal.record(task.id, "quarantined", error="poison")
+    _, states = journal.replay()
+    assert states[task.id].state == QUARANTINED
+    journal.record(task.id, "requeued")
+    _, states = journal.replay()
+    assert states[task.id].state == "pending"
+    assert states[task.id].attempts == 0
+
+
+def test_journal_tolerates_torn_trailing_line(tmp_path):
+    journal = Journal(str(tmp_path / "j"), worker_id="w1")
+    (task,) = journal.register(_simple_tasks(tmp_path, n=1))
+    journal.record(task.id, "leased", attempt=1)
+    events = journal._worker_path("events")
+    with open(events, "a", encoding="utf-8") as f:
+        f.write('{"id": "' + task.id + '", "event": "comm')  # torn write
+    _, states = journal.replay()
+    assert states[task.id].state == "leased"
+
+
+# ------------------------------------------------------------------- leases
+
+def test_lease_exclusive_and_release(tmp_path):
+    broker_a = LeaseBroker(str(tmp_path), "a", ttl=30)
+    broker_b = LeaseBroker(str(tmp_path), "b", ttl=30)
+    lease = broker_a.acquire("t1")
+    assert lease is not None and not lease.stolen
+    assert broker_b.acquire("t1") is None
+    lease.release()
+    assert broker_b.acquire("t1") is not None
+
+
+def test_lease_steal_after_ttl_and_renew_extends(tmp_path):
+    broker_a = LeaseBroker(str(tmp_path), "a", ttl=0.2)
+    broker_b = LeaseBroker(str(tmp_path), "b", ttl=0.2)
+    lease = broker_a.acquire("t1")
+    time.sleep(0.12)
+    lease.renew()  # heartbeat pushes the deadline out
+    time.sleep(0.12)
+    assert broker_b.acquire("t1") is None  # renewed: not expired yet
+    time.sleep(0.25)
+    stolen = broker_b.acquire("t1")
+    assert stolen is not None and stolen.stolen
+
+
+def test_lease_renew_after_steal_raises_and_release_is_safe(tmp_path):
+    broker_a = LeaseBroker(str(tmp_path), "a", ttl=0.05)
+    broker_b = LeaseBroker(str(tmp_path), "b", ttl=30)
+    lease = broker_a.acquire("t1")
+    time.sleep(0.1)
+    stolen = broker_b.acquire("t1")
+    assert stolen is not None
+    with pytest.raises(LeaseLost):
+        lease.renew()
+    lease.release()  # must NOT remove the thief's lock
+    assert broker_a.holder("t1")["worker"] == "b"
+
+
+def test_lease_steal_race_has_one_winner(tmp_path):
+    broker_a = LeaseBroker(str(tmp_path), "a", ttl=0.01)
+    broker_a.acquire("t1")
+    time.sleep(0.05)
+    winners = []
+    barrier = threading.Barrier(6)
+
+    def contend(name):
+        broker = LeaseBroker(str(tmp_path), name, ttl=30)
+        barrier.wait()
+        lease = broker.acquire("t1")
+        if lease is not None:
+            winners.append(name)
+
+    threads = [
+        threading.Thread(target=contend, args=(f"w{i}",)) for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(winners) == 1, winners
+
+
+# ------------------------------------------------------------------- faults
+
+def test_fault_spec_grammar():
+    clauses = parse_spec(
+        "crash@gatherer.batch:match=chunk0000,times=1;"
+        "delay@lease.renew:secs=0.5;fail@task.claimed:match=x,times=2"
+    )
+    assert [c.kind for c in clauses] == ["crash", "delay", "fail"]
+    assert clauses[0].site == "gatherer.batch"
+    assert clauses[0].match == "chunk0000" and clauses[0].times == 1
+    assert clauses[1].secs == 0.5 and clauses[1].times is None
+    assert parse_spec("") == []
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["explode@site", "crash", "fail@x:times=lots", "fail@x:nonsense=1",
+     "fail@x:match"],
+)
+def test_fault_spec_errors(bad):
+    with pytest.raises(FaultSpecError):
+        parse_spec(bad)
+
+
+def test_fault_fail_respects_match_and_times():
+    faults.configure("fail@task.claimed:match=needle,times=2")
+    faults.fire("task.claimed", name="haystack")  # no match: no fire
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            faults.fire("task.claimed", name="a-needle-task")
+    faults.fire("task.claimed", name="a-needle-task")  # times exhausted
+
+
+def test_fault_corrupt_consumes():
+    faults.configure("corrupt@task.input:times=1")
+    assert faults.should_corrupt("task.input", name="x")
+    assert not faults.should_corrupt("task.input", name="x")
+    assert faults.mangle(b"hello") != b"hello"
+
+
+# ------------------------------------------------------------------ backoff
+
+def test_backoff_grows_and_caps():
+    import random
+
+    rng = random.Random(0)
+    delays = [backoff_delay(a, 0.5, 4.0, rng) for a in range(1, 8)]
+    assert all(0.25 <= d <= 4.0 for d in delays)
+    assert backoff_delay(20, 0.5, 4.0, rng) <= 4.0
+
+
+# ---------------------------------------------------------------- the queue
+
+def test_queue_runs_all_tasks_and_is_idempotent(tmp_path):
+    tasks = _simple_tasks(tmp_path, n=4)
+    queue = WorkQueue(str(tmp_path / "j"), worker_id="w1", lease_ttl=5)
+    queue.register(tasks)
+    summary = queue.run(lambda t: _touch_runner(t.payload["out"]))
+    assert len(summary.committed) == 4
+    assert summary.all_committed == 4
+    assert summary.attempts == 4 and summary.steals == 0
+    # a re-launch replays the journal and recomputes nothing
+    queue2 = WorkQueue(str(tmp_path / "j"), worker_id="w2", lease_ttl=5)
+    summary2 = queue2.run(lambda t: _touch_runner(t.payload["out"]))
+    assert summary2.attempts == 0 and summary2.all_committed == 4
+
+
+def test_queue_retries_transient_failure_with_backoff(tmp_path):
+    faults.configure("fail@task.claimed:match=t01,times=2")
+    tasks = _simple_tasks(tmp_path, n=3)
+    queue = WorkQueue(
+        str(tmp_path / "j"), worker_id="w1", lease_ttl=5,
+        max_attempts=4, backoff_base=0.05,
+    )
+    queue.register(tasks)
+    summary = queue.run(lambda t: _touch_runner(t.payload["out"]))
+    assert summary.all_committed == 3 and not summary.quarantined
+    _, states = queue.journal.replay()
+    by_name = {t.name: states[t.id] for t in tasks}
+    assert by_name["t01"].attempts == 3  # two injected failures + success
+    assert by_name["t00"].attempts == 1 and by_name["t02"].attempts == 1
+
+
+def test_queue_quarantines_poison_without_failing_run(tmp_path):
+    faults.configure("fail@task.claimed:match=t01")  # unlimited: poison
+    tasks = _simple_tasks(tmp_path, n=3)
+    queue = WorkQueue(
+        str(tmp_path / "j"), worker_id="w1", lease_ttl=5,
+        max_attempts=2, backoff_base=0.05,
+    )
+    queue.register(tasks)
+    summary = queue.run(lambda t: _touch_runner(t.payload["out"]))
+    # the healthy tasks committed; the poison one is quarantined, not fatal
+    assert summary.all_committed == 2
+    assert list(summary.quarantined) == ["t01"]
+    _, states = queue.journal.replay()
+    by_name = {t.name: states[t.id] for t in tasks}
+    assert by_name["t01"].state == QUARANTINED
+    assert by_name["t01"].attempts == 2  # bounded by max_attempts
+    # requeue + clean rerun commits it
+    faults.configure("")
+    assert sched_cli.main(["retry-quarantined", str(tmp_path / "j")]) == 0
+    summary2 = queue.run(lambda t: _touch_runner(t.payload["out"]))
+    assert summary2.all_committed == 3 and not summary2.quarantined
+
+
+def test_queue_steals_expired_lease_of_dead_worker(tmp_path):
+    tasks = _simple_tasks(tmp_path, n=2)
+    journal_dir = str(tmp_path / "j")
+    seed = WorkQueue(journal_dir, worker_id="dead", lease_ttl=0.2)
+    seed.register(tasks)
+    # simulate a worker that died mid-task: journal says leased, lock held
+    lease = seed.broker.acquire(tasks[0].id)
+    assert lease is not None
+    seed.journal.record(tasks[0].id, "leased", attempt=1)
+    queue = WorkQueue(
+        journal_dir, worker_id="live", lease_ttl=0.2, poll_interval=0.05
+    )
+    summary = queue.run(lambda t: _touch_runner(t.payload["out"]))
+    assert summary.all_committed == 2
+    assert summary.steals == 1
+    _, states = queue.journal.replay()
+    assert states[tasks[0].id].attempts == 2  # dead attempt + steal
+
+
+# ---------------------------------------------------------------------- CLI
+
+def test_cli_status_exit_codes_and_table(tmp_path, capsys):
+    journal_dir = str(tmp_path / "j")
+    assert sched_cli.main(["status", journal_dir]) == 1  # nothing registered
+    queue = WorkQueue(journal_dir, worker_id="w1", lease_ttl=5)
+    tasks = queue.register(_simple_tasks(tmp_path, n=2))
+    assert sched_cli.main(["status", journal_dir]) == 1  # open work
+    queue.run(lambda t: _touch_runner(t.payload["out"]))
+    assert sched_cli.main(["status", journal_dir]) == 0  # all committed
+    out = capsys.readouterr().out
+    assert "committed=2" in out and "t00" in out
+    (poison,) = queue.register(
+        [make_task("touch", "t99", {"out": str(tmp_path / "t99.out")})]
+    )
+    queue.journal.record(poison.id, "quarantined", error="poison")
+    assert sched_cli.main(["status", journal_dir]) == 2  # quarantine wins
+
+
+def test_cli_resume_runs_open_tasks(tmp_path, monkeypatch):
+    journal_dir = str(tmp_path / "j")
+    queue = WorkQueue(journal_dir, worker_id="w1", lease_ttl=5)
+    tasks = _simple_tasks(tmp_path, n=3)
+    queue.register(tasks)
+    queue.run(
+        lambda t: _touch_runner(t.payload["out"]),
+        only_ids=[tasks[0].id],  # leave two tasks pending
+    )
+    from sctools_tpu.sched import runners
+
+    monkeypatch.setattr(
+        runners, "resolve",
+        lambda kind: (lambda t: _touch_runner(t.payload["out"])),
+    )
+    assert sched_cli.main(["resume", journal_dir]) == 0
+    _, states = Journal(journal_dir, worker_id="check").replay()
+    assert all(st.state == COMMITTED for st in states.values())
+    # resume again: everything terminal, status path, still success
+    assert sched_cli.main(["resume", journal_dir]) == 0
+
+
+# ------------------------------------------------------- merge validation
+
+def _write_part(path: str, rows) -> None:
+    with gzip.open(path, "wt") as f:
+        f.write(",a,b\n")
+        for row in rows:
+            f.write(row + "\n")
+
+
+def test_merge_raises_listing_missing_part_indices(tmp_path):
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+
+    _write_part(str(tmp_path / "proc0.part0000.csv.gz"), ["AA,1,2"])
+    _write_part(str(tmp_path / "proc0.part0003.csv.gz"), ["CC,5,6"])
+    with pytest.raises(ValueError, match=r"missing\s+indices \[1, 2\]"):
+        merge_sorted_csv_parts(
+            str(tmp_path / "proc*.part*.csv.gz"), str(tmp_path / "m.csv.gz")
+        )
+
+
+def test_merge_expected_parts_catches_stale_higher_indices(tmp_path):
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+
+    _write_part(str(tmp_path / "metrics.part0000.csv.gz"), ["AA,1,2"])
+    _write_part(str(tmp_path / "metrics.part0001.csv.gz"), ["BB,3,4"])
+    # a re-run with fewer chunks reuses the directory: the stale higher
+    # index is invisible to gap/duplicate checks but not to the count
+    with pytest.raises(ValueError, match="exceed this run's 1 chunk"):
+        merge_sorted_csv_parts(
+            str(tmp_path / "metrics.part*.csv.gz"),
+            str(tmp_path / "m.csv.gz"), expected_parts=1,
+        )
+    assert merge_sorted_csv_parts(
+        str(tmp_path / "metrics.part*.csv.gz"),
+        str(tmp_path / "m.csv.gz"), expected_parts=2,
+    ) == 2
+
+
+def test_lease_unwritten_body_not_stealable_while_fresh(tmp_path):
+    # the open-then-write window of _try_create: lock exists, body empty.
+    # A fresh empty lock must read as HELD (mtime fallback), only turning
+    # stealable once it ages past the TTL (true torn-write debris)
+    broker_a = LeaseBroker(str(tmp_path), "a", ttl=0.2)
+    open(broker_a._path("t1"), "w").close()
+    broker_b = LeaseBroker(str(tmp_path), "b", ttl=0.2)
+    assert broker_b.acquire("t1") is None
+    time.sleep(0.25)
+    lease = broker_b.acquire("t1")
+    assert lease is not None and lease.stolen
+
+
+def test_interrupt_does_not_count_toward_quarantine(tmp_path):
+    # leased events without a matching failed event (crashes, operator
+    # interrupts) must not advance the quarantine threshold
+    journal = Journal(str(tmp_path / "j"), worker_id="w1")
+    (task,) = journal.register(_simple_tasks(tmp_path, n=1))
+    journal.record(task.id, "leased", attempt=1)
+    journal.record(task.id, "leased", attempt=2)  # two interrupted starts
+    _, states = journal.replay()
+    assert states[task.id].attempts == 2
+    assert states[task.id].failures == 0
+    queue = WorkQueue(
+        str(tmp_path / "j"), worker_id="w2", lease_ttl=5,
+        max_attempts=2, backoff_base=0.05,
+    )
+    faults.configure("fail@task.claimed:match=t00,times=1")
+    summary = queue.run(lambda t: _touch_runner(t.payload["out"]))
+    # one real failure < max_attempts=2 despite attempts now being 4
+    assert not summary.quarantined
+    assert summary.all_committed == 1
+
+
+def test_merge_raises_on_duplicate_part_indices(tmp_path):
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+
+    _write_part(str(tmp_path / "proc0.part0000.csv.gz"), ["AA,1,2"])
+    _write_part(str(tmp_path / "proc1.part0000.csv.gz"), ["BB,3,4"])
+    with pytest.raises(ValueError, match="duplicate part indices"):
+        merge_sorted_csv_parts(
+            str(tmp_path / "proc*.part*.csv.gz"), str(tmp_path / "m.csv.gz")
+        )
+
+
+def test_merge_journal_validation_catches_stale_and_tampered(tmp_path):
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+
+    journal_dir = str(tmp_path / "j")
+    journal = Journal(journal_dir, worker_id="w1")
+    parts = []
+    tasks = []
+    for i in range(2):
+        path = str(tmp_path / f"proc0.part{i:04d}.csv.gz")
+        _write_part(path, [f"A{i},1,2"])
+        task = make_task("touch", f"c{i}", {"i": i})
+        tasks.append(task)
+        parts.append(path)
+    journal.register(tasks)
+    for task, path in zip(tasks, parts):
+        journal.record(
+            task.id, "committed", part=path, sha256=sha256_file(path)
+        )
+    pattern = str(tmp_path / "proc*.part*.csv.gz")
+    output = str(tmp_path / "merged.csv.gz")
+    assert merge_sorted_csv_parts(pattern, output, journal_dir=journal_dir) == 2
+
+    # a stale part from an aborted earlier run must refuse the merge
+    stale = str(tmp_path / "proc9.part0002.csv.gz")
+    _write_part(stale, ["ZZ,9,9"])
+    with pytest.raises(ValueError, match="not committed in journal"):
+        merge_sorted_csv_parts(pattern, output, journal_dir=journal_dir)
+    os.remove(stale)
+
+    # a part rewritten after commit (stale overwrite) fails the hash check
+    _write_part(parts[0], ["A0,777,777"])
+    with pytest.raises(ValueError, match="content hash"):
+        merge_sorted_csv_parts(pattern, output, journal_dir=journal_dir)
+
+
+def test_merge_journal_validation_blocks_quarantined(tmp_path):
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+
+    journal_dir = str(tmp_path / "j")
+    journal = Journal(journal_dir, worker_id="w1")
+    path = str(tmp_path / "proc0.part0000.csv.gz")
+    _write_part(path, ["AA,1,2"])
+    good = make_task("touch", "c0", {"i": 0})
+    poison = make_task("touch", "c1", {"i": 1})
+    journal.register([good, poison])
+    journal.record(good.id, "committed", part=path, sha256=sha256_file(path))
+    journal.record(poison.id, "quarantined", error="boom")
+    with pytest.raises(ValueError, match="quarantined"):
+        merge_sorted_csv_parts(
+            str(tmp_path / "proc*.part*.csv.gz"),
+            str(tmp_path / "m.csv.gz"),
+            journal_dir=journal_dir,
+        )
+
+
+# ------------------------------------------------- end-to-end crash/resume
+
+def _make_input(path: str, n_cells: int = 48) -> None:
+    import random
+
+    rng = random.Random(31)
+    records = []
+    for cb in sorted(
+        "".join(rng.choice("ACGT") for _ in range(12)) for _ in range(n_cells)
+    ):
+        for ub in sorted(
+            "".join(rng.choice("ACGT") for _ in range(6)) for _ in range(3)
+        ):
+            ge = rng.choice(["G1", "G2", "G3"])
+            for i in range(2):
+                records.append(
+                    make_record(
+                        name=f"{cb}{ub}{i}", cb=cb, cr=cb, cy="IIII",
+                        ub=ub, ur=ub, uy="IIII", ge=ge, xf="CODING",
+                        nh=1, pos=rng.randrange(1000),
+                    )
+                )
+    write_bam(path, records)
+
+
+def _run_worker(workdir, process_id, fault_spec, timeout=240, ttl="2.0"):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    if fault_spec:
+        env["SCTOOLS_TPU_FAULTS"] = fault_spec
+    else:
+        env.pop("SCTOOLS_TPU_FAULTS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, WORKER, str(workdir), str(process_id), "1",
+            ttl, "3", "0.05",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, timeout=timeout,
+    )
+    return proc.returncode, proc.stdout
+
+
+@pytest.mark.timeout(600)
+def test_crash_midchunk_then_resume_is_byte_identical(tmp_path):
+    """The acceptance scenario: a worker killed mid-chunk + a chunk that
+    transiently fails twice; after resume the merged CSV is byte-identical
+    to a clean single-process run and attempts match the journal."""
+    bam = str(tmp_path / "input.bam")
+    _make_input(bam)
+
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+    from sctools_tpu.platform import GenericPlatform
+
+    single = tmp_path / "single.csv.gz"
+    GatherCellMetrics(bam, str(single), backend="device").extract_metrics()
+
+    chunk_dir = tmp_path / "chunks"
+    chunk_dir.mkdir()
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", str(chunk_dir / "chunk"), "-s", "0.002", "-t", "CB"]
+    )
+    n_chunks = len(list(chunk_dir.glob("*.bam")))
+    assert n_chunks >= 3
+
+    # phase 1: the worker dies MID-CHUNK on its first claim (chunk_0 ->
+    # task chunk0000), leaving a leased journal entry and a held lock
+    rc, out = _run_worker(
+        tmp_path, 0, "crash@gatherer.batch:match=chunk_0.bam,times=1"
+    )
+    assert rc == 86, out
+    assert "injected crash at gatherer.batch" in out
+    journal_dir = str(tmp_path / "sched-journal")
+    _, states = Journal(journal_dir, worker_id="probe").replay()
+    assert sum(st.state == "leased" for st in states.values()) == 1
+
+    # phase 2: re-launch; chunk0002 transiently fails twice, the crashed
+    # task's lease is stolen after TTL, everything converges
+    rc, out = _run_worker(
+        tmp_path, 0, "fail@task.claimed:match=chunk0002,times=2"
+    )
+    assert rc == 0, out
+
+    tasks, states = Journal(journal_dir, worker_id="probe").replay()
+    by_name = {tasks[tid].name: st for tid, st in states.items()}
+    assert all(st.state == COMMITTED for st in by_name.values())
+    # exactly one recompute of the crashed chunk; transient chunk took 3
+    assert by_name["chunk0000"].attempts == 2
+    assert by_name["chunk0000"].steals == 1
+    assert by_name["chunk0002"].attempts == 3
+    for name, st in by_name.items():
+        if name not in ("chunk0000", "chunk0002"):
+            assert st.attempts == 1, (name, st)
+
+    # no in-flight debris got published; parts equal the journal exactly
+    merged = tmp_path / "merged.csv.gz"
+    n_rows = merge_sorted_csv_parts(
+        str(tmp_path / "metrics.part*.csv.gz"), str(merged),
+        journal_dir=journal_dir, expected_parts=n_chunks,
+    )
+    assert n_rows > 0
+    with gzip.open(single, "rb") as f:
+        expected = f.read()
+    with gzip.open(merged, "rb") as f:
+        assert f.read() == expected
+
+
+@pytest.mark.timeout(600)
+def test_poison_chunk_quarantines_then_retry_succeeds(tmp_path):
+    """A corrupt chunk exhausts its attempts into quarantine without
+    failing the rest of the run; retry-quarantined + a clean relaunch
+    completes and the merge validates against the journal."""
+    bam = str(tmp_path / "input.bam")
+    _make_input(bam, n_cells=24)
+
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+    from sctools_tpu.platform import GenericPlatform
+
+    chunk_dir = tmp_path / "chunks"
+    chunk_dir.mkdir()
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", str(chunk_dir / "chunk"), "-s", "0.002", "-t", "CB"]
+    )
+    n_chunks = len(list(chunk_dir.glob("*.bam")))
+    assert n_chunks >= 2
+
+    rc, out = _run_worker(tmp_path, 0, "corrupt@task.input:match=chunk0001")
+    assert rc == 3, out  # QuarantinedTasksError exit
+    journal_dir = str(tmp_path / "sched-journal")
+    tasks, states = Journal(journal_dir, worker_id="probe").replay()
+    by_name = {tasks[tid].name: st for tid, st in states.items()}
+    assert by_name["chunk0001"].state == QUARANTINED
+    committed = [n for n, st in by_name.items() if st.state == COMMITTED]
+    assert len(committed) == n_chunks - 1  # the rest of the run completed
+
+    # quarantined journal blocks the merge outright
+    with pytest.raises(ValueError, match="quarantined"):
+        merge_sorted_csv_parts(
+            str(tmp_path / "metrics.part*.csv.gz"),
+            str(tmp_path / "m.csv.gz"), journal_dir=journal_dir,
+        )
+
+    assert sched_cli.main(["retry-quarantined", journal_dir]) == 0
+    rc, out = _run_worker(tmp_path, 0, None)
+    assert rc == 0, out
+    n_rows = merge_sorted_csv_parts(
+        str(tmp_path / "metrics.part*.csv.gz"),
+        str(tmp_path / "merged.csv.gz"), journal_dir=journal_dir,
+    )
+    assert n_rows > 0
+
+
+def test_queue_raises_quarantined_error_shape():
+    error = QuarantinedTasksError({"chunk0001": "boom"})
+    assert "chunk0001" in str(error)
+    assert "retry-quarantined" in str(error)
