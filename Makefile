@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke sched-smoke docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke sched-smoke fleet-smoke perf-gate docs clean
 
-ci: native lint test obs-smoke sched-smoke
+ci: native lint test obs-smoke sched-smoke fleet-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -59,6 +59,24 @@ sched-smoke:
 	rm -rf /tmp/sctools_tpu_sched_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_SCHED_SMOKE_DIR=/tmp/sctools_tpu_sched_smoke \
 	$(PY) tests/sched_smoke.py
+
+# fleet observability gate: the sched-smoke crash+steal scenario re-run
+# with tracing on, then stitched by obs.fleet — asserts both workers merge
+# onto one timeline, every committed task is attributed to its surviving
+# lineage, the crashed worker's flight record is recovered (open span
+# stack included), and a non-empty critical path is named
+# (tests/fleet_smoke.py; docs/observability.md).
+fleet-smoke:
+	rm -rf /tmp/sctools_tpu_fleet_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_FLEET_SMOKE_DIR=/tmp/sctools_tpu_fleet_smoke \
+	$(PY) tests/fleet_smoke.py
+
+# perf-regression gate self-test: bench.py --check must fail a
+# synthetically-degraded result and pass a trajectory-consistent one
+# (cheap, no device). The real gate runs after a bench:
+#   python bench.py > r.json; python bench.py --check --result r.json
+perf-gate:
+	$(PY) bench.py --check-selftest
 
 native-tsan:
 	$(MAKE) -C sctools_tpu/native tsan

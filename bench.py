@@ -22,15 +22,30 @@ Prints ONE JSON line. Flags:
   --breakdown include decode-only and compute-only timings in the JSON
   --sched     include the scx-sched overhead microbench (no-op tasks/sec
               through a WorkQueue: journal + lease cost per task)
+  --check     perf-regression gate: after the run (or over --result FILE,
+              skipping the run) compare the headline against BASELINE.json
+              and the BENCH_r*.json trajectory; exit 4 when the value
+              falls more than --tolerance (default 0.5, i.e. 50%) below
+              the trajectory median or under the CPU baseline. The wide
+              default absorbs the tunneled link's ~3x day-to-day swing
+              (BASELINE.md caveats) while still catching a real cliff.
+  --check-selftest  verify the gate's own semantics against synthetic
+              degraded/healthy results and exit (cheap; `make ci` leg)
 """
 
 from __future__ import annotations
 
+import argparse
+import glob
 import json
 import os
+import statistics
 import sys
 
 from sctools_tpu import obs
+
+CHECK_EXIT_CODE = 4  # distinct from crashes: "ran fine, but regressed"
+DEFAULT_TOLERANCE = 0.5
 
 # device workload size
 N_CELLS = 1 << 16  # 65k cells
@@ -291,10 +306,182 @@ def bench_sched_overhead(n_tasks: int = 200) -> dict:
     }
 
 
-def main():
-    profile = "--profile" in sys.argv
-    breakdown = "--breakdown" in sys.argv or profile
-    sched = "--sched" in sys.argv
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def load_trajectory(repo_dir: str, metric: str) -> list:
+    """The BENCH_r*.json history points matching ``metric``.
+
+    Each round's driver appends one BENCH_rNN.json with the parsed result;
+    together they are the repo's own performance trajectory — the gate's
+    reference. Unreadable or metric-mismatched files are skipped (the
+    headline metric changed once already, r01 -> r02).
+    """
+    entries = []
+    for path in sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = data.get("parsed") or {}
+        if parsed.get("metric") == metric and isinstance(
+            parsed.get("value"), (int, float)
+        ):
+            entries.append(
+                {
+                    "source": os.path.basename(path),
+                    "value": float(parsed["value"]),
+                    "unit": parsed.get("unit"),
+                }
+            )
+    return entries
+
+
+def _published_reference(repo_dir: str, metric: str):
+    """A published BASELINE.json value for ``metric``, when one exists."""
+    try:
+        with open(os.path.join(repo_dir, "BASELINE.json")) as f:
+            published = json.load(f).get("published") or {}
+    except (OSError, ValueError):
+        return None
+    value = published.get(metric)
+    return float(value) if isinstance(value, (int, float)) else None
+
+
+def check_result(
+    result: dict,
+    repo_dir: str = REPO_DIR,
+    tolerance: float = DEFAULT_TOLERANCE,
+) -> dict:
+    """The perf-regression verdict for one bench result JSON.
+
+    Three independent checks, all of which must hold:
+
+    - trajectory: value >= (1 - tolerance) * median(BENCH_r*.json values
+      for the same metric) — the median is robust to any one round's link
+      weather;
+    - published: same floor against BASELINE.json's ``published`` value
+      for the metric, when one exists;
+    - vs_baseline: the device path at least matches the CPU baseline
+      (``vs_baseline >= 1``) — below that the accelerator is a net loss
+      no matter what the link is doing.
+    """
+    metric = result.get("metric")
+    value = result.get("value")
+    verdict = {
+        "metric": metric,
+        "value": value,
+        "tolerance": tolerance,
+        "ok": True,
+        "checks": [],
+    }
+
+    def add(name: str, ok: bool, **detail) -> None:
+        verdict["checks"].append({"name": name, "ok": ok, **detail})
+        verdict["ok"] = verdict["ok"] and ok
+
+    if not isinstance(value, (int, float)):
+        add("result", False, detail="result JSON has no numeric 'value'")
+        return verdict
+    entries = load_trajectory(repo_dir, metric)
+    if entries:
+        reference = statistics.median(e["value"] for e in entries)
+        floor = reference * (1.0 - tolerance)
+        add(
+            "trajectory",
+            value >= floor,
+            reference=round(reference, 2),
+            floor=round(floor, 2),
+            points=len(entries),
+        )
+    else:
+        add("trajectory", True, detail=f"no BENCH_r*.json points for {metric}")
+    published = _published_reference(repo_dir, metric)
+    if published is not None:
+        floor = published * (1.0 - tolerance)
+        add("published", value >= floor, reference=published,
+            floor=round(floor, 2))
+    vs_baseline = result.get("vs_baseline")
+    if isinstance(vs_baseline, (int, float)):
+        add("vs_baseline", vs_baseline >= 1.0, value=vs_baseline, floor=1.0)
+    return verdict
+
+
+def check_selftest(repo_dir: str = REPO_DIR) -> int:
+    """Prove the gate's semantics without running the benchmark.
+
+    The `make ci` leg: a synthetically-degraded result (far below the
+    trajectory) must FAIL, a trajectory-consistent one must PASS, and the
+    tolerance must move the floor. Uses the repo's real BENCH_r*.json
+    history so the gate is exercised against the data it will judge with.
+    """
+    metric = "calculate_cell_metrics_end_to_end"
+    entries = load_trajectory(repo_dir, metric)
+    if not entries:
+        print("bench --check-selftest: no trajectory to gate against",
+              file=sys.stderr)
+        return 1
+    reference = statistics.median(e["value"] for e in entries)
+    healthy = {"metric": metric, "value": reference, "vs_baseline": 5.0}
+    degraded = {
+        "metric": metric,
+        "value": reference * 0.2,  # far below any sane tolerance
+        "vs_baseline": 5.0,
+    }
+    slow_vs_cpu = {"metric": metric, "value": reference, "vs_baseline": 0.5}
+    failures = []
+    if not check_result(healthy, repo_dir)["ok"]:
+        failures.append("healthy result failed the gate")
+    if check_result(degraded, repo_dir)["ok"]:
+        failures.append("degraded result passed the gate")
+    if check_result(degraded, repo_dir, tolerance=0.9)["ok"] is False:
+        failures.append("tolerance=0.9 did not move the floor")
+    if check_result(slow_vs_cpu, repo_dir)["ok"]:
+        failures.append("sub-CPU-baseline result passed the gate")
+    if failures:
+        for failure in failures:
+            print(f"bench --check-selftest: FAIL: {failure}", file=sys.stderr)
+        return 1
+    print(
+        f"bench --check-selftest: OK (reference {reference:.2f} from "
+        f"{len(entries)} trajectory point(s))"
+    )
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--profile", action="store_true")
+    parser.add_argument("--breakdown", action="store_true")
+    parser.add_argument("--sched", action="store_true")
+    parser.add_argument("--check", action="store_true")
+    parser.add_argument(
+        "--result", metavar="FILE",
+        help="with --check: gate this result JSON instead of running",
+    )
+    parser.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE)
+    parser.add_argument("--check-selftest", action="store_true",
+                        dest="check_selftest")
+    args = parser.parse_args(argv)
+
+    if args.check_selftest:
+        return check_selftest()
+    if args.check and args.result:
+        try:
+            with open(args.result) as f:
+                result = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"bench --check: cannot read {args.result}: {exc}",
+                  file=sys.stderr)
+            return 2
+        verdict = check_result(result, tolerance=args.tolerance)
+        print(json.dumps(verdict))
+        return 0 if verdict["ok"] else CHECK_EXIT_CODE
+
+    profile = args.profile
+    breakdown = args.breakdown or profile
+    sched = args.sched
 
     # timings come from obs spans, so recording must be on; the library's
     # own pipeline spans ride along at negligible cost (a few dozen spans
@@ -326,8 +513,10 @@ def main():
         # fully overlap. end_to_end_s at/near the floor means compute,
         # decode and CSV are hidden behind the link and the headline is
         # the link's number, not the code's.
-        floor_h2d = timings["h2d"] / (link["h2d_MBps"] * 1e6)
-        floor_d2h = timings["d2h"] / (link["d2h_MBps"] * 1e6)
+        # a fully stalled tunnel can round a probe to 0.0 MB/s; the floor
+        # math must degrade, not ZeroDivisionError away the whole run
+        floor_h2d = timings["h2d"] / (max(link["h2d_MBps"], 0.1) * 1e6)
+        floor_d2h = timings["d2h"] / (max(link["d2h_MBps"], 0.1) * 1e6)
         result["breakdown"] = {
             "end_to_end_s": round(timings["end_to_end_s"], 3),
             "decode_only_s": round(decode_s, 3),
@@ -346,7 +535,15 @@ def main():
     if sched:
         result["sched_overhead"] = bench_sched_overhead()
     print(json.dumps(result))
+    if args.check:
+        # the result line above stays the ONE stdout JSON line (the
+        # driver's contract); the verdict goes to stderr and the exit code
+        verdict = check_result(result, tolerance=args.tolerance)
+        print(json.dumps(verdict), file=sys.stderr)
+        if not verdict["ok"]:
+            return CHECK_EXIT_CODE
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -20,11 +20,14 @@ import contextlib
 import io
 import os
 import sys
-import tomllib
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
+
+# tomllib is 3.11+; sctools_tpu.utils.toml falls back to tomli or a
+# vendored minimal parser, so 3.10 hosts can still regenerate/verify
+from sctools_tpu.utils import toml as tomllib  # noqa: E402
 
 # argparse help rendering is stable within a minor version; regenerate and
 # verify on this one (the image/CI interpreter)

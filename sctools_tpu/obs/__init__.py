@@ -25,7 +25,11 @@ Enabling:
 
 Reading a capture: ``python -m sctools_tpu.obs summarize trace.jsonl``
 prints the per-stage time/records/bytes/throughput table
-(docs/observability.md walks through one).
+(docs/observability.md walks through one). Multi-worker runs get the
+run-level view from :mod:`.fleet`: ``python -m sctools_tpu.obs timeline
+<run_dir>`` merges every worker's capture with the scx-sched journal into
+one wall-clock timeline (lanes, stragglers, critical path, crashed-worker
+flight records).
 
 The scheduler (sctools_tpu.sched) reports through this layer too:
 ``sched:task``/``sched:wait`` spans and the ``sched_*`` counters
@@ -57,9 +61,14 @@ __all__ = [
     "disable",
     "enabled",
     "reset",
+    "set_context",
+    "get_context",
+    "flight_dump",
+    "flight_path",
     "install_jax_hooks",
     "xla_trace",
     "configured_trace_dir",
+    "configured_worker_name",
     "summarize_records",
     "render_summary",
 ]
@@ -83,6 +92,11 @@ _sink_file = None
 _sink_lock = threading.Lock()
 _tls = threading.local()
 _jax_hooks_installed = False
+# process-level identity attrs (worker id, current task) stamped onto every
+# span record so a fleet-level merge (obs.fleet) can attribute spans from N
+# workers' captures without guessing. Copy-on-write: set_context() swaps in
+# a fresh dict, so _record_span reads it without taking the lock.
+_context: Dict[str, Any] = {}
 
 
 def _stack() -> list:
@@ -213,7 +227,37 @@ def iter_spans(
             close()
 
 
+def set_context(**attrs: Any) -> None:
+    """Attach identity attrs (``worker=``, ``task=``…) to every new span.
+
+    Values merge into each span record at exit (existing record keys win);
+    ``None`` removes a key. The scheduler sets ``worker`` once per process
+    and ``task``/``task_id`` around each task body, which is what lets
+    ``obs.fleet`` interleave scheduler journal events with pipeline spans
+    on one run-level timeline. Process-global by design: a worker runs one
+    task at a time, and spans recorded on helper threads (prefetch decode)
+    must inherit the same task identity.
+    """
+    global _context
+    fresh = dict(_context)
+    for key, value in attrs.items():
+        if value is None:
+            fresh.pop(key, None)
+        else:
+            fresh[key] = value
+    _context = fresh
+
+
+def get_context() -> Dict[str, Any]:
+    """Snapshot of the current identity attrs."""
+    return dict(_context)
+
+
 def _record_span(record: dict) -> None:
+    context = _context
+    if context:
+        for key, value in context.items():
+            record.setdefault(key, value)
     with _lock:
         _ring.append(record)
         total = _span_totals.setdefault(record["name"], [0.0, 0.0])
@@ -274,23 +318,43 @@ def render_metrics() -> str:
     Counter samples get a ``_total`` suffix; per-span aggregates export as
     ``sctools_tpu_span_count_total{span="..."}`` and
     ``sctools_tpu_span_seconds_total{span="..."}``.
+
+    Raises :class:`ValueError` when two distinct source names mangle to
+    the same exposition metric (``a.b`` and ``a_b`` both become
+    ``sctools_tpu_a_b_total``; a counter ``x`` and a counter ``x_total``
+    do too): an aliased sample would silently merge two series, so the
+    collision must fail loudly at render time instead.
     """
     with _lock:
         counter_items = sorted(_counters.items())
         gauge_items = sorted(_gauges.items())
         totals = sorted((k, v[0], v[1]) for k, v in _span_totals.items())
+    sources: Dict[str, str] = {}
+
+    def _claim(metric: str, source: str) -> None:
+        previous = sources.setdefault(metric, source)
+        if previous != source:
+            raise ValueError(
+                f"metric name collision after Prometheus mangling: "
+                f"{previous} and {source} both render as {metric!r}"
+            )
+
     lines: List[str] = []
     for name, value in counter_items:
         metric = _prom_name(name)
         if not metric.endswith("_total"):
             metric += "_total"
+        _claim(metric, f"counter {name!r}")
         lines.append(f"# TYPE {metric} counter")
         lines.append(f"{metric} {_prom_value(value)}")
     for name, value in gauge_items:
         metric = _prom_name(name)
+        _claim(metric, f"gauge {name!r}")
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {_prom_value(value)}")
     if totals:
+        _claim(f"{_PROM_PREFIX}span_count_total", "span aggregate export")
+        _claim(f"{_PROM_PREFIX}span_seconds_total", "span aggregate export")
         lines.append(f"# TYPE {_PROM_PREFIX}span_count_total counter")
         for name, n, _ in totals:
             lines.append(
@@ -328,6 +392,17 @@ def enable(sink_path: Optional[str] = None) -> None:
             os.makedirs(directory, exist_ok=True)
             _sink_file = open(sink_path, "a", encoding="utf-8")
             _sink_path = sink_path
+            # clock-sync anchor: maps this process's monotonic span
+            # timestamps onto the shared wall clock, so a run-level merge
+            # (obs.fleet) can place N workers' spans on one timeline even
+            # when a worker journals no scheduler events to correlate with
+            meta = {
+                "meta": "clock",
+                "wall": round(time.time(), 6),  # scx-lint: disable=SCX109 -- cross-process anchor, not a duration
+                "mono": round(time.perf_counter() - _T0, 6),
+            }
+            _sink_file.write(json.dumps(meta, separators=(",", ":")) + "\n")
+            _sink_file.flush()
         _enabled = True
     if "jax" in sys.modules:
         install_jax_hooks()
@@ -360,6 +435,144 @@ def reset() -> None:
         _counters.clear()
         _gauges.clear()
         _span_totals.clear()
+
+
+# -------------------------------------------------------- flight recorder
+
+def _sanitize_component(name: str) -> str:
+    return "".join(
+        c if c.isalnum() or c in "-_." else "_" for c in name
+    ) or "unknown"
+
+
+def configured_worker_name() -> str:
+    """This process's worker name for capture filenames.
+
+    Precedence: the ``worker`` context attr (the scheduler sets it to the
+    journal worker id), then ``SCTOOLS_TPU_TRACE_WORKER``, then
+    ``<hostname>-<pid>`` — always filesystem-safe.
+    """
+    worker = _context.get("worker") or os.environ.get(
+        "SCTOOLS_TPU_TRACE_WORKER", ""
+    ).strip()
+    if not worker:
+        import socket
+
+        worker = f"{socket.gethostname()}-{os.getpid()}"
+    return _sanitize_component(str(worker))
+
+
+def flight_path() -> Optional[str]:
+    """Where this process's flight record lands (None when no trace dir)."""
+    base = configured_trace_dir()
+    if base is None:
+        return None
+    return os.path.join(base, f"flight.{configured_worker_name()}.jsonl")
+
+
+def flight_dump(reason: str = "", path: Optional[str] = None) -> Optional[str]:
+    """Persist the span ring + counters for a postmortem; returns the path.
+
+    The crashed-worker story: the JSONL sink only holds spans that CLOSED
+    before death, and a worker killed mid-task (``SCTOOLS_TPU_FAULTS``
+    crash injection, preemption SIGTERM) exits with its current span still
+    open. The flight record captures what the sink cannot: the ring buffer
+    (bounded), counter/gauge snapshots, and the dumping thread's OPEN span
+    stack — i.e. where the process actually was when it died. Fault
+    injection calls this just before ``os._exit``;
+    :func:`install_flight_recorder` wires SIGTERM. Written atomically
+    (tmp + replace) so a half-written record never shadows a whole one.
+    """
+    target = path
+    if target is None:
+        target = flight_path()
+    if target is None:
+        return None
+    # the dump may run inside a signal handler that interrupted THIS
+    # thread while it held _lock (e.g. mid-_record_span): a plain `with
+    # _lock` would deadlock the death path and the orchestrator's SIGKILL
+    # escalation would lose the record. Bounded wait, then a lockless
+    # best-effort snapshot.
+    acquired = _lock.acquire(timeout=1.0)
+    try:
+        try:
+            ring = list(_ring)
+            counters_snapshot = dict(_counters)
+            gauges_snapshot = dict(_gauges)
+        except RuntimeError:  # lockless snapshot raced a mutation
+            ring, counters_snapshot, gauges_snapshot = [], {}, {}
+    finally:
+        if acquired:
+            _lock.release()
+    meta = {
+        "meta": "flight",
+        "reason": reason,
+        "worker": _context.get("worker") or configured_worker_name(),
+        "pid": os.getpid(),
+        "wall": round(time.time(), 6),  # scx-lint: disable=SCX109 -- cross-process anchor, not a duration
+        "mono": round(time.perf_counter() - _T0, 6),
+        "open_spans": list(_stack()),
+        "counters": counters_snapshot,
+        "gauges": gauges_snapshot,
+    }
+    tmp = f"{target}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps(meta, separators=(",", ":")) + "\n")
+            for record in ring:
+                f.write(json.dumps(record, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return None
+    return target
+
+
+_flight_signal_installed = False
+
+
+def install_flight_recorder() -> bool:
+    """Dump a flight record on SIGTERM (idempotent; main thread only).
+
+    SIGTERM is what a preempting orchestrator sends before the kill; the
+    handler persists the flight record and then defers to whatever
+    handler/default was installed before, so termination semantics are
+    unchanged. Requires a configured trace dir; returns whether the hook
+    is active.
+    """
+    global _flight_signal_installed
+    if _flight_signal_installed:
+        return True
+    if configured_trace_dir() is None:
+        return False
+    import signal
+
+    previous = signal.getsignal(signal.SIGTERM)
+
+    def _on_sigterm(signum, frame):
+        try:
+            flight_dump(reason="signal:SIGTERM")
+        except Exception:  # noqa: BLE001 - dying anyway; never mask the signal
+            pass
+        if previous == signal.SIG_IGN:
+            return  # SIGTERM was deliberately ignored: keep ignoring it
+        if callable(previous):
+            previous(signum, frame)
+        else:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # not the main thread / exotic platform
+        return False
+    _flight_signal_installed = True
+    return True
 
 
 # ------------------------------------------------------------ JAX hooks
@@ -546,14 +759,27 @@ def _activate_from_env() -> None:
     trace_dir = configured_trace_dir()
     if trace_dir is not None:
         os.makedirs(trace_dir, exist_ok=True)
-        enable(sink_path=os.path.join(trace_dir, "trace.jsonl"))
+        # multi-worker runs share one capture dir: SCTOOLS_TPU_TRACE_WORKER
+        # gives each process its own trace/metrics files (appending N
+        # processes into one trace.jsonl would tear lines); obs.fleet
+        # discovers and merges both spellings
+        worker = os.environ.get("SCTOOLS_TPU_TRACE_WORKER", "").strip()
+        if worker:
+            safe = _sanitize_component(worker)
+            trace_name = f"trace.{safe}.jsonl"
+            metrics_name = f"metrics.{safe}.prom"
+        else:
+            trace_name = "trace.jsonl"
+            metrics_name = "metrics.prom"
+        enable(sink_path=os.path.join(trace_dir, trace_name))
+        install_flight_recorder()
 
         def _dump_metrics() -> None:
             text = render_metrics()
             if text:
                 try:
                     with open(
-                        os.path.join(trace_dir, "metrics.prom"), "w"
+                        os.path.join(trace_dir, metrics_name), "w"
                     ) as f:
                         f.write(text)
                 except OSError:

@@ -1,39 +1,110 @@
-"""scx-trace CLI: ``python -m sctools_tpu.obs summarize trace.jsonl``.
+"""scx-trace / scx-fleet CLI.
 
-Reads a span capture (the JSON-lines file SCTOOLS_TPU_TRACE writes) and
-prints the per-stage time/records/bytes/throughput table. Pure stdlib —
-usable on any host with the capture file, no jax required.
+``python -m sctools_tpu.obs summarize trace.jsonl [more.jsonl|'glob*']``
+reads one or more span captures (the JSON-lines files SCTOOLS_TPU_TRACE
+writes; globs expand) and prints the combined per-stage time/records/
+bytes/throughput table. A torn or truncated final line — a crashed or
+still-writing worker — degrades to a warning, never an error.
+
+``python -m sctools_tpu.obs timeline <run_dir>`` merges EVERY worker's
+capture plus the scx-sched journal under a run directory into one
+wall-clock timeline: per-worker lanes with busy/wait/idle fractions,
+per-task duration stats and stragglers, the critical chain of tasks that
+bounded the run, and crashed-worker flight records (obs.fleet;
+docs/observability.md).
+
+Pure stdlib — usable on any host with the capture files, no jax required.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob as globmod
 import json
 import sys
 from typing import List, Optional
 
 from . import render_summary, summarize_records
+from .fleet import analyze, discover, load_capture, render_timeline
 
 
-def _load_records(path: str) -> tuple:
-    """(records, bad_line_count) from a trace JSONL file."""
+def _expand(patterns: List[str]) -> List[str]:
+    """Paths from path-or-glob arguments, order-preserving, deduped."""
+    out: List[str] = []
+    for pattern in patterns:
+        matches = sorted(globmod.glob(pattern))
+        for path in matches or [pattern]:
+            if path not in out:
+                out.append(path)
+    return out
+
+
+def _summarize(args, out=None, err=None) -> int:
+    out = out if out is not None else sys.stdout
+    err = err if err is not None else sys.stderr
+    paths = _expand(args.traces)
     records = []
+    files_read = 0
     bad = 0
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                record = json.loads(line)
-            except json.JSONDecodeError:
-                bad += 1
-                continue
-            if isinstance(record, dict):
-                records.append(record)
-            else:
-                bad += 1
-    return records, bad
+    for path in paths:
+        capture = load_capture(path, "trace")
+        if not capture.records and not capture.metas and capture.torn:
+            print(f"obs summarize: cannot read {path}", file=err)
+            return 2
+        if capture.torn:
+            print(
+                f"obs summarize: warning: {path} ends in a torn/"
+                "truncated line (crashed or still-writing worker); "
+                "summarizing the records that terminated",
+                file=err,
+            )
+        if capture.bad_lines:
+            bad += capture.bad_lines
+        records.extend(capture.records)
+        files_read += 1
+    if not records:
+        print(
+            f"obs summarize: no span records in "
+            f"{', '.join(paths) if paths else '(no files)'}",
+            file=err,
+        )
+        return 1
+    rows = summarize_records(records)
+    if args.top:
+        rows = rows[: args.top]
+    if args.as_json:
+        for row in rows:
+            print(json.dumps(row, separators=(",", ":")), file=out)
+    else:
+        print(render_summary(rows), file=out)
+        total = sum(r["total_s"] for r in rows)
+        print(
+            f"\n{len(records)} spans, {len(rows)} stages, "
+            f"{total:.3f} span-seconds"
+            + (f", {files_read} file(s)" if files_read > 1 else "")
+            + (f" ({bad} malformed line(s) skipped)" if bad else ""),
+            file=out,
+        )
+    return 0
+
+
+def _timeline(args, out=None, err=None) -> int:
+    out = out if out is not None else sys.stdout
+    err = err if err is not None else sys.stderr
+    run = discover(args.run_dir)
+    if not run.captures and not run.tasks:
+        print(
+            f"obs timeline: nothing under {args.run_dir}: no trace/flight "
+            "captures and no sched journal",
+            file=err,
+        )
+        return 2
+    analysis = analyze(run)
+    if args.as_json:
+        print(json.dumps(analysis, separators=(",", ":")), file=out)
+    else:
+        print(render_timeline(run, analysis), end="", file=out)
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -43,9 +114,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     sub = parser.add_subparsers(dest="command", required=True)
     summarize = sub.add_parser(
-        "summarize", help="per-stage table from a trace JSONL file"
+        "summarize", help="per-stage table from span capture JSONL file(s)"
     )
-    summarize.add_argument("trace", help="path to trace.jsonl")
+    summarize.add_argument(
+        "traces", nargs="+",
+        help="trace JSONL path(s); globs expand (quote them)",
+    )
     summarize.add_argument(
         "--top", type=int, default=0,
         help="only the N most expensive stages (default: all)",
@@ -54,31 +128,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true", dest="as_json",
         help="machine-readable rows instead of the table",
     )
+    timeline = sub.add_parser(
+        "timeline",
+        help="merged cross-worker run timeline: lanes, stragglers, "
+        "critical path, flight records",
+    )
+    timeline.add_argument(
+        "run_dir",
+        help="run directory holding worker captures and the sched journal",
+    )
+    timeline.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="the full analysis dict as one JSON object",
+    )
     args = parser.parse_args(argv)
-
-    try:
-        records, bad = _load_records(args.trace)
-    except OSError as exc:
-        print(f"obs summarize: cannot read {args.trace}: {exc}", file=sys.stderr)
-        return 2
-    if not records:
-        print(f"obs summarize: no span records in {args.trace}", file=sys.stderr)
-        return 1
-    rows = summarize_records(records)
-    if args.top:
-        rows = rows[: args.top]
-    if args.as_json:
-        for row in rows:
-            print(json.dumps(row, separators=(",", ":")))
-    else:
-        print(render_summary(rows))
-        total = sum(r["total_s"] for r in rows)
-        print(
-            f"\n{len(records)} spans, {len(rows)} stages, "
-            f"{total:.3f} span-seconds"
-            + (f" ({bad} malformed line(s) skipped)" if bad else "")
-        )
-    return 0
+    if args.command == "summarize":
+        return _summarize(args)
+    return _timeline(args)
 
 
 if __name__ == "__main__":

@@ -268,6 +268,11 @@ def run_process_cell_metrics(
         max_attempts=max_attempts,
         backoff_base=backoff_base,
     )
+    # preemption insurance: persist the span ring + open-span stack to
+    # flight.<worker>.jsonl on SIGTERM so a killed worker's postmortem
+    # survives for `obs timeline` (no-op unless a trace dir is configured;
+    # env-activated processes already installed it at import)
+    obs.install_flight_recorder()
     with queue:
         queue.register(tasks)
         with obs.span(

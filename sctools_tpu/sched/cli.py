@@ -5,6 +5,13 @@
 - ``status`` — the folded per-task table (state, attempts, steals, worker,
   error) plus a one-line totals summary. Exit 0 when every task is
   committed, 2 when quarantined tasks remain, 1 when work is still open.
+  ``--watch`` turns it into a live dashboard for an in-flight run:
+  per-worker progress, lease holders with heartbeat age, and steal
+  activity, refreshed every ``--interval`` seconds until the run
+  converges. One :class:`Journal` instance lives across refreshes, so
+  each frame parses only the bytes appended since the previous one (the
+  append-only logs' incremental offset cache) — watching a large run does
+  not re-replay its whole history once a second.
 - ``resume`` — re-enter the worker loop over every non-terminal task,
   resolving each task's runner by kind (:mod:`.runners`). The command any
   operator (or cron) runs after a crash; committed tasks are skipped by
@@ -17,15 +24,22 @@
 from __future__ import annotations
 
 import argparse
+import glob
+import json
+import os
 import sys
+import time
 from typing import List, Optional
 
-from .journal import COMMITTED, QUARANTINED, Journal
+from .journal import COMMITTED, LEASED, QUARANTINED, Journal, wall_clock
 from .scheduler import WorkQueue
 
 
-def _status(journal_dir: str, out) -> int:
-    journal = Journal(journal_dir, worker_id="cli-status")
+def _status(journal_dir: str, out, journal: Optional[Journal] = None) -> int:
+    # a caller-supplied journal (the --watch loop) keeps its incremental
+    # scan cache warm across calls; one-shot status builds a fresh one
+    if journal is None:
+        journal = Journal(journal_dir, worker_id="cli-status")
     tasks, states = journal.replay()
     if not tasks:
         print(f"no tasks registered under {journal_dir}", file=out)
@@ -61,6 +75,124 @@ def _status(journal_dir: str, out) -> int:
     if totals.get(QUARANTINED):
         return 2
     return 0 if totals.get(COMMITTED, 0) == len(tasks) else 1
+
+
+def _read_leases(leases_dir: str) -> List[dict]:
+    """One row per held lock file: holder, heartbeat age, TTL remaining."""
+    now = wall_clock()
+    rows = []
+    for path in sorted(glob.glob(os.path.join(leases_dir, "*.lock"))):
+        try:
+            with open(path, encoding="utf-8") as f:
+                body = json.loads(f.read())
+        except (OSError, ValueError):
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        deadline = body.get("deadline")
+        renewed = body.get("ts")
+        rows.append(
+            {
+                "task_id": os.path.basename(path)[: -len(".lock")],
+                "worker": body.get("worker") or "?",
+                "beat_age": (
+                    now - float(renewed)
+                    if isinstance(renewed, (int, float)) else None
+                ),
+                "ttl_left": (
+                    float(deadline) - now
+                    if isinstance(deadline, (int, float)) else None
+                ),
+            }
+        )
+    return rows
+
+
+def _render_watch_frame(journal: Journal, out) -> int:
+    """One live-dashboard frame; returns the status exit code."""
+    tasks, states = journal.replay()
+    totals = {}
+    workers = {}
+    # only registered tasks count: replay folds states for event-only ids
+    # too (a worker can journal before its register lands), and those must
+    # not make the per-state summary disagree with total=len(tasks)
+    for tid, st in states.items():
+        if tid not in tasks:
+            continue
+        totals[st.state] = totals.get(st.state, 0) + 1
+        if st.worker:
+            row = workers.setdefault(
+                st.worker, {"committed": 0, "running": 0, "steals": 0}
+            )
+            if st.state == COMMITTED:
+                row["committed"] += 1
+            elif st.state == LEASED:
+                row["running"] += 1
+            row["steals"] += st.steals
+    summary = ", ".join(f"{k}={v}" for k, v in sorted(totals.items()))
+    print(f"{journal.root}: total={len(tasks)} ({summary})", file=out)
+    if workers:
+        print("worker                          commit  run  steals", file=out)
+        for name in sorted(workers):
+            row = workers[name]
+            print(
+                f"{name:<30}  {row['committed']:>6}  {row['running']:>3}  "
+                f"{row['steals']:>6}",
+                file=out,
+            )
+    leases = _read_leases(journal.leases_dir)
+    if leases:
+        print("held leases (task  holder  beat-age  ttl-left):", file=out)
+        for row in leases:
+            name = tasks[row["task_id"]].name if row["task_id"] in tasks \
+                else row["task_id"]
+            beat = (
+                f"{row['beat_age']:.1f}s" if row["beat_age"] is not None
+                else "-"
+            )
+            left = (
+                f"{row['ttl_left']:.1f}s" if row["ttl_left"] is not None
+                else "-"
+            )
+            print(
+                f"  {name:<16} {row['worker']:<30} {beat:>8}  {left:>8}",
+                file=out,
+            )
+    if not tasks:
+        return 1
+    if totals.get(QUARANTINED):
+        return 2
+    return 0 if totals.get(COMMITTED, 0) == len(tasks) else 1
+
+
+def _watch(
+    journal_dir: str, interval: float, out, max_frames: int = 0
+) -> int:
+    """Refresh the dashboard until the run converges (or frame budget).
+
+    ONE Journal instance across every frame: the append-only logs'
+    incremental offset cache means each refresh parses only the bytes
+    workers appended since the last one.
+    """
+    journal = Journal(journal_dir, worker_id="cli-status")
+    frames = 0
+    while True:
+        frames += 1
+        if hasattr(out, "isatty") and out.isatty():
+            out.write("\x1b[2J\x1b[H")
+        code = _render_watch_frame(journal, out)
+        tasks_registered = bool(journal.replay()[0])
+        if not tasks_registered:
+            # a mistyped/never-used journal dir must error like one-shot
+            # status does, not clear the screen forever over 'total=0'
+            print(
+                f"no tasks registered under {journal_dir}; not watching",
+                file=out,
+            )
+            return 1
+        if code != 1 or (max_frames and frames >= max_frames):
+            return code
+        time.sleep(interval)
 
 
 def _resume(
@@ -132,8 +264,24 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         if name == "resume":
             p.add_argument("--lease-ttl", type=float, default=30.0)
             p.add_argument("--max-attempts", type=int, default=3)
+        if name == "status":
+            p.add_argument(
+                "--watch", action="store_true",
+                help="live dashboard: per-worker progress, lease "
+                "heartbeats, steals; refreshes until the run converges",
+            )
+            p.add_argument(
+                "--interval", type=float, default=2.0,
+                help="--watch refresh period in seconds (default 2)",
+            )
+            p.add_argument(
+                "--frames", type=int, default=0,
+                help="stop --watch after N refreshes (0 = until converged)",
+            )
     args = parser.parse_args(argv)
     if args.command == "status":
+        if args.watch:
+            return _watch(args.journal, args.interval, out, args.frames)
         return _status(args.journal, out)
     if args.command == "resume":
         return _resume(args.journal, args.lease_ttl, args.max_attempts, out)

@@ -183,6 +183,13 @@ def fire(site: str, name: str = "") -> None:
         raise InjectedFault(f"injected failure at {site} ({name})")
     sys.stderr.write(f"sctools-tpu: injected crash at {site} ({name})\n")
     sys.stderr.flush()
+    # os._exit skips atexit AND leaves the current span open (sink lines
+    # only land at span exit), exactly like a real preemption — persist
+    # the flight record first so the postmortem survives the crash
+    try:
+        obs.flight_dump(reason=f"crash@{site}:{name}")
+    except Exception:  # noqa: BLE001 - the crash must fire regardless
+        pass
     os._exit(clause.code)
 
 

@@ -169,6 +169,10 @@ class Journal:
         self._seq = 0
         self._events_file = None
         self._tasks_file = None
+        # bytes actually parsed by _scan_file since construction: observable
+        # proof the incremental cache works (a second `sched status` must
+        # read only appended bytes, not replay history — tests/test_sched)
+        self.bytes_scanned = 0
         # incremental scan state: path -> [consumed byte offset, records].
         # The files are append-only by construction, so replay() only
         # parses bytes appended since the previous call — without this,
@@ -270,6 +274,7 @@ class Journal:
                         data = f.read()
                 except OSError:
                     return list(records)
+                self.bytes_scanned += len(data)
                 end = data.rfind(b"\n")
                 if end >= 0:
                     for line in data[:end].split(b"\n"):
@@ -283,6 +288,22 @@ class Journal:
                     entry[0] = offset + end + 1
             return list(records)
 
+    def events(self) -> List[Dict[str, Any]]:
+        """Every worker's raw events, merged in replay order (read-only).
+
+        The same `(ts, seq, worker)` order :meth:`replay` folds in; the
+        run-level aggregator (``obs.fleet``) consumes these directly to
+        interleave scheduler transitions with pipeline spans and to derive
+        per-worker clock offsets.
+        """
+        events = self._read_jsonl("events-*.jsonl")
+        events.sort(
+            key=lambda e: (
+                e.get("ts", 0.0), e.get("seq", 0), e.get("worker", "")
+            )
+        )
+        return events
+
     def replay(self) -> Tuple[Dict[str, Task], Dict[str, TaskState]]:
         """Fold every worker's log into (tasks by id, states by id)."""
         tasks: Dict[str, Task] = {}
@@ -295,12 +316,7 @@ class Journal:
                     name=spec.get("name", ""),
                     payload=spec.get("payload") or {},
                 )
-        events = self._read_jsonl("events-*.jsonl")
-        events.sort(
-            key=lambda e: (
-                e.get("ts", 0.0), e.get("seq", 0), e.get("worker", "")
-            )
-        )
+        events = self.events()
         states: Dict[str, TaskState] = {tid: TaskState() for tid in tasks}
         for event in events:
             tid = event.get("id")

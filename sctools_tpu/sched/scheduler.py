@@ -110,6 +110,10 @@ class WorkQueue:
         self.backoff_cap = float(backoff_cap)
         self.poll_interval = float(poll_interval)
         self._rng = random.Random(self.journal.worker_id)
+        # every span this process records from here on carries the journal
+        # worker id, so a fleet-level merge (obs.fleet) can lane spans by
+        # worker and correlate them with this worker's journal events
+        obs.set_context(worker=self.journal.worker_id)
 
     @property
     def worker_id(self) -> str:
@@ -155,11 +159,16 @@ class WorkQueue:
             name=f"sched-heartbeat-{task.name}", daemon=True,
         )
         beat.start()
+        # spans emitted INSIDE the task body (decode/upload/compute/
+        # writeback, including those on prefetch helper threads) inherit
+        # the task identity, so a run-level timeline can attribute every
+        # pipeline span to the scheduler task that produced it
+        obs.set_context(task=task.name, task_id=task.id)
         try:
             faults.fire("task.claimed", name=task.name)
             with obs.span(
-                "sched:task", task=task.name, attempt=attempt,
-                stolen=int(lease.stolen),
+                "sched:task", task=task.name, task_id=task.id,
+                attempt=attempt, stolen=int(lease.stolen),
             ):
                 artifact = run_fn(task)
             # a crash here (after the work, before the commit record) is
@@ -168,6 +177,7 @@ class WorkQueue:
             # the recompute invisible
             faults.fire("task.commit", name=task.name)
         except BaseException as error:  # noqa: BLE001 - every failure journals
+            obs.set_context(task=None, task_id=None)
             stop.set()
             beat.join(timeout=5.0)
             if not isinstance(error, Exception):
@@ -182,6 +192,7 @@ class WorkQueue:
             self._record_failure(task, attempt, state, error, summary)
             lease.release()
             return
+        obs.set_context(task=None, task_id=None)
         stop.set()
         beat.join(timeout=5.0)
         self.journal.record(

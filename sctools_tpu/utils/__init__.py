@@ -1,5 +1,8 @@
-"""Utility helpers: synthetic workloads, prefetching, compilation cache."""
+"""Utility helpers: synthetic workloads, prefetching, compilation cache,
+and TOML loading that degrades gracefully on Python 3.10 (no stdlib
+tomllib) — see :mod:`.toml`."""
 
+from . import toml
 from .cache import enable_compilation_cache
 from .prefetch import prefetch_iterator
 from .synth import make_synthetic_columns
@@ -8,4 +11,5 @@ __all__ = [
     "enable_compilation_cache",
     "make_synthetic_columns",
     "prefetch_iterator",
+    "toml",
 ]

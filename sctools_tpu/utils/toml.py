@@ -1,0 +1,268 @@
+"""TOML loading with a stdlib/tomli/vendored-parser fallback chain.
+
+``tomllib`` landed in CPython 3.11; this project supports 3.10, where the
+stdlib module is absent and the ``tomli`` backport may or may not be
+installed (the container image bakes neither). Anything in the repo that
+reads ``pyproject.toml`` (the CLI-reference generator, its drift test)
+goes through :func:`loads`/:func:`load` here instead of importing
+``tomllib`` directly, so a 3.10 host degrades to the vendored minimal
+parser below rather than failing at import.
+
+The vendored parser is deliberately small: it covers the TOML subset a
+``pyproject.toml`` actually uses — ``[table.headers]`` (bare or quoted
+segments), ``key = value`` with bare or quoted keys, basic/literal
+strings, integers, floats, booleans, and (possibly multi-line) arrays of
+those scalars. It rejects what it does not understand instead of guessing,
+so a silent misparse cannot masquerade as a real read. Inline tables,
+dotted keys, dates, and multi-line strings are out of scope; real
+``tomllib``/``tomli`` handles them when available.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+try:  # CPython >= 3.11
+    import tomllib as _toml_impl  # type: ignore[import-not-found]
+except ModuleNotFoundError:
+    try:  # the PyPI backport, when installed
+        import tomli as _toml_impl  # type: ignore[import-not-found]
+    except ModuleNotFoundError:
+        _toml_impl = None
+
+__all__ = ["load", "loads", "TOMLParseError", "using_fallback_parser"]
+
+
+class TOMLParseError(ValueError):
+    """The vendored minimal parser could not understand the document."""
+
+
+def using_fallback_parser() -> bool:
+    """True when neither ``tomllib`` nor ``tomli`` is importable."""
+    return _toml_impl is None
+
+
+def load(fp) -> Dict[str, Any]:
+    """Parse a binary file object (the ``tomllib.load`` signature)."""
+    data = fp.read()
+    if isinstance(data, bytes):
+        data = data.decode("utf-8")
+    return loads(data)
+
+
+def loads(text: str) -> Dict[str, Any]:
+    """Parse a TOML document from a string."""
+    if _toml_impl is not None:
+        return _toml_impl.loads(text)
+    return _parse_minimal(text)
+
+
+# ------------------------------------------------- vendored minimal parser
+
+def _parse_minimal(text: str) -> Dict[str, Any]:
+    root: Dict[str, Any] = {}
+    table = root
+    lines = text.splitlines()
+    index = 0
+    while index < len(lines):
+        line = _strip_comment(lines[index])
+        index += 1
+        if not line:
+            continue
+        if line.startswith("["):
+            if line.startswith("[["):
+                raise TOMLParseError(
+                    f"arrays of tables are not supported: {line!r}"
+                )
+            if not line.endswith("]"):
+                raise TOMLParseError(f"unterminated table header: {line!r}")
+            table = _descend(root, _split_header(line[1:-1]))
+            continue
+        key, value_text = _split_assignment(line)
+        # arrays may span lines: accumulate until brackets balance
+        while _open_brackets(value_text) > 0:
+            if index >= len(lines):
+                raise TOMLParseError(f"unterminated array for key {key!r}")
+            value_text += " " + _strip_comment(lines[index])
+            index += 1
+        if key in table:
+            raise TOMLParseError(f"duplicate key {key!r}")
+        table[key] = _parse_value(value_text.strip())
+    return root
+
+
+def _strip_comment(line: str) -> str:
+    out = []
+    quote: Optional[str] = None
+    escaped = False
+    for ch in line:
+        if escaped:  # \" inside a basic string does not close it
+            out.append(ch)
+            escaped = False
+            continue
+        if quote == '"' and ch == "\\":
+            escaped = True
+        elif quote is None and ch == "#":
+            break
+        elif quote is None and ch in "\"'":
+            quote = ch
+        elif quote == ch:
+            quote = None
+        out.append(ch)
+    return "".join(out).strip()
+
+
+def _split_header(inner: str) -> List[str]:
+    parts: List[str] = []
+    rest = inner.strip()
+    while rest:
+        if rest[0] in "\"'":
+            segment, rest = _take_string(rest)
+        else:
+            cut = rest.find(".")
+            if cut < 0:
+                segment, rest = rest.strip(), ""
+            else:
+                segment, rest = rest[:cut].strip(), rest[cut:]
+        parts.append(segment)
+        rest = rest.strip()
+        if rest.startswith("."):
+            rest = rest[1:].strip()
+            if not rest:
+                raise TOMLParseError(f"trailing dot in header [{inner}]")
+    if not parts:
+        raise TOMLParseError("empty table header")
+    return parts
+
+
+def _descend(root: Dict[str, Any], parts: List[str]) -> Dict[str, Any]:
+    table = root
+    for part in parts:
+        nxt = table.setdefault(part, {})
+        if not isinstance(nxt, dict):
+            raise TOMLParseError(f"key {part!r} is both value and table")
+        table = nxt
+    return table
+
+
+def _split_assignment(line: str) -> Tuple[str, str]:
+    rest = line.strip()
+    if rest[0] in "\"'":
+        key, rest = _take_string(rest)
+    else:
+        cut = rest.find("=")
+        if cut < 0:
+            raise TOMLParseError(f"expected key = value, got {line!r}")
+        key, rest = rest[:cut].strip(), rest[cut:]
+        if not key or any(c in key for c in " \t."):
+            raise TOMLParseError(f"unsupported key {key!r}")
+    rest = rest.strip()
+    if not rest.startswith("="):
+        raise TOMLParseError(f"expected '=' after key in {line!r}")
+    return key, rest[1:].strip()
+
+
+def _take_string(text: str) -> Tuple[str, str]:
+    quote = text[0]
+    index = 1
+    out = []
+    while index < len(text):
+        ch = text[index]
+        if ch == "\\" and quote == '"':
+            if index + 1 >= len(text):
+                raise TOMLParseError(f"dangling escape in {text!r}")
+            out.append(_unescape(text[index + 1]))
+            index += 2
+            continue
+        if ch == quote:
+            return "".join(out), text[index + 1:]
+        out.append(ch)
+        index += 1
+    raise TOMLParseError(f"unterminated string in {text!r}")
+
+
+def _unescape(ch: str) -> str:
+    mapping = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\"}
+    if ch not in mapping:
+        raise TOMLParseError(f"unsupported escape \\{ch}")
+    return mapping[ch]
+
+
+def _open_brackets(text: str) -> int:
+    depth = 0
+    quote: Optional[str] = None
+    escaped = False
+    for ch in text:
+        if escaped:
+            escaped = False
+        elif quote == '"' and ch == "\\":
+            escaped = True
+        elif quote is None and ch in "\"'":
+            quote = ch
+        elif quote == ch:
+            quote = None
+        elif quote is None and ch == "[":
+            depth += 1
+        elif quote is None and ch == "]":
+            depth -= 1
+    return depth
+
+
+def _parse_value(text: str) -> Any:
+    if not text:
+        raise TOMLParseError("empty value")
+    if text[0] in "\"'":
+        value, rest = _take_string(text)
+        if rest.strip():
+            raise TOMLParseError(f"trailing text after string: {rest!r}")
+        return value
+    if text.startswith("["):
+        return _parse_array(text)
+    if text == "true":
+        return True
+    if text == "false":
+        return False
+    try:
+        return int(text.replace("_", ""), 0)
+    except ValueError:
+        pass
+    try:
+        return float(text.replace("_", ""))
+    except ValueError:
+        pass
+    raise TOMLParseError(f"unsupported value {text!r}")
+
+
+def _parse_array(text: str) -> List[Any]:
+    if not text.endswith("]"):
+        raise TOMLParseError(f"unterminated array {text!r}")
+    inner = text[1:-1].strip()
+    items: List[Any] = []
+    while inner:
+        if inner[0] in "\"'":
+            value, inner = _take_string(inner)
+            items.append(value)
+        elif inner[0] == "[":
+            depth = 0
+            for index, ch in enumerate(inner):
+                if ch == "[":
+                    depth += 1
+                elif ch == "]":
+                    depth -= 1
+                    if depth == 0:
+                        break
+            else:
+                raise TOMLParseError(f"unterminated nested array {inner!r}")
+            items.append(_parse_array(inner[: index + 1]))
+            inner = inner[index + 1:]
+        else:
+            cut = inner.find(",")
+            chunk = inner if cut < 0 else inner[:cut]
+            items.append(_parse_value(chunk.strip()))
+            inner = "" if cut < 0 else inner[cut:]
+        inner = inner.strip()
+        if inner.startswith(","):
+            inner = inner[1:].strip()
+        elif inner:
+            raise TOMLParseError(f"expected ',' in array near {inner!r}")
+    return items

@@ -1,0 +1,185 @@
+"""fleet-smoke: the CI gate for scx-fleet (`make fleet-smoke`).
+
+The sched-smoke scenario — a 2-worker run where worker A is crash-injected
+mid-chunk and worker B (a delayed straggler) steals the dead lease and
+drains the queue — re-run with tracing ON, then stitched by the fleet
+aggregator. The gate asserts:
+
+- ``obs timeline`` merges BOTH workers' captures onto one wall-clock
+  timeline (journal-derived clock offsets, one lane per worker);
+- every committed task is attributed to spans from exactly one surviving
+  lineage: a closed, non-error ``sched:task`` span from the worker the
+  journal says committed it;
+- the crashed worker's flight record is discovered and carries the open
+  span stack it died inside (the sink alone cannot: its mid-task span
+  never closed);
+- the analysis names a non-empty critical path;
+- the steal shows up in the merged view.
+
+Exit 0 on success; any assertion failure is a gate failure.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "sched_worker.py"
+)
+
+LEASE_TTL = "2.0"
+
+
+def launch(workdir: str, process_id: int, fault_spec: str):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    # tracing ON, one capture file per worker in the shared obs/ dir
+    env["SCTOOLS_TPU_TRACE"] = os.path.join(workdir, "obs")
+    env["SCTOOLS_TPU_TRACE_WORKER"] = f"p{process_id}"
+    if fault_spec:
+        env["SCTOOLS_TPU_FAULTS"] = fault_spec
+    else:
+        env.pop("SCTOOLS_TPU_FAULTS", None)
+    return subprocess.Popen(
+        [
+            sys.executable, WORKER, workdir, str(process_id), "2",
+            LEASE_TTL, "3", "0.1",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def main() -> int:
+    workdir = os.environ.get(
+        "SCTOOLS_TPU_FLEET_SMOKE_DIR"
+    ) or tempfile.mkdtemp(prefix="sctools_tpu_fleet_smoke.")
+    os.makedirs(workdir, exist_ok=True)
+    bam = os.path.join(workdir, "input.bam")
+
+    from sched_smoke import make_input
+
+    from sctools_tpu.platform import GenericPlatform
+    from sctools_tpu.sched import COMMITTED, Journal
+
+    make_input(bam)
+    chunk_dir = os.path.join(workdir, "chunks")
+    os.makedirs(chunk_dir, exist_ok=True)
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", os.path.join(chunk_dir, "chunk"), "-s", "0.002",
+         "-t", "CB"]
+    )
+    n_chunks = len(glob.glob(os.path.join(chunk_dir, "*.bam")))
+    assert n_chunks >= 2, f"need >=2 chunks, got {n_chunks}"
+
+    # worker A crashes mid-chunk on its first claim; worker B, delayed,
+    # must steal the expired lease and drain the queue — all under trace
+    proc_a = launch(workdir, 0, "crash@gatherer.batch:times=1")
+    out_a, _ = proc_a.communicate(timeout=300)
+    assert proc_a.returncode == 86, f"A should crash (86):\n{out_a[-2000:]}"
+    proc_b = launch(workdir, 1, "delay@task.claimed:secs=0.4")
+    out_b, _ = proc_b.communicate(timeout=300)
+    assert proc_b.returncode == 0, f"B should converge:\n{out_b[-2000:]}"
+
+    journal_dir = os.path.join(workdir, "sched-journal")
+    tasks, states = Journal(journal_dir, worker_id="smoke-probe").replay()
+    assert len(tasks) == n_chunks and all(
+        st.state == COMMITTED for st in states.values()
+    ), {tasks[t].name: states[t].state for t in tasks}
+    # A's worker id is in the journal via its leased event
+    events = Journal(journal_dir, worker_id="smoke-probe2").events()
+    workers_seen = {e.get("worker") for e in events}
+    committing_workers = {st.worker for st in states.values()}
+    crashed_candidates = workers_seen - committing_workers
+    assert crashed_candidates, (
+        f"no crashed lineage: events from {workers_seen}, commits from "
+        f"{committing_workers}"
+    )
+    crashed_worker = sorted(crashed_candidates)[0]
+
+    # the crashed worker must have left a flight record (written at the
+    # injected os._exit; the sink alone lost the open mid-task span)
+    flights = glob.glob(os.path.join(workdir, "obs", "flight.*.jsonl"))
+    assert flights, "crashed worker left no flight record"
+
+    # ---- the fleet view, via the real CLI
+    from sctools_tpu.obs.fleet import analyze, discover
+
+    run = discover(workdir)
+    analysis = analyze(run)
+
+    lane_workers = set(analysis["workers"])
+    assert len(
+        [c for c in analysis["captures"] if c["kind"] == "trace"]
+    ) == 2, analysis["captures"]
+    assert committing_workers <= lane_workers, (
+        committing_workers, lane_workers
+    )
+    assert crashed_worker in lane_workers, (
+        f"crashed worker {crashed_worker} not stitched into the timeline "
+        f"(lanes: {lane_workers})"
+    )
+    # clock normalization must come from the journal correlation for the
+    # surviving worker (it journaled sched events), any anchor for A
+    offsets = {
+        c["path"]: c["offset_source"] for c in analysis["captures"]
+        if c["spans"]
+    }
+    assert any(src == "journal" for src in offsets.values()), offsets
+
+    # every committed task: spans from exactly one surviving lineage
+    for name, row in analysis["tasks"].items():
+        assert row["state"] == "committed", (name, row)
+        assert row["duration"] is not None and row["duration"] > 0, (
+            f"committed task {name} has no committing sched:task span "
+            f"(span workers: {row['span_workers']})"
+        )
+        assert row["worker"] in row["span_workers"], (name, row)
+
+    # the steal is visible in the merged view
+    total_steals = sum(
+        lane["steals"] for lane in analysis["workers"].values()
+    )
+    assert total_steals >= 1, "B's steal is invisible in the fleet view"
+
+    # flight record recovered, with the open span stack A died inside
+    assert analysis["flight_records"], "flight record not discovered"
+    flight = analysis["flight_records"][0]
+    assert flight["worker"] == crashed_worker, (flight, crashed_worker)
+    assert "crash@gatherer.batch" in flight["reason"], flight
+    assert "sched:task" in flight["open_spans"], (
+        f"flight record lost the open span stack: {flight['open_spans']}"
+    )
+
+    # a non-empty critical path that ends at the run's last commit
+    chain = analysis["critical_path"]
+    assert chain, "critical path is empty"
+    assert all(link["dur"] > 0 for link in chain)
+
+    # and the CLI front door renders both forms
+    from sctools_tpu.obs.__main__ import main as obs_cli
+
+    assert obs_cli(["timeline", workdir]) == 0
+    assert obs_cli(["timeline", workdir, "--json"]) == 0
+    assert obs_cli(
+        ["summarize", os.path.join(workdir, "obs", "trace.*.jsonl")]
+    ) == 0
+
+    print(
+        f"fleet-smoke OK: {n_chunks} chunk(s), "
+        f"{len(lane_workers)} lane(s), {total_steals} steal(s), "
+        f"crashed worker {crashed_worker} recovered via flight record, "
+        f"critical path {len(chain)} task(s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

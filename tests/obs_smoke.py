@@ -85,11 +85,25 @@ def main() -> None:
     if not obs.enabled():
         fail("SCTOOLS_TPU_TRACE did not enable recording at import")
     stale = os.path.join(_TRACE_DIR, "trace.jsonl")
-    if (
-        _INHERITED_TRACE
-        and os.path.exists(stale)
-        and os.path.getsize(stale) > 0
-    ):
+
+    def _holds_span_records(path: str) -> bool:
+        # the sink writes a clock-sync meta anchor at attach (import
+        # time), so a fresh capture is non-empty by design; only prior
+        # SPAN records make it stale
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        record = json.loads(line)
+                    except json.JSONDecodeError:
+                        return True  # foreign debris: treat as stale
+                    if isinstance(record, dict) and "meta" not in record:
+                        return True
+        except OSError:
+            return False
+        return False
+
+    if _INHERITED_TRACE and _holds_span_records(stale):
         fail(
             f"{stale} already holds spans; the sink appends and the "
             "record-conservation sums below would double. Point "
@@ -110,6 +124,7 @@ def main() -> None:
     if not os.path.exists(trace_path):
         fail(f"no trace file at {trace_path}")
     spans = []
+    metas = []
     with open(trace_path) as f:
         for lineno, line in enumerate(f, 1):
             if not line.strip():
@@ -118,9 +133,20 @@ def main() -> None:
                 record = json.loads(line)
             except json.JSONDecodeError as exc:
                 fail(f"trace line {lineno} is not JSON: {exc}")
+            if isinstance(record, dict) and "meta" in record:
+                metas.append(record)
+                continue
             if not isinstance(record, dict) or "name" not in record:
                 fail(f"trace line {lineno} is not a span record")
             spans.append(record)
+    # the sink's clock-sync anchor (obs.fleet's mono->wall fallback)
+    if not any(
+        m.get("meta") == "clock"
+        and isinstance(m.get("wall"), (int, float))
+        and isinstance(m.get("mono"), (int, float))
+        for m in metas
+    ):
+        fail("trace lacks the clock-sync meta anchor")
 
     for stage in ("decode", "upload", "compute", "writeback"):
         stage_records = sum(

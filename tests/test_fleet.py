@@ -1,0 +1,285 @@
+"""scx-fleet acceptance: discovery, clock stitching, timeline analysis.
+
+The run-level aggregator's contract (docs/observability.md):
+
+- capture loading tolerates torn trailing lines (crashed/still-writing
+  workers) and skips garbage without dying;
+- per-capture mono->wall clock offsets derive from journal lease/commit
+  <-> ``sched:task`` span correlation, with the sink's clock-sync meta
+  anchor as fallback;
+- a flight record duplicating spans the sink already flushed collapses to
+  one copy in the merged timeline;
+- committed tasks attribute to the surviving lineage; the critical path
+  chains same-worker executions back from the run's last commit;
+- the ``timeline`` / multi-file ``summarize`` CLI verbs front it all.
+
+Everything here is handcrafted JSONL — no subprocesses, no jax — so the
+numbers (offsets, percentiles, chain membership) are exact.
+"""
+
+import json
+import os
+
+import pytest
+
+from sctools_tpu.obs import fleet
+from sctools_tpu.obs.__main__ import main as obs_cli
+
+# wall-clock base for the synthetic run; worker process epochs differ so
+# identical mono timestamps mean DIFFERENT wall instants (the stitching
+# problem in miniature)
+EPOCH_A = 1000.0  # worker wA's process started at wall 1000.0
+EPOCH_B = 1001.0
+
+T1, T2, T3 = "aaaa000000000001", "bbbb000000000002", "cccc000000000003"
+
+
+def _jsonl(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for record in records:
+            f.write(json.dumps(record, separators=(",", ":")) + "\n")
+    return path
+
+
+def _span(name, ts, dur, worker, depth=0, thread="MainThread", **attrs):
+    record = {
+        "name": name, "ts": ts, "dur": dur, "thread": thread,
+        "depth": depth, "worker": worker,
+    }
+    if attrs:
+        record["attrs"] = attrs
+    return record
+
+
+def _task_span(tid, ts, dur, worker, attempt=1, stolen=0, task=None):
+    return _span(
+        "sched:task", ts, dur, worker,
+        task=task or tid[:4], task_id=tid, attempt=attempt, stolen=stolen,
+    )
+
+
+def _event(tid, event, ts, worker, seq, **extra):
+    record = {
+        "id": tid, "event": event, "ts": ts, "seq": seq, "worker": worker,
+    }
+    record.update(extra)
+    return record
+
+
+@pytest.fixture()
+def run_dir(tmp_path):
+    """A 2-worker, 3-task synthetic run: wA commits t1+t2, wB steals t3."""
+    root = tmp_path / "run"
+    journal = root / "sched-journal"
+    _jsonl(
+        str(journal / "tasks-wA.jsonl"),
+        [
+            {"id": T1, "kind": "k", "name": "t1", "payload": {}},
+            {"id": T2, "kind": "k", "name": "t2", "payload": {}},
+            {"id": T3, "kind": "k", "name": "t3", "payload": {}},
+        ],
+    )
+    _jsonl(
+        str(journal / "events-wA.jsonl"),
+        [
+            _event(T1, "leased", EPOCH_A + 0.5, "wA", 1, attempt=1),
+            _event(T1, "committed", EPOCH_A + 2.55, "wA", 2, attempt=1),
+            _event(T2, "leased", EPOCH_A + 2.6, "wA", 3, attempt=1),
+            _event(T2, "committed", EPOCH_A + 5.65, "wA", 4, attempt=1),
+        ],
+    )
+    _jsonl(
+        str(journal / "events-wB.jsonl"),
+        [
+            _event(T3, "leased", EPOCH_B + 1.0, "wB", 1, attempt=1,
+                   stolen=1),
+            _event(T3, "committed", EPOCH_B + 3.05, "wB", 2, attempt=1),
+        ],
+    )
+    # span ts is seconds since PROCESS start: wall minus that worker's epoch
+    _jsonl(
+        str(root / "obs" / "trace.wA.jsonl"),
+        [
+            {"meta": "clock", "wall": EPOCH_A, "mono": 0.0},
+            _task_span(T1, 0.5, 2.0, "wA", task="t1"),
+            _span("decode", 0.6, 0.2, "wA", depth=1),
+            _task_span(T2, 2.6, 3.0, "wA", task="t2"),
+            _span("sched:wait", 5.7, 0.3, "wA"),
+        ],
+    )
+    t3_span = _task_span(T3, 1.0, 2.0, "wB", stolen=1, task="t3")
+    _jsonl(
+        str(root / "obs" / "trace.wB.jsonl"),
+        [{"meta": "clock", "wall": EPOCH_B, "mono": 0.0}, t3_span],
+    )
+    # wB also left a flight record that duplicates its sink's span (the
+    # ring buffer holds exactly what the sink serialized) plus meta
+    _jsonl(
+        str(root / "obs" / "flight.wB.jsonl"),
+        [
+            {
+                "meta": "flight", "reason": "signal:SIGTERM", "worker": "wB",
+                "wall": EPOCH_B + 3.2, "mono": 3.2,
+                "open_spans": ["sched:task"], "counters": {"x": 1},
+            },
+            t3_span,
+        ],
+    )
+    return str(root)
+
+
+def test_discover_offsets_from_journal_correlation(run_dir):
+    run = fleet.discover(run_dir)
+    assert run.journal_dir and run.journal_dir.endswith("sched-journal")
+    by_name = {os.path.basename(c.path): c for c in run.captures}
+    a = by_name["trace.wA.jsonl"]
+    b = by_name["trace.wB.jsonl"]
+    assert a.offset_source == "journal"
+    assert b.offset_source == "journal"
+    # wA's journal deltas: leased-start 1000.0/1000.0, committed-end
+    # 1000.05/1000.05 -> median 1000.025; wB's likewise around its epoch
+    assert a.offset == pytest.approx(EPOCH_A, abs=0.1)
+    assert b.offset == pytest.approx(EPOCH_B, abs=0.1)
+
+
+def test_clock_meta_fallback_for_capture_without_sched_spans(run_dir):
+    # a driver-style process: spans but no scheduler events to correlate
+    _jsonl(
+        os.path.join(run_dir, "obs", "trace.wC.jsonl"),
+        [
+            {"meta": "clock", "wall": 2000.0, "mono": 5.0},
+            _span("decode", 6.0, 1.0, "wC"),
+        ],
+    )
+    run = fleet.discover(run_dir)
+    c = next(
+        c for c in run.captures if c.path.endswith("trace.wC.jsonl")
+    )
+    assert c.offset_source == "clock-meta"
+    assert c.offset == pytest.approx(1995.0)
+    merged = [s for s in run.merged_spans() if s["worker"] == "wC"]
+    assert merged[0]["wall_ts"] == pytest.approx(2001.0)
+
+
+def test_unanchored_capture_excluded_from_anchored_merge(run_dir):
+    """An old-format capture (no clock meta, no sched spans) must not sit
+    at offset 0 next to epoch-anchored spans — it would blow the shared
+    wall window out to ~1e9 s and collapse every lane."""
+    _jsonl(
+        os.path.join(run_dir, "obs", "trace.old.jsonl"),
+        [_span("decode", 3.0, 1.0, "wOld")],  # no anchor of any kind
+    )
+    run = fleet.discover(run_dir)
+    assert any("excluded" in w for w in run.warnings)
+    merged = run.merged_spans()
+    assert all(s["worker"] != "wOld" for s in merged)
+    analysis = fleet.analyze(run)
+    assert "wOld" not in analysis["workers"]
+    assert analysis["wall_window_s"] < 100.0  # still the real run window
+
+
+def test_all_unanchored_captures_merge_on_process_clock(tmp_path):
+    root = tmp_path / "bare"
+    _jsonl(
+        str(root / "trace.w1.jsonl"), [_span("decode", 1.0, 0.5, "w1")]
+    )
+    run = fleet.discover(str(root))
+    merged = run.merged_spans()
+    assert len(merged) == 1 and merged[0]["wall_ts"] == 1.0
+
+
+def test_flight_record_spans_dedup_against_trace(run_dir):
+    run = fleet.discover(run_dir)
+    merged = run.merged_spans()
+    t3_spans = [
+        s for s in merged
+        if (s.get("attrs") or {}).get("task_id") == T3
+    ]
+    assert len(t3_spans) == 1  # flight duplicate collapsed
+    flight = next(c for c in run.captures if c.kind == "flight")
+    assert flight.worker == "wB"
+    assert flight.flight_meta["open_spans"] == ["sched:task"]
+
+
+def test_analysis_attribution_stats_and_critical_path(run_dir):
+    run = fleet.discover(run_dir)
+    analysis = fleet.analyze(run)
+    # every committed task attributed to its surviving lineage
+    tasks = analysis["tasks"]
+    assert tasks["t1"]["worker"] == "wA" and tasks["t1"]["duration"] == 2.0
+    assert tasks["t2"]["worker"] == "wA" and tasks["t2"]["duration"] == 3.0
+    assert tasks["t3"]["worker"] == "wB" and tasks["t3"]["duration"] == 2.0
+    assert analysis["task_totals"] == {"committed": 3}
+    stats = analysis["task_stats"]
+    assert stats["n"] == 3
+    assert stats["p50_s"] == 2.0 and stats["max_s"] == 3.0
+    assert stats["skew"] == pytest.approx(1.5)
+    # the run ends with t2 (wall 1005.6); its same-lane predecessor is t1
+    chain = [link["task"] for link in analysis["critical_path"]]
+    assert chain == ["t1", "t2"]
+    # the steal is visible in wB's lane
+    assert analysis["workers"]["wB"]["steals"] == 1
+    # wA's lane: 5.0s busy of its 5.5s window
+    lane = analysis["workers"]["wA"]
+    assert lane["busy_s"] == pytest.approx(5.0)
+    assert lane["wait_s"] == pytest.approx(0.3)
+
+
+def test_torn_trailing_line_warns_but_parses(run_dir):
+    path = os.path.join(run_dir, "obs", "trace.wB.jsonl")
+    with open(path, "a") as f:
+        f.write('{"name":"torn-span","ts":9.0,')  # crashed mid-write
+    capture = fleet.load_capture(path, "trace")
+    assert capture.torn
+    assert [r["name"] for r in capture.records] == ["sched:task"]
+    run = fleet.discover(run_dir)
+    assert any("torn" in w for w in run.warnings)
+    # the analysis still proceeds and the CLI still exits 0
+    assert obs_cli(["timeline", run_dir]) == 0
+
+
+def test_timeline_cli_json_payload(run_dir, capsys):
+    assert obs_cli(["timeline", run_dir, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["task_stats"]["n"] == 3
+    assert [link["task"] for link in payload["critical_path"]] == \
+        ["t1", "t2"]
+    assert payload["flight_records"][0]["worker"] == "wB"
+
+
+def test_timeline_cli_renders_lanes_and_flight(run_dir, capsys):
+    assert obs_cli(["timeline", run_dir]) == 0
+    out = capsys.readouterr().out
+    assert "wA" in out and "wB" in out
+    assert "critical path (2 task(s)" in out
+    assert "flight records" in out
+    assert "signal:SIGTERM" in out
+
+
+def test_timeline_cli_empty_dir(tmp_path, capsys):
+    assert obs_cli(["timeline", str(tmp_path)]) == 2
+    capsys.readouterr()
+
+
+def test_summarize_cli_multiple_files_and_glob(run_dir, capsys):
+    pattern = os.path.join(run_dir, "obs", "trace.*.jsonl")
+    assert obs_cli(["summarize", pattern]) == 0
+    out = capsys.readouterr().out
+    assert "sched:task" in out
+    assert "2 file(s)" in out  # the glob expanded to wA + wB
+
+
+def test_summarize_cli_warns_on_torn_file(run_dir, capsys):
+    path = os.path.join(run_dir, "obs", "trace.wB.jsonl")
+    with open(path, "a") as f:
+        f.write('{"name":"torn-span","ts":9.0,')
+    assert obs_cli(["summarize", path]) == 0
+    captured = capsys.readouterr()
+    assert "torn" in captured.err
+    assert "sched:task" in captured.out
+
+
+def test_summarize_cli_missing_file_still_exits_2(tmp_path, capsys):
+    assert obs_cli(["summarize", str(tmp_path / "absent.jsonl")]) == 2
+    capsys.readouterr()

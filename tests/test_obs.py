@@ -167,6 +167,86 @@ def test_counters_and_exposition_format(recording):
             assert line.split("{")[0].split(" ")[0] in seen_type, line
 
 
+def test_render_metrics_rejects_mangled_name_collisions(recording):
+    """`a.b` and `a_b` both mangle to `sctools_tpu_a_b_total`: render
+    must fail loudly rather than silently merge two series."""
+    obs.count("a.b", 1)
+    obs.count("a_b", 2)
+    with pytest.raises(ValueError, match="collision"):
+        obs.render_metrics()
+
+
+def test_render_metrics_rejects_counter_total_suffix_alias(recording):
+    obs.count("x", 1)
+    obs.count("x_total", 2)  # renders as x_total too
+    with pytest.raises(ValueError, match="collision"):
+        obs.render_metrics()
+
+
+def test_render_metrics_rejects_gauge_vs_counter_alias(recording):
+    obs.count("depth", 1)  # -> sctools_tpu_depth_total
+    obs.gauge("depth_total", 2)  # -> sctools_tpu_depth_total
+    with pytest.raises(ValueError, match="collision"):
+        obs.render_metrics()
+
+
+def test_render_metrics_rejects_span_aggregate_shadowing(recording):
+    obs.count("span_count", 1)  # -> sctools_tpu_span_count_total
+    with obs.span("decode"):
+        pass  # span aggregates export under the same family name
+    with pytest.raises(ValueError, match="collision"):
+        obs.render_metrics()
+
+
+def test_context_attrs_stamp_span_records(recording):
+    obs.set_context(worker="w0")
+    try:
+        with obs.span("decode", records=1):
+            pass
+        obs.set_context(task="chunk0001", task_id="abc123")
+        with obs.span("compute"):
+            pass
+        obs.set_context(task=None, task_id=None)
+        with obs.span("writeback"):
+            pass
+    finally:
+        obs.set_context(worker=None, task=None, task_id=None)
+    decode, compute, writeback = obs.spans()
+    assert decode["worker"] == "w0" and "task" not in decode
+    assert compute["worker"] == "w0"
+    assert compute["task"] == "chunk0001"
+    assert compute["task_id"] == "abc123"
+    assert "task" not in writeback  # cleared between tasks
+    assert obs.get_context() == {}
+
+
+def test_flight_dump_persists_ring_counters_and_open_stack(
+    recording, tmp_path
+):
+    obs.count("records_decoded", 7)
+    with obs.span("decode"):
+        pass
+    target = str(tmp_path / "flight.w0.jsonl")
+    with obs.span("sched:task"):
+        # dumped mid-span: the OPEN stack must be captured — that is the
+        # whole point of the flight record (the sink only sees closures)
+        path = obs.flight_dump(reason="test-crash", path=target)
+    assert path == target
+    lines = [json.loads(l) for l in open(target) if l.strip()]
+    meta = lines[0]
+    assert meta["meta"] == "flight"
+    assert meta["reason"] == "test-crash"
+    assert meta["open_spans"] == ["sched:task"]
+    assert meta["counters"]["records_decoded"] == 7
+    assert {"wall", "mono"} <= set(meta)
+    assert [r["name"] for r in lines[1:]] == ["decode"]
+
+
+def test_flight_dump_without_trace_dir_is_noop(monkeypatch):
+    monkeypatch.delenv("SCTOOLS_TPU_TRACE", raising=False)
+    assert obs.flight_dump(reason="nowhere") is None
+
+
 def test_counting_disabled_is_silent():
     assert not obs.enabled()
     obs.count("never", 5)
@@ -189,9 +269,14 @@ def test_jsonl_sink_roundtrip(tmp_path):
     finally:
         obs.disable()
         obs.reset()
-    records = [
+    lines = [
         json.loads(line) for line in sink.read_text().splitlines() if line
     ]
+    # the sink leads with a clock-sync anchor (meta record) so obs.fleet
+    # can map this process's monotonic span ts onto the shared wall clock
+    assert lines[0].get("meta") == "clock"
+    assert {"wall", "mono"} <= set(lines[0])
+    records = [r for r in lines if "meta" not in r]
     assert [r["name"] for r in records] == ["decode", "upload"]
     assert records[0]["attrs"] == {"records": 10, "bytes": 100}
     rows = obs.summarize_records(records)

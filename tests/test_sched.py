@@ -659,3 +659,100 @@ def test_queue_raises_quarantined_error_shape():
     error = QuarantinedTasksError({"chunk0001": "boom"})
     assert "chunk0001" in str(error)
     assert "retry-quarantined" in str(error)
+
+
+# ------------------------------------------------ incremental status/watch
+
+def test_second_status_call_reads_only_appended_bytes(tmp_path):
+    """A reused Journal's replay is incremental: frame 2 of `status
+    --watch` must parse exactly the bytes appended since frame 1, not
+    re-read the whole history (the point of the per-file offset cache)."""
+    import io
+
+    journal_dir = str(tmp_path / "j")
+    writer = Journal(journal_dir, worker_id="w1")
+    tasks = [make_task("touch", f"t{i:02d}", {"i": i}) for i in range(4)]
+    writer.register(tasks)
+    for task in tasks[:2]:
+        writer.record(task.id, "leased", attempt=1)
+
+    reader = Journal(journal_dir, worker_id="cli-status")
+    from sctools_tpu.sched.cli import _status
+
+    assert _status(journal_dir, io.StringIO(), journal=reader) == 1
+    baseline = reader.bytes_scanned
+    assert baseline > 0
+
+    # nothing appended: a second call must scan ZERO new bytes
+    assert _status(journal_dir, io.StringIO(), journal=reader) == 1
+    assert reader.bytes_scanned == baseline
+
+    # append one event: the third call scans exactly that line
+    events_path = writer._worker_path("events")
+    before = os.path.getsize(events_path)
+    writer.record(tasks[0].id, "committed", attempt=1)
+    appended = os.path.getsize(events_path) - before
+    out = io.StringIO()
+    assert _status(journal_dir, out, journal=reader) == 1
+    assert reader.bytes_scanned == baseline + appended
+    assert "committed" in out.getvalue()
+
+
+def test_watch_frame_shows_workers_leases_and_converges(tmp_path):
+    import io
+
+    from sctools_tpu.sched import LeaseBroker
+    from sctools_tpu.sched.cli import _render_watch_frame, _watch
+
+    journal_dir = str(tmp_path / "j")
+    writer = Journal(journal_dir, worker_id="worker-A")
+    tasks = [make_task("touch", f"t{i:02d}", {"i": i}) for i in range(3)]
+    writer.register(tasks)
+    writer.record(tasks[0].id, "leased", attempt=1)
+    writer.record(tasks[0].id, "committed", attempt=1)
+    writer.record(tasks[1].id, "leased", attempt=1, stolen=1)
+    broker = LeaseBroker(writer.leases_dir, "worker-A", ttl=30)
+    lease = broker.acquire(tasks[1].id)
+    assert lease is not None
+
+    reader = Journal(journal_dir, worker_id="cli-status")
+    out = io.StringIO()
+    assert _render_watch_frame(reader, out) == 1  # work still open
+    text = out.getvalue()
+    assert "worker-A" in text
+    assert "held leases" in text and "t01" in text
+    assert "commit" in text  # per-worker progress header
+
+    # converge and the watch loop exits 0 on its next frame
+    lease.release()
+    writer.record(tasks[1].id, "committed", attempt=1)
+    writer.record(tasks[2].id, "leased", attempt=1)
+    writer.record(tasks[2].id, "committed", attempt=1)
+    out = io.StringIO()
+    assert _watch(journal_dir, interval=0.01, out=out, max_frames=5) == 0
+    assert "committed=3" in out.getvalue()
+
+
+def test_watch_on_empty_journal_exits_instead_of_looping(tmp_path):
+    import io
+
+    from sctools_tpu.sched.cli import _watch
+
+    out = io.StringIO()
+    # a mistyped dir must error like one-shot status, not refresh forever
+    assert _watch(
+        str(tmp_path / "jorunal-typo"), interval=0.01, out=out
+    ) == 1
+    assert "no tasks registered" in out.getvalue()
+
+
+def test_cli_status_watch_flag_parses(tmp_path, capsys):
+    journal_dir = str(tmp_path / "j")
+    queue = WorkQueue(journal_dir, worker_id="w1", lease_ttl=5)
+    queue.register(_simple_tasks(tmp_path, n=1))
+    queue.run(lambda t: _touch_runner(t.payload["out"]))
+    assert sched_cli.main(
+        ["status", journal_dir, "--watch", "--interval", "0.01",
+         "--frames", "3"]
+    ) == 0
+    capsys.readouterr()

@@ -9,6 +9,7 @@ including when a single entity is larger than the whole batch.
 from __future__ import annotations
 
 import gzip
+import os
 
 import numpy as np
 import pytest
@@ -25,12 +26,20 @@ from sctools_tpu.metrics.gatherer import GatherCellMetrics, GatherGeneMetrics
 REF_CELL_BAM = "/root/reference/src/sctools/test/data/small-cell-sorted.bam"
 REF_GENE_BAM = "/root/reference/src/sctools/test/data/small-gene-sorted.bam"
 
+# only the tests that read the reference's SHIPPED data files skip when the
+# reference checkout is absent; everything on synthetic fixtures still runs
+_ref_data_available = pytest.mark.skipif(
+    not os.path.exists(REF_CELL_BAM),
+    reason="reference test data not available",
+)
+
 
 def _read_csv_bytes(path) -> bytes:
     with gzip.open(path, "rb") as f:
         return f.read()
 
 
+@_ref_data_available
 @pytest.mark.parametrize("batch_records", [7, 64, 1000])
 def test_cell_metrics_batch_size_invariance(tmp_path, batch_records):
     whole = tmp_path / "whole.csv.gz"
@@ -42,6 +51,7 @@ def test_cell_metrics_batch_size_invariance(tmp_path, batch_records):
     assert _read_csv_bytes(whole) == _read_csv_bytes(batched)
 
 
+@_ref_data_available
 @pytest.mark.parametrize("batch_records", [13, 100])
 def test_gene_metrics_batch_size_invariance(tmp_path, batch_records):
     whole = tmp_path / "whole.csv.gz"
@@ -85,6 +95,7 @@ def test_entity_larger_than_batch(tmp_path):
     assert lines[1].startswith("AAAA,50")  # n_reads is the first column
 
 
+@_ref_data_available
 def test_iter_frames_matches_whole_file():
     whole = frame_from_bam(REF_CELL_BAM)
     frames = list(iter_frames_from_bam(REF_CELL_BAM, batch_records=100))
@@ -112,6 +123,7 @@ def test_iter_frames_matches_whole_file():
         )
 
 
+@_ref_data_available
 def test_iter_frames_python_fallback_matches_native(monkeypatch):
     native_frames = list(iter_frames_from_bam(REF_CELL_BAM, batch_records=64))
     monkeypatch.setenv("SCTOOLS_TPU_NATIVE", "0")
@@ -129,6 +141,7 @@ def test_iter_frames_python_fallback_matches_native(monkeypatch):
         np.testing.assert_array_equal(nf.nh, pf.nh)
 
 
+@_ref_data_available
 def test_slice_and_concat_roundtrip():
     frame = frame_from_bam(REF_GENE_BAM)
     cut = frame.n_records // 3
