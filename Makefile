@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke sched-smoke fleet-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke perf-gate docs clean
 
-ci: native lint test obs-smoke sched-smoke fleet-smoke perf-gate
+ci: native lint test obs-smoke sched-smoke fleet-smoke xprof-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -70,6 +70,18 @@ fleet-smoke:
 	rm -rf /tmp/sctools_tpu_fleet_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_FLEET_SMOKE_DIR=/tmp/sctools_tpu_fleet_smoke \
 	$(PY) tests/fleet_smoke.py
+
+# device-efficiency gate: a traced 2-worker run (no faults) must leave
+# per-worker xprof registries whose merged efficiency report carries
+# every registered jit call site with ZERO steady-state retraces, whose
+# transfer ledger reconciles byte-for-byte with the upload/writeback
+# span bytes (gatherer accounting == ledger), and whose fleet timeline
+# shows a populated occupancy column (tests/xprof_smoke.py;
+# docs/performance.md "Reading an efficiency report").
+xprof-smoke:
+	rm -rf /tmp/sctools_tpu_xprof_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_XPROF_SMOKE_DIR=/tmp/sctools_tpu_xprof_smoke \
+	$(PY) tests/xprof_smoke.py
 
 # perf-regression gate self-test: bench.py --check must fail a
 # synthetically-degraded result and pass a trajectory-consistent one

@@ -29,6 +29,9 @@ Prints ONE JSON line. Flags:
               the trajectory median or under the CPU baseline. The wide
               default absorbs the tunneled link's ~3x day-to-day swing
               (BASELINE.md caveats) while still catching a real cliff.
+              Results carrying the scx-xprof fields are also held to
+              retraces_steady_state == 0 and occupancy >= 0.25 — the
+              device-efficiency regressions link weather cannot excuse.
   --check-selftest  verify the gate's own semantics against synthetic
               degraded/healthy results and exit (cheap; `make ci` leg)
 """
@@ -43,9 +46,15 @@ import statistics
 import sys
 
 from sctools_tpu import obs
+from sctools_tpu.obs import xprof
 
 CHECK_EXIT_CODE = 4  # distinct from crashes: "ran fine, but regressed"
 DEFAULT_TOLERANCE = 0.5
+# padding-occupancy floor for the gate: the bench workload cuts batches at
+# entity boundaries near capacity and buckets its tail, so healthy runs
+# sit far above this; falling below it means the batch cutting or
+# bucketing regressed into mostly-padding dispatches
+OCCUPANCY_FLOOR = 0.25
 
 # device workload size
 N_CELLS = 1 << 16  # 65k cells
@@ -105,7 +114,13 @@ def bench_end_to_end(bam_path: str, profile: bool = False) -> dict:
 
     Timing is the obs span's own measurement: the benchmark reads the same
     clock the library's tracing reports, so a span capture of a bench run
-    and the printed JSON cannot disagree.
+    and the printed JSON cannot disagree. Bytes moved come from the
+    scx-xprof transfer ledger (the one source of truth for boundary
+    crossings) and are verified against the gatherer's own ``bytes_h2d``
+    accounting per run — a divergence is a bug in one of them and fails
+    the benchmark loudly. The warm run compiles; the timed runs then diff
+    the xprof registry, so the JSON also reports steady-state retraces
+    (must be 0) and padding occupancy.
     """
     from sctools_tpu.metrics.gatherer import GatherCellMetrics
 
@@ -113,19 +128,43 @@ def bench_end_to_end(bam_path: str, profile: bool = False) -> dict:
 
     bytes_moved = {}
 
+    def _ledger_site(direction: str, site: str) -> int:
+        by_site = xprof.ledger_totals().get(direction, {}).get("by_site", {})
+        return int(by_site.get(site, {}).get("bytes", 0))
+
     def run() -> float:
+        h2d_before = _ledger_site("h2d", "gatherer.upload")
+        d2h_before = _ledger_site("d2h", "gatherer.writeback")
         with obs.span("bench:end_to_end") as timer:
             gatherer = GatherCellMetrics(
                 bam_path, out, backend="device", batch_records=BATCH_RECORDS
             )
             gatherer.extract_metrics()
-        bytes_moved["h2d"] = gatherer.bytes_h2d
-        bytes_moved["d2h"] = gatherer.bytes_d2h
+        h2d = _ledger_site("h2d", "gatherer.upload") - h2d_before
+        d2h = _ledger_site("d2h", "gatherer.writeback") - d2h_before
+        if h2d != gatherer.bytes_h2d or d2h != gatherer.bytes_d2h:
+            raise RuntimeError(
+                "transfer ledger diverged from gatherer accounting: "
+                f"ledger h2d={h2d} vs gatherer {gatherer.bytes_h2d}, "
+                f"ledger d2h={d2h} vs gatherer {gatherer.bytes_d2h}"
+            )
+        bytes_moved["h2d"] = h2d
+        bytes_moved["d2h"] = d2h
         return timer.duration
 
     import statistics
 
     warm = run()  # includes jit compilation
+
+    def _steady_counters() -> dict:
+        sites = xprof.snapshot()["sites"]
+        return {
+            "compiles": sum(s["compiles"] for s in sites.values()),
+            "real_rows": sum(s["real_rows"] for s in sites.values()),
+            "padded_rows": sum(s["padded_rows"] for s in sites.values()),
+        }
+
+    steady_before = _steady_counters()
     if profile:
         with obs.xla_trace("/tmp/sctools_tpu_profile"):
             timed = run()
@@ -134,7 +173,21 @@ def bench_end_to_end(bam_path: str, profile: bool = False) -> dict:
         # runs minutes apart (BASELINE.md caveats); the median is a
         # defensible single-number summary where any one draw is weather
         timed = statistics.median(run() for _ in range(3))
-    return {"end_to_end_s": timed, "warm_s": warm, **bytes_moved}
+    steady_after = _steady_counters()
+    padded = steady_after["padded_rows"] - steady_before["padded_rows"]
+    real = steady_after["real_rows"] - steady_before["real_rows"]
+    return {
+        "end_to_end_s": timed,
+        "warm_s": warm,
+        # any compile AFTER the warm run is a steady-state retrace: the
+        # streaming loop's whole design (capacity cuts, one-way ratchets,
+        # bucketed tails) exists to make this 0
+        "retraces_steady_state": (
+            steady_after["compiles"] - steady_before["compiles"]
+        ),
+        "occupancy": round(real / padded, 4) if padded else None,
+        **bytes_moved,
+    }
 
 
 def bench_decode_only(bam_path: str) -> float:
@@ -207,6 +260,13 @@ def bench_link_bandwidth() -> dict:
             # pull one scalar: block_until_ready alone under-reports on
             # tunneled backends
             float(device[0])
+        # probes land in the same transfer ledger as the pipeline's own
+        # boundary crossings (one source of truth for bytes moved); being
+        # timed, they also give the ledger a measured MB/s
+        xprof.record_transfer(
+            "h2d", buf.nbytes, seconds=timer.duration,
+            site="bench.h2d_probe",
+        )
         return mb / timer.duration
 
     def down() -> float:
@@ -214,6 +274,10 @@ def bench_link_bandwidth() -> dict:
         float(device[0])
         with obs.span("bench:d2h_probe", bytes=buf.nbytes) as timer:
             np.asarray(device)
+        xprof.record_transfer(
+            "d2h", buf.nbytes, seconds=timer.duration,
+            site="bench.d2h_probe",
+        )
         return mb / timer.duration
 
     up()  # first transfer can include backend setup
@@ -405,6 +469,20 @@ def check_result(
     vs_baseline = result.get("vs_baseline")
     if isinstance(vs_baseline, (int, float)):
         add("vs_baseline", vs_baseline >= 1.0, value=vs_baseline, floor=1.0)
+    # scx-xprof efficiency checks, held whenever the result carries them
+    # (older BENCH_r*.json files predate the fields and skip cleanly):
+    # a steady-state retrace means some call site recompiles per batch —
+    # wall-clock poison wherever compile seconds dwarf the batch; a
+    # collapsed occupancy means the device mostly crunches padding.
+    retraces = result.get("retraces_steady_state")
+    if isinstance(retraces, (int, float)):
+        add("retraces_steady_state", retraces == 0, value=retraces, floor=0)
+    occupancy = result.get("occupancy")
+    if isinstance(occupancy, (int, float)):
+        add(
+            "occupancy", occupancy >= OCCUPANCY_FLOOR, value=occupancy,
+            floor=OCCUPANCY_FLOOR,
+        )
     return verdict
 
 
@@ -430,6 +508,18 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         "vs_baseline": 5.0,
     }
     slow_vs_cpu = {"metric": metric, "value": reference, "vs_baseline": 0.5}
+    retracing = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "occupancy": 0.8, "retraces_steady_state": 3,
+    }
+    padded_out = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "occupancy": 0.05, "retraces_steady_state": 0,
+    }
+    efficient = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "occupancy": 0.8, "retraces_steady_state": 0,
+    }
     failures = []
     if not check_result(healthy, repo_dir)["ok"]:
         failures.append("healthy result failed the gate")
@@ -439,6 +529,12 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         failures.append("tolerance=0.9 did not move the floor")
     if check_result(slow_vs_cpu, repo_dir)["ok"]:
         failures.append("sub-CPU-baseline result passed the gate")
+    if check_result(retracing, repo_dir)["ok"]:
+        failures.append("steady-state-retracing result passed the gate")
+    if check_result(padded_out, repo_dir)["ok"]:
+        failures.append("collapsed-occupancy result passed the gate")
+    if not check_result(efficient, repo_dir)["ok"]:
+        failures.append("healthy result with efficiency fields failed")
     if failures:
         for failure in failures:
             print(f"bench --check-selftest: FAIL: {failure}", file=sys.stderr)
@@ -501,6 +597,11 @@ def main(argv=None):
         "vs_baseline": round(cells_per_sec / cpu_cells_per_sec, 2),
         # measured link weather: the headline's dominant environmental term
         "link_MBps": link,
+        # device-efficiency telemetry (scx-xprof): padding occupancy of
+        # the timed runs and compiles observed after warmup — the perf
+        # gate holds both (retraces must be 0; occupancy above the floor)
+        "occupancy": timings["occupancy"],
+        "retraces_steady_state": timings["retraces_steady_state"],
     }
     if breakdown:
         decode_s = bench_decode_only(bam_path)

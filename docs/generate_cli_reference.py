@@ -1,9 +1,11 @@
 """Regenerate docs/cli_flags.md from the real parsers.
 
 The command list derives from pyproject.toml's [project.scripts] (a new
-entry point appears here automatically) and every command is invoked with
-``--help`` with the terminal width and prog name pinned — the per-flag
-reference cannot drift from the code. Run:
+entry point appears here automatically), plus the diagnostic module CLIs
+(``python -m sctools_tpu.obs|sched|analysis`` with their subcommands),
+and every command is invoked with ``--help`` with the terminal width and
+prog name pinned — the per-flag reference cannot drift from the code.
+Run:
 
     python docs/generate_cli_reference.py     (or: make docs)
 
@@ -30,8 +32,25 @@ sys.path.insert(0, REPO)
 from sctools_tpu.utils import toml as tomllib  # noqa: E402
 
 # argparse help rendering is stable within a minor version; regenerate and
-# verify on this one (the image/CI interpreter)
-PINNED_PYTHON = (3, 12)
+# verify on this one (the image/CI interpreter — pinned to the version the
+# tier-1 suite actually runs so the drift test executes, not skips)
+PINNED_PYTHON = (3, 10)
+
+# diagnostic module CLIs (python -m ...): (prog, import path, main attr,
+# subcommands whose own --help is worth a section)
+MODULE_CLIS = (
+    (
+        "python -m sctools_tpu.obs",
+        "sctools_tpu.obs.__main__",
+        ("summarize", "timeline", "efficiency"),
+    ),
+    (
+        "python -m sctools_tpu.sched",
+        "sctools_tpu.sched.cli",
+        ("status", "resume", "retry-quarantined"),
+    ),
+    ("python -m sctools_tpu.analysis", "sctools_tpu.analysis.cli", ()),
+)
 
 
 def commands():
@@ -70,7 +89,28 @@ def capture_help(cls, method: str) -> str:
     return out.getvalue().rstrip().replace("usage: PROG", "usage:")
 
 
+def capture_module_help(main, argv) -> str:
+    """``--help`` of a module CLI's argparse (prog is set by the parser)."""
+    out = io.StringIO()
+    previous = os.environ.get("COLUMNS")
+    os.environ["COLUMNS"] = "80"
+    try:
+        with contextlib.redirect_stdout(out):
+            try:
+                main(argv)
+            except SystemExit:
+                pass
+    finally:
+        if previous is None:
+            os.environ.pop("COLUMNS", None)
+        else:
+            os.environ["COLUMNS"] = previous
+    return out.getvalue().rstrip()
+
+
 def render_page() -> str:
+    import importlib
+
     from sctools_tpu import platform
 
     lines = [
@@ -78,8 +118,9 @@ def render_page() -> str:
         "",
         "Generated from the live parsers by `docs/generate_cli_reference.py`",
         "(`make docs` to refresh) — the exact `--help` output of every",
-        "console entry point in `pyproject.toml`, so this page cannot drift",
-        "from the code (tests/test_entrypoints.py pins whole-file equality).",
+        "console entry point in `pyproject.toml` plus the diagnostic module",
+        "CLIs, so this page cannot drift from the code",
+        "(tests/test_entrypoints.py pins whole-file equality).",
         f"Rendered with CPython {PINNED_PYTHON[0]}.{PINNED_PYTHON[1]}",
         "(argparse formatting varies across minor versions).",
         "See `cli.md` for the command map and cross-command contracts.",
@@ -90,6 +131,18 @@ def render_page() -> str:
         lines += [
             f"## {command}", "", "```text", capture_help(cls, method), "```", "",
         ]
+    lines += ["# Diagnostic module CLIs", ""]
+    for prog, module_path, subcommands in MODULE_CLIS:
+        main = importlib.import_module(module_path).main
+        lines += [
+            f"## {prog}", "", "```text",
+            capture_module_help(main, ["--help"]), "```", "",
+        ]
+        for subcommand in subcommands:
+            lines += [
+                f"### {prog} {subcommand}", "", "```text",
+                capture_module_help(main, [subcommand, "--help"]), "```", "",
+            ]
     return "\n".join(lines)
 
 

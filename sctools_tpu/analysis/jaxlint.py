@@ -48,6 +48,15 @@ Rule catalog (docs/static_analysis.md has the rationale for each):
   releases (and renamed ``check_rep`` -> ``check_vma``); every call site
   must go through the version-portable ``sctools_tpu.platform.shard_map``
   shim or the library breaks on half the installed jax range.
+- SCX111 uninstrumented-jit: bare ``jax.jit`` (attribute access or
+  ``from jax import jit``) outside the instrumentation shim. Every jit
+  call site must go through ``sctools_tpu.obs.xprof.instrument_jit`` so
+  its compiles, retraces, cost estimates, and occupancy land in the
+  device-efficiency registry — a bare ``jax.jit`` is a call site the
+  ``obs efficiency`` report cannot see. ``platform.py`` and ``xprof.py``
+  (the shim itself) are exempt. The traced-context discovery above
+  treats ``instrument_jit`` exactly like ``jax.jit``, so SCX101-105
+  still cover instrumented functions.
 """
 
 from __future__ import annotations
@@ -70,6 +79,7 @@ JAX_RULES = {
     "SCX108": "print-in-traced",
     "SCX109": "wallclock-duration",
     "SCX110": "shardmap-shim",
+    "SCX111": "uninstrumented-jit",
 }
 
 # files allowed to mutate process-global jax.config (SCX106)
@@ -77,6 +87,9 @@ CONFIG_OWNERS = ("platform.py", "conftest.py")
 # the one module allowed to touch jax.shard_map directly (SCX110): it IS
 # the version-portability shim every other call site must import
 SHARD_MAP_OWNERS = ("platform.py",)
+# modules allowed bare jax.jit (SCX111): the instrumentation shim itself
+# (obs/xprof.py wraps jax.jit in the call-site registry) and platform.py
+JIT_OWNERS = ("platform.py", "xprof.py")
 
 _JNP_CONSTRUCTORS = {
     "array", "asarray", "zeros", "ones", "full", "arange", "empty",
@@ -131,6 +144,8 @@ class _Aliases:
         self.np: Set[str] = set()
         self.functools: Set[str] = set()
         self.jit_names: Set[str] = set()  # from jax import jit
+        self.instrument_names: Set[str] = set()  # from ..obs.xprof import instrument_jit
+        self.xprof_mods: Set[str] = set()  # from ..obs import xprof [as x]
         self.shard_map_names: Set[str] = set()
         self.partial_names: Set[str] = set()
         self.device_get_names: Set[str] = set()
@@ -169,6 +184,16 @@ class _Aliases:
                         self.jnp.add(bound)
                     elif mod == "jax" and alias.name == "jit":
                         self.jit_names.add(bound)
+                    elif alias.name == "instrument_jit" and (
+                        mod.split(".")[-1] in ("xprof", "obs")
+                    ):
+                        # the SCX111 shim: traced-context discovery must
+                        # keep seeing instrumented functions as jit
+                        self.instrument_names.add(bound)
+                    elif alias.name == "xprof" and (
+                        mod.split(".")[-1] == "obs" or mod == ""
+                    ):
+                        self.xprof_mods.add(bound)
                     elif alias.name == "shard_map" and (
                         mod.startswith("jax")
                         # the sanctioned shim (SCX110): traced-context
@@ -208,7 +233,12 @@ class _Aliases:
         return root in self.jax and tuple(chain) in paths
 
     def is_jit_expr(self, node: ast.AST) -> bool:
-        if isinstance(node, ast.Name) and node.id in self.jit_names:
+        if isinstance(node, ast.Name) and node.id in (
+            self.jit_names | self.instrument_names
+        ):
+            return True
+        root, chain = self._root_and_chain(node)
+        if root in self.xprof_mods and chain == ["instrument_jit"]:
             return True
         return self.is_jax_attr(node, ("jit",))
 
@@ -777,6 +807,42 @@ class JaxLinter:
                             "sctools_tpu.platform shim",
                         )
 
+    # -- SCX111 ------------------------------------------------------------
+
+    def _check_uninstrumented_jit(self) -> None:
+        """Bare jax.jit spellings outside the instrumentation shim.
+
+        A bare ``jax.jit`` is a compile source the device-efficiency
+        registry cannot attribute: its compiles surface as
+        "unattributed", its retraces have no triggering call site, and
+        its dispatches have no occupancy. Call sites wrap with
+        ``sctools_tpu.obs.xprof.instrument_jit`` instead (same signature,
+        plus ``name=``); ``platform.py`` and the shim itself are exempt.
+        """
+        if os.path.basename(self.path) in JIT_OWNERS:
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute):
+                if self.aliases.is_jax_attr(node, ("jit",)):
+                    self._report(
+                        "SCX111", node,
+                        "bare `jax.jit`: compiles/retraces at this call "
+                        "site are invisible to the efficiency report; "
+                        "wrap with sctools_tpu.obs.xprof.instrument_jit"
+                        "(fn, name=...)",
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "jax" and any(
+                    alias.name == "jit" for alias in node.names
+                ):
+                    self._report(
+                        "SCX111", node,
+                        "importing jit from jax bypasses the call-site "
+                        "registry; import instrument_jit from "
+                        "sctools_tpu.obs.xprof instead",
+                    )
+
     # -- driver ------------------------------------------------------------
 
     def run(self) -> List[Finding]:
@@ -786,6 +852,7 @@ class JaxLinter:
             self._check_retrace(fn, spec)
         self._check_host()
         self._check_shardmap_shim()
+        self._check_uninstrumented_jit()
         return self.findings
 
 

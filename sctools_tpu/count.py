@@ -28,6 +28,7 @@ import scipy.sparse as sp
 from . import consts
 from .bam import get_tag_or_default
 from .io.sam import AlignmentReader
+from .obs import xprof
 
 _DEFAULT_TAGS = (
     consts.CELL_BARCODE_TAG_KEY,
@@ -112,6 +113,7 @@ class _MoleculeAccumulator:
             self._add_batch_sharded(frame, offset, pad_to)
             return
         cols = device_count_columns(frame, pad_to=pad_to)
+        xprof.record_dispatch("ops.count_molecules", n, len(cols["valid"]))
         out = count_molecules(cols, num_segments=len(cols["valid"]))
         is_molecule = np.asarray(out["is_molecule"])
         cells = np.asarray(out["cell"])[is_molecule]
@@ -140,6 +142,11 @@ class _MoleculeAccumulator:
         cols["_orig"] = np.arange(n_padded, dtype=np.int64)
         stacked = partition_columns(cols, self._n_shards, key="cell")
         orig = stacked.pop("_orig")
+        xprof.record_dispatch(
+            "parallel.sharded_count",
+            frame.n_records,
+            int(stacked["qname"].size),
+        )
         out = sharded_count_molecules(stacked, self._mesh)
         is_molecule = np.asarray(out["is_molecule"])
         gene_vocab_cols = self._gene_vocab_cols(frame)

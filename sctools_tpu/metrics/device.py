@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import consts
+from ..obs import xprof
 from ..io.packed import (
     FLAG_DUPLICATE,
     FLAG_MITO,
@@ -193,7 +194,8 @@ def _unpack_wire(
 
 
 @functools.partial(
-    jax.jit,
+    xprof.instrument_jit,
+    name="metrics.compute_entity_metrics",
     static_argnames=(
         "num_segments", "kind", "presorted", "prepacked", "wide_genomic",
         "small_ref", "num_runs", "with_cb",
@@ -528,7 +530,11 @@ def compute_entity_metrics(
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("int_names", "float_names", "k"))
+@functools.partial(
+    xprof.instrument_jit,
+    name="metrics.compact_results",
+    static_argnames=("int_names", "float_names", "k"),
+)
 def compact_results(
     result: Dict[str, jnp.ndarray],
     int_names: Tuple[str, ...],
@@ -557,7 +563,11 @@ def compact_results(
     return ints, floats
 
 
-@functools.partial(jax.jit, static_argnames=("int_names", "float_names", "k"))
+@functools.partial(
+    xprof.instrument_jit,
+    name="metrics.compact_results_wire",
+    static_argnames=("int_names", "float_names", "k"),
+)
 def compact_results_wire(
     result: Dict[str, jnp.ndarray],
     int_names: Tuple[str, ...],

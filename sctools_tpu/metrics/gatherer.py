@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Set
 import numpy as np
 
 from .. import obs
+from ..obs import xprof
 from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
 from ..io.packed import (
     FLAG_RUN_START,
@@ -595,9 +596,25 @@ class MetricGatherer:
                 batch_h2d = sum(np.asarray(v).nbytes for v in cols.values())
             self.bytes_h2d += batch_h2d
             up.add(bytes=batch_h2d)
+            # the transfer ledger is the ONE source of truth for bytes
+            # moved; bytes_h2d stays as the per-gatherer view and must
+            # reconcile exactly (tests + make xprof-smoke pin it)
+            xprof.record_transfer("h2d", batch_h2d, site="gatherer.upload")
         obs.count("batches_uploaded")
         obs.count("h2d_bytes", batch_h2d)
-        with obs.span("compute", records=frame.n_records):
+        # occupancy telemetry: how much of the padded dispatch was real
+        # rows (the rest is compiled FLOPs spent on padding). The span
+        # attrs feed the fleet timeline's per-task occupancy; the registry
+        # feeds the per-call-site efficiency report.
+        xprof.record_dispatch(
+            "metrics.compute_entity_metrics", frame.n_records, num_segments
+        )
+        with obs.span(
+            "compute",
+            records=frame.n_records,
+            real_rows=frame.n_records,
+            padded_rows=num_segments,
+        ):
             result = device_engine.compute_entity_metrics(
                 {k: np.asarray(v) for k, v in cols.items()},
                 num_segments=num_segments,
@@ -621,6 +638,9 @@ class MetricGatherer:
             block = device_engine.compact_results_wire(
                 result, int_names, float_names, k
             )
+            # watermark sample while the batch's buffers are live on
+            # device (peak attribution = the open `compute` span)
+            xprof.sample_memory()
         # keep only what finalize reads: pinning the whole frame or the full
         # result dict would hold ~40 MB of arrays per in-flight batch
         return (
@@ -641,6 +661,10 @@ class MetricGatherer:
             block = np.asarray(block)
             self.bytes_d2h += block.nbytes
             wb.add(bytes=block.nbytes)
+            xprof.record_transfer(
+                "d2h", block.nbytes, site="gatherer.writeback"
+            )
+            xprof.sample_memory()
             obs.count("d2h_bytes", block.nbytes)
             obs.count("entities_written", n_entities)
             self._do_finalize_device_batch(

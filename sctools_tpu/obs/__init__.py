@@ -29,7 +29,10 @@ prints the per-stage time/records/bytes/throughput table
 run-level view from :mod:`.fleet`: ``python -m sctools_tpu.obs timeline
 <run_dir>`` merges every worker's capture with the scx-sched journal into
 one wall-clock timeline (lanes, stragglers, critical path, crashed-worker
-flight records).
+flight records). The device side of the same capture is :mod:`.xprof`:
+per-jit-call-site compile/retrace attribution, padding occupancy, the
+H2D/D2H transfer ledger, and memory watermarks, read back with
+``python -m sctools_tpu.obs efficiency <run_dir>``.
 
 The scheduler (sctools_tpu.sched) reports through this layer too:
 ``sched:task``/``sched:wait`` spans and the ``sched_*`` counters
@@ -515,6 +518,18 @@ def flight_dump(reason: str = "", path: Optional[str] = None) -> Optional[str]:
         "counters": counters_snapshot,
         "gauges": gauges_snapshot,
     }
+    # a crashed worker's compile/occupancy/ledger registry dies with the
+    # process unless the flight record carries it (the atexit xprof dump
+    # never runs under os._exit); bounded by the registry's own caps
+    xprof = sys.modules.get(__name__ + ".xprof")
+    if xprof is not None:
+        try:
+            if xprof.has_data():  # lockless by design (death path)
+                # bounded lock wait, same reasoning as the obs lock above:
+                # the signal may have interrupted a holder of xprof's lock
+                meta["xprof"] = xprof.snapshot(lock_timeout=1.0)
+        except Exception:  # noqa: BLE001 - the death path must still write
+            pass
     tmp = f"{target}.tmp.{os.getpid()}"
     try:
         with open(tmp, "w", encoding="utf-8") as f:
@@ -598,15 +613,24 @@ def install_jax_hooks() -> bool:
     def _on_duration(event: str, duration: float, **kwargs) -> None:
         if not _enabled:
             return
-        _record_span(
-            {
-                "name": "jax:" + event.strip("/").replace("/", "."),
-                "ts": round(time.perf_counter() - _T0 - duration, 6),
-                "dur": duration,
-                "thread": threading.current_thread().name,
-                "depth": len(_stack()),
-            }
-        )
+        record = {
+            "name": "jax:" + event.strip("/").replace("/", "."),
+            "ts": round(time.perf_counter() - _T0 - duration, 6),
+            "dur": duration,
+            "thread": threading.current_thread().name,
+            "depth": len(_stack()),
+        }
+        # scx-xprof call-site attribution: when the event fired inside an
+        # instrumented jit, the registry accounts the compile to that site
+        # and the jax:* span names it — a retrace is then a grep for the
+        # call site, not a diff of two traces. Lazy module lookup: obs
+        # stays importable (and the hook installable) with xprof unloaded.
+        xprof = sys.modules.get(__name__ + ".xprof")
+        if xprof is not None:
+            site = xprof.observe_event(event, duration)
+            if site is not None:
+                record["attrs"] = {"site": site}
+        _record_span(record)
 
     def _on_event(event: str, **kwargs) -> None:
         count("jax_event." + event.strip("/").replace("/", "."))
@@ -768,9 +792,11 @@ def _activate_from_env() -> None:
             safe = _sanitize_component(worker)
             trace_name = f"trace.{safe}.jsonl"
             metrics_name = f"metrics.{safe}.prom"
+            xprof_name = f"xprof.{safe}.json"
         else:
             trace_name = "trace.jsonl"
             metrics_name = "metrics.prom"
+            xprof_name = "xprof.json"
         enable(sink_path=os.path.join(trace_dir, trace_name))
         install_flight_recorder()
 
@@ -784,6 +810,16 @@ def _activate_from_env() -> None:
                         f.write(text)
                 except OSError:
                     pass
+            # the device-efficiency registry (obs.xprof) rides the same
+            # capture: one JSON dump per worker, read back by
+            # `obs efficiency <run_dir>`. Lazy lookup — host-only runs
+            # that never imported xprof dump nothing.
+            xprof = sys.modules.get(__name__ + ".xprof")
+            if xprof is not None and xprof.has_data():
+                xprof.dump(
+                    os.path.join(trace_dir, xprof_name),
+                    worker=configured_worker_name(),
+                )
 
         atexit.register(_dump_metrics)
     elif os.environ.get("SCTOOLS_TPU_OBS", "") not in ("", "0"):

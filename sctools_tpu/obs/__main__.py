@@ -1,17 +1,27 @@
-"""scx-trace / scx-fleet CLI.
+"""scx-trace / scx-fleet / scx-xprof CLI.
 
 ``python -m sctools_tpu.obs summarize trace.jsonl [more.jsonl|'glob*']``
 reads one or more span captures (the JSON-lines files SCTOOLS_TPU_TRACE
 writes; globs expand) and prints the combined per-stage time/records/
 bytes/throughput table. A torn or truncated final line — a crashed or
-still-writing worker — degrades to a warning, never an error.
+still-writing worker — degrades to a warning, never an error. ``--json``
+emits ONE machine-readable object (stage rows + the counter snapshots
+and xprof compile registries found next to the traces) so the perf gate
+and external dashboards never scrape the text table.
 
 ``python -m sctools_tpu.obs timeline <run_dir>`` merges EVERY worker's
 capture plus the scx-sched journal under a run directory into one
-wall-clock timeline: per-worker lanes with busy/wait/idle fractions,
-per-task duration stats and stragglers, the critical chain of tasks that
-bounded the run, and crashed-worker flight records (obs.fleet;
+wall-clock timeline: per-worker lanes with busy/wait/idle fractions and
+occupancy/transfer columns, per-task duration stats and stragglers (with
+low-occupancy diagnosis), the critical chain of tasks that bounded the
+run, and crashed-worker flight records (obs.fleet;
 docs/observability.md).
+
+``python -m sctools_tpu.obs efficiency <run_dir>`` merges the workers'
+xprof registries into the device-efficiency report: per jit call site,
+compile/retrace counts (with triggering signatures), padding occupancy,
+estimated FLOPs (real vs padding-wasted), the H2D/D2H transfer ledger,
+and device-memory watermarks (docs/performance.md walks through one).
 
 Pure stdlib — usable on any host with the capture files, no jax required.
 """
@@ -21,11 +31,18 @@ from __future__ import annotations
 import argparse
 import glob as globmod
 import json
+import os
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from . import render_summary, summarize_records
 from .fleet import analyze, discover, load_capture, render_timeline
+from .xprof import (
+    efficiency_report,
+    load_registries,
+    merge_registries,
+    render_efficiency,
+)
 
 
 def _expand(patterns: List[str]) -> List[str]:
@@ -37,6 +54,49 @@ def _expand(patterns: List[str]) -> List[str]:
             if path not in out:
                 out.append(path)
     return out
+
+
+def _parse_prom(path: str) -> Dict[str, float]:
+    """Prometheus text exposition -> {sample_name_or_labeled: value}."""
+    out: Dict[str, float] = {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                name, _, value = line.rpartition(" ")
+                try:
+                    out[name] = float(value)
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def _sidecars(paths: List[str]):
+    """Counter snapshots + xprof registries next to the given traces.
+
+    The capture dir writes ``metrics[.<worker>].prom`` and
+    ``xprof[.<worker>].json`` beside each ``trace[.<worker>].jsonl``;
+    summarize --json folds them in so one invocation hands a dashboard
+    the spans, the counters, and the compile registry together.
+    """
+    dirs = []
+    for path in paths:
+        directory = os.path.dirname(os.path.abspath(path))
+        if directory not in dirs:
+            dirs.append(directory)
+    counters: Dict[str, Dict[str, float]] = {}
+    registries = []
+    for directory in dirs:
+        for prom in sorted(globmod.glob(os.path.join(directory, "metrics*.prom"))):
+            parsed = _parse_prom(prom)
+            if parsed:
+                counters[prom] = parsed
+        registries.extend(load_registries(directory))
+    return counters, registries
 
 
 def _summarize(args, out=None, err=None) -> int:
@@ -73,8 +133,17 @@ def _summarize(args, out=None, err=None) -> int:
     if args.top:
         rows = rows[: args.top]
     if args.as_json:
-        for row in rows:
-            print(json.dumps(row, separators=(",", ":")), file=out)
+        counters, registries = _sidecars(paths)
+        payload = {
+            "stages": rows,
+            "spans": len(records),
+            "files": files_read,
+            "counters": counters,
+            "compile_registry": (
+                merge_registries(registries)["sites"] if registries else {}
+            ),
+        }
+        print(json.dumps(payload, separators=(",", ":")), file=out)
     else:
         print(render_summary(rows), file=out)
         total = sum(r["total_s"] for r in rows)
@@ -107,6 +176,21 @@ def _timeline(args, out=None, err=None) -> int:
     return 0
 
 
+def _efficiency(args, out=None, err=None) -> int:
+    out = out if out is not None else sys.stdout
+    err = err if err is not None else sys.stderr
+    report = efficiency_report(args.run_dir)
+    if not report["registries"]:
+        for warning in report["warnings"]:
+            print(f"obs efficiency: {warning}", file=err)
+        return 2
+    if args.as_json:
+        print(json.dumps(report, separators=(",", ":")), file=out)
+    else:
+        print(render_efficiency(report), end="", file=out)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sctools_tpu.obs",
@@ -126,7 +210,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     summarize.add_argument(
         "--json", action="store_true", dest="as_json",
-        help="machine-readable rows instead of the table",
+        help="one machine-readable object (stage rows + adjacent counter "
+        "snapshots + xprof compile registries) instead of the table",
     )
     timeline = sub.add_parser(
         "timeline",
@@ -141,9 +226,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true", dest="as_json",
         help="the full analysis dict as one JSON object",
     )
+    efficiency = sub.add_parser(
+        "efficiency",
+        help="per-jit-call-site device efficiency: compiles, retraces, "
+        "padding occupancy, transfer ledger, memory watermarks",
+    )
+    efficiency.add_argument(
+        "run_dir",
+        help="run directory holding xprof[.<worker>].json registries "
+        "(written at exit of every SCTOOLS_TPU_TRACE'd worker)",
+    )
+    efficiency.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="the full report dict as one JSON object",
+    )
     args = parser.parse_args(argv)
     if args.command == "summarize":
         return _summarize(args)
+    if args.command == "efficiency":
+        return _efficiency(args)
     return _timeline(args)
 
 

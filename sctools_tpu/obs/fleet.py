@@ -367,6 +367,14 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
             "span_workers": [],
             "committing_span": None,
             "duration": None,
+            # scx-xprof columns: padded-dispatch occupancy and bytes over
+            # the device link, summed from the task's pipeline spans (the
+            # gatherer stamps real_rows/padded_rows on compute spans and
+            # bytes on upload/writeback)
+            "occupancy": None,
+            "transfer_bytes": 0,
+            "_real_rows": 0,
+            "_padded_rows": 0,
         }
         task_rows[tid] = row
     for record in spans:
@@ -378,6 +386,13 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
         worker = str(record.get("worker"))
         if worker not in row["span_workers"]:
             row["span_workers"].append(worker)
+        if isinstance(attrs.get("padded_rows"), (int, float)):
+            row["_real_rows"] += int(attrs.get("real_rows") or 0)
+            row["_padded_rows"] += int(attrs["padded_rows"])
+        if record.get("name") in ("upload", "writeback") and isinstance(
+            attrs.get("bytes"), (int, float)
+        ):
+            row["transfer_bytes"] += int(attrs["bytes"])
         if record.get("name") != "sched:task" or record.get("error"):
             continue
         if row["state"] == "committed" and worker == row["worker"]:
@@ -396,6 +411,11 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
                     entry["end"] > row["committing_span"]["end"]:
                 row["committing_span"] = entry
                 row["duration"] = entry["dur"]
+    for row in task_rows.values():
+        padded = row.pop("_padded_rows")
+        real = row.pop("_real_rows")
+        if padded:
+            row["occupancy"] = real / padded
     committing_spans = [
         row["committing_span"] for row in task_rows.values()
         if row["committing_span"] is not None
@@ -414,6 +434,18 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
             float(r.get("dur", 0.0)) for r in records
             if r.get("name") == "sched:wait"
         )
+        real_rows = 0
+        padded_rows = 0
+        transfer_bytes = 0
+        for r in records:
+            attrs = r.get("attrs") or {}
+            if isinstance(attrs.get("padded_rows"), (int, float)):
+                real_rows += int(attrs.get("real_rows") or 0)
+                padded_rows += int(attrs["padded_rows"])
+            if r.get("name") in ("upload", "writeback") and isinstance(
+                attrs.get("bytes"), (int, float)
+            ):
+                transfer_bytes += int(attrs["bytes"])
         window = max(end - start, 1e-9)
         has_sched = any(
             r.get("name", "").startswith("sched:") for r in records
@@ -446,6 +478,10 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
                 1 for s in committing_spans
                 if s["worker"] == worker and s["stolen"]
             ),
+            "occupancy": (
+                real_rows / padded_rows if padded_rows else None
+            ),
+            "transfer_bytes": transfer_bytes,
         }
 
     # --- task duration stats + stragglers
@@ -460,13 +496,43 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
         "max_s": longest,
         "skew": (longest / p50) if p50 > 0 else None,
     }
-    stragglers = sorted(
+    # per-task straggler diagnosis: a task slow because its dispatches ran
+    # mostly on padding (tiny chunk in a big bucket, or a pathological
+    # batch cut) reads directly off the occupancy column — "slow because
+    # 12% occupancy" — instead of needing a per-worker trace dive
+    occupancies = [
+        row["occupancy"] for row in task_rows.values()
+        if row["occupancy"] is not None
+    ]
+    occupancy_median = (
+        statistics.median(occupancies) if occupancies else None
+    )
+    stragglers = []
+    for span_entry in sorted(
         (
             s for s in committing_spans
             if p50 > 0 and s["dur"] > 2.0 * p50
         ),
         key=lambda s: -s["dur"],
-    )
+    ):
+        entry = dict(span_entry)
+        row = task_rows.get(entry["task_id"]) or {}
+        occupancy = row.get("occupancy")
+        entry["occupancy"] = occupancy
+        if (
+            occupancy is not None
+            and occupancy_median
+            and occupancy < 0.5 * occupancy_median
+        ):
+            entry["diagnosis"] = (
+                f"slow because {100 * occupancy:.0f}% occupancy "
+                f"(fleet median {100 * occupancy_median:.0f}%)"
+            )
+        elif entry["stolen"]:
+            entry["diagnosis"] = "waited out a dead worker's lease"
+        else:
+            entry["diagnosis"] = ""
+        stragglers.append(entry)
 
     # --- critical path: the chain of executions that bounded the run.
     # From the last-finishing committed execution walk backwards: the
@@ -514,11 +580,13 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
             row["name"]: {
                 key: row[key] for key in (
                     "id", "state", "worker", "attempts", "steals",
-                    "span_workers", "duration",
+                    "span_workers", "duration", "occupancy",
+                    "transfer_bytes",
                 )
             }
             for row in task_rows.values()
         },
+        "occupancy_median": occupancy_median,
         "task_totals": {
             state: states.count(state) for state in sorted(set(states))
         },
@@ -604,18 +672,25 @@ def render_timeline(run: FleetRun, analysis: Dict[str, Any]) -> str:
         lines.append(
             f"{'worker'.ljust(name_width)}  "
             f"{'lane (#task ~wait ·idle)'.ljust(_LANE_WIDTH)}  "
-            "busy%  wait%  idle%  tasks  steals"
+            "busy%  wait%  idle%  tasks  steals   occ%  moved_MB"
         )
         for worker in sorted(lanes):
             lane = lanes[worker]
             records = [s for s in spans if s.get("worker") == worker]
             bar = _lane_bar(records, start, start + window)
+            occupancy = lane.get("occupancy")
+            occ = (
+                f"{100 * occupancy:5.1f}" if occupancy is not None
+                else "    -"
+            )
+            moved = lane.get("transfer_bytes") or 0
             lines.append(
                 f"{worker.ljust(name_width)}  {bar}  "
                 f"{100 * lane['busy_frac']:5.1f}  "
                 f"{100 * lane['wait_frac']:5.1f}  "
                 f"{100 * lane['idle_frac']:5.1f}  "
-                f"{lane['tasks']:5d}  {lane['steals']:6d}"
+                f"{lane['tasks']:5d}  {lane['steals']:6d}  "
+                f"{occ}  {moved / 1e6:8.1f}"
             )
         lines.append("")
     stats = analysis["task_stats"]
@@ -627,10 +702,12 @@ def render_timeline(run: FleetRun, analysis: Dict[str, Any]) -> str:
             f"skew(max/p50)={skew}"
         )
         for straggler in analysis["stragglers"][:5]:
+            diagnosis = straggler.get("diagnosis") or ""
             lines.append(
                 f"  straggler: {straggler['task']} {straggler['dur']:.3f}s "
                 f"on {straggler['worker']}"
                 + (" (stolen)" if straggler["stolen"] else "")
+                + (f" — {diagnosis}" if diagnosis else "")
             )
         lines.append("")
     chain = analysis["critical_path"]

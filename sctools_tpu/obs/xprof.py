@@ -1,0 +1,994 @@
+"""scx-xprof: device-efficiency observability for the XLA layer.
+
+scx-trace answers "where did wall-clock go" on the host; this module
+answers what the DEVICE side of that wall clock was made of. Every hot
+path in the pipeline runs jit-compiled over padded, bucketed shapes
+(metrics.gatherer pad_to/bucket_size, ops.segments 2x-bound buckets), and
+without a meter nobody can say what fraction of compiled FLOPs were
+padding, which call site triggered a retrace, or whether the bytes that
+crossed the host<->device boundary match what the journal says we
+shipped. Four instruments, all keyed off the scx-trace enable switch
+(``obs.enabled()``) and free when it is off:
+
+1. **Jit call-site registry** — :func:`instrument_jit` wraps ``jax.jit``
+   at every call site in the library. Per site it records call count,
+   the abstract shape signatures seen, compile count + compile seconds
+   (attributed from the ``jax.monitoring`` duration events the existing
+   obs hook already receives), retraces (a backend compile for a
+   signature this site had ALREADY compiled — the thing that must be
+   zero in steady state), and ``cost_analysis()`` FLOPs / bytes-accessed
+   per signature.
+2. **Occupancy telemetry** — padded-batch producers call
+   :func:`record_dispatch` with (real_rows, padded_rows) per dispatch, so
+   the registry exposes wasted-row and wasted-FLOP fractions per site,
+   and the dispatch spans carry ``real_rows``/``padded_rows`` attrs the
+   fleet timeline turns into per-task occupancy.
+3. **Transfer ledger** — :func:`record_transfer` counts H2D/D2H bytes
+   (and, for timed probes, seconds) where arrays actually cross the
+   boundary: gatherer upload/writeback, whitelist queries, bench's link
+   probes. One source of truth, conserved against the gatherer's
+   ``bytes_h2d`` accounting (pinned by tests and ``make xprof-smoke``).
+4. **Device-memory watermarks** — :func:`sample_memory` reads
+   ``device.memory_stats()`` where the backend has it (TPU), falls back
+   to summing ``jax.live_arrays()`` (CPU), and is a graceful no-op where
+   neither works; peaks attribute to the active span/stage.
+
+Persistence: the env-driven trace capture (``SCTOOLS_TPU_TRACE``) dumps
+the registry to ``<dir>/xprof[.<worker>].json`` at exit, and
+``obs.flight_dump`` embeds a snapshot in the flight record so a crashed
+worker's compile history survives. ``python -m sctools_tpu.obs
+efficiency <run_dir>`` merges every worker's registry into the
+per-call-site report (docs/performance.md walks through one).
+
+The reporting half of this module (load/merge/render) is pure stdlib —
+an efficiency report reads anywhere, no jax required; jax imports are
+deferred into the recording functions that need them.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import _stack as _obs_stack
+from . import count as _obs_count
+from . import enabled as _obs_enabled
+from . import gauge as _obs_gauge
+from . import get_context as _obs_context
+from . import install_jax_hooks as _obs_install_jax_hooks
+
+__all__ = [
+    "instrument_jit",
+    "declared_sites",
+    "active_site",
+    "observe_event",
+    "record_dispatch",
+    "record_transfer",
+    "sample_memory",
+    "ledger_totals",
+    "snapshot",
+    "has_data",
+    "reset",
+    "dump",
+    "load_registries",
+    "merge_registries",
+    "efficiency_report",
+    "render_efficiency",
+]
+
+_lock = threading.RLock()
+_tls = threading.local()
+
+# distinct signatures / retrace examples / stage peaks kept per site: the
+# registry must stay flight-record-sized even under pathological shape
+# flapping (which is exactly when someone reads it)
+_MAX_SIGNATURES = 64
+_MAX_RETRACE_EXAMPLES = 8
+_MAX_STAGE_PEAKS = 32
+
+SIGNATURE_OVERFLOW = "(other signatures)"
+
+
+class _Site:
+    """Mutable per-call-site accumulator (guarded by the module lock)."""
+
+    __slots__ = (
+        "name", "calls", "compiles", "retraces", "compile_s",
+        "signatures", "sig_calls", "sig_cost", "retrace_examples",
+        "dispatches", "real_rows", "padded_rows",
+    )
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0
+        self.compiles = 0
+        self.retraces = 0
+        self.compile_s = 0.0
+        self.signatures: Dict[str, int] = {}  # sig -> backend compiles
+        self.sig_calls: Dict[str, int] = {}
+        self.sig_cost: Dict[str, Dict[str, float]] = {}
+        self.retrace_examples: List[Dict[str, Any]] = []
+        self.dispatches = 0
+        self.real_rows = 0
+        self.padded_rows = 0
+
+
+# name -> _Site for sites that have recorded anything; _declared also
+# remembers every instrument_jit() decoration so a site that never ran
+# still shows up (absence from the report must mean "not instrumented",
+# never "instrumented but invisible")
+_sites: Dict[str, _Site] = {}
+_declared: Dict[str, int] = {}  # name -> times declared
+_unattributed_compiles = 0
+_unattributed_compile_s = 0.0
+
+# (direction, site) -> [bytes, seconds, events]
+_ledger: Dict[Tuple[str, str], List[float]] = {}
+
+_memory: Dict[str, Any] = {
+    "supported": None,  # None = never sampled, False = no backend support
+    "source": None,  # "memory_stats" | "live_arrays"
+    "samples": 0,
+    "peak_bytes": 0,
+    "peak_stage": None,
+    "stage_peaks": {},  # stage -> peak bytes
+}
+
+
+def _active_frames() -> list:
+    frames = getattr(_tls, "frames", None)
+    if frames is None:
+        frames = _tls.frames = []
+    return frames
+
+
+def _site(name: str) -> _Site:
+    site = _sites.get(name)
+    if site is None:
+        with _lock:
+            site = _sites.setdefault(name, _Site(name))
+    return site
+
+
+def declared_sites() -> List[str]:
+    """Every call site name instrument_jit has decorated in this process."""
+    with _lock:
+        return sorted(_declared)
+
+
+def active_site() -> Optional[str]:
+    """The innermost instrumented jit currently executing on this thread."""
+    frames = _active_frames()
+    return frames[-1][0] if frames else None
+
+
+# ------------------------------------------------------ jit call sites
+
+class _InstrumentedJit:
+    """A ``jax.jit`` callable with per-call-site registry accounting.
+
+    Calls pass straight through to the wrapped jit; when recording is on,
+    each call also derives the abstract signature of its arguments (leaf
+    shapes/dtypes + static kwarg values — the same things jit keys its
+    cache on, minus weak-type detail) and marks this site active so the
+    jax.monitoring compile events that fire DURING the call attribute
+    here. A backend compile for a signature this site had already seen is
+    a retrace and is recorded with the triggering signature.
+    """
+
+    def __init__(self, jitted, fn, name: str, static_names: Tuple[str, ...]):
+        self._jit = jitted
+        self.site_name = name
+        self._static_names = frozenset(static_names)
+        self.__name__ = getattr(fn, "__name__", name)
+        self.__doc__ = getattr(fn, "__doc__", None)
+        self.__wrapped__ = fn
+
+    def _signature(self, args, kwargs) -> str:
+        import jax
+
+        static = []
+        dynamic = {}
+        for key, value in kwargs.items():
+            if key in self._static_names:
+                static.append((key, value))
+            else:
+                dynamic[key] = value
+        leaves, _ = jax.tree_util.tree_flatten((args, dynamic))
+        parts = []
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                parts.append(repr(leaf))
+            else:
+                parts.append(f"{dtype}[{','.join(str(d) for d in shape)}]")
+        sig = "(" + ", ".join(parts) + ")"
+        if static:
+            static.sort()
+            sig += " {" + ", ".join(f"{k}={v!r}" for k, v in static) + "}"
+        return sig
+
+    def _record_cost(self, site: _Site, sig: str, args, kwargs) -> None:
+        """Best-effort cost_analysis for a freshly compiled signature.
+
+        ``Lowered.cost_analysis()`` re-traces the function once (no second
+        backend compile); the price is paid only on the first compile of a
+        signature, only while recording. Anything the backend refuses to
+        estimate degrades to absence, never an error on the pipeline.
+        """
+        try:
+            import jax
+
+            if not jax.core.trace_state_clean():
+                return
+            # the probe's own lower/compile work emits monitoring events;
+            # without the gate they would surface as phantom unattributed
+            # (or worse, mis-attributed) compiles in the very report this
+            # probe feeds
+            _tls.ignore_events = True
+            try:
+                cost = self._jit.lower(*args, **kwargs).cost_analysis()
+            finally:
+                _tls.ignore_events = False
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+            if not isinstance(cost, dict):
+                return
+            entry = {}
+            flops = cost.get("flops")
+            accessed = cost.get("bytes accessed")
+            if isinstance(flops, (int, float)) and flops >= 0:
+                entry["flops"] = float(flops)
+            if isinstance(accessed, (int, float)) and accessed >= 0:
+                entry["bytes_accessed"] = float(accessed)
+            if entry:
+                with _lock:
+                    if len(site.sig_cost) < _MAX_SIGNATURES:
+                        site.sig_cost[sig] = entry
+        except Exception:  # noqa: BLE001 - telemetry must never break the op
+            return
+
+    def __call__(self, *args, **kwargs):
+        if not _obs_enabled():
+            return self._jit(*args, **kwargs)
+        _obs_install_jax_hooks()  # compile events route through observe_event
+        sig = self._signature(args, kwargs)
+        site = _site(self.site_name)
+        with _lock:
+            site.calls += 1
+            if sig in site.signatures:
+                seen = True
+            elif len(site.signatures) < _MAX_SIGNATURES:
+                seen = False
+                site.signatures[sig] = 0
+            else:
+                sig = SIGNATURE_OVERFLOW
+                seen = sig in site.signatures
+                site.signatures.setdefault(sig, 0)
+            site.sig_calls[sig] = site.sig_calls.get(sig, 0) + 1
+        # frame = [site, signature, seen_before_this_call, compiles_during]
+        frame = [self.site_name, sig, seen, 0]
+        frames = _active_frames()
+        frames.append(frame)
+        try:
+            out = self._jit(*args, **kwargs)
+        finally:
+            frames.pop()
+        if frame[3] and not seen:
+            self._record_cost(site, sig, args, kwargs)
+        return out
+
+    # AOT/introspection passthroughs so the wrapper stays drop-in
+    def lower(self, *args, **kwargs):
+        return self._jit.lower(*args, **kwargs)
+
+    def trace(self, *args, **kwargs):
+        return self._jit.trace(*args, **kwargs)
+
+    def clear_cache(self) -> None:
+        self._jit.clear_cache()
+
+    def __repr__(self) -> str:
+        return f"<instrumented jit {self.site_name!r}>"
+
+
+def instrument_jit(fn, *, name: Optional[str] = None, **jit_kwargs):
+    """``jax.jit`` with call-site registry accounting (the SCX111 shim).
+
+    Drop-in for ``jax.jit(fn, **jit_kwargs)`` — usable directly or as
+    ``@functools.partial(xprof.instrument_jit, name=..., static_argnames=...)``.
+    ``name`` is the stable call-site id the efficiency report keys on
+    (defaults to the function name). Disabled recording adds one bool
+    check per call; see the module docstring for what is recorded when
+    on. Every ``jax.jit`` in the library must go through here
+    (scx-lint rule SCX111) so no compile can happen off the books.
+    """
+    import jax
+
+    site_name = name or getattr(fn, "__name__", "jit")
+    static_names = tuple(jit_kwargs.get("static_argnames") or ())
+    with _lock:
+        _declared[site_name] = _declared.get(site_name, 0) + 1
+    return _InstrumentedJit(
+        jax.jit(fn, **jit_kwargs), fn, site_name, static_names
+    )
+
+
+def observe_event(event: str, duration: float) -> Optional[str]:
+    """Attribute one jax.monitoring duration event; returns the site.
+
+    Called by the obs jax hook for every duration event while recording.
+    Compile-family events (``/jax/core/compile/...``) accumulate onto the
+    active call site: compile seconds for every sub-phase, compile count
+    on the backend-compile event, and a retrace when that backend compile
+    hit a signature the site had already seen before the current call.
+    Returns the active site name (for span attribution) whether or not
+    the event was compile-related.
+    """
+    frames = _active_frames()
+    frame = frames[-1] if frames else None
+    if getattr(_tls, "ignore_events", False):
+        return frame[0] if frame else None
+    if "compile" not in event:
+        return frame[0] if frame else None
+    global _unattributed_compiles, _unattributed_compile_s
+    backend = "backend_compile" in event
+    if frame is None:
+        with _lock:
+            _unattributed_compile_s += duration
+            if backend:
+                _unattributed_compiles += 1
+        return None
+    name, sig, seen = frame[0], frame[1], frame[2]
+    site = _site(name)
+    with _lock:
+        site.compile_s += duration
+        if backend:
+            frame[3] += 1
+            site.compiles += 1
+            site.signatures[sig] = site.signatures.get(sig, 0) + 1
+            if seen:
+                site.retraces += 1
+                for example in site.retrace_examples:
+                    if example["signature"] == sig:
+                        example["count"] += 1
+                        break
+                else:
+                    if len(site.retrace_examples) < _MAX_RETRACE_EXAMPLES:
+                        site.retrace_examples.append(
+                            {"signature": sig, "count": 1}
+                        )
+    if backend:
+        _obs_count("xprof_compiles")
+        if seen:
+            _obs_count("xprof_retraces")
+    return name
+
+
+# -------------------------------------------------- occupancy telemetry
+
+def record_dispatch(
+    site_name: str,
+    real_rows: int,
+    padded_rows: int,
+    bucket: Optional[int] = None,
+) -> None:
+    """One padded-batch dispatch: ``real_rows`` of ``padded_rows`` real.
+
+    No-op while recording is off. ``bucket`` (the padded bucket size) is
+    accepted for call-site readability; the padded total already carries
+    it. Feeds the per-site wasted-row fraction and the
+    ``xprof_real_rows``/``xprof_padded_rows`` counters; call sites also
+    stamp the same numbers onto their dispatch span so the fleet timeline
+    can compute per-task occupancy.
+    """
+    if not _obs_enabled():
+        return
+    site = _site(site_name)
+    with _lock:
+        site.dispatches += 1
+        site.real_rows += int(real_rows)
+        site.padded_rows += int(padded_rows)
+    _obs_count("xprof_real_rows", int(real_rows))
+    _obs_count("xprof_padded_rows", int(padded_rows))
+
+
+# ------------------------------------------------------ transfer ledger
+
+def record_transfer(
+    direction: str, nbytes: int, seconds: float = 0.0, site: str = ""
+) -> None:
+    """Count bytes (and, when timed, seconds) crossing the device link.
+
+    ``direction`` is ``"h2d"`` or ``"d2h"``. One ledger for every
+    boundary crossing in the process — gatherer upload/writeback,
+    whitelist queries, bench probes — so "bytes moved" has a single
+    source of truth that other accounting (``MetricGatherer.bytes_h2d``,
+    ``bench.py``'s transfer floor) must reconcile with. No-op while
+    recording is off.
+    """
+    if direction not in ("h2d", "d2h"):
+        raise ValueError(f"direction must be 'h2d' or 'd2h', got {direction!r}")
+    if not _obs_enabled():
+        return
+    with _lock:
+        entry = _ledger.setdefault((direction, site), [0, 0.0, 0])
+        entry[0] += int(nbytes)
+        entry[1] += float(seconds)
+        entry[2] += 1
+    _obs_count(f"xprof_transfer_bytes_{direction}", int(nbytes))
+
+
+def ledger_totals() -> Dict[str, Dict[str, Any]]:
+    """Ledger snapshot: per-direction totals with a per-site breakdown."""
+    with _lock:
+        return _ledger_totals_locked()
+
+
+def _ledger_totals_locked() -> Dict[str, Dict[str, Any]]:
+    out: Dict[str, Dict[str, Any]] = {}
+    items = [(k, list(v)) for k, v in _ledger.items()]
+    for (direction, site), (nbytes, seconds, events) in items:
+        total = out.setdefault(
+            direction, {"bytes": 0, "seconds": 0.0, "events": 0, "by_site": {}}
+        )
+        total["bytes"] += int(nbytes)
+        total["seconds"] += seconds
+        total["events"] += events
+        total["by_site"][site or "(unlabeled)"] = {
+            "bytes": int(nbytes), "seconds": seconds, "events": events,
+        }
+    return out
+
+
+# -------------------------------------------------- memory watermarks
+
+def sample_memory(stage: Optional[str] = None) -> Optional[int]:
+    """Sample device bytes-in-use; track the peak and its stage.
+
+    Reads ``device.memory_stats()`` summed over local devices (TPU
+    backends); where that returns nothing (CPU), falls back to summing
+    ``jax.live_arrays()``; where jax itself is absent or both probes
+    fail, records the backend as unsupported and stays silent. ``stage``
+    defaults to the innermost open obs span on this thread (falling back
+    to the obs context ``task``), which is what attributes a peak to
+    upload/compute/writeback.
+    """
+    if not _obs_enabled():
+        return None
+    try:
+        import jax
+    except Exception:
+        return None
+    if stage is None:
+        open_spans = _obs_stack()
+        stage = open_spans[-1] if open_spans else _obs_context().get("task")
+    in_use = None
+    source = None
+    try:
+        for device in jax.local_devices():
+            stats = device.memory_stats()
+            if stats and isinstance(stats.get("bytes_in_use"), int):
+                in_use = (in_use or 0) + stats["bytes_in_use"]
+        if in_use is not None:
+            source = "memory_stats"
+    except Exception:  # noqa: BLE001 - probe only
+        in_use = None
+    if in_use is None:
+        try:
+            in_use = sum(
+                int(getattr(array, "nbytes", 0))
+                for array in jax.live_arrays()
+            )
+            source = "live_arrays"
+        except Exception:  # noqa: BLE001 - probe only
+            with _lock:
+                if _memory["supported"] is None:
+                    _memory["supported"] = False
+            return None
+    with _lock:
+        _memory["supported"] = True
+        _memory["source"] = source
+        _memory["samples"] += 1
+        if in_use > _memory["peak_bytes"]:
+            _memory["peak_bytes"] = in_use
+            _memory["peak_stage"] = stage
+        if stage is not None:
+            peaks = _memory["stage_peaks"]
+            if stage in peaks or len(peaks) < _MAX_STAGE_PEAKS:
+                peaks[stage] = max(peaks.get(stage, 0), in_use)
+    _obs_gauge("xprof_device_bytes_in_use", in_use)
+    _obs_gauge("xprof_device_peak_bytes", _memory["peak_bytes"])
+    return in_use
+
+
+# ------------------------------------------------------------ snapshot
+
+def _site_row(site: _Site) -> Dict[str, Any]:
+    occupancy = (
+        site.real_rows / site.padded_rows if site.padded_rows else None
+    )
+    flops_total = 0.0
+    bytes_total = 0.0
+    costed = False
+    for sig, cost in site.sig_cost.items():
+        calls = site.sig_calls.get(sig, 0)
+        if "flops" in cost:
+            flops_total += cost["flops"] * calls
+            costed = True
+        if "bytes_accessed" in cost:
+            bytes_total += cost["bytes_accessed"] * calls
+    return {
+        "calls": site.calls,
+        "compiles": site.compiles,
+        "retraces": site.retraces,
+        "compile_s": round(site.compile_s, 6),
+        "signatures": dict(site.signatures),
+        "retrace_signatures": [dict(e) for e in site.retrace_examples],
+        "cost_per_signature": {k: dict(v) for k, v in site.sig_cost.items()},
+        "dispatches": site.dispatches,
+        "real_rows": site.real_rows,
+        "padded_rows": site.padded_rows,
+        "occupancy": round(occupancy, 6) if occupancy is not None else None,
+        "est_flops_total": flops_total if costed else None,
+        "est_bytes_accessed_total": bytes_total if costed else None,
+    }
+
+
+def snapshot(lock_timeout: Optional[float] = None) -> Dict[str, Any]:
+    """The whole registry as one JSON-safe dict (flight-record sized).
+
+    ``lock_timeout`` bounds the lock wait for callers on a death path
+    (``obs.flight_dump`` runs inside a signal handler that may have
+    interrupted a thread holding this very lock — an unbounded acquire
+    would deadlock the handler and lose the flight record). On timeout
+    the snapshot degrades to a lockless best effort; a racing mutation
+    degrades it further to empty, never to a hang or a raise.
+    """
+    if lock_timeout is None:
+        acquired = _lock.acquire()
+    else:
+        acquired = _lock.acquire(timeout=lock_timeout)
+    try:
+        try:
+            rows = {name: _site_row(site) for name, site in _sites.items()}
+            for name in list(_declared):
+                if name not in rows:
+                    rows[name] = _site_row(_Site(name))
+            declared = sorted(_declared)
+            ledger = _ledger_totals_locked()
+            memory = dict(_memory)
+            memory["stage_peaks"] = dict(memory["stage_peaks"])
+            unattributed = {
+                "compiles": _unattributed_compiles,
+                "compile_s": round(_unattributed_compile_s, 6),
+            }
+        except RuntimeError:  # lockless snapshot raced a mutation
+            rows, declared, ledger, memory = {}, [], {}, {}
+            unattributed = {"compiles": 0, "compile_s": 0.0}
+    finally:
+        if acquired:
+            _lock.release()
+    return {
+        "version": 1,
+        "sites": rows,
+        "declared_sites": declared,
+        "ledger": ledger,
+        "memory": memory,
+        "unattributed": unattributed,
+    }
+
+
+def has_data() -> bool:
+    """Whether anything at all has been recorded or declared.
+
+    Deliberately lockless (container truthiness reads are atomic): the
+    flight-record death path calls this from a signal handler that must
+    never block on the registry lock.
+    """
+    return bool(_sites or _declared or _ledger or _memory["samples"])
+
+
+def reset() -> None:
+    """Clear the registry, ledger, and watermarks (tests)."""
+    global _unattributed_compiles, _unattributed_compile_s
+    with _lock:
+        _sites.clear()
+        _declared.clear()
+        _ledger.clear()
+        _unattributed_compiles = 0
+        _unattributed_compile_s = 0.0
+        _memory.update(
+            supported=None, source=None, samples=0, peak_bytes=0,
+            peak_stage=None, stage_peaks={},
+        )
+
+
+def dump(path: str, worker: Optional[str] = None) -> Optional[str]:
+    """Persist the snapshot atomically (tmp + replace); returns the path."""
+    data = snapshot()
+    if worker:
+        data["worker"] = worker
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, separators=(",", ":"))
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return None
+    return path
+
+
+# ------------------------------------------------- load / merge / report
+
+def _filename_worker(path: str) -> Optional[str]:
+    base = os.path.basename(path)
+    for prefix in ("xprof.", "flight."):
+        if base.startswith(prefix):
+            inner = base[len(prefix):].rsplit(".", 1)[0]
+            if inner and inner not in ("json", "jsonl"):
+                return inner
+    return None
+
+
+def load_registries(run_dir: str) -> List[Dict[str, Any]]:
+    """Every worker registry under a run dir (one level deep, like fleet).
+
+    Reads ``xprof[.<worker>].json`` dumps and the ``xprof`` section of
+    ``flight.<worker>.jsonl`` records (a crashed worker's only copy). A
+    worker with both keeps the exit dump — it is a superset of the flight
+    snapshot. Unreadable files are skipped, never fatal.
+    """
+    run_dir = os.path.abspath(run_dir)
+    roots = [run_dir] + sorted(
+        p
+        for p in _glob.glob(os.path.join(run_dir, "*"))
+        if os.path.isdir(p)
+    )
+    registries: List[Dict[str, Any]] = []
+    seen_workers: Dict[str, int] = {}
+    for root in roots:
+        for path in sorted(_glob.glob(os.path.join(root, "xprof*.json"))):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(data, dict) or "sites" not in data:
+                continue
+            data.setdefault(
+                "worker", _filename_worker(path) or "unknown"
+            )
+            data["path"] = path
+            seen_workers[str(data["worker"])] = 1
+            named = _filename_worker(path)
+            if named:
+                # dedup against a flight record by EITHER identity: the
+                # capture filename and the registry's own worker field can
+                # legitimately differ (explicit worker= on dump)
+                seen_workers[named] = 1
+            registries.append(data)
+    for root in roots:
+        for path in sorted(_glob.glob(os.path.join(root, "flight.*.jsonl"))):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    first = f.readline()
+                meta = json.loads(first)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(meta, dict) or meta.get("meta") != "flight":
+                continue
+            data = meta.get("xprof")
+            if not isinstance(data, dict) or "sites" not in data:
+                continue
+            worker = str(
+                meta.get("worker") or _filename_worker(path) or "unknown"
+            )
+            named = _filename_worker(path)
+            if worker in seen_workers or (named and named in seen_workers):
+                continue  # the exit dump supersedes the flight copy
+            data = dict(data)
+            data["worker"] = worker
+            data["path"] = path
+            data["from_flight"] = True
+            registries.append(data)
+    return registries
+
+
+def merge_registries(registries: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Sum per-site stats, ledgers, and watermarks across workers."""
+    sites: Dict[str, Dict[str, Any]] = {}
+    ledger: Dict[str, Dict[str, Any]] = {}
+    declared: set = set()
+    memory = {"peak_bytes": 0, "peak_stage": None, "peak_worker": None,
+              "samples": 0, "supported": False}
+    unattributed = 0
+    for registry in registries:
+        declared.update(registry.get("declared_sites") or [])
+        for name, row in (registry.get("sites") or {}).items():
+            merged = sites.setdefault(
+                name,
+                {
+                    "calls": 0, "compiles": 0, "retraces": 0,
+                    "compile_s": 0.0, "dispatches": 0, "real_rows": 0,
+                    "padded_rows": 0, "signatures": {},
+                    "retrace_signatures": [], "est_flops_total": None,
+                    "est_bytes_accessed_total": None, "workers": [],
+                },
+            )
+            for key in ("calls", "compiles", "retraces", "dispatches",
+                        "real_rows", "padded_rows"):
+                merged[key] += int(row.get(key) or 0)
+            merged["compile_s"] += float(row.get("compile_s") or 0.0)
+            for sig, count in (row.get("signatures") or {}).items():
+                merged["signatures"][sig] = (
+                    merged["signatures"].get(sig, 0) + int(count)
+                )
+            merged["retrace_signatures"].extend(
+                row.get("retrace_signatures") or []
+            )
+            for key in ("est_flops_total", "est_bytes_accessed_total"):
+                value = row.get(key)
+                if isinstance(value, (int, float)):
+                    merged[key] = (merged[key] or 0.0) + float(value)
+            worker = str(registry.get("worker", "unknown"))
+            if worker not in merged["workers"]:
+                merged["workers"].append(worker)
+        for direction, total in (registry.get("ledger") or {}).items():
+            out = ledger.setdefault(
+                direction,
+                {"bytes": 0, "seconds": 0.0, "events": 0, "by_site": {}},
+            )
+            out["bytes"] += int(total.get("bytes") or 0)
+            out["seconds"] += float(total.get("seconds") or 0.0)
+            out["events"] += int(total.get("events") or 0)
+            for site, entry in (total.get("by_site") or {}).items():
+                slot = out["by_site"].setdefault(
+                    site, {"bytes": 0, "seconds": 0.0, "events": 0}
+                )
+                slot["bytes"] += int(entry.get("bytes") or 0)
+                slot["seconds"] += float(entry.get("seconds") or 0.0)
+                slot["events"] += int(entry.get("events") or 0)
+        mem = registry.get("memory") or {}
+        memory["samples"] += int(mem.get("samples") or 0)
+        memory["supported"] = memory["supported"] or bool(mem.get("supported"))
+        peak = int(mem.get("peak_bytes") or 0)
+        if peak > memory["peak_bytes"]:
+            memory["peak_bytes"] = peak
+            memory["peak_stage"] = mem.get("peak_stage")
+            memory["peak_worker"] = registry.get("worker")
+        unattributed += int(
+            (registry.get("unattributed") or {}).get("compiles") or 0
+        )
+    for row in sites.values():
+        padded = row["padded_rows"]
+        row["occupancy"] = row["real_rows"] / padded if padded else None
+    return {
+        "sites": sites,
+        "declared_sites": sorted(declared),
+        "ledger": ledger,
+        "memory": memory,
+        "unattributed_compiles": unattributed,
+    }
+
+
+def efficiency_report(run_dir: str) -> Dict[str, Any]:
+    """The merged device-efficiency view of one (traced) run directory."""
+    registries = load_registries(run_dir)
+    merged = merge_registries(registries)
+    warnings: List[str] = []
+    if not registries:
+        warnings.append(
+            f"no xprof registries under {run_dir}: run with "
+            "SCTOOLS_TPU_TRACE set (the capture dumps xprof[.worker].json "
+            "at exit)"
+        )
+    total_real = sum(r["real_rows"] for r in merged["sites"].values())
+    total_padded = sum(r["padded_rows"] for r in merged["sites"].values())
+    wasted_flops = 0.0
+    for row in merged["sites"].values():
+        flops = row.get("est_flops_total")
+        occupancy = row.get("occupancy")
+        if isinstance(flops, (int, float)) and occupancy is not None:
+            wasted_flops += flops * (1.0 - occupancy)
+    ledger = merged["ledger"]
+    # measured link rate: TIMED entries only. Most ledger entries carry
+    # bytes with seconds=0 (async dispatches are not honestly timeable);
+    # dividing the whole direction's bytes by only the probes' seconds
+    # would inflate the roofline by the untimed bulk.
+    link = {}
+    for direction, total in ledger.items():
+        timed_bytes = sum(
+            entry["bytes"]
+            for entry in total["by_site"].values()
+            if entry["seconds"] > 0
+        )
+        timed_seconds = sum(
+            entry["seconds"]
+            for entry in total["by_site"].values()
+            if entry["seconds"] > 0
+        )
+        if timed_seconds > 0:
+            link[f"{direction}_MBps"] = round(
+                timed_bytes / timed_seconds / 1e6, 1
+            )
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "workers": sorted(
+            {str(r.get("worker", "unknown")) for r in registries}
+        ),
+        "registries": [
+            {
+                "worker": str(r.get("worker", "unknown")),
+                "path": r.get("path"),
+                "from_flight": bool(r.get("from_flight")),
+            }
+            for r in registries
+        ],
+        "sites": merged["sites"],
+        "declared_sites": merged["declared_sites"],
+        "ledger": ledger,
+        "measured_link": link,
+        "memory": merged["memory"],
+        "totals": {
+            "compiles": sum(
+                r["compiles"] for r in merged["sites"].values()
+            ),
+            "retraces": sum(
+                r["retraces"] for r in merged["sites"].values()
+            ),
+            "real_rows": total_real,
+            "padded_rows": total_padded,
+            "occupancy": (
+                total_real / total_padded if total_padded else None
+            ),
+            "est_wasted_flops": wasted_flops,
+            "unattributed_compiles": merged["unattributed_compiles"],
+        },
+        "warnings": warnings,
+    }
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if not n:
+        return "-"
+    return f"{n / 1e6:.1f}"
+
+
+def _fmt_flops(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    for unit, scale in (("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if n >= scale:
+            return f"{n / scale:.1f}{unit}"
+    return f"{n:.0f}"
+
+
+def render_efficiency(report: Dict[str, Any]) -> str:
+    """The human-facing ``obs efficiency`` report."""
+    lines: List[str] = []
+    lines.append(f"device efficiency: {report['run_dir']}")
+    workers = report["workers"]
+    totals = report["totals"]
+    lines.append(
+        f"{len(workers)} worker registr{'y' if len(workers) == 1 else 'ies'}"
+        f" ({', '.join(workers) or 'none'}); "
+        f"{totals['compiles']} compile(s), {totals['retraces']} retrace(s)"
+        + (
+            f", {totals['unattributed_compiles']} unattributed compile(s)"
+            if totals["unattributed_compiles"]
+            else ""
+        )
+    )
+    lines.append("")
+    sites = report["sites"]
+    if sites:
+        headers = (
+            "call site", "calls", "compiles", "retraces", "compile_s",
+            "occupancy", "wasted", "est FLOPs", "wasted FLOPs",
+        )
+        table = [headers]
+        for name in sorted(
+            sites, key=lambda n: -(sites[n].get("est_flops_total") or 0)
+        ):
+            row = sites[name]
+            occupancy = row.get("occupancy")
+            flops = row.get("est_flops_total")
+            wasted = (
+                flops * (1.0 - occupancy)
+                if isinstance(flops, (int, float)) and occupancy is not None
+                else None
+            )
+            table.append(
+                (
+                    name,
+                    str(row["calls"]),
+                    str(row["compiles"]),
+                    str(row["retraces"]),
+                    f"{row['compile_s']:.3f}",
+                    f"{100 * occupancy:.1f}%" if occupancy is not None else "-",
+                    (
+                        f"{100 * (1 - occupancy):.1f}%"
+                        if occupancy is not None
+                        else "-"
+                    ),
+                    _fmt_flops(flops),
+                    _fmt_flops(wasted),
+                )
+            )
+        widths = [max(len(r[i]) for r in table) for i in range(len(headers))]
+        for index, row in enumerate(table):
+            lines.append(
+                "  ".join(
+                    cell.ljust(widths[i]) if i == 0 else cell.rjust(widths[i])
+                    for i, cell in enumerate(row)
+                )
+            )
+            if index == 0:
+                lines.append("  ".join("-" * w for w in widths))
+        lines.append("")
+        for name in sorted(sites):
+            for example in sites[name].get("retrace_signatures") or []:
+                signature = str(example.get("signature", "?"))
+                if len(signature) > 200:  # display only; registries are exact
+                    signature = signature[:200] + "…"
+                lines.append(
+                    f"retrace: {name} x{example.get('count', 1)} "
+                    f"triggered by {signature}"
+                )
+        if any(s.get("retrace_signatures") for s in sites.values()):
+            lines.append("")
+    ledger = report["ledger"]
+    if ledger:
+        lines.append("transfer ledger:")
+        measured = report.get("measured_link") or {}
+        for direction in sorted(ledger):
+            total = ledger[direction]
+            # rate from timed entries only (efficiency_report computes
+            # it); untimed bulk bytes must not inflate the roofline
+            rate = ""
+            if f"{direction}_MBps" in measured:
+                rate = f" @ {measured[f'{direction}_MBps']} MB/s measured"
+            lines.append(
+                f"  {direction}: {_fmt_bytes(total['bytes'])} MB in "
+                f"{total['events']} transfer(s){rate}"
+            )
+            for site in sorted(total["by_site"]):
+                entry = total["by_site"][site]
+                lines.append(
+                    f"    {site}: {_fmt_bytes(entry['bytes'])} MB "
+                    f"({entry['events']})"
+                )
+        lines.append("")
+    if totals["padded_rows"]:
+        lines.append(
+            f"overall occupancy: {100 * totals['occupancy']:.1f}% "
+            f"({totals['real_rows']} real rows of {totals['padded_rows']} "
+            f"dispatched; est {_fmt_flops(totals['est_wasted_flops'])} "
+            "FLOPs spent on padding)"
+        )
+    memory = report["memory"]
+    if memory.get("samples"):
+        stage = memory.get("peak_stage") or "-"
+        worker = memory.get("peak_worker") or "-"
+        lines.append(
+            f"device memory peak: {_fmt_bytes(memory['peak_bytes'])} MB "
+            f"(stage {stage}, worker {worker}, "
+            f"{memory['samples']} sample(s))"
+        )
+    elif memory.get("supported") is False:
+        lines.append(
+            "device memory: backend exposes no memory_stats/live_arrays; "
+            "watermarks unavailable"
+        )
+    for warning in report["warnings"]:
+        lines.append(f"warning: {warning}")
+    return "\n".join(lines).rstrip() + "\n"

@@ -24,12 +24,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import xprof
 from . import segments as seg
 
 _I32_MAX = np.iinfo(np.int32).max
 
 
-@functools.partial(jax.jit, static_argnames=("num_segments",))
+@functools.partial(
+    xprof.instrument_jit,
+    name="ops.count_molecules",
+    static_argnames=("num_segments",),
+)
 def count_molecules(cols: Dict[str, jnp.ndarray], num_segments: int):
     """Unique (cell, molecule, gene) triples from query-name groups.
 

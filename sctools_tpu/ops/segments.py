@@ -239,7 +239,10 @@ def bucket_size(n: int, minimum: int = 4096) -> int:
     """Power-of-two padded size >= max(n, minimum).
 
     Bucketing record counts to powers of two bounds the number of distinct
-    compiled shapes (jit specializes per shape) while wasting at most 2x.
+    compiled shapes (jit specializes per shape) while wasting at most 2x:
+    for n >= minimum the result is < 2n (property-tested by
+    tests/test_xprof.py; the live waste per dispatch is what scx-xprof's
+    occupancy telemetry measures).
     """
     size = minimum
     while size < n:

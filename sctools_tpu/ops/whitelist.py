@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import xprof
+
 _BASE_TO_COL = {"A": 0, "C": 1, "G": 2, "T": 3}
 # byte value -> one-hot column (A=0 C=1 G=2 T=3); 4 = no column. Uppercase
 # ACGT only: the reference's mutation map is case-sensitive (barcode.py:
@@ -66,7 +68,11 @@ def onehot_barcodes(barcodes: Sequence[str], length: int) -> np.ndarray:
     return out[:, :, :4].reshape(n, length * 4)
 
 
-@functools.partial(jax.jit, static_argnames=("length",))
+@functools.partial(
+    xprof.instrument_jit,
+    name="whitelist.correct_jnp",
+    static_argnames=("length",),
+)
 def _correct_jnp(queries_onehot, whitelist_onehot, length: int):
     scores = jnp.dot(
         queries_onehot, whitelist_onehot.T, preferred_element_type=jnp.float32
@@ -101,7 +107,9 @@ def _pallas_kernel(q_ref, w_ref, out_ref, *, length: int, tile_w: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("length", "tile_q", "tile_w", "interpret")
+    xprof.instrument_jit,
+    name="whitelist.correct_pallas",
+    static_argnames=("length", "tile_q", "tile_w", "interpret"),
 )
 def _correct_pallas(
     queries_onehot,
@@ -179,8 +187,11 @@ class WhitelistCorrector:
         # padded once: the whitelist matrix is invariant across batches, and
         # zero-padded rows score 0 (< L-1) so they can never hit
         w_onehot = onehot_barcodes(whitelist, self._length)
-        self._w_onehot = jax.device_put(
-            _pad_rows(w_onehot, 2048) if use_pallas else w_onehot
+        if use_pallas:
+            w_onehot = _pad_rows(w_onehot, 2048)
+        self._w_onehot = jax.device_put(w_onehot)
+        xprof.record_transfer(
+            "h2d", w_onehot.nbytes, site="whitelist.table"
         )
 
     @classmethod
@@ -199,6 +210,12 @@ class WhitelistCorrector:
         # queries are padded to one compiled batch shape; padded rows are
         # sliced off, so every batch size reuses a single executable
         q = _pad_rows(onehot_barcodes(barcodes, self._length), 256)
+        site = (
+            "whitelist.correct_pallas" if self._use_pallas
+            else "whitelist.correct_jnp"
+        )
+        xprof.record_dispatch(site, len(barcodes), q.shape[0])
+        xprof.record_transfer("h2d", q.nbytes, site="whitelist.queries")
         if self._use_pallas:
             result = _correct_pallas(
                 q, self._w_onehot, self._length, interpret=self._interpret
@@ -206,6 +223,7 @@ class WhitelistCorrector:
         else:
             result = _correct_jnp(q, self._w_onehot, self._length)[: len(barcodes)]
         result = np.asarray(result)
+        xprof.record_transfer("d2h", result.nbytes, site="whitelist.queries")
         # the reference hash map has no keys of other lengths: a query whose
         # length differs can never correct (a one-short query would otherwise
         # pass the >= L-1 threshold via truncation)

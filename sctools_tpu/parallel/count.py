@@ -19,6 +19,7 @@ from typing import Dict
 import jax
 import numpy as np
 
+from ..obs import xprof
 from ..ops.counting import count_molecules
 from ..platform import shard_map
 from .mesh import DEFAULT_AXIS
@@ -60,4 +61,4 @@ def _build_sharded_count(mesh, axis_name: str, shard_size: int):
         )
         return _expand_local(out)
 
-    return jax.jit(run)
+    return xprof.instrument_jit(run, name="parallel.sharded_count")

@@ -22,6 +22,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import obs
+from ..obs import xprof
 from ..io.packed import KEY_HI_SHIFT
 from ..sched import faults
 from ..metrics.gatherer import (
@@ -96,10 +97,23 @@ class _ShardedMixin:
             batch_h2d = sum(v.nbytes for v in stacked.values())
             self.bytes_h2d += batch_h2d
             up.add(bytes=batch_h2d, prepacked=int(prepacked))
+            # same ledger site as the single-device path: "bytes the
+            # gatherer uploaded" is one series however the batch shipped
+            xprof.record_transfer("h2d", batch_h2d, site="gatherer.upload")
         obs.count("batches_uploaded")
         obs.count("h2d_bytes", batch_h2d)
         shard_size = max(v.shape[1] for v in stacked.values())
-        with obs.span("compute", records=frame.n_records):
+        xprof.record_dispatch(
+            "parallel.sharded_metrics",
+            frame.n_records,
+            self._n_shards * shard_size,
+        )
+        with obs.span(
+            "compute",
+            records=frame.n_records,
+            real_rows=frame.n_records,
+            padded_rows=self._n_shards * shard_size,
+        ):
             # per-shard entity counts are host-knowable (distinct codes
             # routed to each shard), so each shard compacts its rows ON
             # DEVICE into the same fused int32 block the single-device path
@@ -132,6 +146,10 @@ class _ShardedMixin:
             batch_d2h = blocks.nbytes + n_entities.nbytes
             self.bytes_d2h += batch_d2h
             wb.add(bytes=batch_d2h)
+            xprof.record_transfer(
+                "d2h", batch_d2h, site="gatherer.writeback"
+            )
+            xprof.sample_memory()
             obs.count("d2h_bytes", batch_d2h)
             rows = np.concatenate(
                 [

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..metrics.device import compute_entity_metrics
+from ..obs import xprof
 from ..ops import segments as seg
 from ..platform import shard_map
 from .mesh import DEFAULT_AXIS
@@ -201,14 +202,15 @@ def _build_sharded_metrics(
         return block[None], out["n_entities"][None]
 
     out_specs = P(axis_name) if compact is None else (P(axis_name), P(axis_name))
-    return jax.jit(
+    return xprof.instrument_jit(
         shard_map(
             run,
             mesh=mesh,
             in_specs=(P(axis_name),),
             out_specs=out_specs,
             check_vma=False,
-        )
+        ),
+        name="parallel.sharded_metrics",
     )
 
 
@@ -375,7 +377,7 @@ def _build_distributed_step(
         )
         return _expand_local(cell_out), _expand_local(gene_out), dropped[None]
 
-    return jax.jit(step)
+    return xprof.instrument_jit(step, name="parallel.metrics_step")
 
 
 def hybrid_metrics_step(

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..obs import xprof
 from ..ops import segments as seg
 from ..platform import shard_map
 from .metrics import P, _check_shard_count, reshard_by_key
@@ -219,7 +220,7 @@ def _build_sample_sort(
             n_dropped[None],
         )
 
-    return jax.jit(run)
+    return xprof.instrument_jit(run, name="parallel.sample_sort")
 
 
 def distributed_sort(
@@ -262,10 +263,18 @@ def distributed_sort(
                 # Computed only while recording: the scan (and a possible
                 # device pull of the valid column) must not ride the
                 # disabled serving path.
+                real_records = int(
+                    np.count_nonzero(np.asarray(stacked_cols["valid"]))
+                )
                 sort_span.add(
-                    records=int(
-                        np.count_nonzero(np.asarray(stacked_cols["valid"]))
-                    )
+                    records=real_records,
+                    real_rows=real_records,
+                    padded_rows=n_shards * shard_size,
+                )
+                xprof.record_dispatch(
+                    "parallel.sample_sort",
+                    real_records,
+                    n_shards * shard_size,
                 )
             with obs.span("distributed:sort_capacity"):
                 required = required_sort_capacity(

@@ -72,9 +72,50 @@ def _status(journal_dir: str, out, journal: Optional[Journal] = None) -> int:
             print("  ".join("-" * w for w in widths), file=out)
     summary = ", ".join(f"{k}={v}" for k, v in sorted(totals.items()))
     print(f"total={len(tasks)} ({summary})", file=out)
+    _print_efficiency_summary(journal_dir, out)
     if totals.get(QUARANTINED):
         return 2
     return 0 if totals.get(COMMITTED, 0) == len(tasks) else 1
+
+
+def _print_efficiency_summary(journal_dir: str, out) -> None:
+    """One scx-xprof line when the run dir carries worker registries.
+
+    The journal conventionally lives at ``<run>/sched-journal``, with the
+    trace capture (and its ``xprof[.<worker>].json`` dumps) under the same
+    run dir — an operator reading ``sched status`` mid-incident gets the
+    device-side headline (occupancy, retraces, bytes moved) without
+    switching tools; ``python -m sctools_tpu.obs efficiency <run>`` has
+    the full per-call-site report.
+    """
+    from ..obs import xprof
+
+    run_dir = os.path.dirname(os.path.abspath(journal_dir)) or "."
+    try:
+        registries = xprof.load_registries(run_dir)
+        if not registries:
+            return
+        merged = xprof.merge_registries(registries)
+        real = sum(r["real_rows"] for r in merged["sites"].values())
+        padded = sum(r["padded_rows"] for r in merged["sites"].values())
+        retraces = sum(r["retraces"] for r in merged["sites"].values())
+        moved = sum(
+            total["bytes"] for total in merged["ledger"].values()
+        )
+        occupancy = f"{100 * real / padded:.1f}%" if padded else "-"
+        line = (
+            f"device: occupancy={occupancy} retraces={retraces} "
+            f"transfer={moved / 1e6:.1f}MB "
+            f"({len(registries)} xprof registr"
+            f"{'y' if len(registries) == 1 else 'ies'}; "
+            "`python -m sctools_tpu.obs efficiency` for the per-site "
+            "report)"
+        )
+    except Exception:  # noqa: BLE001 - status must never die on telemetry
+        # a torn/hand-edited registry is a telemetry problem, never a
+        # reason to lose the journal status an operator came for
+        return
+    print(line, file=out)
 
 
 def _read_leases(leases_dir: str) -> List[dict]:

@@ -1,4 +1,5 @@
 """SCX101 negative: device math in traced code, host syncs outside it."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import jax
 import jax.numpy as jnp

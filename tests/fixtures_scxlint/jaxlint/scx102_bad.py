@@ -1,4 +1,5 @@
 """SCX102 positive: Python control flow on traced values."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import jax
 

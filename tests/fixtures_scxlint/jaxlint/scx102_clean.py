@@ -1,4 +1,5 @@
 """SCX102 negative: branches on static args, None checks, shape reads."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import functools
 

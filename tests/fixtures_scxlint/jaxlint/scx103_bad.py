@@ -1,4 +1,5 @@
 """SCX103 positive: scalar/shape params traced instead of static."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import jax
 

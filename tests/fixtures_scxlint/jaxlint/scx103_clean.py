@@ -1,4 +1,5 @@
 """SCX103 negative: scalar/shape params declared static."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import functools
 

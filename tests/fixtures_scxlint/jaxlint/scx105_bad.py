@@ -1,4 +1,5 @@
 """SCX105 positive: functional param update without donation."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import jax
 

@@ -1,4 +1,5 @@
 """SCX105 negative: the updated buffer is donated."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import functools
 

@@ -1,4 +1,5 @@
 """SCX107 positive: jit construction inside a host loop."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import jax
 

@@ -1,4 +1,5 @@
 """SCX107 negative: the jit callable is hoisted out of the loop."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import jax
 

@@ -1,4 +1,5 @@
 """SCX108 positive: print inside a traced function."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import jax
 

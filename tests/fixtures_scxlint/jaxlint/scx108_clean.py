@@ -1,4 +1,5 @@
 """SCX108 negative: jax.debug.print traces correctly."""
+# scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
 
 import jax
 

@@ -1,0 +1,19 @@
+"""SCX111 positive fixture: bare jax.jit spellings outside the shim."""
+import functools
+
+import jax
+from jax import jit  # noqa: F401
+
+
+@jax.jit
+def doubled(x):
+    return x * 2
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows",))
+def padded(x, n_rows):
+    return x[:n_rows]
+
+
+def build(fn):
+    return jax.jit(fn)

@@ -1,0 +1,32 @@
+"""SCX111 negative fixture: every jit rides the instrumentation shim.
+
+The last function shows the inline escape hatch for the rare deliberate
+bare jit (e.g. a REPL-only experiment file).
+"""
+import functools
+
+import jax
+from sctools_tpu.obs.xprof import instrument_jit
+from sctools_tpu.obs import xprof
+
+
+@functools.partial(
+    xprof.instrument_jit, name="fixture.doubled"
+)
+def doubled(x):
+    return x * 2
+
+
+@functools.partial(
+    instrument_jit, name="fixture.padded", static_argnames=("n_rows",)
+)
+def padded(x, n_rows):
+    return x[:n_rows]
+
+
+def build(fn):
+    return xprof.instrument_jit(fn, name="fixture.built")
+
+
+def build_escaped(fn):
+    return jax.jit(fn)  # scx-lint: disable=SCX111 -- deliberate bare jit

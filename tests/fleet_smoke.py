@@ -110,7 +110,7 @@ def main() -> int:
     assert flights, "crashed worker left no flight record"
 
     # ---- the fleet view, via the real CLI
-    from sctools_tpu.obs.fleet import analyze, discover
+    from sctools_tpu.obs.fleet import analyze, discover, render_timeline
 
     run = discover(workdir)
     analysis = analyze(run)
@@ -142,6 +142,16 @@ def main() -> int:
             f"(span workers: {row['span_workers']})"
         )
         assert row["worker"] in row["span_workers"], (name, row)
+        # scx-xprof columns: the committing lineage's dispatch spans carry
+        # real/padded rows and transfer bytes, so the timeline's occupancy
+        # column must be populated for every committed task
+        assert row["occupancy"] is not None and 0 < row["occupancy"] <= 1, (
+            f"committed task {name} has no occupancy in the timeline: {row}"
+        )
+        assert row["transfer_bytes"] > 0, (name, row)
+    assert "occ%" in render_timeline(run, analysis), (
+        "occupancy column missing from the rendered timeline"
+    )
 
     # the steal is visible in the merged view
     total_steals = sum(

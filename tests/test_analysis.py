@@ -34,7 +34,7 @@ ABI_BAD = os.path.join(FIXTURES, "abi", "bad")
 SUPP = os.path.join(FIXTURES, "supp")
 NATIVE = os.path.join(REPO, "sctools_tpu", "native")
 
-JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)] + ["SCX110"]
+JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)] + ["SCX110", "SCX111"]
 
 
 # --------------------------------------------------------------- jax lint
@@ -65,6 +65,7 @@ def test_inline_and_file_suppressions():
 def test_suppression_is_rule_specific(tmp_path):
     # suppressing a DIFFERENT rule must not silence the finding
     src = (
+        "# scx-lint: disable-file=SCX111\n"
         "import jax\n\n"
         "@jax.jit\n"
         "def f(x):\n"
@@ -79,6 +80,7 @@ def test_suppression_is_rule_specific(tmp_path):
 def test_import_jax_numpy_binds_root_package(tmp_path):
     # `import jax.numpy` binds the ROOT name: jax.jit must still be seen
     src = (
+        "# scx-lint: disable-file=SCX111\n"
         "import jax.numpy\n\n"
         "@jax.jit\n"
         "def f(x):\n"
@@ -91,6 +93,7 @@ def test_import_jax_numpy_binds_root_package(tmp_path):
 
 def test_comment_above_decorator_suppresses_function_finding(tmp_path):
     src = (
+        "# scx-lint: disable-file=SCX111\n"
         "import jax\n\n"
         "# scx-lint: disable=SCX103 -- shape param is deliberately traced\n"
         "@jax.jit\n"
@@ -102,8 +105,32 @@ def test_comment_above_decorator_suppresses_function_finding(tmp_path):
     assert lint_file(str(path)) == []
 
 
+def test_instrument_jit_is_a_traced_context(tmp_path):
+    # the SCX111 shim must not blind the traced-context rules: a function
+    # wrapped with xprof.instrument_jit still gets SCX101/SCX103 coverage
+    # (and its static_argnames are honored), exactly as if it were jit
+    src = (
+        "import functools\n"
+        "from sctools_tpu.obs import xprof\n\n"
+        "@functools.partial(\n"
+        "    xprof.instrument_jit, name='x', static_argnames=('kind',)\n"
+        ")\n"
+        "def f(x, kind, n_records):\n"
+        "    return x[:n_records].sum().item()\n"
+    )
+    path = tmp_path / "instrumented.py"
+    path.write_text(src)
+    rules = sorted({f.rule for f in lint_file(str(path))})
+    assert rules == ["SCX101", "SCX103"], rules
+    # the `kind` static name is honored: no SCX103 about `kind`
+    assert not any(
+        "`kind`" in f.message for f in lint_file(str(path))
+    )
+
+
 def test_log_named_array_is_not_a_logging_call(tmp_path):
     src = (
+        "# scx-lint: disable-file=SCX111\n"
         "import jax\n"
         "import jax.numpy as jnp\n\n"
         "@jax.jit\n"

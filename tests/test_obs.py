@@ -321,14 +321,43 @@ def test_summarize_cli_on_recorded_fixture(tmp_path, capsys):
 
 
 def test_summarize_cli_json_mode(tmp_path, capsys):
+    # one JSON object: stage rows + the counter snapshots and xprof
+    # registries sitting next to the trace (dashboards get spans,
+    # counters, and the compile registry from a single invocation)
     trace = tmp_path / "trace.jsonl"
     trace.write_text(
         json.dumps({"name": "x", "dur": 1.0, "attrs": {"records": 5}}) + "\n"
         + "not json\n"
     )
+    (tmp_path / "metrics.prom").write_text(
+        "# TYPE sctools_tpu_h2d_bytes_total counter\n"
+        "sctools_tpu_h2d_bytes_total 123\n"
+    )
+    (tmp_path / "xprof.p0.json").write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "worker": "p0",
+                "sites": {
+                    "metrics.compute_entity_metrics": {
+                        "calls": 4, "compiles": 1, "retraces": 0,
+                        "compile_s": 0.5, "dispatches": 4,
+                        "real_rows": 64, "padded_rows": 128,
+                        "signatures": {"(int32[128])": 1},
+                    }
+                },
+            }
+        )
+    )
     assert obs_cli(["summarize", str(trace), "--json"]) == 0
-    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
-    assert rows[0]["name"] == "x" and rows[0]["records"] == 5
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["stages"][0]["name"] == "x"
+    assert payload["stages"][0]["records"] == 5
+    assert payload["spans"] == 1 and payload["files"] == 1
+    counters = next(iter(payload["counters"].values()))
+    assert counters["sctools_tpu_h2d_bytes_total"] == 123
+    registry = payload["compile_registry"]["metrics.compute_entity_metrics"]
+    assert registry["compiles"] == 1 and registry["occupancy"] == 0.5
 
 
 def test_summarize_cli_missing_and_empty(tmp_path, capsys):

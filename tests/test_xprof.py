@@ -1,0 +1,458 @@
+"""scx-xprof: call-site registry, occupancy, transfer ledger, watermarks.
+
+The acceptance surface of the device-efficiency layer:
+
+- the instrument_jit registry counts calls/compiles and classifies a
+  compile on an already-seen signature as a retrace (with the triggering
+  signature recorded);
+- occupancy conservation: per-dispatch real rows sum to exactly the
+  records the gatherer's batch/tail paths processed — no record counted
+  twice, none invisible;
+- the transfer ledger reconciles byte-for-byte with the gatherer's own
+  ``bytes_h2d``/``bytes_d2h`` accounting (one source of truth);
+- the ``bucket_size`` <= 2x-waste claim (ops/segments.py) holds as a
+  property, not an anecdote;
+- registries dump/load/merge and render through ``obs efficiency``;
+- the flight record carries the registry (a crashed worker's compile
+  history survives os._exit);
+- the fleet timeline derives per-task occupancy from the dispatch spans.
+"""
+
+import json
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from helpers import make_record, write_bam
+
+from sctools_tpu import obs
+from sctools_tpu.obs import xprof
+from sctools_tpu.ops.segments import bucket_size, pad_to
+
+
+@pytest.fixture
+def recording():
+    """Recording on, registry clean; restored afterwards."""
+    obs.enable()
+    obs.reset()
+    xprof.reset()
+    yield
+    obs.disable()
+    obs.reset()
+    xprof.reset()
+
+
+def _small_bam(path, n_cells=24, molecules=2, reads=2):
+    records = []
+    for c in range(n_cells):
+        for m in range(molecules):
+            for r in range(reads):
+                records.append(
+                    make_record(
+                        name=f"q{c}_{m}_{r}",
+                        cb=f"CB{c:04d}",
+                        ub=f"UB{m:02d}",
+                        ge=f"GENE{(c + m) % 5:02d}",
+                        xf="25",
+                        nh=1,
+                        pos=100 + 10 * r,
+                        duplicate=r > 0,
+                    )
+                )
+    write_bam(path, records)
+    return n_cells * molecules * reads
+
+
+# ------------------------------------------------------ bucket property
+
+def test_bucket_size_two_x_waste_property():
+    """The <=2x-waste claim, property-tested over random sizes."""
+    rng = random.Random(20260803)
+    sizes = [1, 2, 3, 4095, 4096, 4097, 8191, 8192, 8193] + [
+        rng.randrange(1, 1 << 22) for _ in range(500)
+    ]
+    for n in sizes:
+        for minimum in (1, 8, 4096):
+            size = bucket_size(n, minimum=minimum)
+            # covers the input and the floor
+            assert size >= n and size >= minimum
+            # power of two (bounded compiled-shape count)
+            assert size & (size - 1) == 0, (n, minimum, size)
+            # at most 2x waste once past the floor
+            if n >= minimum:
+                assert size < 2 * n, (n, minimum, size)
+            else:
+                assert size == bucket_size(minimum, minimum=minimum)
+    # monotonic: more records never shrink the bucket
+    previous = 0
+    for n in sorted(rng.randrange(1, 1 << 20) for _ in range(200)):
+        size = bucket_size(n)
+        assert size >= previous
+        previous = size
+
+
+def test_pad_to_property():
+    rng = random.Random(7)
+    for _ in range(200):
+        n = rng.randrange(0, 1 << 16)
+        multiple = rng.randrange(1, 1 << 10)
+        padded = pad_to(n, multiple)
+        assert padded % multiple == 0
+        assert padded >= max(n, 1)
+        assert padded - n < multiple or n <= 0
+
+
+# --------------------------------------------------- registry mechanics
+
+def test_instrument_jit_counts_compiles_and_retraces(recording):
+    calls = {"n": 0}
+
+    def body(x):
+        calls["n"] += 1  # trace-time only: counts compiles, not calls
+        return x * 2 + 1
+
+    fn = xprof.instrument_jit(body, name="test.body")
+    fn(np.ones(8, np.float32))
+    fn(np.ones(8, np.float32))  # cached
+    fn(np.ones(16, np.float32))  # new shape -> compile, NOT a retrace
+    site = xprof.snapshot()["sites"]["test.body"]
+    assert site["calls"] == 3
+    assert site["compiles"] == 2
+    assert site["retraces"] == 0
+    assert set(site["signatures"]) == {"(float32[8])", "(float32[16])"}
+    assert site["compile_s"] > 0
+
+    # a compile for an ALREADY-SEEN signature is a retrace, and the
+    # triggering signature is recorded (clear_cache simulates the cache
+    # eviction / weak-type flapping that causes real ones)
+    fn.clear_cache()
+    fn(np.ones(8, np.float32))
+    site = xprof.snapshot()["sites"]["test.body"]
+    assert site["retraces"] == 1
+    assert site["retrace_signatures"] == [
+        {"signature": "(float32[8])", "count": 1}
+    ]
+
+
+def test_instrument_jit_static_kwargs_in_signature(recording):
+    fn = xprof.instrument_jit(
+        lambda x, k: x[:k], name="test.static", static_argnames=("k",)
+    )
+    fn(np.ones(8, np.float32), k=4)
+    fn(np.ones(8, np.float32), k=2)  # distinct static value -> new sig
+    site = xprof.snapshot()["sites"]["test.static"]
+    assert site["compiles"] == 2 and site["retraces"] == 0
+    assert any("k=4" in sig for sig in site["signatures"])
+    assert any("k=2" in sig for sig in site["signatures"])
+
+
+def test_instrument_jit_cost_analysis(recording):
+    fn = xprof.instrument_jit(lambda x: x * 2 + 1, name="test.cost")
+    fn(np.ones(16, np.float32))
+    site = xprof.snapshot()["sites"]["test.cost"]
+    cost = site["cost_per_signature"].get("(float32[16])")
+    if cost is None:
+        pytest.skip("backend offers no cost_analysis")
+    assert cost["flops"] > 0
+    assert site["est_flops_total"] and site["est_flops_total"] >= cost["flops"]
+
+
+def test_disabled_recording_is_invisible():
+    obs.disable()
+    xprof.reset()
+    fn = xprof.instrument_jit(lambda x: x + 1, name="test.off")
+    fn(np.ones(4, np.float32))
+    xprof.record_dispatch("test.off", 4, 8)
+    xprof.record_transfer("h2d", 100, site="test.off")
+    snap = xprof.snapshot()
+    # declared (decoration is static structure), but zero dynamics
+    assert "test.off" in snap["declared_sites"]
+    assert snap["sites"]["test.off"]["calls"] == 0
+    assert snap["ledger"] == {}
+
+
+def test_record_transfer_validates_direction(recording):
+    with pytest.raises(ValueError):
+        xprof.record_transfer("sideways", 1)
+
+
+# ------------------------------------------ conservation on the gatherer
+
+def test_gatherer_occupancy_and_ledger_conservation(recording, tmp_path):
+    """Occupancy rows sum to the records processed; ledger == gatherer.
+
+    batch_records=24 forces the multi-batch path (capacity cuts + carry)
+    AND the tail path, so the conservation covers both: every record is
+    dispatched exactly once, and every byte the gatherer says it moved is
+    in the ledger under the gatherer's sites.
+    """
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    bam = str(tmp_path / "t.bam")
+    n_records = _small_bam(bam)
+    gatherer = GatherCellMetrics(
+        bam, str(tmp_path / "out"), backend="device", batch_records=24
+    )
+    gatherer.extract_metrics()
+
+    snap = xprof.snapshot()
+    site = snap["sites"]["metrics.compute_entity_metrics"]
+    assert site["real_rows"] == n_records, (
+        f"occupancy rows {site['real_rows']} != records {n_records}: a "
+        "batch was double-dispatched or skipped"
+    )
+    assert site["dispatches"] >= 2  # batch path AND tail path ran
+    assert site["padded_rows"] >= site["real_rows"]
+    assert 0 < site["occupancy"] <= 1
+    assert site["retraces"] == 0
+
+    ledger = xprof.ledger_totals()
+    assert (
+        ledger["h2d"]["by_site"]["gatherer.upload"]["bytes"]
+        == gatherer.bytes_h2d
+    )
+    assert (
+        ledger["d2h"]["by_site"]["gatherer.writeback"]["bytes"]
+        == gatherer.bytes_d2h
+    )
+
+    # the dispatch spans carry the same telemetry for the fleet view
+    compute_spans = [s for s in obs.spans() if s["name"] == "compute"]
+    assert compute_spans
+    span_real = sum(s["attrs"]["real_rows"] for s in compute_spans)
+    span_padded = sum(s["attrs"]["padded_rows"] for s in compute_spans)
+    assert span_real == n_records
+    assert span_padded == site["padded_rows"]
+
+    # memory watermarks sampled during the run (CPU: live_arrays fallback)
+    memory = snap["memory"]
+    if memory["supported"]:
+        assert memory["samples"] >= 1
+
+
+# ------------------------------------------------- persistence + report
+
+def test_dump_load_merge_and_render(recording, tmp_path):
+    fn = xprof.instrument_jit(lambda x: x + 1, name="test.site")
+    fn(np.ones(8, np.float32))
+    xprof.record_dispatch("test.site", 100, 128)
+    xprof.record_transfer("h2d", 1000, seconds=0.01, site="test.site")
+    assert xprof.dump(str(tmp_path / "xprof.p0.json"), worker="p0")
+
+    registries = xprof.load_registries(str(tmp_path))
+    assert len(registries) == 1 and registries[0]["worker"] == "p0"
+
+    # a second worker's registry merges additively
+    xprof.dump(str(tmp_path / "xprof.p1.json"), worker="p1")
+    merged = xprof.merge_registries(xprof.load_registries(str(tmp_path)))
+    site = merged["sites"]["test.site"]
+    assert site["calls"] == 2 and site["real_rows"] == 200
+    assert sorted(site["workers"]) == ["p0", "p1"]
+    assert merged["ledger"]["h2d"]["bytes"] == 2000
+
+    report = xprof.efficiency_report(str(tmp_path))
+    assert report["workers"] == ["p0", "p1"]
+    text = xprof.render_efficiency(report)
+    assert "test.site" in text and "transfer ledger" in text
+
+
+def test_measured_link_uses_timed_entries_only(recording, tmp_path):
+    # untimed bulk transfers (async dispatches, seconds=0) must not
+    # inflate the measured roofline computed from the timed probes
+    xprof.record_transfer("h2d", 1_000_000, seconds=1.0, site="probe")
+    xprof.record_transfer("h2d", 99_000_000, seconds=0.0, site="bulk")
+    xprof.dump(str(tmp_path / "xprof.json"))
+    report = xprof.efficiency_report(str(tmp_path))
+    assert report["measured_link"]["h2d_MBps"] == 1.0
+    assert "@ 1.0 MB/s measured" in xprof.render_efficiency(report)
+
+
+def test_sched_status_survives_malformed_registry(recording, tmp_path):
+    import io
+
+    from sctools_tpu.sched import Journal, make_task
+    from sctools_tpu.sched.cli import main as sched_cli
+
+    journal_dir = str(tmp_path / "sched-journal")
+    journal = Journal(journal_dir, worker_id="w0")
+    (task,) = journal.register([make_task("noop", "t0", {})])
+    journal.record(task.id, "committed", attempt=1, part=None)
+    journal.close()
+    # valid JSON, garbage shape: the status table must still print
+    (tmp_path / "xprof.bad.json").write_text('{"sites": {"a": 1}}')
+    out = io.StringIO()
+    assert sched_cli(["status", journal_dir], out=out) == 0
+    assert "total=1" in out.getvalue()
+
+
+def test_efficiency_cli(recording, tmp_path, capsys):
+    from sctools_tpu.obs.__main__ import main as obs_cli
+
+    # empty dir: loud, exit 2
+    assert obs_cli(["efficiency", str(tmp_path)]) == 2
+    capsys.readouterr()
+
+    fn = xprof.instrument_jit(lambda x: x * 3, name="test.cli")
+    fn(np.ones(8, np.float32))
+    xprof.record_dispatch("test.cli", 8, 16)
+    xprof.dump(str(tmp_path / "xprof.json"))
+    assert obs_cli(["efficiency", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "test.cli" in out and "occupancy" in out
+    assert obs_cli(["efficiency", str(tmp_path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["sites"]["test.cli"]["compiles"] == 1
+    assert payload["totals"]["occupancy"] == 0.5
+
+
+def test_flight_record_carries_registry(recording, tmp_path):
+    fn = xprof.instrument_jit(lambda x: x - 1, name="test.flight")
+    fn(np.ones(8, np.float32))
+    target = str(tmp_path / "flight.w0.jsonl")
+    assert obs.flight_dump(reason="test", path=target) == target
+    with open(target) as f:
+        meta = json.loads(f.readline())
+    assert meta["meta"] == "flight"
+    assert meta["xprof"]["sites"]["test.flight"]["compiles"] == 1
+
+    # load_registries reads the flight copy when no exit dump exists
+    registries = xprof.load_registries(str(tmp_path))
+    assert len(registries) == 1 and registries[0]["from_flight"]
+    # ... and prefers the exit dump when both exist
+    xprof.dump(str(tmp_path / "xprof.w0.json"), worker="w0")
+    registries = xprof.load_registries(str(tmp_path))
+    assert len(registries) == 1 and not registries[0].get("from_flight")
+
+
+def test_compile_events_attributed_to_jax_spans(recording):
+    fn = xprof.instrument_jit(lambda x: x * 5, name="test.attr")
+    fn(np.ones(8, np.float32))
+    jax_compiles = [
+        s for s in obs.spans()
+        if s["name"].startswith("jax:") and "compile" in s["name"]
+    ]
+    assert jax_compiles, "no jax compile spans recorded"
+    assert any(
+        (s.get("attrs") or {}).get("site") == "test.attr"
+        for s in jax_compiles
+    ), jax_compiles
+
+
+def test_sched_status_shows_efficiency_line(recording, tmp_path, capsys):
+    """`sched status` surfaces the device headline when registries exist."""
+    import io
+
+    from sctools_tpu.sched import Journal, make_task
+    from sctools_tpu.sched.cli import main as sched_cli
+
+    journal_dir = str(tmp_path / "sched-journal")
+    journal = Journal(journal_dir, worker_id="w0")
+    (task,) = journal.register([make_task("noop", "t0", {})])
+    journal.record(task.id, "leased", attempt=1, stolen=0)
+    journal.record(task.id, "committed", attempt=1, part=None)
+    journal.close()
+
+    out = io.StringIO()
+    assert sched_cli(["status", journal_dir], out=out) == 0
+    assert "device:" not in out.getvalue()  # no registries yet
+
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    xprof.record_dispatch("test.site", 50, 100)
+    xprof.record_transfer("h2d", 1_000_000, site="test.site")
+    xprof.dump(str(obs_dir / "xprof.w0.json"), worker="w0")
+    out = io.StringIO()
+    assert sched_cli(["status", journal_dir], out=out) == 0
+    text = out.getvalue()
+    assert "device: occupancy=50.0% retraces=0 transfer=1.0MB" in text, text
+
+
+# ------------------------------------------------- fleet per-task view
+
+def test_fleet_task_occupancy_and_diagnosis(tmp_path):
+    """Synthetic 1-worker run: dispatch spans -> per-task occupancy."""
+    from sctools_tpu.obs import fleet
+    from sctools_tpu.sched import Journal, make_task
+
+    journal_dir = str(tmp_path / "sched-journal")
+    journal = Journal(journal_dir, worker_id="w0")
+    tasks = journal.register(
+        [
+            make_task("noop", "t0", {}),
+            make_task("noop", "t1", {}),
+            make_task("noop", "t2", {}),
+        ]
+    )
+    for index, task in enumerate(tasks):
+        journal.record(task.id, "leased", attempt=1, stolen=0)
+        journal.record(task.id, "committed", attempt=1, part=None)
+    journal.close()
+    events = Journal(journal_dir, worker_id="probe").events()
+    leased_ts = [e["ts"] for e in events if e.get("event") == "leased"]
+
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    spans = []
+    for index, task in enumerate(tasks):
+        base = 1.0 + 10.0 * index
+        straggler = index == 2
+        spans.append(
+            {
+                "name": "sched:task", "ts": base,
+                "dur": 8.0 if straggler else 2.0, "thread": "m",
+                "depth": 0, "worker": "w0",
+                "attrs": {
+                    "task": task.name, "task_id": task.id, "attempt": 1,
+                    "stolen": 0,
+                },
+            }
+        )
+        spans.append(
+            {
+                "name": "compute", "ts": base + 0.1, "dur": 1.0,
+                "thread": "m", "depth": 1, "worker": "w0",
+                "task_id": task.id,
+                "attrs": {
+                    "records": 100,
+                    # the last task is the low-occupancy straggler
+                    "real_rows": 10 if straggler else 100,
+                    "padded_rows": 128,
+                },
+            }
+        )
+        spans.append(
+            {
+                "name": "upload", "ts": base + 0.05, "dur": 0.1,
+                "thread": "m", "depth": 1, "worker": "w0",
+                "task_id": task.id,
+                "attrs": {"records": 100, "bytes": 5000},
+            }
+        )
+    # anchor the capture's clock: mono ts ~= journal wall ts of the first
+    # lease (offsets come from the (task_id, attempt) correlation)
+    with open(obs_dir / "trace.w0.jsonl", "w") as f:
+        f.write(json.dumps({"meta": "clock", "wall": leased_ts[0],
+                            "mono": 1.0}) + "\n")
+        for record in spans:
+            f.write(json.dumps(record) + "\n")
+
+    run = fleet.discover(str(tmp_path))
+    analysis = fleet.analyze(run)
+    rows = analysis["tasks"]
+    assert rows["t0"]["occupancy"] == pytest.approx(100 / 128)
+    assert rows["t2"]["occupancy"] == pytest.approx(10 / 128)
+    assert rows["t0"]["transfer_bytes"] == 5000
+    lane = analysis["workers"]["w0"]
+    assert lane["occupancy"] == pytest.approx(210 / 384)
+    assert lane["transfer_bytes"] == 15000
+    # the slow task is diagnosed by its collapsed occupancy
+    stragglers = analysis["stragglers"]
+    assert stragglers and stragglers[0]["task"] == "t2"
+    assert "occupancy" in stragglers[0]["diagnosis"], stragglers[0]
+    rendered = fleet.render_timeline(run, analysis)
+    assert "occ%" in rendered and "slow because" in rendered

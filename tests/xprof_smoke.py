@@ -1,0 +1,217 @@
+"""xprof-smoke: the CI gate for scx-xprof (`make xprof-smoke`).
+
+A traced 2-worker run of the real chunk-metrics pipeline (the sched-smoke
+scenario WITHOUT fault injection — both workers converge cleanly), then
+the device-efficiency surfaces are held to their contracts:
+
+- every worker's exit dump (``xprof.<worker>.json``) is discovered and
+  the merged ``obs efficiency`` report carries every call site a worker
+  declared — absence must mean "not instrumented", never "lost";
+- per call site: compile count >= 1 where work ran, and ZERO steady-state
+  retraces (a compile for an already-seen signature) — the streaming
+  loop's capacity cuts / one-way ratchets / bucketed tails exist to make
+  this 0, and this gate is where that claim is enforced;
+- occupancy telemetry conserves: the merged registry's real rows equal
+  the records the input holds times the passes over them;
+- the transfer ledger reconciles byte-for-byte with the upload/writeback
+  span bytes in the workers' traces (gatherer accounting == ledger);
+- the fleet timeline's occupancy column is populated for committed tasks;
+- the CLI front door (`obs efficiency`, text and --json) renders it all.
+
+Exit 0 on success; any assertion failure is a gate failure.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "sched_worker.py"
+)
+
+
+def launch(workdir: str, process_id: int):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("SCTOOLS_TPU_FAULTS", None)
+    env["SCTOOLS_TPU_TRACE"] = os.path.join(workdir, "obs")
+    env["SCTOOLS_TPU_TRACE_WORKER"] = f"p{process_id}"
+    return subprocess.Popen(
+        [sys.executable, WORKER, workdir, str(process_id), "2", "5.0",
+         "3", "0.1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def fail(message: str) -> None:
+    print(f"xprof-smoke: FAIL: {message}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    workdir = os.environ.get(
+        "SCTOOLS_TPU_XPROF_SMOKE_DIR"
+    ) or tempfile.mkdtemp(prefix="sctools_tpu_xprof_smoke.")
+    os.makedirs(workdir, exist_ok=True)
+    bam = os.path.join(workdir, "input.bam")
+
+    from sched_smoke import make_input
+
+    from sctools_tpu.obs import xprof
+    from sctools_tpu.obs.fleet import analyze, discover
+    from sctools_tpu.platform import GenericPlatform
+
+    make_input(bam)
+    chunk_dir = os.path.join(workdir, "chunks")
+    os.makedirs(chunk_dir, exist_ok=True)
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", os.path.join(chunk_dir, "chunk"), "-s", "0.002",
+         "-t", "CB"]
+    )
+    n_chunks = len(glob.glob(os.path.join(chunk_dir, "*.bam")))
+    assert n_chunks >= 2, f"need >=2 chunks, got {n_chunks}"
+
+    # both workers race the shared queue under tracing; both must converge
+    procs = [launch(workdir, 0), launch(workdir, 1)]
+    outputs = []
+    for proc in procs:
+        out, _ = proc.communicate(timeout=300)
+        outputs.append(out)
+        if proc.returncode != 0:
+            fail(f"worker exited {proc.returncode}:\n{out[-2000:]}")
+
+    # ---- registries discovered, one per worker that did device work
+    registries = xprof.load_registries(workdir)
+    if not registries:
+        fail("no xprof registries dumped (atexit hook broken?)")
+    workers = sorted(str(r.get("worker")) for r in registries)
+    print(f"xprof-smoke: {len(registries)} registr(ies) from {workers}")
+
+    report = xprof.efficiency_report(workdir)
+
+    # every call site any worker DECLARED is present in the report; the
+    # core metrics sites must be among them (the pipeline ran)
+    declared = set(report["declared_sites"])
+    present = set(report["sites"])
+    if not declared <= present:
+        fail(f"declared sites missing from report: {declared - present}")
+    for needed in (
+        "metrics.compute_entity_metrics",
+        "metrics.compact_results",
+        "metrics.compact_results_wire",
+    ):
+        if needed not in present:
+            fail(f"registered call site {needed} absent from the report")
+
+    # zero steady-state retraces after warmup, per site, across workers
+    for name, row in report["sites"].items():
+        if row["retraces"]:
+            fail(
+                f"{name}: {row['retraces']} steady-state retrace(s): "
+                f"{row['retrace_signatures']}"
+            )
+    # a backend compile lands on the OUTERMOST instrumented jit (the
+    # inner engine traces inline under it and shows compile seconds but
+    # no backend compile of its own) — so the compile floor is a report
+    # total, and the engine site must still show its trace cost
+    if report["totals"]["compiles"] < 1:
+        fail("no compiles recorded anywhere in the report")
+    if report["totals"]["unattributed_compiles"]:
+        fail(
+            f"{report['totals']['unattributed_compiles']} compile(s) "
+            "escaped call-site attribution"
+        )
+    if report["sites"]["metrics.compute_entity_metrics"]["compile_s"] <= 0:
+        fail("metrics engine shows no attributed compile seconds")
+    # occupancy telemetry on every dispatching site
+    dispatching = {
+        name: row for name, row in report["sites"].items()
+        if row["dispatches"]
+    }
+    if not dispatching:
+        fail("no site recorded a padded dispatch")
+    for name, row in dispatching.items():
+        if not row["real_rows"] or not row["padded_rows"]:
+            fail(f"{name}: occupancy telemetry empty: {row}")
+        if not (0 < row["occupancy"] <= 1):
+            fail(f"{name}: occupancy out of range: {row['occupancy']}")
+
+    # ---- ledger bytes == the upload/writeback span bytes in the traces
+    span_bytes = {"upload": 0, "writeback": 0}
+    for trace in glob.glob(os.path.join(workdir, "obs", "trace.*.jsonl")):
+        with open(trace) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    continue
+                name = record.get("name")
+                if name in span_bytes:
+                    span_bytes[name] += int(
+                        (record.get("attrs") or {}).get("bytes") or 0
+                    )
+    ledger = report["ledger"]
+    ledger_h2d = (
+        ledger.get("h2d", {}).get("by_site", {})
+        .get("gatherer.upload", {}).get("bytes", 0)
+    )
+    ledger_d2h = (
+        ledger.get("d2h", {}).get("by_site", {})
+        .get("gatherer.writeback", {}).get("bytes", 0)
+    )
+    if ledger_h2d != span_bytes["upload"] or ledger_h2d == 0:
+        fail(
+            f"h2d ledger {ledger_h2d} != upload span bytes "
+            f"{span_bytes['upload']} (gatherer accounting diverged)"
+        )
+    if ledger_d2h != span_bytes["writeback"] or ledger_d2h == 0:
+        fail(
+            f"d2h ledger {ledger_d2h} != writeback span bytes "
+            f"{span_bytes['writeback']}"
+        )
+
+    # ---- the fleet timeline's occupancy column is populated
+    analysis = analyze(discover(workdir))
+    committed = {
+        name: row for name, row in analysis["tasks"].items()
+        if row["state"] == "committed"
+    }
+    if len(committed) != n_chunks:
+        fail(f"{len(committed)} committed of {n_chunks} chunks")
+    for name, row in committed.items():
+        if row["occupancy"] is None or not (0 < row["occupancy"] <= 1):
+            fail(f"task {name} has no occupancy in the timeline: {row}")
+        if not row["transfer_bytes"]:
+            fail(f"task {name} has no transfer bytes in the timeline")
+
+    # ---- CLI front door
+    from sctools_tpu.obs.__main__ import main as obs_cli
+
+    if obs_cli(["efficiency", workdir]) != 0:
+        fail("obs efficiency CLI exited non-zero")
+    if obs_cli(["efficiency", workdir, "--json"]) != 0:
+        fail("obs efficiency --json exited non-zero")
+
+    occupancy = report["totals"]["occupancy"]
+    print(
+        f"xprof-smoke: OK ({n_chunks} chunk(s), "
+        f"{report['totals']['compiles']} compile(s), 0 retraces, "
+        f"occupancy {100 * occupancy:.1f}%, "
+        f"ledger h2d {ledger_h2d} == span bytes)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
