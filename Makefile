@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke perf-gate docs clean
 
-ci: native lint test obs-smoke sched-smoke fleet-smoke xprof-smoke perf-gate
+ci: native lint test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -82,6 +82,18 @@ xprof-smoke:
 	rm -rf /tmp/sctools_tpu_xprof_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_XPROF_SMOKE_DIR=/tmp/sctools_tpu_xprof_smoke \
 	$(PY) tests/xprof_smoke.py
+
+# ingest gate: a traced 2-worker device-gatherer run on the prefetch ring
+# must show the ring rotating (decode spans over >=2 arena slots on the
+# prefetch thread), real overlap (decode spans intersecting upload/compute
+# spans in wall time), zero steady-state retraces in the merged efficiency
+# report, and a transfer ledger that reconciles byte-for-byte with the
+# upload/writeback span bytes AND the gatherers' own accounting
+# (tests/ingest_smoke.py; docs/ingest.md).
+ingest-smoke:
+	rm -rf /tmp/sctools_tpu_ingest_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_INGEST_SMOKE_DIR=/tmp/sctools_tpu_ingest_smoke \
+	$(PY) tests/ingest_smoke.py
 
 # perf-regression gate self-test: bench.py --check must fail a
 # synthetically-degraded result and pass a trajectory-consistent one

@@ -22,6 +22,10 @@ Prints ONE JSON line. Flags:
   --breakdown include decode-only and compute-only timings in the JSON
   --sched     include the scx-sched overhead microbench (no-op tasks/sec
               through a WorkQueue: journal + lease cost per task)
+  --ingest    include the scx-ingest microbench: decode-only, pack-only,
+              H2D-only, and overlapped-ring legs with ledger-derived MB/s
+              each (docs/ingest.md); --check then holds the ring's
+              steady-state H2D to >= 50% of the bulk-probe roofline
   --check     perf-regression gate: after the run (or over --result FILE,
               skipping the run) compare the headline against BASELINE.json
               and the BENCH_r*.json trajectory; exit 4 when the value
@@ -55,6 +59,12 @@ DEFAULT_TOLERANCE = 0.5
 # sit far above this; falling below it means the batch cutting or
 # bucketing regressed into mostly-padding dispatches
 OCCUPANCY_FLOOR = 0.25
+# ingest-roofline floor (ROADMAP item 1's success bar): the overlapped
+# ring's ledger-measured steady-state H2D must reach at least half of what
+# a bulk probe of the same buffer size sustains — below that, per-batch
+# overheads (packing stalls, small transfers, queue bubbles) are eating
+# the link again
+INGEST_ROOFLINE_FLOOR = 0.5
 
 # device workload size
 N_CELLS = 1 << 16  # 65k cells
@@ -207,9 +217,9 @@ def bench_decode_only(bam_path: str) -> float:
 
 def bench_compute_only() -> float:
     """The compiled metrics pass on pre-packed arrays (round-1's number)."""
-    import jax
     import numpy as np
 
+    from sctools_tpu import ingest
     from sctools_tpu.metrics.device import compute_entity_metrics
     from sctools_tpu.utils import make_synthetic_columns
 
@@ -217,7 +227,11 @@ def bench_compute_only() -> float:
         BATCH_RECORDS, n_cells=N_CELLS, n_genes=N_GENES, seed=42
     )
     num_segments = len(cols["valid"])
-    device_cols = {k: jax.device_put(v) for k, v in cols.items()}
+    # record=False: this leg isolates compute; its staging must not count
+    # as pipeline bytes in the ledger the transfer floor reads
+    device_cols, _ = ingest.upload(
+        cols, site="bench.compute_only", record=False
+    )
 
     def run():
         result = compute_entity_metrics(
@@ -246,8 +260,9 @@ def bench_link_bandwidth() -> dict:
     """
     import statistics
 
-    import jax
     import numpy as np
+
+    from sctools_tpu import ingest
 
     buf = np.random.default_rng(0).random(25 * 1024 * 1024 // 4).astype(
         np.float32
@@ -256,7 +271,12 @@ def bench_link_bandwidth() -> dict:
 
     def up() -> float:
         with obs.span("bench:h2d_probe", bytes=buf.nbytes) as timer:
-            device = jax.device_put(buf)
+            # record=False: the ledger entry below carries the measured
+            # seconds (a probe recorded untimed would dilute the ledger's
+            # MB/s with a zero-duration duplicate)
+            device, _ = ingest.upload(
+                buf, site="bench.h2d_probe", record=False
+            )
             # pull one scalar: block_until_ready alone under-reports on
             # tunneled backends
             float(device[0])
@@ -270,7 +290,9 @@ def bench_link_bandwidth() -> dict:
         return mb / timer.duration
 
     def down() -> float:
-        device = jax.device_put(buf)
+        device, _ = ingest.upload(
+            buf, site="bench.d2h_probe", record=False
+        )
         float(device[0])
         with obs.span("bench:d2h_probe", bytes=buf.nbytes) as timer:
             np.asarray(device)
@@ -284,6 +306,171 @@ def bench_link_bandwidth() -> dict:
     return {
         "h2d_MBps": round(statistics.median(up() for _ in range(3)), 1),
         "d2h_MBps": round(statistics.median(down() for _ in range(3)), 1),
+    }
+
+
+def bench_ingest(bam_path: str) -> dict:
+    """scx-ingest microbench: decode, pack, H2D, and overlapped-ring legs.
+
+    One MB/s per pipeline stage, so an ingest regression names its stage
+    instead of hiding in the e2e headline:
+
+    - ``decode_MBps``: the native arena ring with no device work — raw
+      BAM -> packed columns throughput (arena bytes produced / wall);
+    - ``pack_MBps``: the gatherer's schema/pack prologue (_prepare_batch +
+      _pack_wire) over already-decoded frames — wire bytes produced / pack
+      wall;
+    - ``h2d_MBps`` and ``ring_h2d_MBps``: the overlapped ring (decode on
+      the prefetch thread, pack + timed H2D on the main thread — the
+      ingest engine minus device compute) with every pipeline upload
+      paired to an adjacent bulk-probe upload of the same byte count.
+      Each is the median of its per-upload rates from timed ledger
+      entries (sites ``bench.ingest_ring`` / ``bench.ingest_h2d``; pack
+      time excluded — the upload timing starts at ``ingest.upload``);
+    - ``ring_vs_probe``: the median of the per-PAIR ``t_probe / t_ring``
+      ratios — adjacent-in-time equal-size pairing cancels the machine's
+      minute-scale weather. This is the number ROADMAP item 1 gates:
+      ``--check`` holds it >= 0.5 (per-batch staging keeps at least half
+      of bulk speed) when the microbench rides a result.
+    """
+    import numpy as np
+
+    from sctools_tpu import ingest
+    from sctools_tpu.ingest.arena import ARENA_ALIGN, arena_nbytes
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    record_bytes = arena_nbytes(ARENA_ALIGN) // ARENA_ALIGN
+    legs = {"record_bytes": record_bytes}
+
+    # ---- decode-only: the arena ring, no device work
+    n_records = 0
+    with obs.span("bench:ingest_decode") as timer:
+        for frame in ingest.ring_frames(
+            bam_path, batch_records=BATCH_RECORDS
+        ):
+            n_records += frame.n_records
+        timer.add(records=n_records)
+    legs["decode_rec_per_s"] = round(n_records / timer.duration)
+    legs["decode_MBps"] = round(
+        n_records * record_bytes / 1e6 / timer.duration, 1
+    )
+
+    # ---- pack-only: schema decision + monoblock wire, no device work
+    from sctools_tpu.metrics.gatherer import _pack_wire
+    from sctools_tpu.ops.segments import bucket_size
+
+    from sctools_tpu.io.sam import AlignmentReader
+
+    gatherer = GatherCellMetrics(
+        bam_path, "/tmp/sctools_tpu_bench_ingest_pack", backend="device",
+        batch_records=BATCH_RECORDS,
+    )
+    # the wire-schema decisions _extract_device makes before streaming
+    with AlignmentReader(bam_path) as header_probe:
+        gatherer._small_ref = len(header_probe.header.references) <= 0x7F
+    gatherer._wide_genomic = False
+    gatherer._runs_bucket = 0
+    pack_seconds = 0.0
+    wire_bytes = 0
+    capacity = bucket_size(BATCH_RECORDS)
+    for frame in ingest.ring_frames(bam_path, batch_records=BATCH_RECORDS):
+        with obs.span("bench:ingest_pack", records=frame.n_records) as sp:
+            cols, static_flags, prepacked = gatherer._prepare_batch(
+                frame, presorted=True,
+                pad_to=capacity if frame.n_records >= BATCH_RECORDS else 0,
+            )
+            if prepacked:
+                batch_bytes = _pack_wire(cols, static_flags).nbytes
+            else:
+                batch_bytes = sum(
+                    np.asarray(v).nbytes for v in cols.values()
+                )
+            wire_bytes += batch_bytes
+            sp.add(bytes=batch_bytes)
+        pack_seconds += sp.duration
+    legs["wire_bytes_per_record"] = round(wire_bytes / max(n_records, 1), 1)
+    legs["pack_MBps"] = round(wire_bytes / 1e6 / max(pack_seconds, 1e-9), 1)
+
+    # ---- overlapped ring + bulk probe, INTERLEAVED: the full ingest
+    # engine minus device compute (decode on the prefetch thread, pack +
+    # timed H2D on the main thread), where every pipeline upload is
+    # immediately paired with a bulk-probe upload of the SAME byte count
+    # (one contiguous random buffer). Pairing adjacent-in-time,
+    # equal-size transfers makes the roofline ratio robust: the machine's
+    # minute-scale weather (allocator state, shared-VM load, the tunneled
+    # link's swing) hits both sides of a pair equally and cancels, where
+    # two independently-timed legs produced ratios swinging 10x run to
+    # run. ring_vs_probe = median of the per-pair ratios; --check holds
+    # it >= 0.5: per-batch staging that keeps only a fraction of adjacent
+    # bulk speed means per-batch overheads (small buffers, pack stalls,
+    # queue bubbles) are eating the link again — exactly the regression
+    # this subsystem exists to kill.
+    rng = np.random.default_rng(0)
+    probes = {}
+
+    def probe_for(nbytes: int) -> np.ndarray:
+        if nbytes not in probes:
+            probes[nbytes] = np.frombuffer(
+                rng.bytes(nbytes // 4 * 4), dtype=np.int32
+            )
+        return probes[nbytes]
+
+    ring_rates, probe_rates, pair_ratios = [], [], []
+    ring_bytes_total = 0
+    ring_wall = 0.0
+
+    def timed_entry(site: str, value) -> float:
+        before = _ledger_site_entry("h2d", site)
+        ingest.upload(value, site=site, timed=True)
+        return _ledger_site_entry("h2d", site)["seconds"] - before["seconds"]
+
+    for _ in range(3):
+        with obs.span("bench:ingest_ring") as timer:
+            for frame in ingest.ring_frames(
+                bam_path, batch_records=BATCH_RECORDS
+            ):
+                with obs.span("upload", records=frame.n_records) as sp:
+                    cols, static_flags, prepacked = gatherer._prepare_batch(
+                        frame, presorted=True,
+                        pad_to=(
+                            capacity
+                            if frame.n_records >= BATCH_RECORDS else 0
+                        ),
+                    )
+                    if prepacked:
+                        cols = {"wire": _pack_wire(cols, static_flags)}
+                    nbytes = sum(
+                        np.asarray(v).nbytes for v in cols.values()
+                    )
+                    sp.add(bytes=nbytes)
+                    t_ring = timed_entry("bench.ingest_ring", cols)
+                t_probe = timed_entry(
+                    "bench.ingest_h2d", probe_for(nbytes)
+                )
+                ring_bytes_total += nbytes
+                ring_rates.append(nbytes / 1e6 / max(t_ring, 1e-9))
+                probe_rates.append(nbytes / 1e6 / max(t_probe, 1e-9))
+                pair_ratios.append(max(t_probe, 1e-9) / max(t_ring, 1e-9))
+        ring_wall += timer.duration
+    legs["h2d_MBps"] = round(statistics.median(probe_rates), 1)
+    legs["ring_wall_s"] = round(ring_wall / 3, 3)
+    legs["ring_h2d_bytes"] = ring_bytes_total // 3
+    # effective throughput of the whole overlapped engine (decode+pack+
+    # H2D, including the interleaved probe overhead — a floor, not a peak)
+    legs["ring_effective_MBps"] = round(
+        ring_bytes_total / 1e6 / max(ring_wall, 1e-9), 1
+    )
+    legs["ring_h2d_MBps"] = round(statistics.median(ring_rates), 1)
+    legs["ring_vs_probe"] = round(statistics.median(pair_ratios), 3)
+    return legs
+
+
+def _ledger_site_entry(direction: str, site: str) -> dict:
+    by_site = xprof.ledger_totals().get(direction, {}).get("by_site", {})
+    entry = by_site.get(site, {})
+    return {
+        "bytes": int(entry.get("bytes", 0)),
+        "seconds": float(entry.get("seconds", 0.0)),
     }
 
 
@@ -483,6 +670,19 @@ def check_result(
             "occupancy", occupancy >= OCCUPANCY_FLOOR, value=occupancy,
             floor=OCCUPANCY_FLOOR,
         )
+    # scx-ingest roofline, held whenever the result carries the microbench
+    # (bench --ingest): the overlapped ring's steady-state H2D vs the bulk
+    # probe of the same buffer size
+    ingest_legs = result.get("ingest")
+    if isinstance(ingest_legs, dict) and isinstance(
+        ingest_legs.get("ring_vs_probe"), (int, float)
+    ):
+        add(
+            "ingest_roofline",
+            ingest_legs["ring_vs_probe"] >= INGEST_ROOFLINE_FLOOR,
+            value=ingest_legs["ring_vs_probe"],
+            floor=INGEST_ROOFLINE_FLOOR,
+        )
     return verdict
 
 
@@ -520,6 +720,16 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         "metric": metric, "value": reference, "vs_baseline": 5.0,
         "occupancy": 0.8, "retraces_steady_state": 0,
     }
+    ingest_stalled = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "ingest": {"ring_h2d_MBps": 10.0, "h2d_MBps": 100.0,
+                   "ring_vs_probe": 0.1},
+    }
+    ingest_healthy = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "ingest": {"ring_h2d_MBps": 80.0, "h2d_MBps": 100.0,
+                   "ring_vs_probe": 0.8},
+    }
     failures = []
     if not check_result(healthy, repo_dir)["ok"]:
         failures.append("healthy result failed the gate")
@@ -535,6 +745,10 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         failures.append("collapsed-occupancy result passed the gate")
     if not check_result(efficient, repo_dir)["ok"]:
         failures.append("healthy result with efficiency fields failed")
+    if check_result(ingest_stalled, repo_dir)["ok"]:
+        failures.append("below-roofline ingest result passed the gate")
+    if not check_result(ingest_healthy, repo_dir)["ok"]:
+        failures.append("healthy ingest result failed the gate")
     if failures:
         for failure in failures:
             print(f"bench --check-selftest: FAIL: {failure}", file=sys.stderr)
@@ -551,6 +765,7 @@ def main(argv=None):
     parser.add_argument("--profile", action="store_true")
     parser.add_argument("--breakdown", action="store_true")
     parser.add_argument("--sched", action="store_true")
+    parser.add_argument("--ingest", action="store_true")
     parser.add_argument("--check", action="store_true")
     parser.add_argument(
         "--result", metavar="FILE",
@@ -635,6 +850,8 @@ def main(argv=None):
         }
     if sched:
         result["sched_overhead"] = bench_sched_overhead()
+    if args.ingest:
+        result["ingest"] = bench_ingest(bam_path)
     print(json.dumps(result))
     if args.check:
         # the result line above stays the ONE stdout JSON line (the

@@ -36,6 +36,7 @@ __all__ = [
     "fastq",
     "groups",
     "gtf",
+    "ingest",
     "io",
     "metrics",
     "obs",

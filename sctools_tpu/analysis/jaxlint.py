@@ -57,6 +57,15 @@ Rule catalog (docs/static_analysis.md has the rationale for each):
   (the shim itself) are exempt. The traced-context discovery above
   treats ``instrument_jit`` exactly like ``jax.jit``, so SCX101-105
   still cover instrumented functions.
+- SCX112 device-put-outside-ingest: bare ``jax.device_put`` (or the
+  ``device_put_replicated``/``device_put_sharded`` variants, attribute
+  access or ``from jax import device_put``) outside the scx-ingest
+  subsystem. Every host->device staging must go through
+  ``sctools_tpu.ingest.upload`` — the one choke point that writes the
+  scx-xprof transfer ledger — or the ledger's "bytes moved" stops being
+  the single source of truth and the H2D reconciliation gates
+  (xprof-smoke, ingest-smoke, bench) go blind to the bytes. Files under
+  ``ingest/`` and ``platform.py`` are exempt.
 """
 
 from __future__ import annotations
@@ -80,6 +89,7 @@ JAX_RULES = {
     "SCX109": "wallclock-duration",
     "SCX110": "shardmap-shim",
     "SCX111": "uninstrumented-jit",
+    "SCX112": "device-put-outside-ingest",
 }
 
 # files allowed to mutate process-global jax.config (SCX106)
@@ -90,6 +100,14 @@ SHARD_MAP_OWNERS = ("platform.py",)
 # modules allowed bare jax.jit (SCX111): the instrumentation shim itself
 # (obs/xprof.py wraps jax.jit in the call-site registry) and platform.py
 JIT_OWNERS = ("platform.py", "xprof.py")
+# file basenames / owning directory allowed bare jax.device_put (SCX112):
+# the scx-ingest subsystem IS the host->device boundary every other call
+# site must stage through (sctools_tpu.ingest.upload)
+DEVICE_PUT_OWNERS = ("platform.py",)
+DEVICE_PUT_OWNER_DIRS = ("ingest",)
+_DEVICE_PUT_NAMES = (
+    "device_put", "device_put_replicated", "device_put_sharded",
+)
 
 _JNP_CONSTRUCTORS = {
     "array", "asarray", "zeros", "ones", "full", "arange", "empty",
@@ -843,6 +861,47 @@ class JaxLinter:
                         "sctools_tpu.obs.xprof instead",
                     )
 
+    # -- SCX112 ------------------------------------------------------------
+
+    def _check_device_put(self) -> None:
+        """Bare jax.device_put spellings outside the ingest subsystem.
+
+        A device_put outside ``ingest/`` is a host->device crossing the
+        transfer ledger never sees: its bytes are invisible to the
+        reconciliation gates and its timing to the ingest microbench.
+        Stage through ``sctools_tpu.ingest.upload(value, site=...)``
+        instead, which performs the same (async) put and records it once.
+        """
+        if os.path.basename(self.path) in DEVICE_PUT_OWNERS:
+            return
+        parts = os.path.normpath(self.path).split(os.sep)
+        # only the IMMEDIATE parent directory confers ownership: matching
+        # any ancestor would let a checkout path containing an "ingest"
+        # component silently disable the rule repo-wide
+        if len(parts) >= 2 and parts[-2] in DEVICE_PUT_OWNER_DIRS:
+            return
+        put_paths = tuple((name,) for name in _DEVICE_PUT_NAMES)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute):
+                if self.aliases.is_jax_attr(node, *put_paths):
+                    self._report(
+                        "SCX112", node,
+                        "bare `jax.device_put`: this host->device crossing "
+                        "bypasses the transfer ledger; stage through "
+                        "sctools_tpu.ingest.upload(value, site=...)",
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "jax" and any(
+                    alias.name in _DEVICE_PUT_NAMES for alias in node.names
+                ):
+                    self._report(
+                        "SCX112", node,
+                        "importing device_put from jax bypasses the "
+                        "transfer ledger; import upload from "
+                        "sctools_tpu.ingest instead",
+                    )
+
     # -- driver ------------------------------------------------------------
 
     def run(self) -> List[Finding]:
@@ -853,6 +912,7 @@ class JaxLinter:
         self._check_host()
         self._check_shardmap_shim()
         self._check_uninstrumented_jit()
+        self._check_device_put()
         return self.findings
 
 

@@ -112,9 +112,15 @@ class _MoleculeAccumulator:
         if self._mesh is not None:
             self._add_batch_sharded(frame, offset, pad_to)
             return
+        from . import ingest
+
         cols = device_count_columns(frame, pad_to=pad_to)
-        xprof.record_dispatch("ops.count_molecules", n, len(cols["valid"]))
-        out = count_molecules(cols, num_segments=len(cols["valid"]))
+        num_segments = len(cols["valid"])
+        xprof.record_dispatch("ops.count_molecules", n, num_segments)
+        # explicit staging through the ingest choke point: the H2D lands
+        # in the transfer ledger and overlaps the previous batch's kernel
+        cols, _ = ingest.upload(cols, site="count.upload")
+        out = count_molecules(cols, num_segments=num_segments)
         is_molecule = np.asarray(out["is_molecule"])
         cells = np.asarray(out["cell"])[is_molecule]
         umis = np.asarray(out["umi"])[is_molecule]
@@ -131,6 +137,7 @@ class _MoleculeAccumulator:
         through a carried position column, so cross-batch dedup and the
         first-observation row order are bit-identical to single-device.
         """
+        from . import ingest
         from .parallel.count import sharded_count_molecules
         from .parallel.shard import partition_columns
 
@@ -146,6 +153,12 @@ class _MoleculeAccumulator:
             "parallel.sharded_count",
             frame.n_records,
             int(stacked["qname"].size),
+        )
+        # shard-per-device placement: each stacked row lands on its own
+        # mesh device instead of piling onto device 0
+        stacked, _ = ingest.upload(
+            stacked, site="count.upload",
+            sharding=ingest.mesh_sharding(self._mesh),
         )
         out = sharded_count_molecules(stacked, self._mesh)
         is_molecule = np.asarray(out["is_molecule"])
@@ -381,16 +394,23 @@ class CountMatrix:
         batch_records: int = DEFAULT_BATCH_RECORDS,
         mesh=None,
     ) -> "CountMatrix":
+        from . import ingest
         from .io.packed import (
             compact_frame,
             concat_frames,
-            iter_frames_from_bam,
+            copy_frame,
             slice_frame,
         )
         from .ops.segments import bucket_size
 
         accumulator = _MoleculeAccumulator(gene_name_to_index, mesh=mesh)
-        frames = iter_frames_from_bam(
+        # the scx-ingest prefetch ring: native batches decode into recycled
+        # zero-copy arenas on the prefetch thread while the kernel counts
+        # the previous batch; custom tag keys fall back to the Python
+        # decoder behind the same bounded queue. This loop holds at most
+        # two live ring frames (frame + following) — the ring's retention
+        # window — and every carry is copied.
+        frames = ingest.ring_frames(
             bam_file,
             batch_records,
             open_mode if open_mode != "rb" else None,
@@ -431,16 +451,19 @@ class CountMatrix:
                         pad_to=capacity if multi_batch else 0,
                     )
                     offset += cut
-                    frame = compact_frame(
+                    frame = copy_frame(compact_frame(
                         slice_frame(frame, cut, frame.n_records)
-                    )
+                    ))
                 accumulator.add_batch(
                     frame, offset, pad_to=capacity if multi_batch else 0
                 )
                 break
             changes = np.nonzero(frame.qname[1:] != frame.qname[:-1])[0]
             if changes.size == 0:
-                carry = frame  # one query group so far; keep accumulating
+                # one query group so far; keep accumulating. Copied: a
+                # ring frame views a recycled arena slot and a carry
+                # outlives the ring's retention window.
+                carry = copy_frame(frame)
                 frame = following
                 continue
             # cut at the last query boundary inside the fixed capacity so
@@ -457,7 +480,10 @@ class CountMatrix:
                 pad_to=capacity if multi_batch else 0,
             )
             offset += cut
-            carry = compact_frame(slice_frame(frame, cut, frame.n_records))
+            # compacted (vocabulary hygiene) AND copied (arena aliasing)
+            carry = copy_frame(
+                compact_frame(slice_frame(frame, cut, frame.n_records))
+            )
             frame = following
         matrix, row_index = accumulator.assemble()
         return cls(matrix, row_index, _col_index_from_map(gene_name_to_index))

@@ -1,0 +1,123 @@
+"""scx-ingest: the host->device boundary subsystem.
+
+Owns everything between the native decoder and the first compiled pass:
+
+- :mod:`.arena` — pre-allocated packed column arenas the native decoder
+  writes into across ctypes (zero-copy ``np.frombuffer`` views, in-place
+  PAD_FILLS padding; the ``kArenaLanes``/``ARENA_SPEC`` ABI);
+- :mod:`.ring` — the double-buffered prefetch ring: N slots of arena,
+  a decode thread filling slot k+1 while the consumer computes on slot k,
+  backpressured by the bounded-queue semantics of
+  :func:`sctools_tpu.utils.prefetch.prefetch_iterator`;
+- :func:`upload` — THE ``jax.device_put`` choke point. Every host->device
+  staging in the library goes through it, so each crossing lands in the
+  scx-xprof transfer ledger exactly once, and scx-lint rule SCX112 can ban
+  bare ``jax.device_put`` everywhere else.
+
+Knobs: ``SCTOOLS_TPU_PREFETCH_DEPTH`` (decode-ahead depth, default 2;
+validated 1..64 in :func:`sctools_tpu.utils.prefetch.prefetch_depth`)
+drives both the queue depth and the ring's slot count (depth + 3 — see
+:func:`ring.ring_slots`). docs/ingest.md has the full lifecycle.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Tuple
+
+from ..obs import xprof
+from ..utils.prefetch import prefetch_depth
+from .ring import ring_frames, ring_slots
+
+__all__ = [
+    "mesh_sharding",
+    "prefetch_depth",
+    "ring_frames",
+    "ring_slots",
+    "timed_uploads",
+    "upload",
+]
+
+# measurement mode (bench --ingest): every upload blocks until the
+# transfer lands and records measured seconds, so the ledger's per-site
+# MB/s is real link time, not async enqueue time. Serializes the pipeline
+# — never leave it on outside a microbench.
+_TIMED_UPLOADS = False
+
+
+@contextlib.contextmanager
+def timed_uploads():
+    """Force every ``upload`` in the block to run ``timed=True``."""
+    global _TIMED_UPLOADS
+    previous = _TIMED_UPLOADS
+    _TIMED_UPLOADS = True
+    try:
+        yield
+    finally:
+        _TIMED_UPLOADS = previous
+
+
+def upload(
+    value: Any,
+    site: str,
+    record: bool = True,
+    timed: bool = False,
+    sharding: Any = None,
+) -> Tuple[Any, int]:
+    """Stage host arrays onto the device: the one ``device_put`` call site.
+
+    ``value`` is an array or any pytree of arrays (a column dict uploads as
+    one call). Returns ``(device_value, nbytes)`` — callers keep their own
+    byte accounting (``MetricGatherer.bytes_h2d``) from the same number the
+    ledger records, so the two reconcile by construction.
+
+    ``sharding`` (a ``jax.sharding.Sharding``, applied to every leaf)
+    places each shard of a mesh-partitioned batch directly on its own
+    device — see :func:`mesh_sharding`. Without it the put targets the
+    default device, which on a multi-device mesh would materialize the
+    whole batch on device 0 and force a reshard inside the sharded pass.
+
+    ``record=False`` skips the ledger write for callers that attach their
+    own timing to the entry afterwards (bench probes). ``timed=True``
+    blocks until the transfer lands and records measured seconds — the
+    microbench's ledger-derived MB/s; never use it on the hot path, where
+    the async dispatch IS the overlap.
+    """
+    import jax
+
+    timed = timed or _TIMED_UPLOADS
+    nbytes = int(
+        sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(value))
+    )
+    start = time.perf_counter() if timed else 0.0
+    if sharding is not None:
+        device_value = jax.device_put(value, sharding)
+    else:
+        device_value = jax.device_put(value)
+    seconds = 0.0
+    if timed:
+        jax.block_until_ready(device_value)
+        seconds = time.perf_counter() - start
+    if record:
+        xprof.record_transfer("h2d", nbytes, seconds=seconds, site=site)
+    return device_value, nbytes
+
+
+def mesh_sharding(mesh: Any, axis_name: Any = None) -> Any:
+    """Row sharding for ``[n_shards, ...]``-stacked columns on ``mesh``.
+
+    The partitioned batches every sharded pass consumes stack shard-major
+    (dim 0 = one row per device), so the right placement is dim 0 split
+    over the mesh's axes: ``axis_name`` (a name or tuple of names,
+    defaulting to ALL of the mesh's axes) becomes the leading
+    PartitionSpec entry. Handing the result to :func:`upload` stages each
+    shard straight onto its own device.
+    """
+    import jax
+
+    if axis_name is None:
+        axis_name = tuple(mesh.axis_names)
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(axis_name)
+    )

@@ -1,0 +1,173 @@
+"""Packed column arena: caller-owned zero-copy staging for native decode.
+
+One contiguous pre-allocated buffer holds every per-record column of a
+decoded batch as adjacent struct-of-arrays sections. The native decoder
+writes straight into it across the ctypes boundary
+(``native.NativeBatchStream.fill_arena`` -> ``scx_batch_fill_arena``) and
+the Python side only *views* the sections with ``np.frombuffer`` — no
+per-record Python objects, no per-column copies, no intermediate lists.
+The views assemble into an ordinary :class:`~sctools_tpu.io.packed.ReadFrame`
+(so everything downstream is unchanged). For consumers that dispatch
+arena-resident columns directly, ``pad_in_place`` pads past the real
+record count **on the same buffer** with the
+:data:`~sctools_tpu.io.packed.PAD_FILLS` sentinels; the metric gatherers
+instead run their schema transform (narrow-genomic packing, key packing,
+monoblock wire) over the views, which derives fresh device columns and
+applies the same PAD_FILLS policy there — decode stays zero-copy either
+way, the transform is where the per-batch bytes shrink to wire size.
+
+ARENA_SPEC is the Python half of the ingest ABI: the C++ side iterates the
+same ordered (name, width) list (``kArenaLanes`` in native/bamdecode.cpp)
+and the byte-parity test in tests/test_ingest.py holds the two sides to
+identical bytes over a real decode, so the layouts cannot drift silently.
+Two fields are *finished* host-side because they need host-only knowledge:
+``flags`` arrives with bits 0..11 packed (everything except FLAG_MITO and
+FLAG_RUN_START, which need the mitochondrial-gene set / run boundaries and
+are OR-ed in by the gatherer's padder), and ``ps`` arrives fully packed
+(``pos << 1 | strand``, the prepacked sort operand).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..io.packed import PAD_FILLS, ReadFrame
+
+# capacity granularity: every section offset stays 64-byte aligned for any
+# capacity that is a multiple of this (lane widths descend 4 -> 2 -> 1)
+ARENA_ALIGN = 64
+
+# the ingest ABI: order and dtypes mirror kArenaLanes in native/bamdecode.cpp
+ARENA_SPEC = (
+    ("cell", np.int32),
+    ("umi", np.int32),
+    ("gene", np.int32),
+    ("qname", np.int32),
+    ("ref", np.int32),
+    ("pos", np.int32),
+    ("nh", np.int32),
+    ("ps", np.int32),
+    ("genomic_qual", np.uint32),
+    ("genomic_total", np.uint32),
+    ("umi_qual", np.uint16),
+    ("cb_qual", np.uint16),
+    ("flags", np.int16),
+    ("strand", np.int8),
+    ("xf", np.int8),
+    ("perfect_umi", np.int8),
+    ("perfect_cb", np.int8),
+    ("unmapped", np.bool_),
+    ("duplicate", np.bool_),
+    ("spliced", np.bool_),
+)
+
+# ReadFrame per-record fields that come straight off arena sections (the
+# two native-prepacked extras, flags and ps, ride ReadFrame.extras instead)
+_FRAME_FIELDS = tuple(
+    name for name, _ in ARENA_SPEC if name not in ("flags", "ps")
+)
+_EXTRA_FIELDS = ("flags", "ps")
+
+
+def arena_capacity(n: int) -> int:
+    """Smallest valid arena capacity (multiple of ARENA_ALIGN) >= ``n``."""
+    if n < 1:
+        raise ValueError(f"capacity must cover at least one record, got {n}")
+    return -(-n // ARENA_ALIGN) * ARENA_ALIGN
+
+
+def arena_nbytes(capacity: int) -> int:
+    """Byte size of an arena for ``capacity`` records (Python-side sizing).
+
+    Must equal ``native.arena_nbytes(capacity)`` — the parity test pins the
+    two computations together.
+    """
+    if capacity < 1 or capacity % ARENA_ALIGN:
+        raise ValueError(
+            f"capacity must be a positive multiple of {ARENA_ALIGN}, "
+            f"got {capacity}"
+        )
+    return capacity * sum(np.dtype(dt).itemsize for _, dt in ARENA_SPEC)
+
+
+class ColumnArena:
+    """One pre-allocated packed column arena (one ring slot's host half).
+
+    The buffer is allocated once and refilled per batch; ``frame()`` hands
+    out zero-copy views, so a frame built from this arena is only valid
+    until the arena is refilled — the ring's slot accounting guarantees
+    consumers a safe window, and anything retained longer must be copied
+    (:func:`sctools_tpu.io.packed.copy_frame`).
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.nbytes = arena_nbytes(capacity)  # validates capacity
+        self.buf = np.empty(self.nbytes, dtype=np.uint8)
+        self.n = 0
+        self._views = {}
+        offset = 0
+        for name, dt in ARENA_SPEC:
+            dt = np.dtype(dt)
+            self._views[name] = np.frombuffer(
+                self.buf, dtype=dt, count=capacity, offset=offset
+            )
+            offset += capacity * dt.itemsize
+
+    def column(self, name: str) -> np.ndarray:
+        """Full-capacity zero-copy view of one column section."""
+        return self._views[name]
+
+    def fill(self, stream) -> int:
+        """Decode ``stream``'s current batch into this arena (native write).
+
+        ``stream`` is a :class:`sctools_tpu.native.NativeBatchStream` whose
+        ``next()`` already parsed a batch. Returns the record count.
+        """
+        self.n = stream.fill_arena(self.buf, self.capacity)
+        return self.n
+
+    def pad_in_place(self, n: int, padded: int) -> None:
+        """Fill rows [n:padded) of every column with its PAD_FILLS sentinel.
+
+        The in-place analog of the gatherer padder's fresh-buffer fills:
+        columns named in PAD_FILLS get their semantic "absent" sentinel
+        (nh == -1, sort operands == INT32_MAX, ...), everything else zeros.
+        """
+        if not 0 <= n <= padded <= self.capacity:
+            raise ValueError(
+                f"pad window [{n}:{padded}) outside capacity {self.capacity}"
+            )
+        for name, _ in ARENA_SPEC:
+            self._views[name][n:padded] = PAD_FILLS.get(name, 0)
+
+    def frame(
+        self,
+        n: int,
+        cell_names: List[str],
+        umi_names: List[str],
+        gene_names: List[str],
+        qname_names: Optional[List[str]] = None,
+    ) -> ReadFrame:
+        """Zero-copy ReadFrame over rows [0:n) of this arena.
+
+        Every per-record array is a view into the arena buffer; the two
+        native-prepacked columns (``flags`` bits 0..11 and ``ps``) ride
+        ``ReadFrame.extras`` for the gatherer's padder to finish and
+        consume.
+        """
+        if not 0 <= n <= self.capacity:
+            raise ValueError(f"{n} records outside capacity {self.capacity}")
+        kwargs = {name: self._views[name][:n] for name in _FRAME_FIELDS}
+        kwargs["extras"] = {
+            name: self._views[name][:n] for name in _EXTRA_FIELDS
+        }
+        return ReadFrame(
+            cell_names=cell_names,
+            umi_names=umi_names,
+            gene_names=gene_names,
+            qname_names=qname_names if qname_names is not None else [""],
+            **kwargs,
+        )

@@ -1,0 +1,184 @@
+"""Double-buffered host->device prefetch ring.
+
+The streaming shape ROADMAP item 1 names: decode(k+1) overlaps H2D(k)
+overlaps compute(k-1). A decode thread (the bounded-queue producer of
+``utils.prefetch.prefetch_iterator``) fills ring slot k+1's arena through
+the native zero-copy path while the consumer packs/uploads slot k (the
+upload is an async ``ingest.upload`` — the H2D leg is in flight the moment
+dispatch returns) and the device still computes batch k-1 (the gatherer's
+pipelined ``pending`` queue). Backpressure is the queue bound: a consumer
+that stalls stops the decode thread after ``depth`` batches, so host
+memory stays at ``slots`` arenas regardless of file size.
+
+Slot accounting (why ``slots = depth + 3``): at any instant up to
+``depth`` filled arenas sit in the queue, one is being filled by the
+decode thread, and the consumer may hold up to two yielded frames alive
+(the streaming loops hold the current frame plus one look-ahead). A frame
+yielded by the ring is therefore valid only until the consumer has pulled
+``slots - depth - 1`` further frames; anything retained longer — the
+gatherers' entity carry — must be copied
+(:func:`sctools_tpu.io.packed.copy_frame`), and the rewired pipelines do.
+
+Failure contract: a decoder death mid-fill (truncated BGZF, malformed
+record, native error) raises promptly in the consumer at the point of the
+failed batch — never a hang — via prefetch_iterator's dead-producer
+detection; the stream handle is closed on both clean exhaustion and
+abandonment. When the native layer is unavailable (no toolchain,
+``SCTOOLS_TPU_NATIVE=0``), the input is SAM, or custom tag keys are
+requested, the ring degrades to the Python decoder behind the same
+prefetch queue — the CPU fallback path, intact.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Iterable, Iterator, Optional
+
+from .. import obs
+from ..io.packed import DEFAULT_TAG_KEYS, ReadFrame
+from ..utils.prefetch import prefetch_depth, prefetch_iterator
+from .arena import ColumnArena, arena_capacity
+
+# consumer-held frames the slot budget reserves headroom for (current
+# frame + one look-ahead, the widest pattern among the rewired pipelines)
+_CONSUMER_SLOTS = 2
+
+
+def ring_slots(depth: Optional[int] = None) -> int:
+    """Arena slot count for a decode-ahead ``depth`` (default: configured).
+
+    ``depth`` queued + 1 being filled + ``_CONSUMER_SLOTS`` consumer-held.
+    """
+    if depth is None:
+        depth = prefetch_depth()
+    return depth + 1 + _CONSUMER_SLOTS
+
+
+def _wrap_source(source: Iterable[ReadFrame], depth: int) -> Iterator[ReadFrame]:
+    """The fallback ring: Python-decoded frames behind the prefetch queue."""
+    return prefetch_iterator(
+        obs.iter_spans("decode", source, records=lambda f: f.n_records),
+        depth=depth,
+    )
+
+
+def _produce_arena_frames(stream, arenas, batch_records: int, want_qname: bool):
+    """Cycle the ring's arenas, filling one per decoded batch (producer side).
+
+    Runs on the prefetch thread: the ``decode`` spans here time actual
+    native decode + arena fill work, not consumer wait, and carry the slot
+    index so a trace shows the ring rotating.
+    """
+    n_slots = len(arenas)
+    try:
+        for k in itertools.count():
+            arena = arenas[k % n_slots]
+            with obs.span("decode", slot=k % n_slots) as sp:
+                n = stream.next(batch_records)
+                if n == 0:
+                    sp.add(eof=1)  # the terminating poll, not a batch
+                    return
+                arena.fill(stream)
+                frame = arena.frame(
+                    n,
+                    cell_names=stream.vocab("cell"),
+                    umi_names=stream.vocab("umi"),
+                    gene_names=stream.vocab("gene"),
+                    qname_names=(
+                        stream.vocab("qname") if want_qname else None
+                    ),
+                )
+                sp.add(records=n)
+            obs.count("ingest_arena_batches")
+            yield frame
+    finally:
+        stream.close()
+
+
+def ring_frames(
+    bam_path: Optional[str] = None,
+    batch_records: int = 1 << 20,
+    mode: Optional[str] = None,
+    want_qname: bool = False,
+    tag_keys: Optional[tuple] = None,
+    source: Optional[Iterable[ReadFrame]] = None,
+    depth: Optional[int] = None,
+    slots: Optional[int] = None,
+) -> Iterator[ReadFrame]:
+    """Yield decoded ReadFrames through the prefetch ring.
+
+    With a ``bam_path``, BGZF inputs decode through the native arena path
+    (zero-copy frames over recycled slots — see the module docstring for
+    the retention contract); SAM inputs, custom ``tag_keys``, and
+    native-unavailable environments stream the Python decoder behind the
+    same bounded queue. With ``source`` (an already-open frame iterable,
+    e.g. the fused tag-sort merge), the ring only adds the prefetch
+    stage — the frames are the source's own and carry no retention limit
+    beyond the source's.
+    """
+    if depth is None:
+        depth = prefetch_depth()
+    if source is not None:
+        if bam_path is not None:
+            raise ValueError("pass bam_path or source, not both")
+        return _wrap_source(source, depth)
+    if bam_path is None:
+        raise ValueError("ring_frames needs a bam_path or a source")
+    if batch_records < 1:
+        raise ValueError(f"batch_records must be >= 1, got {batch_records}")
+
+    from ..io import bgzf
+    from ..io.packed import iter_frames_from_bam
+
+    keys = tuple(tag_keys) if tag_keys is not None else DEFAULT_TAG_KEYS
+
+    def fallback() -> Iterator[ReadFrame]:
+        return _wrap_source(
+            iter_frames_from_bam(
+                bam_path, batch_records, mode,
+                want_qname=want_qname, tag_keys=keys,
+            ),
+            depth,
+        )
+
+    if keys != DEFAULT_TAG_KEYS or mode == "r" or not bgzf.is_gzip(bam_path):
+        return fallback()
+    from .. import native
+
+    if not native.available():
+        return fallback()
+    if slots is None:
+        slots = ring_slots(depth)
+    try:
+        stream = native.NativeBatchStream(bam_path, want_qname=want_qname)
+    except RuntimeError:
+        return fallback()
+    arenas = [
+        ColumnArena(arena_capacity(batch_records)) for _ in range(slots)
+    ]
+    produced = _produce_arena_frames(stream, arenas, batch_records, want_qname)
+    # probe the first batch eagerly: a native decode failure at the head of
+    # the file (bad magic, truncated header) falls back to the Python
+    # decoder and its diagnostics, matching iter_frames_from_bam; failures
+    # PAST the first batch raise — silently re-decoding from scratch would
+    # hide data corruption mid-file
+    try:
+        first = next(produced)
+    except StopIteration:
+        return iter(())
+    except RuntimeError:
+        produced.close()
+        return fallback()
+
+    def chained():
+        # a real generator (not itertools.chain): prefetch_iterator's
+        # abandonment path calls close() on its iterable, and that close
+        # must reach the producer so the native stream handle is released
+        # deterministically, not at GC
+        try:
+            yield first
+            yield from produced
+        finally:
+            produced.close()
+
+    return prefetch_iterator(chained(), depth=depth)

@@ -227,6 +227,12 @@ class ReadFrame:
     genomic_qual: np.ndarray  # uint32: above30<<16 | aligned len; 0 == none
     genomic_total: np.ndarray  # uint32: sum of aligned phred scores
 
+    # optional per-record side columns riding the frame through slicing /
+    # concatenation / compaction. The native arena decoder ships two:
+    # ``flags`` (the packed int16 device word, bits 0..11 — everything
+    # except the host-knowledge FLAG_MITO / FLAG_RUN_START bits) and ``ps``
+    # (the prepacked pos<<1|strand sort operand). Consumers treat a missing
+    # key as "derive it yourself"; concat keeps only keys both sides carry.
     extras: Dict[str, np.ndarray] = field(default_factory=dict)
 
     def __len__(self) -> int:
@@ -420,6 +426,25 @@ def slice_frame(frame: ReadFrame, start: int, stop: int) -> ReadFrame:
     kwargs = {name: getattr(frame, name)[start:stop] for name in _PER_RECORD_FIELDS}
     for name in _CODED_FIELDS:
         kwargs[f"{name}_names"] = getattr(frame, f"{name}_names")
+    kwargs["extras"] = {k: v[start:stop] for k, v in frame.extras.items()}
+    return ReadFrame(**kwargs)
+
+
+def copy_frame(frame: ReadFrame) -> ReadFrame:
+    """Deep-copy every per-record array (vocabulary lists are shared).
+
+    Required before *retaining* a frame produced by the ingest ring: ring
+    frames are zero-copy views into a recycled arena slot, valid only for
+    the ring's documented window (ingest.ring docs) — a carry held across
+    batches must own its memory or the next slot refill would rewrite it
+    underneath.
+    """
+    kwargs = {
+        name: np.array(getattr(frame, name)) for name in _PER_RECORD_FIELDS
+    }
+    for name in _CODED_FIELDS:
+        kwargs[f"{name}_names"] = getattr(frame, f"{name}_names")
+    kwargs["extras"] = {k: np.array(v) for k, v in frame.extras.items()}
     return ReadFrame(**kwargs)
 
 
@@ -433,6 +458,7 @@ def compact_frame(frame: ReadFrame) -> ReadFrame:
     sorted) vocabulary.
     """
     kwargs = {name: getattr(frame, name) for name in _PER_RECORD_FIELDS}
+    kwargs["extras"] = dict(frame.extras)
     for name in _CODED_FIELDS:
         codes = getattr(frame, name)
         names = getattr(frame, f"{name}_names")
@@ -491,6 +517,14 @@ def concat_frames(a: ReadFrame, b: ReadFrame) -> ReadFrame:
         if name in _CODED_FIELDS:
             continue
         kwargs[name] = np.concatenate([getattr(a, name), getattr(b, name)])
+    # keep only side columns BOTH sides carry: a half-present extra (e.g. a
+    # native arena batch concatenated with a Python-decoded carry) cannot be
+    # concatenated, and consumers must re-derive it instead
+    kwargs["extras"] = {
+        k: np.concatenate([a.extras[k], b.extras[k]])
+        for k in a.extras
+        if k in b.extras
+    }
     return ReadFrame(**kwargs)
 
 

@@ -15,10 +15,11 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
-from .. import obs
+from .. import ingest, obs
 from ..obs import xprof
 from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
 from ..io.packed import (
+    FLAG_MITO,
     FLAG_RUN_START,
     KEY_CODE_BITS,
     KEY_HI_SHIFT,
@@ -27,14 +28,13 @@ from ..io.packed import (
     ReadFrame,
     compact_frame,
     concat_frames,
-    iter_frames_from_bam,
+    copy_frame,
     pack_flags,
     slice_frame,
     wire_layout,
 )
 from ..io.sam import AlignmentReader
 from ..ops.segments import bucket_size
-from ..utils import prefetch_iterator
 from .aggregator import CellMetrics, GeneMetrics
 from .schema import CELL_COLUMNS, GENE_COLUMNS, INT_COLUMNS
 from .writer import MetricCSVWriter
@@ -92,11 +92,20 @@ def _pad_columns(
         out[:n] = arr
         return out
 
-    flags = pack_flags(
-        frame.strand, frame.unmapped, frame.duplicate, frame.spliced,
-        frame.xf, frame.perfect_umi, frame.perfect_cb, frame.nh,
-        is_mito[frame.gene],
-    )
+    if "flags" in frame.extras:
+        # the native arena decoder prepacked bits 0..11; only the
+        # host-knowledge mito bit remains (FLAG_RUN_START is OR-ed below
+        # for run-keyed batches, identically for both flag sources)
+        flags = (
+            frame.extras["flags"].astype(np.int32)
+            | (is_mito[frame.gene].astype(np.int32) * FLAG_MITO)
+        ).astype(np.int16)
+    else:
+        flags = pack_flags(
+            frame.strand, frame.unmapped, frame.duplicate, frame.spliced,
+            frame.xf, frame.perfect_umi, frame.perfect_cb, frame.nh,
+            is_mito[frame.gene],
+        )
     cols = {"flags": pad(flags, 0, np.int16)}
     if prepacked_keys is None:
         # plain schema ships the derived float32 views (the compat
@@ -169,14 +178,15 @@ def _pad_columns(
         )
     key_hi = (k1 << KEY_HI_SHIFT) | (k2 >> KEY_HI_SHIFT)
     key_lo = ((k2 & KEY_LO_MASK) << KEY_CODE_BITS) | k3
+    ps_col = frame.extras.get("ps")
+    if ps_col is None:
+        ps_col = (
+            frame.pos.astype(np.int32) << 1
+        ) | frame.strand.astype(np.int32)
     cols.update(
         umi_qual=pad(frame.umi_qual, 0, np.uint16),
         m_ref=m_ref,
-        ps=pad(
-            (frame.pos.astype(np.int32) << 1) | frame.strand.astype(np.int32),
-            _I32_MAX,
-            np.int32,
-        ),
+        ps=pad(ps_col, _I32_MAX, np.int32),
         n_valid=np.asarray([n], dtype=np.int32),
     )
     if include_cb:
@@ -376,20 +386,19 @@ class MetricGatherer:
             self._small_ref = len(header_probe.header.references) <= 0x7F
         self._wide_genomic = False
         self._runs_bucket = 0  # run-table high-water (one-way, like above)
+        # the scx-ingest ring owns the decode side: native batches land in
+        # recycled zero-copy arenas filled on the prefetch thread (decode
+        # spans time actual decode work, not consumer wait); a custom frame
+        # source (the fused tag-sort merge) rides the same bounded queue.
+        # Ring frames alias recycled slots — every carry below is copied.
         if self._frame_source is not None:
-            source = self._frame_source()
+            frames = ingest.ring_frames(source=self._frame_source())
         else:
-            source = iter_frames_from_bam(
+            frames = ingest.ring_frames(
                 self._bam_file,
                 self._batch_records,
                 mode if mode != "rb" else None,
             )
-        # decode spans wrap the SOURCE side of the prefetch queue, so they
-        # run on the producer thread and time actual decode work, not the
-        # consumer's wait
-        frames = prefetch_iterator(
-            obs.iter_spans("decode", source, records=lambda f: f.n_records)
-        )
         out = MetricCSVWriter(self._output_stem, self._compress)
         try:
             out.write_header({c: None for c in self.columns})
@@ -434,7 +443,10 @@ class MetricGatherer:
             )
             changes = np.nonzero(key[1:] != key[:-1])[0]
             if changes.size == 0:
-                carry = frame  # one entity so far; keep accumulating
+                # one entity so far; keep accumulating. Copied: a ring
+                # frame views a recycled arena slot and a carry outlives
+                # the ring's retention window.
+                carry = copy_frame(frame)
                 continue
             # cut at the last entity boundary that fits the capacity, so
             # every batch of a multi-batch run pads to ONE fixed shape
@@ -468,8 +480,11 @@ class MetricGatherer:
             if len(pending) > self._PIPELINE_DEPTH:
                 self._finalize_device_batch(*pending.popleft(), out)
             # compact, or the carried vocabularies would accumulate the
-            # union of every batch seen so far
-            carry = compact_frame(slice_frame(frame, cut, frame.n_records))
+            # union of every batch seen so far; copy, or the carried tail
+            # would alias a ring arena slot that gets rewritten underneath
+            carry = copy_frame(
+                compact_frame(slice_frame(frame, cut, frame.n_records))
+            )
         if carry is not None and carry.n_records:
             tail_key = (
                 carry.cell if self.entity_kind == "cell" else carry.gene
@@ -591,15 +606,15 @@ class MetricGatherer:
                 # monoblock transport: one upload per batch instead of nine
                 # (each buffer pays fixed tunnel overhead; _pack_wire docs)
                 cols = {"wire": _pack_wire(cols, static_flags)}
-                batch_h2d = cols["wire"].nbytes
-            else:
-                batch_h2d = sum(np.asarray(v).nbytes for v in cols.values())
+            # the ingest choke point stages the batch (async device_put —
+            # this H2D is in flight while the NEXT batch decodes and the
+            # PREVIOUS one computes) and writes the transfer ledger, the
+            # ONE source of truth for bytes moved; bytes_h2d stays as the
+            # per-gatherer view and must reconcile exactly (tests + make
+            # xprof-smoke + make ingest-smoke pin it)
+            cols, batch_h2d = ingest.upload(cols, site="gatherer.upload")
             self.bytes_h2d += batch_h2d
             up.add(bytes=batch_h2d)
-            # the transfer ledger is the ONE source of truth for bytes
-            # moved; bytes_h2d stays as the per-gatherer view and must
-            # reconcile exactly (tests + make xprof-smoke pin it)
-            xprof.record_transfer("h2d", batch_h2d, site="gatherer.upload")
         obs.count("batches_uploaded")
         obs.count("h2d_bytes", batch_h2d)
         # occupancy telemetry: how much of the padded dispatch was real
@@ -616,7 +631,7 @@ class MetricGatherer:
             padded_rows=num_segments,
         ):
             result = device_engine.compute_entity_metrics(
-                {k: np.asarray(v) for k, v in cols.items()},
+                cols,  # already staged on device by ingest.upload
                 num_segments=num_segments,
                 kind=self.entity_kind,
                 presorted=presorted,

@@ -139,6 +139,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.scx_stream_error.argtypes = [ctypes.c_void_p]
         lib.scx_stream_close.restype = None
         lib.scx_stream_close.argtypes = [ctypes.c_void_p]
+        lib.scx_arena_nbytes.restype = ctypes.c_long
+        lib.scx_arena_nbytes.argtypes = [ctypes.c_long]
+        lib.scx_batch_fill_arena.restype = ctypes.c_long
+        lib.scx_batch_fill_arena.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
+        ]
         lib.scx_synth_bam.restype = ctypes.c_long
         lib.scx_synth_bam.argtypes = [
             ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
@@ -387,6 +393,111 @@ def stream_frames_native(
             yield frame
     finally:
         lib.scx_stream_close(handle)
+
+
+def arena_nbytes(capacity: int) -> int:
+    """Required byte size of a packed column arena for ``capacity`` records.
+
+    The native layout's own sizing (scx_arena_nbytes) — ingest/arena.py
+    computes the same number from ARENA_SPEC and the parity test holds the
+    two sides equal, so the layouts cannot drift silently. Raises
+    RuntimeError when the native layer is unavailable or the capacity is
+    invalid (must be a positive multiple of 64).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native layer unavailable")
+    n = lib.scx_arena_nbytes(capacity)
+    if n < 0:
+        raise RuntimeError(
+            f"invalid arena capacity {capacity} (positive multiple of 64)"
+        )
+    return int(n)
+
+
+class NativeBatchStream:
+    """Streaming BAM decode handle for the ingest subsystem.
+
+    Thin object wrapper over the scx_stream_* / scx_batch_fill_arena C API:
+    ``next()`` decodes up to ``max_records`` alignments into the handle's
+    internal batch, ``fill_arena()`` writes that batch's columns straight
+    into a caller-owned contiguous buffer (sctools_tpu.ingest.arena views
+    it with np.frombuffer — no per-record Python objects, no per-column
+    copies), and ``vocab()`` returns the batch's sorted dictionary for a
+    coded column. Keeps every ctypes touch inside this module, where the
+    SCX201-206 ABI checker audits it.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        n_threads: Optional[int] = None,
+        want_qname: bool = False,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native decoder unavailable")
+        if n_threads is None:
+            n_threads = _default_threads()
+        errbuf = ctypes.create_string_buffer(512)
+        handle = lib.scx_stream_open(
+            path.encode(), n_threads, 1 if want_qname else 0,
+            errbuf, ctypes.sizeof(errbuf),
+        )
+        if not handle:
+            raise RuntimeError(
+                f"native BAM stream open failed: "
+                f"{errbuf.value.decode(errors='replace')}"
+            )
+        self._lib = lib
+        self._handle = handle
+        self.want_qname = want_qname
+
+    def next(self, max_records: int) -> int:
+        """Decode the next batch; returns its record count (0 == EOF)."""
+        n = self._lib.scx_stream_next(self._handle, max_records)
+        if n < 0:
+            raise RuntimeError(
+                "native BAM stream failed: "
+                f"{self._lib.scx_stream_error(self._handle).decode(errors='replace')}"
+            )
+        return int(n)
+
+    def fill_arena(self, arena: np.ndarray, capacity: int) -> int:
+        """Write the current batch's columns into ``arena`` (uint8 buffer).
+
+        Returns the record count written; the [n:capacity) tails of each
+        column section are left untouched for the caller's in-place
+        PAD_FILLS padding.
+        """
+        if arena.dtype != np.uint8 or not arena.flags["C_CONTIGUOUS"]:
+            raise ValueError("arena must be a C-contiguous uint8 buffer")
+        n = self._lib.scx_batch_fill_arena(
+            self._handle,
+            arena.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            capacity,
+        )
+        if n < 0:
+            raise RuntimeError(
+                f"arena fill failed: capacity {capacity} cannot hold the "
+                "batch (or is not a positive multiple of 64)"
+            )
+        return int(n)
+
+    def vocab(self, name: str) -> List[str]:
+        """The current batch's sorted vocabulary for a coded column."""
+        return _vocab(self._lib, self._handle, name.encode())
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.scx_stream_close(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "NativeBatchStream":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
 
 def synth_bam_native(

@@ -189,10 +189,11 @@ class WhitelistCorrector:
         w_onehot = onehot_barcodes(whitelist, self._length)
         if use_pallas:
             w_onehot = _pad_rows(w_onehot, 2048)
-        self._w_onehot = jax.device_put(w_onehot)
-        xprof.record_transfer(
-            "h2d", w_onehot.nbytes, site="whitelist.table"
-        )
+        # staged through the ingest choke point: the table's one-time H2D
+        # lands in the transfer ledger like every other boundary crossing
+        from .. import ingest
+
+        self._w_onehot, _ = ingest.upload(w_onehot, site="whitelist.table")
 
     @classmethod
     def from_file(cls, whitelist_file: str, **kwargs) -> "WhitelistCorrector":
@@ -215,7 +216,11 @@ class WhitelistCorrector:
             else "whitelist.correct_jnp"
         )
         xprof.record_dispatch(site, len(barcodes), q.shape[0])
-        xprof.record_transfer("h2d", q.nbytes, site="whitelist.queries")
+        from .. import ingest
+
+        # explicit staging (was an implicit upload inside the jit call):
+        # same ledger site and bytes, now through the one device_put door
+        q, _ = ingest.upload(q, site="whitelist.queries")
         if self._use_pallas:
             result = _correct_pallas(
                 q, self._w_onehot, self._length, interpret=self._interpret

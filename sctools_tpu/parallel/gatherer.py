@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .. import obs
+from .. import ingest, obs
 from ..obs import xprof
 from ..io.packed import KEY_HI_SHIFT
 from ..sched import faults
@@ -94,12 +94,18 @@ class _ShardedMixin:
                 outer_codes = np.asarray(cols[self.entity_kind])[
                     np.asarray(cols["valid"], dtype=bool)
                 ]
-            batch_h2d = sum(v.nbytes for v in stacked.values())
+            # same ledger site as the single-device path: "bytes the
+            # gatherer uploaded" is one series however the batch shipped;
+            # the ingest choke point stages the partitioned columns and
+            # records them in one step. mesh_sharding places each stacked
+            # row straight on its own device — a default put would pile
+            # the whole batch onto device 0 and reshard inside the pass.
+            stacked, batch_h2d = ingest.upload(
+                stacked, site="gatherer.upload",
+                sharding=ingest.mesh_sharding(self._mesh),
+            )
             self.bytes_h2d += batch_h2d
             up.add(bytes=batch_h2d, prepacked=int(prepacked))
-            # same ledger site as the single-device path: "bytes the
-            # gatherer uploaded" is one series however the batch shipped
-            xprof.record_transfer("h2d", batch_h2d, site="gatherer.upload")
         obs.count("batches_uploaded")
         obs.count("h2d_bytes", batch_h2d)
         shard_size = max(v.shape[1] for v in stacked.values())

@@ -280,6 +280,18 @@ def distributed_sort(
                 required = required_sort_capacity(
                     stacked_cols, key_names, n_shards
                 )
+            # stage the sharded columns through the ingest choke point
+            # AFTER the host-side capacity mirror read them: the H2D is
+            # ledger-recorded, in flight while the pivot math finishes,
+            # and shard-per-device (a default put would pile the whole
+            # batch onto device 0 and reshard inside the pass)
+            from .. import ingest
+
+            stacked_cols, sort_h2d = ingest.upload(
+                stacked_cols, site="sort.upload",
+                sharding=ingest.mesh_sharding(mesh, axis_name),
+            )
+            sort_span.add(bytes=sort_h2d)
             if capacity is None:
                 # bucketed so streaming batches of similar skew reuse one
                 # compiled program instead of recompiling per exact capacity

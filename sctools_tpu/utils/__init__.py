@@ -4,12 +4,13 @@ tomllib) — see :mod:`.toml`."""
 
 from . import toml
 from .cache import enable_compilation_cache
-from .prefetch import prefetch_iterator
+from .prefetch import prefetch_depth, prefetch_iterator
 from .synth import make_synthetic_columns
 
 __all__ = [
     "enable_compilation_cache",
     "make_synthetic_columns",
+    "prefetch_depth",
     "prefetch_iterator",
     "toml",
 ]

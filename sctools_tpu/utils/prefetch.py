@@ -23,16 +23,43 @@ Failure handling contract (regression-tested in tests/test_prefetch.py):
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
-from typing import Iterable, Iterator, TypeVar
+from typing import Iterable, Iterator, Optional, TypeVar
 
 from .. import obs
 
 T = TypeVar("T")
 
 _SENTINEL = object()
+
+# decode-ahead depth: items the producer may run ahead of the consumer.
+# SCTOOLS_TPU_PREFETCH_DEPTH overrides the default for every bounded queue
+# in the pipeline (this iterator AND the ingest ring, whose slot count is
+# depth-derived) — one knob, so the backpressure story cannot diverge
+# between the two. The window is 1..64: 0 would serialize producer and
+# consumer (use no prefetch instead), and past 64 the queue is no longer
+# backpressure, just unbounded memory. Out-of-window or non-integer values
+# fall back to the default (same forgiving contract as SCTOOLS_TPU_THREADS
+# in native._default_threads, regression-tested in tests/test_ingest.py).
+DEFAULT_PREFETCH_DEPTH = 2
+_DEPTH_ENV = "SCTOOLS_TPU_PREFETCH_DEPTH"
+MAX_PREFETCH_DEPTH = 64
+
+
+def prefetch_depth() -> int:
+    """Configured decode-ahead depth (SCTOOLS_TPU_PREFETCH_DEPTH, default 2)."""
+    env = os.environ.get(_DEPTH_ENV)
+    if env:
+        try:
+            value = int(env)
+            if 1 <= value <= MAX_PREFETCH_DEPTH:
+                return value
+        except ValueError:
+            pass
+    return DEFAULT_PREFETCH_DEPTH
 
 # consumer-side poll period: bounds how late a producer death without a
 # sentinel (interpreter teardown, native crash unwinding the thread) is
@@ -43,8 +70,17 @@ _GET_POLL_S = 0.5
 _ABANDON_JOIN_S = 10.0
 
 
-def prefetch_iterator(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
-    """Yield from ``iterable``, producing up to ``depth`` items ahead."""
+def prefetch_iterator(
+    iterable: Iterable[T], depth: Optional[int] = None
+) -> Iterator[T]:
+    """Yield from ``iterable``, producing up to ``depth`` items ahead.
+
+    ``depth=None`` (the default) reads the configured decode-ahead depth
+    (``prefetch_depth()``: SCTOOLS_TPU_PREFETCH_DEPTH, default 2); an
+    explicit depth pins it for callers with their own buffer budget.
+    """
+    if depth is None:
+        depth = prefetch_depth()
     items: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
 

@@ -1,0 +1,12 @@
+"""SCX112 positive fixture: bare device_put outside the ingest subsystem."""
+import jax
+import numpy as np
+from jax import device_put  # noqa: F401
+
+
+def stage(cols):
+    return {k: jax.device_put(v) for k, v in cols.items()}
+
+
+def stage_replicated(buf, devices):
+    return jax.device_put_replicated(np.asarray(buf), devices)

@@ -1,0 +1,23 @@
+"""SCX112 negative fixture: every staging rides the ingest choke point.
+
+The last function shows the inline escape hatch for a deliberate bare
+device_put (e.g. a REPL-only experiment file).
+"""
+import jax
+
+from sctools_tpu import ingest
+from sctools_tpu.ingest import upload
+
+
+def stage(cols):
+    device_cols, _ = ingest.upload(cols, site="fixture.stage")
+    return device_cols
+
+
+def stage_timed(buf):
+    device, nbytes = upload(buf, site="fixture.probe", timed=True)
+    return device, nbytes
+
+
+def stage_escaped(buf):
+    return jax.device_put(buf)  # scx-lint: disable=SCX112 -- deliberate

@@ -1,0 +1,238 @@
+"""ingest-smoke: the CI gate for scx-ingest (`make ingest-smoke`).
+
+A traced 2-worker run of the device gatherer on the prefetch ring, then
+the ingest contracts are held:
+
+- the ring actually ROTATED: each worker's trace carries ``decode`` spans
+  for at least two distinct arena slots, produced on the prefetch thread;
+- overlap actually HAPPENED: for adjacent pipeline stages, a decode span
+  (slot k+1, prefetch thread) overlaps an upload/compute span (slot k,
+  main thread) in wall time — the double-buffered claim, asserted on the
+  recorded timeline rather than trusted;
+- ZERO steady-state retraces across both workers' merged efficiency
+  report (the ring's fixed-capacity batches exist to make this 0);
+- the transfer ledger reconciles byte-for-byte with the upload/writeback
+  span bytes in the traces (gatherer accounting == ledger == spans).
+
+Exit 0 on success; any assertion failure is a gate failure. Run a worker
+directly with: python tests/ingest_smoke.py worker <bam> <out_stem>.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+BATCH_RECORDS = 4096
+N_CELLS = 2048  # x 4 molecules x 4 reads = 32768 records = 8 batches
+
+
+def fail(message: str) -> None:
+    print(f"ingest-smoke: FAIL: {message}", file=sys.stderr)
+    sys.exit(1)
+
+
+def worker(bam: str, out_stem: str) -> int:
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    gatherer = GatherCellMetrics(
+        bam, out_stem, backend="device", batch_records=BATCH_RECORDS
+    )
+    gatherer.extract_metrics()
+    print(json.dumps({
+        "bytes_h2d": gatherer.bytes_h2d, "bytes_d2h": gatherer.bytes_d2h,
+    }))
+    return 0
+
+
+def launch(workdir: str, process_id: int, bam: str):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["SCTOOLS_TPU_TRACE"] = os.path.join(workdir, "obs")
+    env["SCTOOLS_TPU_TRACE_WORKER"] = f"p{process_id}"
+    return subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__), "worker", bam,
+            os.path.join(workdir, f"metrics_p{process_id}"),
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def load_spans(trace_path: str):
+    spans = []
+    with open(trace_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            if "name" in record and "ts" in record:
+                spans.append(record)
+    return spans
+
+
+def overlaps(a: dict, b: dict) -> bool:
+    return a["ts"] < b["ts"] + b["dur"] and b["ts"] < a["ts"] + a["dur"]
+
+
+def check_worker_trace(trace_path: str) -> dict:
+    spans = load_spans(trace_path)
+    decodes = [s for s in spans if s["name"] == "decode"]
+    uploads = [s for s in spans if s["name"] == "upload"]
+    computes = [s for s in spans if s["name"] == "compute"]
+    if not decodes or not uploads or not computes:
+        fail(
+            f"{os.path.basename(trace_path)}: missing pipeline spans "
+            f"(decode={len(decodes)}, upload={len(uploads)}, "
+            f"compute={len(computes)})"
+        )
+    # the ring rotated: decode spans name >= 2 distinct arena slots
+    slots = {
+        (s.get("attrs") or {}).get("slot")
+        for s in decodes
+        if (s.get("attrs") or {}).get("slot") is not None
+    }
+    if len(slots) < 2:
+        fail(
+            f"{os.path.basename(trace_path)}: ring never rotated "
+            f"(slots seen: {sorted(slots)})"
+        )
+    # decode runs on the prefetch thread (except the eager first probe)
+    threaded = [s for s in decodes if s.get("thread") == "sctools-prefetch"]
+    if not threaded:
+        fail(
+            f"{os.path.basename(trace_path)}: no decode span on the "
+            "prefetch thread — the ring is not overlapping at all"
+        )
+    # overlap of adjacent stages: a prefetch-thread decode span must
+    # intersect a main-thread upload or compute span in wall time
+    upload_overlaps = sum(
+        1 for d in threaded for u in uploads if overlaps(d, u)
+    )
+    compute_overlaps = sum(
+        1 for d in threaded for c in computes if overlaps(d, c)
+    )
+    if upload_overlaps + compute_overlaps < 2:
+        fail(
+            f"{os.path.basename(trace_path)}: decode never overlapped "
+            f"upload/compute (upload={upload_overlaps}, "
+            f"compute={compute_overlaps}) — the pipeline is serialized"
+        )
+    return {
+        "decode": len(decodes),
+        "slots": len(slots),
+        "upload_overlaps": upload_overlaps,
+        "compute_overlaps": compute_overlaps,
+        "upload_bytes": sum(
+            int((s.get("attrs") or {}).get("bytes") or 0) for s in uploads
+        ),
+        "writeback_bytes": sum(
+            int((s.get("attrs") or {}).get("bytes") or 0)
+            for s in spans
+            if s["name"] == "writeback"
+        ),
+    }
+
+
+def main() -> int:
+    workdir = os.environ.get(
+        "SCTOOLS_TPU_INGEST_SMOKE_DIR"
+    ) or tempfile.mkdtemp(prefix="sctools_tpu_ingest_smoke.")
+    os.makedirs(workdir, exist_ok=True)
+
+    from sctools_tpu import native
+    from sctools_tpu.obs import xprof
+
+    if not native.available():
+        fail("native layer unavailable — the arena ring cannot be gated")
+
+    bams = []
+    for i in range(2):
+        bam = os.path.join(workdir, f"input_p{i}.bam")
+        native.synth_bam_native(
+            bam, n_cells=N_CELLS, molecules_per_cell=4,
+            reads_per_molecule=4, n_genes=512, seed=100 + i,
+        )
+        bams.append(bam)
+
+    procs = [launch(workdir, i, bams[i]) for i in range(2)]
+    worker_bytes = []
+    for proc in procs:
+        out, _ = proc.communicate(timeout=300)
+        if proc.returncode != 0:
+            fail(f"worker exited {proc.returncode}:\n{out[-2000:]}")
+        worker_bytes.append(json.loads(out.strip().splitlines()[-1]))
+
+    # ---- per-worker timeline: rotation + overlap
+    span_totals = {"upload": 0, "writeback": 0}
+    for i in range(2):
+        trace = os.path.join(workdir, "obs", f"trace.p{i}.jsonl")
+        if not os.path.exists(trace):
+            fail(f"missing worker trace {trace}")
+        stats = check_worker_trace(trace)
+        span_totals["upload"] += stats["upload_bytes"]
+        span_totals["writeback"] += stats["writeback_bytes"]
+        print(
+            f"ingest-smoke: p{i}: {stats['decode']} decode spans over "
+            f"{stats['slots']} slots, overlaps upload={stats['upload_overlaps']} "
+            f"compute={stats['compute_overlaps']}"
+        )
+
+    # ---- merged efficiency report: zero steady-state retraces
+    registries = xprof.load_registries(workdir)
+    if len(registries) < 2:
+        fail(f"expected 2 xprof registries, found {len(registries)}")
+    report = xprof.efficiency_report(workdir)
+    for name, row in report["sites"].items():
+        if row["retraces"]:
+            fail(
+                f"{name}: {row['retraces']} steady-state retrace(s) on "
+                "the ring pipeline"
+            )
+
+    # ---- ledger == span bytes == gatherer accounting
+    ledger = report["ledger"]
+    ledger_h2d = (
+        ledger.get("h2d", {}).get("by_site", {})
+        .get("gatherer.upload", {}).get("bytes", 0)
+    )
+    ledger_d2h = (
+        ledger.get("d2h", {}).get("by_site", {})
+        .get("gatherer.writeback", {}).get("bytes", 0)
+    )
+    gatherer_h2d = sum(w["bytes_h2d"] for w in worker_bytes)
+    gatherer_d2h = sum(w["bytes_d2h"] for w in worker_bytes)
+    if not (ledger_h2d == span_totals["upload"] == gatherer_h2d) or not ledger_h2d:
+        fail(
+            f"h2d reconciliation broke: ledger={ledger_h2d}, "
+            f"spans={span_totals['upload']}, gatherers={gatherer_h2d}"
+        )
+    if not (ledger_d2h == span_totals["writeback"] == gatherer_d2h) or not ledger_d2h:
+        fail(
+            f"d2h reconciliation broke: ledger={ledger_d2h}, "
+            f"spans={span_totals['writeback']}, gatherers={gatherer_d2h}"
+        )
+
+    print(
+        f"ingest-smoke: OK (h2d {ledger_h2d} bytes == spans == gatherers; "
+        f"0 steady-state retraces across {len(registries)} workers)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "worker":
+        sys.exit(worker(sys.argv[2], sys.argv[3]))
+    sys.exit(main())

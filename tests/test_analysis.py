@@ -34,7 +34,9 @@ ABI_BAD = os.path.join(FIXTURES, "abi", "bad")
 SUPP = os.path.join(FIXTURES, "supp")
 NATIVE = os.path.join(REPO, "sctools_tpu", "native")
 
-JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)] + ["SCX110", "SCX111"]
+JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)] + [
+    "SCX110", "SCX111", "SCX112",
+]
 
 
 # --------------------------------------------------------------- jax lint
@@ -55,6 +57,35 @@ def test_rule_silent_on_clean_fixture(rule):
     name = "platform.py" if rule == "SCX106" else f"{rule.lower()}_clean.py"
     findings = lint_file(os.path.join(JAXLINT, name))
     assert findings == [], [f.render() for f in findings]
+
+
+def test_scx112_ingest_dir_is_exempt(tmp_path):
+    # SCX112 is about ownership: the scx-ingest subsystem IS the sanctioned
+    # device_put site, wherever the repo checkout lives
+    ingest_dir = tmp_path / "ingest"
+    ingest_dir.mkdir()
+    path = ingest_dir / "staging.py"
+    path.write_text(
+        "import jax\n\n\ndef up(value):\n    return jax.device_put(value)\n"
+    )
+    assert lint_file(str(path)) == []
+    outside = tmp_path / "staging.py"
+    outside.write_text(
+        "import jax\n\n\ndef up(value):\n    return jax.device_put(value)\n"
+    )
+    findings = lint_file(str(outside))
+    assert {f.rule for f in findings} == {"SCX112"}
+    # only the IMMEDIATE parent confers ownership: a mere "ingest"
+    # ancestor (e.g. a checkout cloned under ~/ingest/) must not disable
+    # the rule
+    nested = ingest_dir / "sub"
+    nested.mkdir()
+    deep = nested / "staging.py"
+    deep.write_text(
+        "import jax\n\n\ndef up(value):\n    return jax.device_put(value)\n"
+    )
+    findings = lint_file(str(deep))
+    assert {f.rule for f in findings} == {"SCX112"}
 
 
 def test_inline_and_file_suppressions():
