@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate docs clean
 
-ci: native lint test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke perf-gate
+ci: native lint test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -94,6 +94,18 @@ ingest-smoke:
 	rm -rf /tmp/sctools_tpu_ingest_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_INGEST_SMOKE_DIR=/tmp/sctools_tpu_ingest_smoke \
 	$(PY) tests/ingest_smoke.py
+
+# resilience gate: a 2-worker run under the full device-fault cocktail
+# (device_oom + xla_transient + stall + two corrupt_record poisons) must
+# converge with ZERO failed journal events (guard absorbs device faults
+# below the scheduler), quarantine sidecars naming exactly the injected
+# records, output byte-identical to a fault-free run minus those records,
+# and 0 steady-state retraces from the OOM bisection
+# (tests/guard_smoke.py; docs/robustness.md).
+guard-smoke:
+	rm -rf /tmp/sctools_tpu_guard_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_GUARD_SMOKE_DIR=/tmp/sctools_tpu_guard_smoke \
+	$(PY) tests/guard_smoke.py
 
 # perf-regression gate self-test: bench.py --check must fail a
 # synthetically-degraded result and pass a trajectory-consistent one

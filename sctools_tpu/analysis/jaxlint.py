@@ -66,6 +66,17 @@ Rule catalog (docs/static_analysis.md has the rationale for each):
   the single source of truth and the H2D reconciliation gates
   (xprof-smoke, ingest-smoke, bench) go blind to the bytes. Files under
   ``ingest/`` and ``platform.py`` are exempt.
+- SCX113 unguarded-device-boundary: a ``try`` whose body makes a
+  device-boundary call (``ingest.upload``, an engine dispatch, the
+  distributed sort) with a broad handler (bare ``except``, ``Exception``,
+  ``BaseException``) that swallows the error instead of re-raising.
+  Ad-hoc swallowing at the device boundary bypasses the scx-guard
+  taxonomy: a transient loses its in-lease retry, an OOM its bisection,
+  poison its quarantine sidecar — and the failure disappears from every
+  counter. Route recovery through ``sctools_tpu.guard.run_batch`` /
+  ``guard.retrying`` instead. Handlers that re-raise (cleanup-then-raise,
+  e.g. the gatherers' discard-on-error) are fine; files under ``guard/``
+  (the recovery ladder itself) are exempt.
 """
 
 from __future__ import annotations
@@ -90,6 +101,7 @@ JAX_RULES = {
     "SCX110": "shardmap-shim",
     "SCX111": "uninstrumented-jit",
     "SCX112": "device-put-outside-ingest",
+    "SCX113": "unguarded-device-boundary",
 }
 
 # files allowed to mutate process-global jax.config (SCX106)
@@ -107,6 +119,23 @@ DEVICE_PUT_OWNERS = ("platform.py",)
 DEVICE_PUT_OWNER_DIRS = ("ingest",)
 _DEVICE_PUT_NAMES = (
     "device_put", "device_put_replicated", "device_put_sharded",
+)
+# the recovery ladder itself owns its try/except (SCX113): its attempt
+# loops ARE the sanctioned broad handlers every other call site routes
+# through
+GUARD_OWNER_DIRS = ("guard",)
+# function names that cross the device boundary (SCX113): the engine
+# dispatches and the one upload choke point. Matched as a call's terminal
+# name (`ingest.upload(...)` additionally requires an ingest-module root,
+# so an unrelated `.upload()` method elsewhere cannot false-positive).
+_BOUNDARY_CALL_NAMES = frozenset(
+    (
+        "compute_entity_metrics",
+        "sharded_entity_metrics",
+        "count_molecules",
+        "sharded_count_molecules",
+        "distributed_sort",
+    )
 )
 
 _JNP_CONSTRUCTORS = {
@@ -162,6 +191,8 @@ class _Aliases:
         self.np: Set[str] = set()
         self.functools: Set[str] = set()
         self.jit_names: Set[str] = set()  # from jax import jit
+        self.ingest_mods: Set[str] = set()  # from .. import ingest [as x]
+        self.upload_names: Set[str] = set()  # from ..ingest import upload
         self.instrument_names: Set[str] = set()  # from ..obs.xprof import instrument_jit
         self.xprof_mods: Set[str] = set()  # from ..obs import xprof [as x]
         self.shard_map_names: Set[str] = set()
@@ -194,6 +225,8 @@ class _Aliases:
                         self.time_mod.add(name)
                     elif alias.name == "datetime":
                         self.datetime_mod.add(name)
+                    elif alias.name.endswith(".ingest") and alias.asname:
+                        self.ingest_mods.add(alias.asname)
             elif isinstance(node, ast.ImportFrom):
                 mod = node.module or ""
                 for alias in node.names:
@@ -231,6 +264,12 @@ class _Aliases:
                         self.time_fn.add(bound)
                     elif mod == "datetime" and alias.name == "datetime":
                         self.datetime_cls.add(bound)
+                    elif alias.name == "ingest":
+                        # `from .. import ingest` / `from sctools_tpu
+                        # import ingest` (SCX113 boundary-call roots)
+                        self.ingest_mods.add(bound)
+                    elif alias.name == "upload" and mod.endswith("ingest"):
+                        self.upload_names.add(bound)
 
     # -- expression classifiers ------------------------------------------
 
@@ -902,6 +941,82 @@ class JaxLinter:
                         "sctools_tpu.ingest instead",
                     )
 
+    # -- SCX113 ------------------------------------------------------------
+
+    def _is_boundary_call(self, node: ast.Call) -> Optional[str]:
+        """The spelling when ``node`` crosses the device boundary."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in self.aliases.upload_names:
+                return f"{func.id}(...)"
+            if func.id in _BOUNDARY_CALL_NAMES:
+                return f"{func.id}(...)"
+            return None
+        if isinstance(func, ast.Attribute):
+            if func.attr == "upload" and isinstance(func.value, ast.Name) \
+                    and func.value.id in self.aliases.ingest_mods:
+                return f"{func.value.id}.upload(...)"
+            if func.attr in _BOUNDARY_CALL_NAMES:
+                return f"...{func.attr}(...)"
+        return None
+
+    def _is_broad_handler(self, handler: ast.ExceptHandler) -> bool:
+        kind = handler.type
+        if kind is None:
+            return True  # bare except
+        names = []
+        if isinstance(kind, ast.Name):
+            names = [kind.id]
+        elif isinstance(kind, ast.Tuple):
+            names = [e.id for e in kind.elts if isinstance(e, ast.Name)]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    def _check_unguarded_boundary(self) -> None:
+        """try/except that swallows device-boundary failures (SCX113).
+
+        Fires when a ``try`` body makes a device-boundary call AND a broad
+        handler swallows (no ``raise`` anywhere in the handler body). The
+        cleanup-then-reraise shape — the gatherers' discard-on-error —
+        keeps its re-raise and stays exempt, as does the guard package:
+        its attempt loops ARE the sanctioned handlers.
+        """
+        parts = os.path.normpath(self.path).split(os.sep)
+        if len(parts) >= 2 and parts[-2] in GUARD_OWNER_DIRS:
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            swallowing = [
+                h for h in node.handlers
+                if self._is_broad_handler(h)
+                and not any(
+                    isinstance(sub, ast.Raise) for sub in ast.walk(h)
+                )
+            ]
+            if not swallowing:
+                continue
+            boundary = None
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        boundary = self._is_boundary_call(sub)
+                        if boundary:
+                            break
+                if boundary:
+                    break
+            if boundary:
+                handler = swallowing[0]
+                self._report(
+                    "SCX113", handler,
+                    f"broad `except` swallows failures from the "
+                    f"device-boundary call `{boundary}`: the error loses "
+                    "its taxonomy (no transient retry, no OOM bisection, "
+                    "no poison quarantine) and vanishes from every "
+                    "counter; route recovery through "
+                    "sctools_tpu.guard.run_batch / guard.retrying",
+                    span=handler,
+                )
+
     # -- driver ------------------------------------------------------------
 
     def run(self) -> List[Finding]:
@@ -913,6 +1028,7 @@ class JaxLinter:
         self._check_shardmap_shim()
         self._check_uninstrumented_jit()
         self._check_device_put()
+        self._check_unguarded_boundary()
         return self.findings
 
 

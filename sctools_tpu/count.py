@@ -163,6 +163,12 @@ class _MoleculeAccumulator:
         out = sharded_count_molecules(stacked, self._mesh)
         is_molecule = np.asarray(out["is_molecule"])
         gene_vocab_cols = self._gene_vocab_cols(frame)
+        # two phases, deliberately: ALL device pulls first, host mutation
+        # only after every shard landed. The guard ladder may re-run this
+        # whole batch on a transient/OOM surfacing at any pull — a
+        # per-shard append interleaved with pulls would leave the earlier
+        # shards' molecules double-counted on retry.
+        staged = []
         for shard in range(self._n_shards):
             mask = is_molecule[shard]
             if not mask.any():
@@ -172,6 +178,8 @@ class _MoleculeAccumulator:
             genes = np.asarray(out["gene"][shard])[mask]
             local_first = np.asarray(out["first_index"][shard])[mask]
             first = orig[shard][local_first.astype(np.int64)]
+            staged.append((cells, umis, genes, first))
+        for cells, umis, genes, first in staged:
             self._append_molecules(
                 frame, cells, umis, genes, first, offset, gene_vocab_cols
             )
@@ -394,7 +402,7 @@ class CountMatrix:
         batch_records: int = DEFAULT_BATCH_RECORDS,
         mesh=None,
     ) -> "CountMatrix":
-        from . import ingest
+        from . import guard, ingest
         from .io.packed import (
             compact_frame,
             concat_frames,
@@ -404,6 +412,26 @@ class CountMatrix:
         from .ops.segments import bucket_size
 
         accumulator = _MoleculeAccumulator(gene_name_to_index, mesh=mesh)
+
+        def guarded_add(batch_frame, batch_offset: int, pad: int) -> None:
+            """One kernel batch through the scx-guard recovery ladder.
+
+            Transient device errors retry under the lease; OOM bisects at
+            query-name boundaries (a query's multi-gene resolution spans
+            its whole group, so the cut must respect groups); poisoned
+            records quarantine to sidecars and the batch continues
+            without them. Sub-frames pad per ``guard.sub_pad_to``.
+            """
+            guard.run_batch(
+                lambda sub, off: accumulator.add_batch(
+                    sub, off, pad_to=guard.sub_pad_to(pad),
+                ),
+                batch_frame,
+                site="count.dispatch",
+                name=str(bam_file),
+                offset=batch_offset,
+                splitter=guard.key_splitter(lambda f: f.qname),
+            )
         # the scx-ingest prefetch ring: native batches decode into recycled
         # zero-copy arenas on the prefetch thread while the kernel counts
         # the previous batch; custom tag keys fall back to the Python
@@ -445,18 +473,16 @@ class CountMatrix:
                     if not eligible.size:
                         break
                     cut = int(eligible[-1]) + 1
-                    accumulator.add_batch(
+                    guarded_add(
                         slice_frame(frame, 0, cut),
                         offset,
-                        pad_to=capacity if multi_batch else 0,
+                        capacity if multi_batch else 0,
                     )
                     offset += cut
                     frame = copy_frame(compact_frame(
                         slice_frame(frame, cut, frame.n_records)
                     ))
-                accumulator.add_batch(
-                    frame, offset, pad_to=capacity if multi_batch else 0
-                )
+                guarded_add(frame, offset, capacity if multi_batch else 0)
                 break
             changes = np.nonzero(frame.qname[1:] != frame.qname[:-1])[0]
             if changes.size == 0:
@@ -474,10 +500,10 @@ class CountMatrix:
             # that keeps the group intact
             eligible = changes[changes < capacity]
             cut = int(eligible[-1] if eligible.size else changes[0]) + 1
-            accumulator.add_batch(
+            guarded_add(
                 slice_frame(frame, 0, cut),
                 offset,
-                pad_to=capacity if multi_batch else 0,
+                capacity if multi_batch else 0,
             )
             offset += cut
             # compacted (vocabulary hygiene) AND copied (arena aliasing)

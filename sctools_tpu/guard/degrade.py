@@ -1,0 +1,141 @@
+"""The degradation ladder: loud, bounded fallback when a device site fails.
+
+A resident server cannot treat "the accelerator path at site X keeps
+failing" as a reason to fail every task that touches X. Each site gets a
+per-process failure budget (``SCTOOLS_TPU_GUARD_DEGRADE_AFTER`` device
+failures, default 3); when the budget is spent, the site is marked
+degraded to its next rung and consumers switch paths:
+
+===========================  =====================  ======================
+site                         healthy                degraded rung
+===========================  =====================  ======================
+``ingest.native``            native arena decoder   Python decoder
+                                                    (rest of the stream)
+``whitelist.correct_pallas`` Pallas TPU kernel      jnp fallback kernel
+``gatherer.dispatch``        device batch pipeline  CPU streaming backend
+                                                    (next task attempt)
+===========================  =====================  ======================
+
+Degradation is NEVER silent: each transition bumps the
+``guard_degraded`` counter (plus a per-site ``guard_degraded_<site>``
+series for the Prometheus snapshot), emits a ``guard:degraded`` span so
+the fleet timeline shows exactly when a worker fell off the device path,
+and prints one stderr line. State is per-process and in-memory — a
+restarted worker gets a fresh chance at the healthy path, which is the
+behavior a transient device incident wants.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict
+
+from .. import obs
+
+ENV_THRESHOLD = "SCTOOLS_TPU_GUARD_DEGRADE_AFTER"
+DEFAULT_THRESHOLD = 3
+
+# the rung each site falls to when its failure budget is spent — the
+# table above, as data. A site with NO entry here never marks itself
+# degraded (there is nothing to fall to): its failures still count
+# (``guard_device_failures*``), but no "degraded to X" message is ever
+# printed for a fallback that does not exist.
+RUNGS: Dict[str, str] = {
+    "ingest.native": "python-decoder",
+    "whitelist.correct_pallas": "jnp",
+    "gatherer.dispatch": "cpu",
+}
+
+_lock = threading.Lock()
+_failures: Dict[str, int] = {}
+_degraded: Dict[str, str] = {}  # site -> level name
+
+
+def threshold() -> int:
+    """Device failures at one site before it degrades (>=1; env knob)."""
+    raw = os.environ.get(ENV_THRESHOLD, "")
+    if raw:
+        try:
+            value = int(raw)
+            if value >= 1:
+                return value
+        except ValueError:
+            pass
+    return DEFAULT_THRESHOLD
+
+
+def note_device_failure(site: str) -> bool:
+    """Record one device-side failure at ``site``; True when this one
+    crossed the threshold and the site just degraded to its RUNGS entry.
+
+    Sites without a rung only accumulate failure counters — a loud
+    "degraded to cpu" for a site nothing ever falls back from would send
+    an operator chasing a fallback that does not exist.
+    """
+    obs.count("guard_device_failures")
+    obs.count(f"guard_device_failures_{site.replace('.', '_')}")
+    level = RUNGS.get(site)
+    with _lock:
+        if site in _degraded:
+            return False
+        _failures[site] = _failures.get(site, 0) + 1
+        if level is None or _failures[site] < threshold():
+            return False
+        _degraded[site] = level
+    obs.count("guard_degraded")
+    obs.count(f"guard_degraded_{site.replace('.', '_')}")
+    with obs.span("guard:degraded", site=site, level=level):
+        pass
+    sys.stderr.write(
+        f"sctools-tpu guard: site {site} degraded to {level} after "
+        f"{threshold()} device failure(s) (this process)\n"
+    )
+    sys.stderr.flush()
+    return True
+
+
+def degrade_now(site: str, level: str, reason: str = "") -> None:
+    """Degrade ``site`` immediately (mid-stream native failure: one strike).
+
+    Same loud path as the threshold crossing — counter, span, stderr.
+    """
+    with _lock:
+        if site in _degraded:
+            return
+        _degraded[site] = level
+    obs.count("guard_degraded")
+    obs.count(f"guard_degraded_{site.replace('.', '_')}")
+    with obs.span("guard:degraded", site=site, level=level, reason=reason):
+        pass
+    sys.stderr.write(
+        f"sctools-tpu guard: site {site} degraded to {level}"
+        f"{': ' + reason if reason else ''}\n"
+    )
+    sys.stderr.flush()
+
+
+def is_degraded(site: str) -> bool:
+    with _lock:
+        return site in _degraded
+
+
+# death-path safe (obs.bounded_snapshot): the flight dump may run inside
+# a signal handler that interrupted a lock holder on this thread
+degraded_sites = obs.bounded_snapshot(_lock, lambda: dict(_degraded), {})
+degraded_sites.__doc__ = (
+    "Snapshot of degraded sites -> level (flight records, status lines)."
+)
+
+
+def failure_counts() -> Dict[str, int]:
+    with _lock:
+        return dict(_failures)
+
+
+def reset() -> None:
+    """Clear all degradation state (tests)."""
+    with _lock:
+        _failures.clear()
+        _degraded.clear()

@@ -1,0 +1,176 @@
+"""The device-boundary error taxonomy: what an exception MEANS for retry.
+
+Every exception crossing the device boundary folds into one of four
+recovery classes (docs/robustness.md has the full table):
+
+- :class:`Transient` — the work was fine, the attempt was unlucky
+  (XLA runtime hiccup, link reset, preempted collective). Retry in place
+  with jittered backoff; lease-safe (the retry happens under the same
+  scheduler lease and burns no sched attempt).
+- :class:`ResourceExhausted` — the device ran out of memory for this
+  batch shape. The batch bisects into halves down to a floor bucket
+  (:func:`sctools_tpu.guard.run_batch`), merging partial results.
+- :class:`PoisonData` — the failure is attributable to the input bytes
+  (decode error, validation failure). Retrying cannot help; the offending
+  record range is isolated, quarantined to a sidecar, and the remainder
+  continues.
+- :class:`Fatal` — everything else: bugs, misconfiguration, injected
+  task-level faults. Propagates unchanged so the scheduler's own
+  retry/quarantine ladder (which DOES burn attempts) takes over.
+
+:func:`classify` maps a raw exception to one of the four kinds. It is
+string/type-name based on purpose: importing jax (or jaxlib) here would
+drag the device runtime into every stdlib-only consumer (sched CLI,
+faults), and the XLA error surface is stringly-typed anyway — the status
+code NAMES inside ``XlaRuntimeError`` messages are the stable contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+# classification kinds (classify() return values)
+TRANSIENT = "transient"
+RESOURCE_EXHAUSTED = "resource_exhausted"
+POISON = "poison"
+FATAL = "fatal"
+
+KINDS = (TRANSIENT, RESOURCE_EXHAUSTED, POISON, FATAL)
+
+
+class GuardError(RuntimeError):
+    """Base of the typed taxonomy (raisable forms of the classes above)."""
+
+    kind = FATAL
+
+
+class Transient(GuardError):
+    """Retry in place: the attempt failed, the work and the data are fine."""
+
+    kind = TRANSIENT
+
+
+class ResourceExhausted(GuardError):
+    """Device OOM for this batch shape: bisect and merge partial results."""
+
+    kind = RESOURCE_EXHAUSTED
+
+
+class PoisonData(GuardError):
+    """The input bytes are bad: isolate, quarantine, continue without them.
+
+    ``record_range`` (absolute ``(start, stop)`` record indices in the
+    task's decode stream) localizes the poison when the raiser knows it —
+    guard then quarantines exactly that range without bisecting.
+    """
+
+    kind = POISON
+
+    def __init__(self, *args, record_range: Optional[Tuple[int, int]] = None):
+        super().__init__(*args)
+        self.record_range = record_range
+
+
+class Fatal(GuardError):
+    """Not recoverable at the batch boundary; the scheduler decides."""
+
+    kind = FATAL
+
+
+class Stall(Transient):
+    """A watchdog deadline fired: the leg exceeded its configured budget.
+
+    Raised asynchronously into the stalled thread by
+    :mod:`sctools_tpu.guard.watchdog` — a Transient, so the guard retry
+    ladder absorbs it instead of the lease hanging to TTL.
+    """
+
+
+class NativeDecodeError(PoisonData):
+    """The native decoder failed mid-stream, with localization attached.
+
+    ``batch_index`` is the ring batch that failed; ``record_offset`` the
+    approximate absolute record index where the stream stood (records
+    yielded so far) — what guard's poison bisection and a human
+    postmortem both need to find WHERE in a 100M-record file the bytes
+    went bad.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        batch_index: Optional[int] = None,
+        record_offset: Optional[int] = None,
+    ):
+        detail = message
+        if batch_index is not None or record_offset is not None:
+            detail = (
+                f"{message} (batch_index={batch_index}, "
+                f"record_offset~={record_offset})"
+            )
+        super().__init__(detail)
+        self.batch_index = batch_index
+        self.record_offset = record_offset
+
+
+# message fragments that mark an XLA/runtime failure as OOM vs transient.
+# These are gRPC/absl status-code NAMES plus the phrases XLA's allocator
+# uses — the stable, documented surface of the stringly-typed errors.
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "OOM",
+    "Resource exhausted",
+    "Failed to allocate",
+)
+# status-code names that mark a device error as PERMANENT — wrong
+# program, wrong arguments, wrong permissions — where a retry can only
+# waste backoff before the scheduler sees it anyway
+_PERMANENT_MARKERS = (
+    "INVALID_ARGUMENT",
+    "FAILED_PRECONDITION",
+    "PERMISSION_DENIED",
+    "UNAUTHENTICATED",
+    "UNIMPLEMENTED",
+    "NOT_FOUND",
+)
+# exception TYPE names that put an error on the device side of the
+# boundary at all (anything else non-taxonomy classifies fatal)
+_DEVICE_ERROR_TYPES = (
+    "XlaRuntimeError",
+    "JaxRuntimeError",
+    "RpcError",
+)
+
+
+def classify(error: BaseException) -> str:
+    """Fold ``error`` into one of the four recovery kinds.
+
+    Explicit taxonomy instances win. Device-runtime errors (recognized by
+    type name, never by import) split on the status-code markers in
+    their message: OOM markers -> RESOURCE_EXHAUSTED, permanent markers
+    (INVALID_ARGUMENT and friends — a retry can only waste backoff) ->
+    FATAL, and everything else on the device side defaults to TRANSIENT
+    (the conservative choice at this boundary: one bounded retry ladder,
+    then the scheduler sees it anyway). ``MemoryError`` is resource
+    exhaustion wherever it happens. Non-device errors — including the
+    scheduler's own injected task faults — are FATAL here, meaning "not
+    guard's call": they propagate to the scheduler unchanged.
+    """
+    if isinstance(error, GuardError):
+        return error.kind
+    if isinstance(error, MemoryError):
+        return RESOURCE_EXHAUSTED
+    type_name = type(error).__name__
+    message = str(error)
+    device_side = type_name in _DEVICE_ERROR_TYPES or type(
+        error
+    ).__module__.startswith(("jaxlib", "jax._src.lib"))
+    if device_side:
+        if any(marker in message for marker in _OOM_MARKERS):
+            return RESOURCE_EXHAUSTED
+        if any(marker in message for marker in _PERMANENT_MARKERS):
+            return FATAL
+        return TRANSIENT
+    return FATAL

@@ -1,0 +1,174 @@
+"""Stall watchdogs: deadline timers on the decode/upload/compute legs.
+
+A hung leg — a decoder thread wedged on a dead filesystem, an upload
+stuck behind a dropped link, a collective waiting on a peer that will
+never arrive — previously held its scheduler lease until TTL, then died
+as an anonymous steal. The watchdog turns a stall into a DIAGNOSED,
+RETRYABLE failure: when a leg exceeds its deadline the timer thread
+
+1. dumps a flight record (``stall@<leg>:<site>`` — ring-buffer spans,
+   counters, the stalled thread's open span stack, ring slot states and
+   open guard retries via the flight sections registry), and
+2. raises :class:`~sctools_tpu.guard.errors.Stall` — a ``Transient`` —
+   asynchronously into the stalled thread, so the guard retry ladder
+   absorbs it in place instead of the lease expiring.
+
+Deadlines are OFF by default (0 = disabled) and configured per leg::
+
+    SCTOOLS_TPU_GUARD_TIMEOUT_DECODE=30   # ring frame pull, seconds
+    SCTOOLS_TPU_GUARD_TIMEOUT_UPLOAD=30   # ingest.upload H2D staging
+    SCTOOLS_TPU_GUARD_TIMEOUT_COMPUTE=120 # guarded batch dispatch
+
+Limitation (by design, documented): the asynchronous raise lands between
+Python bytecodes, so a leg blocked inside ONE long uninterruptible C
+call surfaces the Stall only when that call returns. The flight record
+and the ``guard_stalls`` counter still fire on time — the postmortem
+exists even when the unstick has to wait for the C call (or the lease
+TTL) — and the injected ``stall`` fault sleeps in small increments
+precisely so the chaos tests exercise the prompt path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import ctypes
+import os
+import threading
+from typing import Iterable, Iterator, Optional, TypeVar
+
+from .. import obs
+from .errors import Stall
+
+T = TypeVar("T")
+
+ENV_PREFIX = "SCTOOLS_TPU_GUARD_TIMEOUT_"
+LEGS = ("decode", "upload", "compute")
+
+
+def leg_timeout(leg: str) -> float:
+    """Configured deadline in seconds for ``leg`` (0 = watchdog off).
+
+    Garbage or negative values fall back to 0 (disabled) — the same
+    forgiving env contract as SCTOOLS_TPU_PREFETCH_DEPTH.
+    """
+    raw = os.environ.get(ENV_PREFIX + leg.upper(), "")
+    if not raw:
+        return 0.0
+    try:
+        value = float(raw)
+    except ValueError:
+        return 0.0
+    return value if value > 0 else 0.0
+
+
+def _async_raise(thread_ident: int) -> bool:
+    """Raise :class:`Stall` in the thread ``thread_ident`` (CPython API)."""
+    result = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_ident), ctypes.py_object(Stall)
+    )
+    if result > 1:
+        # more than one thread state modified: revoke (CPython contract)
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(thread_ident), None
+        )
+        return False
+    return result == 1
+
+
+@contextlib.contextmanager
+def deadline(leg: str, site: str = "", seconds: Optional[float] = None):
+    """Run the body under a stall deadline for ``leg`` (no-op when off).
+
+    ``seconds=None`` reads the leg's env knob. The timer thread checks an
+    armed flag under a lock before raising, and the exit path clears the
+    flag under the same lock, so a deadline that expires while the body
+    is already unwinding cannot raise into unrelated code.
+    """
+    if seconds is None:
+        seconds = leg_timeout(leg)
+    if not seconds or seconds <= 0:
+        yield
+        return
+    target = threading.get_ident()
+    lock = threading.Lock()
+    armed = [True]
+    fired = [False]
+
+    def fire() -> None:
+        with lock:
+            if not armed[0]:
+                return
+            fired[0] = True
+            obs.count("guard_stalls")
+            obs.count(f"guard_stalls_{leg}")
+            try:
+                obs.flight_dump(reason=f"stall@{leg}:{site}")
+            except Exception:  # noqa: BLE001 - the raise must still happen
+                pass
+            _async_raise(target)
+
+    timer = threading.Timer(seconds, fire)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        # the timer may have decided to raise, with asynchronous delivery
+        # landing at a bytecode boundary of THIS thread — possibly only
+        # now, after the body already finished (the deadline expired in
+        # the same instant the leg completed). The ENTIRE teardown runs
+        # inside the absorbing try, so a pending Stall delivered at the
+        # flag clear, the cancel, the fired check, or the spin loop is
+        # swallowed — a successfully-finished body is never retried as a
+        # stall. When the Stall already delivered inside the body (the
+        # normal case), it is in flight, not pending: nothing new arrives
+        # here and the unwinding exception continues untouched.
+        try:
+            with lock:
+                armed[0] = False
+            timer.cancel()
+            if fired[0]:
+                for _ in range(100):
+                    pass
+        except Stall:
+            pass
+
+
+def guarded_iter(
+    iterable: Iterable[T], leg: str = "decode", site: str = ""
+) -> Iterator[T]:
+    """Yield from ``iterable`` with each pull under the leg's deadline.
+
+    The ring decode watchdog: wraps the consumer side of the prefetch
+    ring, so a producer that stops feeding the queue without dying (the
+    one case prefetch's dead-producer detection cannot see) surfaces as
+    a Stall at the pull instead of hanging the consumer.
+    """
+    iterator = iter(iterable)
+    try:
+        while True:
+            # the same late-delivery belt as the guard attempt loops: a
+            # Stall landing after next() already returned (async delivery
+            # racing the deadline exit) must not drop the pulled item
+            pulled = False
+            exhausted = False
+            item = None
+            try:
+                with deadline(leg, site=site):
+                    try:
+                        item = next(iterator)
+                        pulled = True
+                    except StopIteration:
+                        exhausted = True
+            except Stall:
+                if not pulled and not exhausted:
+                    raise
+            if exhausted:
+                return
+            yield item
+    finally:
+        # abandonment must reach the source promptly (the prefetch ring's
+        # close hook releases the native stream handle)
+        close = getattr(iterator, "close", None)
+        if close is not None:
+            close()

@@ -26,6 +26,7 @@ import contextlib
 import time
 from typing import Any, Tuple
 
+from .. import guard
 from ..obs import xprof
 from ..utils.prefetch import prefetch_depth
 from .ring import ring_frames, ring_slots
@@ -90,17 +91,30 @@ def upload(
     nbytes = int(
         sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(value))
     )
-    start = time.perf_counter() if timed else 0.0
-    if sharding is not None:
-        device_value = jax.device_put(value, sharding)
-    else:
-        device_value = jax.device_put(value)
-    seconds = 0.0
-    if timed:
-        jax.block_until_ready(device_value)
-        seconds = time.perf_counter() - start
+    measured = [0.0]
+
+    def _put():
+        # the retried unit: the put (and, when timed, the landing). A
+        # transient link failure re-dispatches the same host buffers; a
+        # successful earlier attempt's device value is simply replaced.
+        start = time.perf_counter() if timed else 0.0
+        if sharding is not None:
+            staged = jax.device_put(value, sharding)
+        else:
+            staged = jax.device_put(value)
+        if timed:
+            jax.block_until_ready(staged)
+            measured[0] = time.perf_counter() - start
+        return staged
+
+    # the guard transient ladder around the ONE device_put door: every
+    # upload in the library gets retry-on-transient and the upload stall
+    # watchdog for free (the deadline lives in retrying, so it also
+    # covers an injected stall at this site; no-fault overhead is one
+    # armed-faults check)
+    device_value = guard.retrying(_put, site=site, leg="upload")
     if record:
-        xprof.record_transfer("h2d", nbytes, seconds=seconds, site=site)
+        xprof.record_transfer("h2d", nbytes, seconds=measured[0], site=site)
     return device_value, nbytes
 
 

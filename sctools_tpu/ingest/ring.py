@@ -19,11 +19,34 @@ yielded by the ring is therefore valid only until the consumer has pulled
 gatherers' entity carry — must be copied
 (:func:`sctools_tpu.io.packed.copy_frame`), and the rewired pipelines do.
 
-Failure contract: a decoder death mid-fill (truncated BGZF, malformed
-record, native error) raises promptly in the consumer at the point of the
-failed batch — never a hang — via prefetch_iterator's dead-producer
-detection; the stream handle is closed on both clean exhaustion and
-abandonment. When the native layer is unavailable (no toolchain,
+Failure contract (scx-guard integration):
+
+- A decoder death mid-fill raises promptly in the consumer at the point
+  of the failed batch — never a hang — via prefetch_iterator's
+  dead-producer detection. The error is a
+  :class:`~sctools_tpu.guard.errors.NativeDecodeError` carrying the
+  failing batch index and the approximate record offset, so guard's
+  poison isolation and a human postmortem can localize WHERE in the file
+  the bytes went bad.
+- A mid-stream native failure DOWNGRADES to the Python decoder for the
+  remainder of the stream (the guard degradation ladder, loud: the
+  ``ingest.native`` site degrades, ``guard_native_downgrades`` counts,
+  one stderr line) — the Python decoder re-reads from the top and skips
+  the records already yielded, so the consumer sees one uninterrupted
+  record stream. If the bytes are truly corrupt the Python decoder fails
+  at the same region and THAT error propagates; set
+  ``SCTOOLS_TPU_GUARD_NATIVE_DOWNGRADE=0`` to restore the old hard
+  raise. A failure at the head of the file (bad magic, truncated header)
+  still falls back before any batch is yielded, as before.
+- The consumer side rides the ``decode`` stall watchdog
+  (``SCTOOLS_TPU_GUARD_TIMEOUT_DECODE``): a producer that stops feeding
+  the queue without dying surfaces as a flight-dumped
+  :class:`~sctools_tpu.guard.errors.Stall` instead of a silent hang.
+- Ring slot states are registered as a flight-record section, so a
+  SIGTERM/crash postmortem shows which slot was filling and how many
+  batches the ring had rotated.
+
+When the native layer is unavailable (no toolchain,
 ``SCTOOLS_TPU_NATIVE=0``), the input is SAM, or custom tag keys are
 requested, the ring degrades to the Python decoder behind the same
 prefetch queue — the CPU fallback path, intact.
@@ -32,9 +55,15 @@ prefetch queue — the CPU fallback path, intact.
 from __future__ import annotations
 
 import itertools
+import os
+import sys
+import threading
 from typing import Iterable, Iterator, Optional
 
 from .. import obs
+from ..guard import degrade
+from ..guard.errors import NativeDecodeError
+from ..guard.watchdog import guarded_iter
 from ..io.packed import DEFAULT_TAG_KEYS, ReadFrame
 from ..utils.prefetch import prefetch_depth, prefetch_iterator
 from .arena import ColumnArena, arena_capacity
@@ -42,6 +71,43 @@ from .arena import ColumnArena, arena_capacity
 # consumer-held frames the slot budget reserves headroom for (current
 # frame + one look-ahead, the widest pattern among the rewired pipelines)
 _CONSUMER_SLOTS = 2
+
+ENV_NATIVE_DOWNGRADE = "SCTOOLS_TPU_GUARD_NATIVE_DOWNGRADE"
+
+# live ring state for flight records: ring id -> {slot, batches, phase}.
+# Updated by the producer thread (cheap dict stores under one lock);
+# a postmortem reads it through the obs flight-section registry.
+_state_lock = threading.Lock()
+_ring_state: dict = {}
+_ring_ids = itertools.count()
+
+
+# death-path safe (obs.bounded_snapshot): the flight dump may run inside
+# a signal handler that interrupted a _set_ring_state holder on this very
+# thread (the eager first-batch probe fills on the caller's thread)
+_ring_snapshot = obs.bounded_snapshot(
+    _state_lock,
+    lambda: [dict(v, ring=k) for k, v in sorted(_ring_state.items())],
+    [],
+)
+
+obs.register_flight_section("ring_slots", _ring_snapshot)
+
+
+def _set_ring_state(ring_id: int, **fields) -> None:
+    with _state_lock:
+        _ring_state.setdefault(ring_id, {}).update(fields)
+
+
+def _drop_ring_state(ring_id: int) -> None:
+    with _state_lock:
+        _ring_state.pop(ring_id, None)
+
+
+def native_downgrade_enabled() -> bool:
+    """Whether a mid-stream native failure downgrades to the Python
+    decoder (default) instead of raising (``=0`` restores the raise)."""
+    return os.environ.get(ENV_NATIVE_DOWNGRADE, "") != "0"
 
 
 def ring_slots(depth: Optional[int] = None) -> int:
@@ -56,9 +122,12 @@ def ring_slots(depth: Optional[int] = None) -> int:
 
 def _wrap_source(source: Iterable[ReadFrame], depth: int) -> Iterator[ReadFrame]:
     """The fallback ring: Python-decoded frames behind the prefetch queue."""
-    return prefetch_iterator(
-        obs.iter_spans("decode", source, records=lambda f: f.n_records),
-        depth=depth,
+    return guarded_iter(
+        prefetch_iterator(
+            obs.iter_spans("decode", source, records=lambda f: f.n_records),
+            depth=depth,
+        ),
+        leg="decode",
     )
 
 
@@ -67,32 +136,83 @@ def _produce_arena_frames(stream, arenas, batch_records: int, want_qname: bool):
 
     Runs on the prefetch thread: the ``decode`` spans here time actual
     native decode + arena fill work, not consumer wait, and carry the slot
-    index so a trace shows the ring rotating.
+    index so a trace shows the ring rotating. A native failure raises
+    :class:`NativeDecodeError` with the batch index and the approximate
+    record offset (records yielded before the failing batch) attached.
     """
     n_slots = len(arenas)
+    ring_id = next(_ring_ids)
+    _set_ring_state(ring_id, slots=n_slots, batches=0, phase="starting")
+    consumed = 0
     try:
         for k in itertools.count():
             arena = arenas[k % n_slots]
+            _set_ring_state(
+                ring_id, slot=k % n_slots, batches=k, phase="filling",
+                record_offset=consumed,
+            )
             with obs.span("decode", slot=k % n_slots) as sp:
-                n = stream.next(batch_records)
-                if n == 0:
-                    sp.add(eof=1)  # the terminating poll, not a batch
-                    return
-                arena.fill(stream)
-                frame = arena.frame(
-                    n,
-                    cell_names=stream.vocab("cell"),
-                    umi_names=stream.vocab("umi"),
-                    gene_names=stream.vocab("gene"),
-                    qname_names=(
-                        stream.vocab("qname") if want_qname else None
-                    ),
-                )
+                try:
+                    n = stream.next(batch_records)
+                    if n == 0:
+                        sp.add(eof=1)  # the terminating poll, not a batch
+                        _set_ring_state(ring_id, phase="eof")
+                        return
+                    arena.fill(stream)
+                    frame = arena.frame(
+                        n,
+                        cell_names=stream.vocab("cell"),
+                        umi_names=stream.vocab("umi"),
+                        gene_names=stream.vocab("gene"),
+                        qname_names=(
+                            stream.vocab("qname") if want_qname else None
+                        ),
+                    )
+                except NativeDecodeError:
+                    raise
+                except RuntimeError as error:
+                    _set_ring_state(ring_id, phase="failed")
+                    raise NativeDecodeError(
+                        str(error), batch_index=k, record_offset=consumed
+                    ) from error
                 sp.add(records=n)
             obs.count("ingest_arena_batches")
+            _set_ring_state(ring_id, phase="queued")
+            consumed += n
             yield frame
     finally:
         stream.close()
+        _drop_ring_state(ring_id)
+
+
+def _python_frames_from(
+    bam_path: str,
+    batch_records: int,
+    mode: Optional[str],
+    want_qname: bool,
+    keys: tuple,
+    skip_records: int,
+) -> Iterator[ReadFrame]:
+    """Python-decoded frames starting at absolute record ``skip_records``.
+
+    The downgrade tail: re-reads the file from the top (the Python
+    decoder has no mid-file seek) and drops the records the native ring
+    already yielded, so the consumer's stream stays gap- and
+    duplicate-free.
+    """
+    from ..io.packed import iter_frames_from_bam, slice_frame
+
+    remaining = skip_records
+    for frame in iter_frames_from_bam(
+        bam_path, batch_records, mode, want_qname=want_qname, tag_keys=keys
+    ):
+        if remaining >= frame.n_records:
+            remaining -= frame.n_records
+            continue
+        if remaining:
+            frame = slice_frame(frame, remaining, frame.n_records)
+            remaining = 0
+        yield frame
 
 
 def ring_frames(
@@ -160,8 +280,7 @@ def ring_frames(
     # probe the first batch eagerly: a native decode failure at the head of
     # the file (bad magic, truncated header) falls back to the Python
     # decoder and its diagnostics, matching iter_frames_from_bam; failures
-    # PAST the first batch raise — silently re-decoding from scratch would
-    # hide data corruption mid-file
+    # PAST the first batch ride the guard degradation ladder below
     try:
         first = next(produced)
     except StopIteration:
@@ -175,10 +294,50 @@ def ring_frames(
         # abandonment path calls close() on its iterable, and that close
         # must reach the producer so the native stream handle is released
         # deterministically, not at GC
+        consumed = 0
+        native_error = None
         try:
-            yield first
-            yield from produced
+            try:
+                yield first
+                consumed += first.n_records
+                for frame in produced:
+                    yield frame
+                    consumed += frame.n_records
+                return
+            except NativeDecodeError as error:
+                if not native_downgrade_enabled():
+                    raise
+                native_error = error
+                # the degradation ladder, rung 1: finish the stream on the
+                # Python decoder. Loud by contract — site counter + span +
+                # stderr — and gap-free: the tail skips the records the
+                # native ring already yielded. Truly corrupt bytes make
+                # the Python decoder fail in the same region, and that
+                # error (with this one chained) propagates.
+                obs.count("guard_native_downgrades")
+                degrade.degrade_now(
+                    "ingest.native", "python-decoder",
+                    reason=f"mid-stream native failure: {error}",
+                )
+                sys.stderr.write(
+                    f"sctools-tpu guard: native decode failed mid-stream "
+                    f"({error}); finishing {bam_path} on the Python "
+                    f"decoder from record {consumed}\n"
+                )
+                sys.stderr.flush()
+            try:
+                yield from _python_frames_from(
+                    bam_path, batch_records, mode, want_qname, keys,
+                    consumed,
+                )
+            except Exception as tail_error:
+                # truly corrupt bytes: the Python decoder failed in the
+                # same region — surface ITS error with the native one
+                # (and its batch/offset localization) chained as cause
+                raise tail_error from native_error
         finally:
             produced.close()
 
-    return prefetch_iterator(chained(), depth=depth)
+    return guarded_iter(
+        prefetch_iterator(chained(), depth=depth), leg="decode"
+    )

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
-from .. import ingest, obs
+from .. import guard, ingest, obs
 from ..obs import xprof
 from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
 from ..io.packed import (
@@ -417,6 +417,41 @@ class MetricGatherer:
     # waits behind k+1's upload on a shared (tunneled) host<->device link.
     _PIPELINE_DEPTH = 2
 
+    # every device dispatch in the streaming loop goes through
+    # guard.run_batch: transient device errors retry under the lease, OOM
+    # bisects at entity boundaries (halves pad to their own existing
+    # buckets), poisoned records quarantine to sidecars and the batch
+    # continues without them (docs/robustness.md)
+    _GUARD_SITE = "gatherer.dispatch"
+
+    def _guarded_dispatch(
+        self, frame, device_engine, pad_to, presorted, offset: int,
+    ):
+        """One batch through the scx-guard ladder -> list of pending tuples.
+
+        ``offset`` is the absolute record index of ``frame``'s first
+        record in the decode stream — what quarantine sidecars and the
+        ``corrupt_record`` fault grammar localize by. Sub-frames pad per
+        ``guard.sub_pad_to`` (filtered remainders keep the pinned shape,
+        bisected halves take their own existing buckets): bisection costs
+        at most a fresh compile per new bucket, never a steady-state
+        retrace.
+        """
+        def dispatch(sub, sub_offset):
+            return self._dispatch_device_batch(
+                sub, device_engine,
+                pad_to=guard.sub_pad_to(pad_to),
+                presorted=presorted,
+            )
+
+        return guard.run_batch(
+            dispatch, frame,
+            site=self._GUARD_SITE,
+            name=str(self._bam_file),
+            offset=offset,
+            splitter=guard.entity_splitter(self.entity_kind),
+        )
+
     def _stream_device_batches(self, frames, device_engine, out) -> None:
         import sys
         from collections import deque
@@ -425,6 +460,7 @@ class MetricGatherer:
         pending = deque()  # dispatched but not yet written
         multi_batch = False
         processed = 0
+        dispatch_offset = 0  # absolute record index of the next dispatch
         next_progress = 10_000_000  # reference cadence (fastq_common.cpp:340)
         for frame in frames:
             processed += frame.n_records
@@ -469,15 +505,19 @@ class MetricGatherer:
             # (e.g. samtools collate) falls back to the device-sorted path
             # for the batch instead of mis-attributing sorted-side metrics.
             ascending = bool(np.all(key[1:cut] >= key[: cut - 1]))
-            pending.append(
-                self._dispatch_device_batch(
+            pending.extend(
+                self._guarded_dispatch(
                     slice_frame(frame, 0, cut),
                     device_engine,
                     pad_to=capacity if multi_batch else 0,
                     presorted=ascending,
+                    offset=dispatch_offset,
                 )
             )
-            if len(pending) > self._PIPELINE_DEPTH:
+            dispatch_offset += cut
+            # `while`, not `if`: a bisected batch extends pending by more
+            # than one tuple and the backlog must still drain to depth
+            while len(pending) > self._PIPELINE_DEPTH:
                 self._finalize_device_batch(*pending.popleft(), out)
             # compact, or the carried vocabularies would accumulate the
             # union of every batch seen so far; copy, or the carried tail
@@ -495,12 +535,13 @@ class MetricGatherer:
             # link that is the measured end-to-end floor. The extra compile
             # for the tail shape amortizes across runs via the persistent
             # compilation cache.
-            pending.append(
-                self._dispatch_device_batch(
+            pending.extend(
+                self._guarded_dispatch(
                     carry,
                     device_engine,
                     pad_to=0,
                     presorted=bool(np.all(tail_key[1:] >= tail_key[:-1])),
+                    offset=dispatch_offset,
                 )
             )
         while pending:
@@ -673,7 +714,19 @@ class MetricGatherer:
         with obs.span(
             "writeback", records=n_records, entities=n_entities
         ) as wb:
-            block = np.asarray(block)
+            # under async dispatch, a device-side failure for this batch
+            # surfaces HERE, at the first blocking pull — after the
+            # guarded dispatch returned and the frame was released. The
+            # transient ladder still applies (a d2h blip re-pulls the
+            # device-resident result in place); a poisoned computation
+            # re-raises identically, notes a device failure toward the
+            # dispatch site's CPU rung, and escalates to the scheduler's
+            # task retry — the documented async recovery boundary
+            # (docs/robustness.md).
+            block = guard.retrying(
+                lambda: np.asarray(block), site=self._GUARD_SITE,
+                leg="compute",
+            )
             self.bytes_d2h += block.nbytes
             wb.add(bytes=block.nbytes)
             xprof.record_transfer(

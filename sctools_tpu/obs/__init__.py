@@ -68,6 +68,7 @@ __all__ = [
     "get_context",
     "flight_dump",
     "flight_path",
+    "register_flight_section",
     "install_jax_hooks",
     "xla_trace",
     "configured_trace_dir",
@@ -465,6 +466,45 @@ def configured_worker_name() -> str:
     return _sanitize_component(str(worker))
 
 
+# extra named sections subsystems contribute to flight records: the ingest
+# ring registers its slot states, scx-guard its open retry ladders and
+# degraded sites — so a crash/SIGTERM postmortem shows not just WHERE the
+# process was (open spans) but what recovery machinery was mid-flight.
+# Providers must be cheap, lock-light, and safe to call from a signal
+# handler's dump path; a provider that raises is skipped, never fatal.
+_flight_sections: Dict[str, Callable[[], Any]] = {}
+
+
+def register_flight_section(name: str, provider: Callable[[], Any]) -> None:
+    """Attach ``provider()``'s value under ``name`` in every flight record."""
+    _flight_sections[name] = provider
+
+
+def bounded_snapshot(
+    lock: Any, snapshot: Callable[[], Any], default: Any
+) -> Callable[[], Any]:
+    """Wrap a lock-guarded ``snapshot()`` for the flight-dump death path.
+
+    The ONE place the death-path invariant lives: a provider may run
+    inside a signal handler that interrupted a holder of ``lock`` on the
+    same thread, so the acquire is bounded, and on timeout the snapshot
+    degrades to a lockless best effort (``default`` if a concurrent
+    mutation races the read) — never a self-deadlock, never a raise.
+    """
+    def provider():
+        acquired = lock.acquire(timeout=0.5)
+        try:
+            try:
+                return snapshot()
+            except RuntimeError:  # lockless snapshot raced a mutation
+                return default
+        finally:
+            if acquired:
+                lock.release()
+
+    return provider
+
+
 def flight_path() -> Optional[str]:
     """Where this process's flight record lands (None when no trace dir)."""
     base = configured_trace_dir()
@@ -518,6 +558,14 @@ def flight_dump(reason: str = "", path: Optional[str] = None) -> Optional[str]:
         "counters": counters_snapshot,
         "gauges": gauges_snapshot,
     }
+    sections = {}
+    for section_name, provider in list(_flight_sections.items()):
+        try:
+            sections[section_name] = provider()
+        except Exception:  # noqa: BLE001 - the death path must still write
+            continue
+    if sections:
+        meta["sections"] = sections
     # a crashed worker's compile/occupancy/ledger registry dies with the
     # process unless the flight record carries it (the atexit xprof dump
     # never runs under os._exit); bounded by the registry's own caps

@@ -211,22 +211,55 @@ class WhitelistCorrector:
         # queries are padded to one compiled batch shape; padded rows are
         # sliced off, so every batch size reuses a single executable
         q = _pad_rows(onehot_barcodes(barcodes, self._length), 256)
+        from .. import guard, ingest, obs
+
+        pallas = self._use_pallas and not guard.degrade.is_degraded(
+            "whitelist.correct_pallas"
+        )
         site = (
-            "whitelist.correct_pallas" if self._use_pallas
+            "whitelist.correct_pallas" if pallas
             else "whitelist.correct_jnp"
         )
         xprof.record_dispatch(site, len(barcodes), q.shape[0])
-        from .. import ingest
-
         # explicit staging (was an implicit upload inside the jit call):
         # same ledger site and bytes, now through the one device_put door
         q, _ = ingest.upload(q, site="whitelist.queries")
-        if self._use_pallas:
-            result = _correct_pallas(
-                q, self._w_onehot, self._length, interpret=self._interpret
+
+        def run_kernel():
+            # the guard degradation ladder, whitelist rung: a device-side
+            # failure in the Pallas kernel notes a strike and answers the
+            # query on the jnp fallback (same semantics, oracle-tested);
+            # at the threshold the site degrades and later calls skip
+            # Pallas outright. A host-side (fatal) error propagates.
+            if pallas:
+                try:
+                    return _correct_pallas(
+                        q, self._w_onehot, self._length,
+                        interpret=self._interpret,
+                    )[: len(barcodes)]
+                except Exception as error:
+                    kind = guard.classify(error)
+                    if kind in (guard.FATAL, guard.TRANSIENT):
+                        # fatal: not ours. Transient (incl. a watchdog
+                        # Stall): escape to the outer retrying ladder so
+                        # Pallas itself gets its in-place retries — a
+                        # slow-but-healthy kernel must not collect
+                        # degradation strikes
+                        raise
+                    obs.count("guard_whitelist_pallas_fallbacks")
+                    guard.degrade.note_device_failure(
+                        "whitelist.correct_pallas"
+                    )
+            return _correct_jnp(
+                q, self._w_onehot, self._length
             )[: len(barcodes)]
-        else:
-            result = _correct_jnp(q, self._w_onehot, self._length)[: len(barcodes)]
+
+        # the transient ladder around the kernel: a runtime hiccup on the
+        # jnp path (or in the fallback itself) retries in place, under
+        # the compute stall watchdog
+        result = guard.retrying(
+            run_kernel, site="whitelist.correct", leg="compute"
+        )
         result = np.asarray(result)
         xprof.record_transfer("d2h", result.nbytes, site="whitelist.queries")
         # the reference hash map has no keys of other lengths: a query whose

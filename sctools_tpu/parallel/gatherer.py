@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .. import ingest, obs
+from .. import guard, ingest, obs
 from ..obs import xprof
 from ..io.packed import KEY_HI_SHIFT
 from ..sched import faults
@@ -147,8 +147,19 @@ class _ShardedMixin:
         n_records, out,
     ) -> None:
         with obs.span("writeback", records=n_records) as wb:
-            blocks = np.asarray(blocks)
-            n_entities = np.asarray(n_entities).reshape(-1)
+            # the async recovery boundary, same as the single-device path:
+            # device failures for this batch surface at the first blocking
+            # pull — BOTH pulls ride one transient-ladder attempt, so a
+            # blip at either lands in the same retry
+            device_blocks, device_counts = blocks, n_entities
+            blocks, n_entities = guard.retrying(
+                lambda: (
+                    np.asarray(device_blocks),
+                    np.asarray(device_counts).reshape(-1),
+                ),
+                site=self._GUARD_SITE,
+                leg="compute",
+            )
             batch_d2h = blocks.nbytes + n_entities.nbytes
             self.bytes_d2h += batch_d2h
             wb.add(bytes=batch_d2h)

@@ -156,22 +156,19 @@ def make_cell_metric_tasks(
     can re-run any task in a fresh process (sched.runners).
     """
     from ..sched import make_task
+    from ..sched.commit import content_signature
 
-    def signature(path: str) -> str:
-        # binds task identity to the chunk's CONTENT generation, not just
-        # its path: re-splitting into same-named chunk files yields new
-        # task ids, so a stale journal can never whitelist skipping the
-        # recompute of changed input (rsync-style size:mtime check)
-        stat = os.stat(path)
-        return f"{stat.st_size}:{stat.st_mtime_ns}"
-
+    # binds task identity to the chunk's CONTENT generation, not just its
+    # path: re-splitting into same-named chunk files yields new task ids,
+    # so a stale journal can never whitelist skipping the recompute of
+    # changed input; retry-quarantined verifies against the SAME helper
     return [
         make_task(
             "cell_metrics",
             f"chunk{index:04d}",
             {
                 "chunk": os.path.abspath(chunk),
-                "chunk_sig": signature(chunk),
+                "chunk_sig": content_signature(chunk),
                 "index": index,
                 "out_dir": os.path.abspath(out_dir),
                 "mito": sorted(mitochondrial_gene_ids),
@@ -193,6 +190,7 @@ def run_cell_metrics_task(task, mesh=None):
     duplicate part under a second name. Publication is atomic via the
     CSV writer, so a crash at any instant leaves no partial part.
     """
+    from .. import guard
     from ..sched import faults
     from .gatherer import ShardedCellMetrics
 
@@ -213,10 +211,23 @@ def run_cell_metrics_task(task, mesh=None):
             f.write(mangle(data))
         chunk = poisoned
     with obs.span("distributed:chunk_metrics", chunk=index):
-        ShardedCellMetrics(
-            chunk, part, set(payload.get("mito", ())),
-            mesh=mesh if mesh is not None else local_mesh(),
-        ).extract_metrics()
+        if guard.degrade.is_degraded("gatherer.dispatch"):
+            # the degradation ladder's last rung: repeated device failures
+            # at the dispatch site downgraded it, so this attempt runs the
+            # streaming CPU backend (exact reference semantics, no
+            # device). Loud by contract — the transition already counted
+            # and spanned; here the task just honors it.
+            from ..metrics.gatherer import GatherCellMetrics
+
+            obs.count("guard_cpu_backend_tasks")
+            GatherCellMetrics(
+                chunk, part, set(payload.get("mito", ())), backend="cpu",
+            ).extract_metrics()
+        else:
+            ShardedCellMetrics(
+                chunk, part, set(payload.get("mito", ())),
+                mesh=mesh if mesh is not None else local_mesh(),
+            ).extract_metrics()
     obs.count("chunks_processed")
     return part + ".csv.gz"
 
@@ -253,6 +264,7 @@ def run_process_cell_metrics(
     poison chunks were quarantined (the rest of the run still completes
     and commits first).
     """
+    from ..guard import quarantine
     from ..sched import QuarantinedTasksError, WorkQueue
 
     mesh = mesh if mesh is not None else local_mesh()
@@ -261,12 +273,18 @@ def run_process_cell_metrics(
         os.path.dirname(os.path.abspath(part_stem)),
         mitochondrial_gene_ids,
     )
+    resolved_journal = journal_dir or default_journal_dir(part_stem)
     queue = WorkQueue(
-        journal_dir or default_journal_dir(part_stem),
+        resolved_journal,
         worker_id=f"proc{process_id}-of-{num_processes}-{os.getpid()}",
         lease_ttl=lease_ttl,
         max_attempts=max_attempts,
         backoff_base=backoff_base,
+    )
+    # guard's poison-record sidecars land next to the journal, where
+    # `sched status` (and the merge-time operator) will look for them
+    quarantine.set_quarantine_dir(
+        os.path.join(resolved_journal, "quarantine")
     )
     # preemption insurance: persist the span ring + open-span stack to
     # flight.<worker>.jsonl on SIGTERM so a killed worker's postmortem

@@ -304,9 +304,19 @@ def distributed_sort(
         elif capacity is None:
             capacity = shard_size
         sort_span.add(capacity=capacity)
-        out, dropped = _build_sample_sort(
-            mesh, tuple(key_names), n_shards, axis_name, capacity
-        )(stacked_cols)
+        # the compiled exchange rides the guard transient ladder: a
+        # runtime hiccup in the collectives retries in place instead of
+        # failing the task (no record-range structure to bisect here —
+        # OOM propagates to the scheduler)
+        from .. import guard
+
+        out, dropped = guard.retrying(
+            lambda: _build_sample_sort(
+                mesh, tuple(key_names), n_shards, axis_name, capacity
+            )(stacked_cols),
+            site="sort.dispatch",
+            leg="compute",
+        )
         if not isinstance(dropped, jax.core.Tracer):
             n_dropped = int(np.asarray(dropped).sum())
             if n_dropped:

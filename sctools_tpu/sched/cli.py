@@ -18,7 +18,15 @@
   replay, so it is idempotent.
 - ``retry-quarantined`` — record a ``requeued`` event for each quarantined
   task, zeroing its attempt count so the next ``resume`` (or pipeline
-  re-launch) retries it. Journal-only: nothing executes here.
+  re-launch) retries it. Journal-only: nothing executes here. Tasks whose
+  payload carries a content signature (``chunk`` + ``chunk_sig``) are
+  re-verified against the file on disk first: a chunk that changed (or
+  vanished) since quarantine is REFUSED, not resurrected blind — task ids
+  bind to content, and requeueing a changed input would commit an
+  artifact under the wrong identity.
+
+``status`` also surfaces scx-guard poison-record sidecars when the
+journal's ``quarantine/`` directory holds any (docs/robustness.md).
 """
 
 from __future__ import annotations
@@ -73,6 +81,7 @@ def _status(journal_dir: str, out, journal: Optional[Journal] = None) -> int:
     summary = ", ".join(f"{k}={v}" for k, v in sorted(totals.items()))
     print(f"total={len(tasks)} ({summary})", file=out)
     _print_efficiency_summary(journal_dir, out)
+    _print_quarantined_records(journal_dir, out)
     if totals.get(QUARANTINED):
         return 2
     return 0 if totals.get(COMMITTED, 0) == len(tasks) else 1
@@ -116,6 +125,62 @@ def _print_efficiency_summary(journal_dir: str, out) -> None:
         # reason to lose the journal status an operator came for
         return
     print(line, file=out)
+
+
+def _print_quarantined_records(journal_dir: str, out) -> None:
+    """Surface scx-guard poison-record sidecars next to the task table.
+
+    A run can converge with every TASK committed while individual RECORDS
+    were quarantined below the scheduler (guard's poison isolation) — the
+    operator reading ``sched status`` must see that the output is
+    record-complete or not without hunting for sidecar files.
+    """
+    from ..guard.quarantine import load_quarantine
+
+    try:
+        entries = load_quarantine(os.path.join(journal_dir, "quarantine"))
+    except Exception:  # noqa: BLE001 - status must never die on telemetry
+        return
+    if not entries:
+        return
+    records = sum(
+        max(0, (e.get("record_stop") or 0) - (e.get("record_start") or 0))
+        for e in entries
+    )
+    print(
+        f"guard: {records} poisoned record(s) quarantined across "
+        f"{len(entries)} range(s):", file=out,
+    )
+    for entry in entries[:10]:
+        print(
+            f"  {entry.get('task') or '?'}  records "
+            f"[{entry.get('record_start')}, {entry.get('record_stop')})  "
+            f"{str(entry.get('reason', ''))[:60]}", file=out,
+        )
+    if len(entries) > 10:
+        print(f"  ... {len(entries) - 10} more range(s)", file=out)
+
+
+def _chunk_signature_drift(task) -> Optional[str]:
+    """Why ``task``'s input no longer matches its quarantine-era content
+    signature (None = no signature to check, or it matches)."""
+    from .commit import content_signature
+
+    payload = task.payload if task is not None else {}
+    chunk = payload.get("chunk")
+    expected = payload.get("chunk_sig")
+    if not chunk or not expected:
+        return None
+    try:
+        current = content_signature(chunk)
+    except OSError:
+        return f"input {chunk} is gone"
+    if current != expected:
+        return (
+            f"input {chunk} changed since quarantine "
+            f"(signature {current} != {expected})"
+        )
+    return None
 
 
 def _read_leases(leases_dir: str) -> List[dict]:
@@ -282,14 +347,29 @@ def _retry_quarantined(journal_dir: str, out) -> int:
     journal = Journal(journal_dir, worker_id="cli-requeue")
     tasks, states = journal.replay()
     requeued = 0
+    refused = 0
     for tid, st in states.items():
-        if st.state == QUARANTINED:
-            journal.record(tid, "requeued")
-            name = tasks[tid].name if tid in tasks else tid
-            print(f"requeued {name}", file=out)
-            requeued += 1
-    print(f"{requeued} task(s) requeued", file=out)
-    return 0
+        if st.state != QUARANTINED:
+            continue
+        name = tasks[tid].name if tid in tasks else tid
+        # re-verify the task's content signature before resurrecting it:
+        # a quarantined task whose input changed since quarantine is a
+        # DIFFERENT computation under a stale identity — requeueing it
+        # blind would let the next resume commit the new bytes' output
+        # under the old task id (and part path)
+        drift = _chunk_signature_drift(tasks.get(tid))
+        if drift is not None:
+            print(
+                f"REFUSED {name}: {drift}; re-split and re-launch to "
+                "register the new content", file=out,
+            )
+            refused += 1
+            continue
+        journal.record(tid, "requeued")
+        print(f"requeued {name}", file=out)
+        requeued += 1
+    print(f"{requeued} task(s) requeued, {refused} refused", file=out)
+    return 1 if refused else 0
 
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:

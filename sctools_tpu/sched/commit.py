@@ -41,6 +41,19 @@ def atomic_output(final_path: str) -> Iterator[str]:
         raise
 
 
+def content_signature(path: str) -> str:
+    """rsync-style ``size:mtime_ns`` content-generation signature.
+
+    The ONE definition of the input signature task ids bind to: the task
+    builder (``parallel.launch.make_cell_metric_tasks``) stamps it into
+    payloads and ``sched retry-quarantined`` re-verifies it before
+    resurrecting a quarantined task — both sides must always agree on
+    the format, or requeue refusals become format-mismatch noise.
+    """
+    stat = os.stat(path)
+    return f"{stat.st_size}:{stat.st_mtime_ns}"
+
+
 def sha256_file(path: str, chunk: int = 1 << 20) -> Optional[str]:
     """Hex content hash of ``path`` (None when unreadable)."""
     digest = hashlib.sha256()

@@ -11,7 +11,11 @@ Spec grammar (full BNF in docs/scheduler.md)::
     spec    := clause (';' clause)*
     clause  := kind '@' site [':' key '=' value (',' key '=' value)*]
     kind    := 'crash' | 'delay' | 'fail' | 'corrupt'
-    key     := 'match' | 'times' | 'secs' | 'code'
+             | 'device_oom' | 'xla_transient' | 'stall' | 'corrupt_record'
+    key     := 'match' | 'times' | 'secs' | 'code' | 'record'
+
+Task-level kinds (fired by :func:`fire` at scheduler sites — these burn
+scheduler attempts, by design):
 
 - ``crash`` — ``os._exit(code)`` (default 86): the process dies without
   cleanup, exactly like a preempted TPU host. Leases stay held until TTL.
@@ -20,6 +24,28 @@ Spec grammar (full BNF in docs/scheduler.md)::
   retry ladder must absorb.
 - ``corrupt`` — sites that produce bytes consult :func:`should_corrupt`
   and garble their output when told to: poison inputs and torn writes.
+
+Device-boundary kinds (fired by :func:`device_fault` /
+:func:`poison_check` inside ``guard.run_batch``'s attempt loop — the
+scx-guard recovery ladder must absorb ALL of these below the scheduler,
+with zero ``failed`` journal events):
+
+- ``device_oom`` — raise :class:`sctools_tpu.guard.errors.ResourceExhausted`
+  (a synthetic ``RESOURCE_EXHAUSTED`` allocator failure): guard must
+  bisect the batch and merge partial results.
+- ``xla_transient`` — raise :class:`sctools_tpu.guard.errors.Transient`
+  (a synthetic retryable ``XlaRuntimeError``): guard must retry in place.
+- ``stall`` — sleep ``secs`` (default 1.0) in small interruptible
+  increments: the stall watchdog's prey. With a
+  ``SCTOOLS_TPU_GUARD_TIMEOUT_*`` deadline below ``secs`` the watchdog
+  interrupts it with a flight dump + ``Stall``; without one it
+  self-resolves after ``secs``.
+- ``corrupt_record`` — the record at absolute stream index ``record=N``
+  is poisoned: :func:`poison_check` raises
+  :class:`sctools_tpu.guard.errors.PoisonData` (UNlocalized, so guard's
+  probe bisection has to isolate it) whenever its window covers N. Never
+  consumed by firing — corrupt bytes stay corrupt — so ``times`` does
+  not apply; one clause per poisoned record.
 
 ``match=SUBSTR`` arms a clause only for sites whose ``name`` argument
 contains SUBSTR (task names, chunk paths). ``times=N`` fires at most N
@@ -51,7 +77,10 @@ from typing import List, Optional
 from .. import obs
 
 ENV_VAR = "SCTOOLS_TPU_FAULTS"
-KINDS = ("crash", "delay", "fail", "corrupt")
+KINDS = (
+    "crash", "delay", "fail", "corrupt",
+    "device_oom", "xla_transient", "stall", "corrupt_record",
+)
 DEFAULT_CRASH_CODE = 86
 
 
@@ -71,6 +100,7 @@ class Clause:
     times: Optional[int] = None  # None = unlimited
     secs: float = 1.0
     code: int = DEFAULT_CRASH_CODE
+    record: Optional[int] = None  # corrupt_record: absolute stream index
 
     def arm_check(self, site: str, name: str) -> bool:
         if self.site != site:
@@ -113,6 +143,8 @@ def parse_spec(text: str) -> List[Clause]:
                     clause.secs = float(value)
                 elif key == "code":
                     clause.code = int(value)
+                elif key == "record":
+                    clause.record = int(value)
                 else:
                     raise FaultSpecError(
                         f"unknown fault option {key!r} in {raw!r}"
@@ -208,3 +240,84 @@ def mangle(data: bytes) -> bytes:
     """Deterministically garble ``data`` (for sites that opted in)."""
     prefix = b"\x00CORRUPTED\x00"
     return prefix + bytes(b ^ 0xFF for b in data[: 1 << 12]) + data[1 << 12:]
+
+
+# ------------------------------------------------- device-boundary faults
+
+# stall sleeps in short interruptible increments: the watchdog's
+# asynchronous Stall lands between Python bytecodes, so one long
+# time.sleep would defeat the very path the injection exists to test
+_STALL_TICK_S = 0.05
+
+
+def armed() -> bool:
+    """Whether ANY fault clause is armed (guard's hot-path fast gate)."""
+    return bool(_active())
+
+
+def device_fault(site: str, name: str = "") -> None:
+    """Fire an armed device_oom/xla_transient/stall clause for ``site``.
+
+    Called by ``guard.run_batch``'s attempt loop (and ``guard.retrying``)
+    just before the guarded work. The raised exceptions are the guard
+    taxonomy's own classes, so classification is exact: the injection
+    tests the recovery ladder, not the classifier's string matching.
+    No-op in a spec-less process after one cached-list check.
+    """
+    if not _active():
+        return
+    clause = _take(site, name, ("device_oom", "xla_transient", "stall"))
+    if clause is None:
+        return
+    # deferred import: guard imports this module (lazily); importing guard
+    # at module load here would be a cycle
+    from ..guard import errors as guard_errors
+
+    if clause.kind == "device_oom":
+        obs.count("sched_fault_device_oom")
+        raise guard_errors.ResourceExhausted(
+            f"injected RESOURCE_EXHAUSTED: out of memory allocating batch "
+            f"at {site} ({name})"
+        )
+    if clause.kind == "xla_transient":
+        obs.count("sched_fault_xla_transient")
+        raise guard_errors.Transient(
+            f"injected transient XlaRuntimeError at {site} ({name})"
+        )
+    obs.count("sched_fault_stalls")
+    deadline = time.perf_counter() + clause.secs
+    while time.perf_counter() < deadline:
+        time.sleep(_STALL_TICK_S)
+
+
+def poison_check(site: str, name: str = "", start: int = 0, stop: int = 0) -> None:
+    """Raise PoisonData when an armed corrupt_record falls in [start, stop).
+
+    The probe behind guard's poison bisection. Deliberately UNlocalized
+    (no ``record_range`` on the exception) and never consumed: a corrupt
+    record fails every window that covers it, exactly like real bad
+    bytes, so the bisection has to do the isolating.
+    """
+    if not _active():
+        return
+    hit = None
+    with _lock:
+        for clause in _clauses or ():
+            if (
+                clause.kind == "corrupt_record"
+                and clause.site == site
+                and (not clause.match or clause.match in name)
+                and clause.record is not None
+                and start <= clause.record < stop
+            ):
+                hit = clause.record
+                break
+    if hit is None:
+        return
+    obs.count("sched_fault_corrupt_records")
+    from ..guard import errors as guard_errors
+
+    raise guard_errors.PoisonData(
+        f"injected corrupt record in window [{start}, {stop}) at {site} "
+        f"({name})"
+    )

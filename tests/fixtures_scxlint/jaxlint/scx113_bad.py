@@ -1,0 +1,26 @@
+"""SCX113 positive fixture: broad handlers swallowing boundary failures."""
+from sctools_tpu import ingest
+from sctools_tpu.ops.counting import count_molecules
+from sctools_tpu.parallel.sort import distributed_sort
+
+
+def stage_or_none(cols):
+    try:
+        device_cols, _ = ingest.upload(cols, site="fixture.stage")
+        return device_cols
+    except Exception:
+        return None
+
+
+def count_and_shrug(cols, segments):
+    try:
+        return count_molecules(cols, num_segments=segments)
+    except BaseException:
+        pass
+
+
+def sort_with_bare_except(stacked, mesh):
+    try:
+        return distributed_sort(stacked, ["key"], mesh)
+    except:  # noqa: E722 - the anti-pattern under test
+        return stacked

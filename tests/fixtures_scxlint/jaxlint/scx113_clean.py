@@ -1,0 +1,43 @@
+"""SCX113 negative fixture: boundary recovery routed through scx-guard.
+
+The last two functions show the exempt shapes: cleanup-then-reraise (the
+error still propagates into guard/sched), and a narrow handler for a
+specific host-side condition.
+"""
+from sctools_tpu import guard, ingest
+
+
+def staged(cols):
+    return guard.retrying(
+        lambda: ingest.upload(cols, site="fixture.stage"),
+        site="fixture.stage",
+    )
+
+
+def dispatched(fn, frame):
+    return guard.run_batch(fn, frame, site="fixture.dispatch")
+
+
+def cleanup_then_reraise(cols, writer):
+    try:
+        device_cols, _ = ingest.upload(cols, site="fixture.stage")
+        return device_cols
+    except BaseException:
+        writer.discard()
+        raise
+
+
+def narrow_handler(cols):
+    try:
+        device_cols, _ = ingest.upload(cols, site="fixture.stage")
+    except ValueError:
+        device_cols = None
+    return device_cols
+
+
+def swallow_away_from_the_boundary(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return None

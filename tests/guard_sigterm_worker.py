@@ -1,0 +1,33 @@
+"""Worker process for the SIGTERM-mid-ring flight-record tests.
+
+Runs one device-backend gatherer with a SMALL batch size so the prefetch
+ring stays in flight for many batches; the caller arms faults
+(``stall@gatherer.dispatch``) and tracing (``SCTOOLS_TPU_TRACE`` +
+``SCTOOLS_TPU_TRACE_WORKER``) through the environment — importing
+sctools_tpu activates the capture and the SIGTERM flight recorder.
+
+Invoked as: python guard_sigterm_worker.py <bam> <output_stem> <batch>
+Prints ``BYTES_H2D=<n>`` on clean completion (the parent reconciles it
+against the worker's dumped transfer ledger).
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    bam, stem, batch = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    gatherer = GatherCellMetrics(
+        bam, stem, backend="device", batch_records=batch
+    )
+    gatherer.extract_metrics()
+    print(f"BYTES_H2D={gatherer.bytes_h2d}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

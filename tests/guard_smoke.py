@@ -1,0 +1,316 @@
+"""guard-smoke: the CI gate for scx-guard (`make guard-smoke`).
+
+A 2-worker run under the full device-fault cocktail — ``device_oom``,
+``xla_transient``, ``stall``, and two ``corrupt_record`` poisons — must
+prove record-level isolation and below-scheduler absorption:
+
+- the run CONVERGES: every task commits, both workers exit 0;
+- the journal shows ZERO ``failed`` events — every injected device fault
+  was absorbed by guard under the lease, burning no scheduler attempt;
+- quarantine sidecars name exactly the two injected records (task +
+  record range), and nothing else;
+- the merged CSV is byte-identical to a fault-free run over the same
+  chunks with those two records removed from the input — one poisoned
+  record costs exactly one record, never a chunk;
+- the merged xprof registries show 0 steady-state retraces: the OOM
+  bisection's halves landed on their own buckets (fresh compiles at
+  worst), never a recompile of a seen signature;
+- guard counters prove each ladder actually ran (bisection, transient
+  retries, a watchdog-interrupted stall).
+
+Exit 0 on success; any assertion failure is a gate failure.
+"""
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "sched_worker.py")
+
+LEASE_TTL = "5.0"
+POISON_RECORDS = (3, 10)  # absolute record indices within chunk_0's stream
+
+
+def make_input(path: str, n_cells: int = 48) -> None:
+    import random
+
+    from helpers import make_record, write_bam
+
+    rng = random.Random(7)
+    records = []
+    for cb in sorted(
+        "".join(rng.choice("ACGT") for _ in range(12)) for _ in range(n_cells)
+    ):
+        for ub in sorted(
+            "".join(rng.choice("ACGT") for _ in range(6)) for _ in range(3)
+        ):
+            ge = rng.choice(["G1", "G2"])
+            for i in range(2):
+                records.append(
+                    make_record(
+                        name=f"{cb}{ub}{i}", cb=cb, cr=cb, cy="IIII",
+                        ub=ub, ur=ub, uy="IIII", ge=ge, xf="CODING",
+                        nh=1, pos=rng.randrange(1000),
+                    )
+                )
+    write_bam(path, records)
+
+
+def split_chunks(bam: str, chunk_dir: str) -> list:
+    from sctools_tpu.platform import GenericPlatform
+
+    os.makedirs(chunk_dir, exist_ok=True)
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", os.path.join(chunk_dir, "chunk"), "-s", "0.002",
+         "-t", "CB"]
+    )
+    chunks = sorted(glob.glob(os.path.join(chunk_dir, "*.bam")))
+    assert len(chunks) >= 3, f"need >=3 chunks, got {len(chunks)}"
+    return chunks
+
+
+def filter_chunk(src: str, dst: str, drop: set) -> int:
+    """Copy ``src`` minus the record indices in ``drop`` (stream order)."""
+    from sctools_tpu.io.sam import AlignmentReader, AlignmentWriter
+
+    kept = 0
+    with AlignmentReader(src) as reader:
+        header = reader.header
+        records = list(reader)
+    assert max(drop) < len(records), (max(drop), len(records))
+    with AlignmentWriter(dst, header, "wb") as writer:
+        for index, record in enumerate(records):
+            if index in drop:
+                continue
+            writer.write(record)
+            kept += 1
+    return kept
+
+
+def launch(workdir: str, process_id: int, fault_spec: str, trace_dir: str):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["SCTOOLS_TPU_TRACE"] = trace_dir
+    env["SCTOOLS_TPU_TRACE_WORKER"] = f"w{process_id}"
+    # the stall watchdog must interrupt the injected 60 s stall promptly —
+    # but the deadline must sit ABOVE the cold-compile time of the device
+    # passes (docs/robustness.md): a deadline that fires mid-compile
+    # aborts and re-traces the same signature, turning the watchdog
+    # itself into a retrace source on a loaded host
+    env["SCTOOLS_TPU_GUARD_TIMEOUT_COMPUTE"] = "20.0"
+    if fault_spec:
+        env["SCTOOLS_TPU_FAULTS"] = fault_spec
+    else:
+        env.pop("SCTOOLS_TPU_FAULTS", None)
+    return subprocess.Popen(
+        [
+            sys.executable, WORKER, workdir, str(process_id), "2",
+            LEASE_TTL, "3", "0.1",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def run_pair(workdir: str, fault_spec: str) -> None:
+    trace_dir = os.path.join(workdir, "trace")
+    procs = [
+        launch(workdir, pid, fault_spec, trace_dir) for pid in (0, 1)
+    ]
+    outputs = []
+    for proc in procs:
+        out, _ = proc.communicate(timeout=600)
+        outputs.append(out)
+        assert proc.returncode == 0, (
+            f"worker rc={proc.returncode}:\n{out[-3000:]}"
+        )
+
+
+def merge(workdir: str, n_chunks: int) -> str:
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+
+    merged = os.path.join(workdir, "merged.csv.gz")
+    n_rows = merge_sorted_csv_parts(
+        os.path.join(workdir, "metrics.part*.csv.gz"), merged,
+        journal_dir=os.path.join(workdir, "sched-journal"),
+        expected_parts=n_chunks,
+    )
+    assert n_rows > 0
+    return merged
+
+
+def read_counters(trace_dir: str) -> dict:
+    totals = {}
+    for path in glob.glob(os.path.join(trace_dir, "metrics*.prom")):
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                if line.startswith("#") or not line.strip():
+                    continue
+                name, _, value = line.rpartition(" ")
+                if name.startswith("sctools_tpu_guard") or name.startswith(
+                    "sctools_tpu_sched_fault"
+                ):
+                    totals[name] = totals.get(name, 0.0) + float(value)
+    return totals
+
+
+def main() -> int:
+    workdir = os.environ.get(
+        "SCTOOLS_TPU_GUARD_SMOKE_DIR"
+    ) or tempfile.mkdtemp(prefix="sctools_tpu_guard_smoke.")
+    os.makedirs(workdir, exist_ok=True)
+    bam = os.path.join(workdir, "input.bam")
+    make_input(bam)
+
+    from sctools_tpu.guard.quarantine import load_quarantine
+    from sctools_tpu.obs import xprof
+    from sctools_tpu.sched import COMMITTED, Journal
+
+    # ---- the chunk set, and its expected-output twin -------------------
+    fault_dir = os.path.join(workdir, "faulted")
+    expect_dir = os.path.join(workdir, "expected")
+    os.makedirs(fault_dir, exist_ok=True)
+    os.makedirs(expect_dir, exist_ok=True)
+    chunks = split_chunks(bam, os.path.join(fault_dir, "chunks"))
+    n_chunks = len(chunks)
+    # expected twin: the SAME chunks, except chunk_0 loses exactly the two
+    # records the fault spec poisons — a fault-free run over this set IS
+    # the byte-exact answer the faulted run must produce
+    expect_chunks = os.path.join(expect_dir, "chunks")
+    os.makedirs(expect_chunks, exist_ok=True)
+    for chunk in chunks:
+        dst = os.path.join(expect_chunks, os.path.basename(chunk))
+        if os.path.basename(chunk) == os.path.basename(chunks[0]):
+            filter_chunk(chunk, dst, set(POISON_RECORDS))
+        else:
+            shutil.copyfile(chunk, dst)
+
+    # ---- the fault-free twin run --------------------------------------
+    run_pair(expect_dir, "")
+    expected_csv = merge(expect_dir, n_chunks)
+
+    # ---- the faulted run ----------------------------------------------
+    chunk0 = os.path.basename(chunks[0])  # e.g. chunk_0.bam
+    chunk1 = os.path.basename(chunks[1])
+    chunk2 = os.path.basename(chunks[2])
+    spec = ";".join(
+        [
+            f"device_oom@gatherer.dispatch:match={chunk1},times=1",
+            "xla_transient@gatherer.dispatch:times=1",
+            f"stall@gatherer.dispatch:match={chunk2},times=1,secs=60",
+        ]
+        + [
+            f"corrupt_record@gatherer.dispatch:match={chunk0},record={r}"
+            for r in POISON_RECORDS
+        ]
+    )
+    run_pair(fault_dir, spec)
+
+    # converged: every task committed
+    journal_dir = os.path.join(fault_dir, "sched-journal")
+    journal = Journal(journal_dir, worker_id="smoke-probe")
+    tasks, states = journal.replay()
+    assert len(tasks) == n_chunks, (len(tasks), n_chunks)
+    assert all(st.state == COMMITTED for st in states.values()), {
+        tasks[t].name: states[t].state for t in tasks
+    }
+
+    # absorbed BELOW the scheduler: zero failed events in the journal
+    failed = [e for e in journal.events() if e.get("event") == "failed"]
+    assert not failed, f"device faults leaked into sched failures: {failed}"
+    # and zero retries burned attempts: every task ran exactly once
+    assert all(st.attempts == 1 for st in states.values()), {
+        tasks[t].name: states[t].attempts for t in tasks
+    }
+
+    # quarantine sidecars: exactly the injected records, nothing else
+    entries = load_quarantine(os.path.join(journal_dir, "quarantine"))
+    got = sorted(
+        (e["task"], e["record_start"], e["record_stop"]) for e in entries
+    )
+    assert got == [
+        ("chunk0000", r, r + 1) for r in sorted(POISON_RECORDS)
+    ], got
+    assert all(e["site"] == "gatherer.dispatch" for e in entries)
+    assert all(chunk0 in (e["name"] or "") for e in entries)
+    assert all(e["task_id"] for e in entries)
+
+    # output byte-identity: faulted merge == fault-free merge minus the
+    # quarantined records
+    faulted_csv = merge(fault_dir, n_chunks)
+    with gzip.open(expected_csv, "rb") as f:
+        expected_bytes = f.read()
+    with gzip.open(faulted_csv, "rb") as f:
+        faulted_bytes = f.read()
+    assert faulted_bytes == expected_bytes, (
+        "faulted output differs from fault-free-minus-poisoned output"
+    )
+
+    # 0 steady-state retraces from bisection (merged xprof registries)
+    registries = xprof.load_registries(os.path.join(fault_dir, "trace"))
+    assert len(registries) >= 2, [r.get("worker") for r in registries]
+    merged_reg = xprof.merge_registries(registries)
+    retraces = sum(
+        row["retraces"] for row in merged_reg["sites"].values()
+    )
+    assert retraces == 0, {
+        name: row["retraces"]
+        for name, row in merged_reg["sites"].items()
+        if row["retraces"]
+    }
+
+    # every ladder actually ran
+    counters = read_counters(os.path.join(fault_dir, "trace"))
+    assert counters.get("sctools_tpu_guard_oom_bisections_total", 0) >= 1, (
+        counters
+    )
+    assert counters.get("sctools_tpu_guard_transient_retries_total", 0) >= 2, (
+        counters  # >=1 xla_transient per worker + the stall retry
+    )
+    assert counters.get("sctools_tpu_guard_stalls_total", 0) >= 1, counters
+    assert counters.get("sctools_tpu_guard_poison_records_total", 0) == len(
+        POISON_RECORDS
+    ), counters
+
+    # `sched status` surfaces the quarantined records and still exits 0
+    # (tasks all committed)
+    from io import StringIO
+
+    from sctools_tpu.sched import cli as sched_cli
+
+    status_out = StringIO()
+    code = sched_cli.main(["status", journal_dir], out=status_out)
+    assert code == 0, status_out.getvalue()
+    assert "poisoned record(s) quarantined" in status_out.getvalue()
+
+    print(
+        json.dumps(
+            {
+                "guard_smoke": "ok",
+                "chunks": n_chunks,
+                "quarantined": got,
+                "retraces": retraces,
+                "oom_bisections": counters.get(
+                    "sctools_tpu_guard_oom_bisections_total"
+                ),
+                "transient_retries": counters.get(
+                    "sctools_tpu_guard_transient_retries_total"
+                ),
+                "stalls": counters.get("sctools_tpu_guard_stalls_total"),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

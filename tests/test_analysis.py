@@ -35,7 +35,7 @@ SUPP = os.path.join(FIXTURES, "supp")
 NATIVE = os.path.join(REPO, "sctools_tpu", "native")
 
 JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)] + [
-    "SCX110", "SCX111", "SCX112",
+    "SCX110", "SCX111", "SCX112", "SCX113",
 ]
 
 

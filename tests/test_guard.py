@@ -1,0 +1,599 @@
+"""scx-guard: taxonomy, batch recovery, watchdogs, degrade, quarantine.
+
+The contracts this file pins (docs/robustness.md):
+
+- classification is by meaning, not spelling: OOM markers -> bisect,
+  transient markers -> retry, taxonomy instances win, everything else is
+  the scheduler's problem;
+- run_batch absorbs injected device faults below the scheduler: transient
+  retries burn no sched attempt, OOM bisects at group boundaries and
+  merges partial results, poison isolates the EXACT record, quarantines
+  it to a sidecar, and the committed remainder equals a fault-free run
+  over the input minus those records;
+- the stall watchdog interrupts a stalled leg with a flight dump and a
+  Transient, and stands down cleanly when the leg finishes in time;
+- degradation is loud, per-site, thresholded, and per-process.
+"""
+
+import json
+import random
+import time
+
+import numpy as np
+import pytest
+
+from helpers import make_record, write_bam  # noqa: F401 - fixture parity
+from sctools_tpu import guard, obs
+from sctools_tpu.guard import degrade, quarantine, watchdog
+from sctools_tpu.guard.errors import (
+    Fatal,
+    NativeDecodeError,
+    PoisonData,
+    ResourceExhausted,
+    Stall,
+    Transient,
+    classify,
+)
+from sctools_tpu.io.packed import frame_from_records
+from sctools_tpu.sched import faults
+from sctools_tpu.sched.faults import parse_spec
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    obs.reset()
+    obs.enable()
+    degrade.reset()
+    quarantine.set_quarantine_dir(None)
+    faults.reset()
+    yield
+    faults.reset()
+    quarantine.set_quarantine_dir(None)
+    degrade.reset()
+    obs.disable()
+    obs.reset()
+
+
+def _frame(cells_with_counts, seed=5):
+    """A tiny sorted ReadFrame: [(cell, n_records), ...] in order."""
+    rng = random.Random(seed)
+    records = []
+    for index, (cb, count) in enumerate(cells_with_counts):
+        for i in range(count):
+            records.append(
+                make_record(
+                    name=f"q{index:02d}_{i:02d}", cb=cb, cr=cb, cy="IIII",
+                    ub="ACGTAC", ur="ACGTAC", uy="IIIIII",
+                    ge="G1", xf="CODING", nh=1, pos=rng.randrange(1000),
+                )
+            )
+    return frame_from_records(iter(records))
+
+
+# ------------------------------------------------------------- taxonomy
+
+class _FakeXla(Exception):
+    pass
+
+
+_FakeXla.__name__ = "XlaRuntimeError"
+
+
+def test_classify_by_meaning():
+    assert classify(_FakeXla("RESOURCE_EXHAUSTED: oom")) == "resource_exhausted"
+    assert classify(_FakeXla("Out of memory allocating 2G")) == (
+        "resource_exhausted"
+    )
+    assert classify(_FakeXla("UNAVAILABLE: link reset")) == "transient"
+    assert classify(_FakeXla("something unrecognized")) == "transient"
+    # permanent status codes must not burn retries: wrong program/args
+    assert classify(_FakeXla("INVALID_ARGUMENT: shape mismatch")) == "fatal"
+    assert classify(_FakeXla("PERMISSION_DENIED: no device")) == "fatal"
+    assert classify(MemoryError()) == "resource_exhausted"
+    assert classify(Transient("x")) == "transient"
+    assert classify(ResourceExhausted("x")) == "resource_exhausted"
+    assert classify(PoisonData("x")) == "poison"
+    assert classify(Stall()) == "transient"  # a watchdog stall retries
+    assert classify(ValueError("host bug")) == "fatal"
+    assert classify(Fatal("x")) == "fatal"
+    # the scheduler's own injected task faults are NOT guard's call
+    from sctools_tpu.sched.faults import InjectedFault
+
+    assert classify(InjectedFault("injected failure at x")) == "fatal"
+
+
+def test_native_decode_error_carries_localization():
+    error = NativeDecodeError("bad block", batch_index=7, record_offset=112)
+    assert error.batch_index == 7
+    assert error.record_offset == 112
+    assert "batch_index=7" in str(error)
+    assert "record_offset~=112" in str(error)
+    assert classify(error) == "poison"
+
+
+# --------------------------------------------------------- fault grammar
+
+def test_device_fault_grammar_parses():
+    clauses = parse_spec(
+        "device_oom@gatherer.dispatch:times=1;"
+        "xla_transient@count.dispatch:times=2,match=chunk;"
+        "stall@gatherer.dispatch:secs=0.2;"
+        "corrupt_record@gatherer.dispatch:record=17"
+    )
+    assert [c.kind for c in clauses] == [
+        "device_oom", "xla_transient", "stall", "corrupt_record"
+    ]
+    assert clauses[3].record == 17
+    with pytest.raises(faults.FaultSpecError):
+        parse_spec("corrupt_record@x:record=lots")
+
+
+def test_device_fault_raises_taxonomy_and_consumes():
+    faults.configure("device_oom@s:times=1")
+    with pytest.raises(ResourceExhausted, match="RESOURCE_EXHAUSTED"):
+        faults.device_fault("s")
+    faults.device_fault("s")  # consumed: second call is clean
+    faults.configure("xla_transient@s:times=1")
+    with pytest.raises(Transient, match="XlaRuntimeError"):
+        faults.device_fault("s")
+
+
+def test_poison_check_windows_and_is_not_consumed():
+    faults.configure("corrupt_record@s:record=5")
+    faults.poison_check("s", start=0, stop=5)  # below: clean
+    faults.poison_check("s", start=6, stop=99)  # above: clean
+    for _ in range(3):  # never consumed
+        with pytest.raises(PoisonData):
+            faults.poison_check("s", start=0, stop=10)
+    error = None
+    try:
+        faults.poison_check("s", start=0, stop=10)
+    except PoisonData as e:
+        error = e
+    assert error.record_range is None  # unlocalized: bisection must isolate
+
+
+# ------------------------------------------------------------- retrying()
+
+def test_retrying_absorbs_transients_and_counts():
+    faults.configure("xla_transient@s:times=2")
+    calls = []
+    assert guard.retrying(lambda: calls.append(1) or "ok", site="s") == "ok"
+    assert len(calls) == 1
+    assert obs.counters()["guard_transient_retries"] == 2
+
+
+def test_retrying_exhausted_reraises_and_notes_degrade(monkeypatch):
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_RETRIES", "1")
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_DEGRADE_AFTER", "1")
+    monkeypatch.setitem(degrade.RUNGS, "s", "cpu")
+    faults.configure("xla_transient@s")  # unlimited
+    with pytest.raises(Transient):
+        guard.retrying(lambda: "never", site="s")
+    assert degrade.is_degraded("s")
+    assert obs.counters()["guard_degraded"] == 1
+
+
+def test_retrying_stall_injection_interrupted_by_leg_watchdog(monkeypatch):
+    """The chaos stall at a retrying()-guarded site must be interruptible
+    by that leg's watchdog (the deadline covers the injected fault, not
+    just fn)."""
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_TIMEOUT_UPLOAD", "0.5")
+    faults.configure("stall@u:secs=30,times=1")
+    start = time.perf_counter()
+    assert guard.retrying(lambda: "ok", site="u", leg="upload") == "ok"
+    assert time.perf_counter() - start < 10
+    assert obs.counters()["guard_stalls_upload"] >= 1
+    assert obs.counters()["guard_transient_retries"] >= 1
+
+
+# ------------------------------------------------------------- run_batch
+
+def test_run_batch_transient_retries_in_place():
+    frame = _frame([("AAAA", 3), ("CCCC", 3)])
+    faults.configure("xla_transient@s:times=2")
+    seen = []
+    out = guard.run_batch(
+        lambda sub, off: seen.append((sub.n_records, off)) or "r",
+        frame, site="s",
+    )
+    assert out == ["r"]
+    assert seen == [(6, 0)]
+    assert obs.counters()["guard_transient_retries"] == 2
+
+
+def test_run_batch_oom_bisects_at_entity_boundary_and_merges():
+    frame = _frame([("AAAA", 4), ("CCCC", 2), ("GGGG", 2)])
+    faults.configure("device_oom@s:times=1")
+    seen = []
+
+    def fn(sub, off):
+        seen.append((off, sub.n_records))
+        return off
+
+    out = guard.run_batch(
+        fn, frame, site="s", offset=100,
+        splitter=guard.entity_splitter("cell"),
+    )
+    # one OOM -> two halves, cut at the entity boundary <= midpoint
+    assert out == [100, 104]
+    assert seen == [(100, 4), (104, 4)]
+    assert obs.counters()["guard_oom_bisections"] == 1
+    # halves never split a cell
+    assert frame.cell[3] != frame.cell[4]
+
+
+def test_sub_pad_to_discriminates_bisected_pieces():
+    """The pinned pad shape holds for the top-level (filtered) frame and
+    NEVER for a bisected piece — whatever its size, a piece re-padded to
+    the shape that just OOMed would OOM again."""
+    frame = _frame([("AAAA", 4), ("CCCC", 2)])
+    faults.configure("device_oom@s:times=1")
+    seen = []
+
+    def fn(sub, off):
+        seen.append((sub.n_records, guard.in_bisected_sub(),
+                     guard.sub_pad_to(4096)))
+        return "r"
+
+    guard.run_batch(
+        fn, frame, site="s", splitter=guard.entity_splitter("cell")
+    )
+    # top-level attempt OOMs before fn runs; both halves are bisected —
+    # including the LEFT one, which covers 4/6 > half of the batch
+    assert seen == [(4, True, 0), (2, True, 0)]
+    assert not guard.in_bisected_sub()  # restored after the ladder
+
+
+def test_run_batch_oom_at_floor_reraises():
+    frame = _frame([("AAAA", 5)])  # single entity: unsplittable
+    faults.configure("device_oom@s")  # unlimited
+    with pytest.raises(ResourceExhausted):
+        guard.run_batch(
+            fn=lambda sub, off: "never", frame=frame, site="s",
+            splitter=guard.entity_splitter("cell"),
+        )
+
+
+def test_run_batch_isolates_exact_poisoned_record(tmp_path):
+    """corrupt_record injection: probe bisection isolates exactly the
+    armed record, the sidecar names it, and fn sees the frame minus it."""
+    quarantine.set_quarantine_dir(str(tmp_path / "q"))
+    frame = _frame([("AAAA", 4), ("CCCC", 4)])
+    faults.configure(
+        "corrupt_record@s:record=102;corrupt_record@s:record=105"
+    )
+    obs.set_context(task="chunk0001", task_id="tid01", worker="w0")
+    seen = []
+    guard.run_batch(
+        lambda sub, off: seen.append(sub) or "r",
+        frame, site="s", offset=100, name="chunk_1.bam",
+        splitter=guard.entity_splitter("cell"),
+    )
+    obs.set_context(task=None, task_id=None, worker=None)
+    assert len(seen) == 1
+    filtered = seen[0]
+    assert filtered.n_records == 6  # exactly the two poisoned records gone
+    # entity structure survived: AAAA lost record idx 2, CCCC lost idx 5
+    names = [filtered.cell_names[c] for c in filtered.cell]
+    assert names == ["AAAA"] * 3 + ["CCCC"] * 3
+    entries = quarantine.load_quarantine(str(tmp_path / "q"))
+    assert [
+        (e["record_start"], e["record_stop"]) for e in entries
+    ] == [(102, 103), (105, 106)]
+    assert all(e["task"] == "chunk0001" for e in entries)
+    assert all(e["task_id"] == "tid01" for e in entries)
+    assert all(e["site"] == "s" for e in entries)
+    assert all(e["name"] == "chunk_1.bam" for e in entries)
+    assert all(e["approx_bytes"] > 0 for e in entries)
+    assert obs.counters()["guard_poison_records"] == 2
+    assert obs.counters()["guard_quarantined_ranges"] == 2
+
+
+def test_run_batch_localized_poison_from_fn_filters_and_retries(tmp_path):
+    """A PoisonData raised by fn WITH record_range: quarantine exactly it,
+    retry fn on the filtered remainder."""
+    quarantine.set_quarantine_dir(str(tmp_path / "q"))
+    frame = _frame([("AAAA", 3), ("CCCC", 3)])
+    calls = []
+
+    def fn(sub, off):
+        calls.append(sub.n_records)
+        if len(calls) == 1:
+            raise PoisonData("bad bytes", record_range=(2, 3))
+        return "ok"
+
+    out = guard.run_batch(fn, frame, site="s")
+    assert out == ["ok"]
+    assert calls == [6, 5]
+    entries = quarantine.load_quarantine(str(tmp_path / "q"))
+    assert [(e["record_start"], e["record_stop"]) for e in entries] == [
+        (2, 3)
+    ]
+
+
+def test_run_batch_two_localized_poisons_keep_absolute_coordinates(tmp_path):
+    """Regression: after the first localized quarantine shifts the
+    filtered frame, a SECOND localized PoisonData (computed by fn on the
+    filtered view) must still quarantine the records' TRUE stream
+    positions — not the shifted ones."""
+    quarantine.set_quarantine_dir(str(tmp_path / "q"))
+    frame = _frame([("AAAA", 4), ("CCCC", 4)])  # absolute records 100..108
+    calls = []
+
+    def fn(sub, off):
+        # the records at ABSOLUTE stream indices 101 and 105 are bad; fn
+        # localizes by what it sees: off + local index in the sub it got.
+        # Recover which absolute records this filtered sub holds from the
+        # quarantine trail so far (the test's stand-in for "the decoder
+        # knows which record it choked on").
+        dropped = sorted(
+            (e["record_start"], e["record_stop"])
+            for e in quarantine.load_quarantine(str(tmp_path / "q"))
+        )
+        absolutes = [a for a in range(100, 108) if not any(
+            s <= a < t for s, t in dropped
+        )]
+        calls.append(list(absolutes))
+        for local, absolute in enumerate(absolutes):
+            if absolute in (101, 105):
+                raise PoisonData(
+                    f"bad record at local {local}",
+                    record_range=(off + local, off + local + 1),
+                )
+        return "ok"
+
+    out = guard.run_batch(fn, frame, site="s", offset=100)
+    assert out == ["ok"]
+    entries = quarantine.load_quarantine(str(tmp_path / "q"))
+    assert [(e["record_start"], e["record_stop"]) for e in entries] == [
+        (101, 102), (105, 106)
+    ]
+    # fn ultimately saw the frame minus exactly those two records
+    assert calls[-1] == [100, 102, 103, 104, 106, 107]
+
+
+def test_run_batch_straddling_localized_poison_splits_sidecars(tmp_path):
+    """A localized range that straddles an earlier drop must quarantine
+    only the still-kept stretches — never re-name (or double-count)
+    records already quarantined."""
+    quarantine.set_quarantine_dir(str(tmp_path / "q"))
+    frame = _frame([("AAAA", 16)])
+    calls = []
+
+    def fn(sub, off):
+        calls.append(sub.n_records)
+        if len(calls) == 1:
+            raise PoisonData("first", record_range=(5, 10))
+        if len(calls) == 2:
+            # filtered locals [3, 7) = originals 3, 4, 10, 11 — straddles
+            # the dropped [5, 10)
+            raise PoisonData("second", record_range=(3, 7))
+        return "ok"
+
+    out = guard.run_batch(fn, frame, site="s")
+    assert out == ["ok"]
+    assert calls == [16, 11, 7]
+    entries = quarantine.load_quarantine(str(tmp_path / "q"))
+    got = sorted((e["record_start"], e["record_stop"]) for e in entries)
+    assert got == [(3, 5), (5, 10), (10, 12)]
+    assert obs.counters()["guard_poison_records"] == 9  # no double count
+
+
+def test_run_batch_unlocalized_poison_bisects_to_entity_floor(tmp_path):
+    """A PoisonData raised by fn WITHOUT localization: bisect via the
+    splitter; the floor (one whole entity) quarantines, the rest commits."""
+    quarantine.set_quarantine_dir(str(tmp_path / "q"))
+    frame = _frame([("AAAA", 2), ("CCCC", 2), ("GGGG", 2)])
+
+    def fn(sub, off):
+        # the CCCC entity (absolute records 2..4) is poisoned
+        names = {sub.cell_names[c] for c in sub.cell}
+        if "CCCC" in names:
+            raise PoisonData("decode failure somewhere in here")
+        return sorted(names)
+
+    out = guard.run_batch(
+        fn, frame, site="s", splitter=guard.entity_splitter("cell")
+    )
+    assert out == [["AAAA"], ["GGGG"]]
+    entries = quarantine.load_quarantine(str(tmp_path / "q"))
+    assert [(e["record_start"], e["record_stop"]) for e in entries] == [
+        (2, 4)
+    ]
+
+
+def test_run_batch_fatal_propagates_unwrapped():
+    frame = _frame([("AAAA", 2)])
+
+    def fn(sub, off):
+        raise ValueError("host bug")
+
+    with pytest.raises(ValueError, match="host bug"):
+        guard.run_batch(fn, frame, site="s")
+
+
+def test_run_batch_empty_and_none_frames():
+    assert guard.run_batch(lambda sub, off: "x", None, site="s") == []
+
+
+# ------------------------------------------------------------- watchdog
+
+def test_watchdog_interrupts_injected_stall(monkeypatch):
+    """Deadline far below the injected stall: the watchdog fires a flight
+    dump + Stall, guard retries, the (consumed) clause lets the retry
+    through — the lease never hangs to TTL."""
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_TIMEOUT_COMPUTE", "0.5")
+    faults.configure("stall@s:secs=30,times=1")
+    frame = _frame([("AAAA", 2)])
+    start = time.perf_counter()
+    out = guard.run_batch(
+        lambda sub, off: "ok", frame, site="s", retries=2,
+    )
+    assert out == ["ok"]
+    assert time.perf_counter() - start < 10
+    assert obs.counters()["guard_stalls"] >= 1
+    assert obs.counters()["guard_transient_retries"] >= 1
+
+
+def test_watchdog_deadline_fires_and_stands_down(monkeypatch):
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_TIMEOUT_COMPUTE", "0.3")
+    with pytest.raises(Stall):
+        with watchdog.deadline("compute", site="slow"):
+            for _ in range(200):
+                time.sleep(0.05)
+    assert obs.counters()["guard_stalls"] == 1
+    # a leg that finishes in time must not be interrupted afterwards
+    with watchdog.deadline("compute", site="fast"):
+        time.sleep(0.01)
+    time.sleep(0.5)
+    assert obs.counters()["guard_stalls"] == 1
+
+
+def test_watchdog_env_knob_validation(monkeypatch):
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_TIMEOUT_DECODE", "garbage")
+    assert watchdog.leg_timeout("decode") == 0.0
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_TIMEOUT_DECODE", "-3")
+    assert watchdog.leg_timeout("decode") == 0.0
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_TIMEOUT_DECODE", "12.5")
+    assert watchdog.leg_timeout("decode") == 12.5
+
+
+def test_watchdog_guarded_iter_passes_items_through(monkeypatch):
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_TIMEOUT_DECODE", "5")
+    assert list(watchdog.guarded_iter(iter([1, 2, 3]))) == [1, 2, 3]
+
+
+def test_stall_injection_self_resolves_without_watchdog():
+    faults.configure("stall@s:secs=0.2,times=1")
+    start = time.perf_counter()
+    faults.device_fault("s")
+    elapsed = time.perf_counter() - start
+    assert 0.15 <= elapsed < 5.0
+
+
+# -------------------------------------------------------------- degrade
+
+def test_degrade_threshold_and_loudness(monkeypatch, capsys):
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_DEGRADE_AFTER", "2")
+    monkeypatch.setitem(degrade.RUNGS, "site.a", "cpu")
+    assert not degrade.note_device_failure("site.a")
+    assert not degrade.is_degraded("site.a")
+    assert degrade.note_device_failure("site.a")
+    assert degrade.is_degraded("site.a")
+    assert degrade.degraded_sites() == {"site.a": "cpu"}
+    assert not degrade.note_device_failure("site.a")  # already degraded
+    assert obs.counters()["guard_degraded"] == 1
+    assert obs.counters()["guard_device_failures"] == 3
+    assert "site.a degraded to cpu" in capsys.readouterr().err
+
+
+def test_degrade_rungless_site_counts_but_never_degrades(capsys):
+    """A site with no fallback rung must never announce a degradation
+    nothing consumes — failures count, the site stays healthy."""
+    for _ in range(10):
+        degrade.note_device_failure("sort.dispatch")
+    assert not degrade.is_degraded("sort.dispatch")
+    assert degrade.degraded_sites() == {}
+    assert obs.counters()["guard_device_failures"] == 10
+    assert "guard_degraded" not in obs.counters()
+    assert "degraded" not in capsys.readouterr().err
+
+
+def test_degrade_now_is_immediate_and_idempotent(capsys):
+    degrade.degrade_now("ingest.native", "python-decoder", reason="mid-stream")
+    degrade.degrade_now("ingest.native", "python-decoder")
+    assert degrade.degraded_sites() == {"ingest.native": "python-decoder"}
+    assert obs.counters()["guard_degraded"] == 1
+
+
+# ----------------------------------------------------------- quarantine
+
+def test_quarantine_sidecar_roundtrip_and_env_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "SCTOOLS_TPU_GUARD_QUARANTINE", str(tmp_path / "env_q")
+    )
+    entry = quarantine.record_quarantine("s", 10, 12, "why", name="f.bam")
+    assert entry["record_start"] == 10 and entry["record_stop"] == 12
+    loaded = quarantine.load_quarantine(str(tmp_path / "env_q"))
+    assert len(loaded) == 1 and loaded[0]["reason"] == "why"
+    # programmatic dir beats the env
+    quarantine.set_quarantine_dir(str(tmp_path / "prog_q"))
+    quarantine.record_quarantine("s", 1, 2, "again")
+    assert len(quarantine.load_quarantine(str(tmp_path / "prog_q"))) == 1
+
+
+def test_quarantine_counts_even_without_dir():
+    quarantine.record_quarantine("s", 0, 3, "no dir configured")
+    assert obs.counters()["guard_poison_records"] == 3
+
+
+def test_quarantine_skips_torn_trailing_line(tmp_path):
+    base = tmp_path / "q"
+    base.mkdir()
+    good = {"task": "t", "record_start": 1, "record_stop": 2}
+    (base / "records-w0.jsonl").write_text(
+        json.dumps(good) + "\n{torn half-lin"
+    )
+    assert quarantine.load_quarantine(str(base)) == [good]
+
+
+# ----------------------------------------------- flight-record sections
+
+def test_flight_sections_capture_guard_state(tmp_path, monkeypatch):
+    frame = _frame([("AAAA", 2)])
+    captured = {}
+
+    def snoop(sub, off):
+        captured.update(guard.open_retries())
+        return "ok"
+
+    guard.run_batch(snoop, frame, site="flight.site", offset=7)
+    assert captured["flight.site"] == {
+        "attempt": 0, "offset": 7, "records": 2,
+    }
+    assert guard.open_retries() == {}  # cleared after the attempt
+    # degraded sites ride the flight record too
+    degrade.degrade_now("x.y", "cpu")
+    path = tmp_path / "flight.jsonl"
+    obs.flight_dump(reason="test", path=str(path))
+    meta = json.loads(path.read_text().splitlines()[0])
+    assert meta["sections"]["guard_degraded"] == {"x.y": "cpu"}
+    assert meta["sections"]["guard_retries"] == {}
+
+
+def test_flight_providers_never_deadlock_on_held_locks():
+    """The flight-section providers run inside a signal handler that may
+    have interrupted a lock holder ON THE SAME THREAD — they must return
+    (bounded wait + lockless fallback), never self-deadlock the death
+    path."""
+    import sctools_tpu.guard as guard_mod
+    from sctools_tpu.guard import degrade as degrade_mod
+    from sctools_tpu.ingest import ring as ring_mod
+
+    for lock, provider in (
+        (guard_mod._open_lock, guard_mod.open_retries),
+        (degrade_mod._lock, degrade_mod.degraded_sites),
+        (ring_mod._state_lock, ring_mod._ring_snapshot),
+    ):
+        assert lock.acquire()
+        try:
+            start = time.perf_counter()
+            result = provider()  # held by THIS thread: must still return
+            assert time.perf_counter() - start < 5.0
+            assert result is not None
+        finally:
+            lock.release()
+
+
+# ------------------------------------------------------- env validation
+
+def test_guard_retries_env_validation(monkeypatch):
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_RETRIES", "garbage")
+    assert guard.configured_retries() == guard.DEFAULT_RETRIES
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_RETRIES", "-1")
+    assert guard.configured_retries() == guard.DEFAULT_RETRIES
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_RETRIES", "0")
+    assert guard.configured_retries() == 0
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_DEGRADE_AFTER", "junk")
+    assert degrade.threshold() == degrade.DEFAULT_THRESHOLD

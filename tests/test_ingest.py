@@ -262,16 +262,54 @@ def _dying_stream(monkeypatch, fatal_call: int):
 
 @_NATIVE
 def test_ring_decoder_death_propagates_promptly(sorted_bam, monkeypatch):
-    """Decoder dying mid-fill raises at the failed batch — no hang, and
-    the batches decoded before the death were delivered."""
+    """With the downgrade ladder disabled, a decoder dying mid-fill raises
+    at the failed batch — no hang, the batches decoded before the death
+    were delivered, and the error localizes WHERE (batch index + approx
+    record offset) for guard and human postmortems."""
+    from sctools_tpu.guard.errors import NativeDecodeError
+
     path, _ = sorted_bam
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_NATIVE_DOWNGRADE", "0")
     _dying_stream(monkeypatch, fatal_call=3)
     frames = ingest.ring_frames(path, batch_records=16)
     delivered = 0
-    with pytest.raises(RuntimeError, match="injected decoder death"):
+    with pytest.raises(RuntimeError, match="injected decoder death") as info:
         for _ in frames:
             delivered += 1
     assert delivered >= 1
+    assert isinstance(info.value, NativeDecodeError)
+    assert info.value.batch_index == 2
+    assert info.value.record_offset == delivered * 16
+    assert "batch_index=2" in str(info.value)
+
+
+@_NATIVE
+def test_ring_midstream_failure_downgrades_to_python(
+    sorted_bam, monkeypatch, recording
+):
+    """Default behavior: a mid-stream native failure finishes the stream
+    on the Python decoder — same records, no gap, no duplicate — and the
+    degradation is loud (site degraded + counter)."""
+    from sctools_tpu import guard, obs
+    from sctools_tpu.io.packed import iter_frames_from_bam
+
+    path, _ = sorted_bam
+    guard.degrade.reset()
+    _dying_stream(monkeypatch, fatal_call=3)
+    got = [
+        (f.cell_names[c], f.umi_names[u], f.gene_names[g])
+        for f in ingest.ring_frames(path, batch_records=16)
+        for c, u, g in zip(f.cell, f.umi, f.gene)
+    ]
+    want = [
+        (f.cell_names[c], f.umi_names[u], f.gene_names[g])
+        for f in iter_frames_from_bam(path, 16)
+        for c, u, g in zip(f.cell, f.umi, f.gene)
+    ]
+    assert got == want
+    assert guard.degrade.is_degraded("ingest.native")
+    assert obs.counters().get("guard_native_downgrades", 0) >= 1
+    guard.degrade.reset()
 
 
 @_NATIVE
@@ -286,6 +324,7 @@ def test_ring_ledger_reconciles_after_crash(
     from sctools_tpu.obs import xprof
 
     path, _ = sorted_bam
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_NATIVE_DOWNGRADE", "0")
     _dying_stream(monkeypatch, fatal_call=4)
     before = (
         xprof.ledger_totals()
@@ -461,3 +500,140 @@ def test_upload_timed_records_seconds(recording):
         xprof.ledger_totals()["h2d"]["by_site"]["test.timed_ctx"]["seconds"]
         > 0
     )
+
+
+# ---------------------------------------------- SIGTERM mid-ring (guard)
+
+@_NATIVE
+@pytest.mark.timeout(300)
+def test_sigterm_midring_flight_record_then_recovery(tmp_path, sorted_bam):
+    """SIGTERM landing while ring slots are in flight and a guard retry is
+    open: the flight record captures the ring slot states and the open
+    guard retry ladder, no partial CSV is published, and a clean re-run
+    completes with the transfer ledger reconciling byte-for-byte against
+    the gatherer's own accounting."""
+    import gzip
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    path, _ = sorted_bam
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "guard_sigterm_worker.py"
+    )
+    trace_dir = tmp_path / "trace"
+    stem = str(tmp_path / "out")
+
+    def worker_env(worker_name, faults_spec):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["SCTOOLS_TPU_TRACE"] = str(trace_dir)
+        env["SCTOOLS_TPU_TRACE_WORKER"] = worker_name
+        if faults_spec:
+            env["SCTOOLS_TPU_FAULTS"] = faults_spec
+        else:
+            env.pop("SCTOOLS_TPU_FAULTS", None)
+        return env
+
+    # phase 1: the first dispatch stalls (far longer than the test), so
+    # the worker sits inside guard's attempt loop with the decode thread
+    # still rotating ring slots behind the bounded queue
+    proc = subprocess.Popen(
+        [sys.executable, worker, path, stem, "16"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=worker_env("w0", "stall@gatherer.dispatch:secs=600"),
+    )
+    try:
+        trace_file = trace_dir / "trace.w0.jsonl"
+        deadline = time.time() + 120
+        seen_decode = False
+        while time.time() < deadline and not seen_decode:
+            if trace_file.exists():
+                seen_decode = '"decode"' in trace_file.read_text()
+            time.sleep(0.2)
+        assert seen_decode, "worker never reached the ring decode stage"
+        time.sleep(1.0)  # let the stall engage past the first decode
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode != 0, out
+
+    flight = trace_dir / "flight.w0.jsonl"
+    assert flight.exists(), "SIGTERM must leave a flight record"
+    meta = json.loads(flight.read_text().splitlines()[0])
+    sections = meta.get("sections") or {}
+    # the open guard retry ladder: the stalled dispatch, attempt 0
+    open_retries = sections.get("guard_retries") or {}
+    assert "gatherer.dispatch" in open_retries, sections
+    assert open_retries["gatherer.dispatch"]["records"] > 0
+    # ring slot states: the decode ring was mid-flight when SIGTERM landed
+    ring = sections.get("ring_slots") or []
+    assert ring, sections
+    assert ring[0]["slots"] >= 3
+    assert ring[0]["phase"] in ("filling", "queued", "starting", "eof")
+    # no partial CSV was published (the atomic-commit contract held)
+    assert not os.path.exists(stem + ".csv.gz")
+
+    # phase 2: a clean re-run converges; its ledger reconciles exactly
+    proc = subprocess.run(
+        [sys.executable, worker, path, stem, "16"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=worker_env("w1", ""), timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout
+    bytes_h2d = int(
+        [l for l in proc.stdout.splitlines() if l.startswith("BYTES_H2D=")][
+            0
+        ].split("=")[1]
+    )
+    assert bytes_h2d > 0
+    with open(trace_dir / "xprof.w1.json", encoding="utf-8") as f:
+        registry = json.load(f)
+    ledger_entry = registry["ledger"]["h2d"]["by_site"]["gatherer.upload"]
+    assert ledger_entry["bytes"] == bytes_h2d
+    # the recovered output matches an in-process clean run byte-for-byte
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    clean = str(tmp_path / "clean")
+    GatherCellMetrics(
+        path, clean, backend="device", batch_records=16
+    ).extract_metrics()
+    with gzip.open(stem + ".csv.gz", "rb") as f:
+        got = f.read()
+    with gzip.open(clean + ".csv.gz", "rb") as f:
+        assert got == f.read()
+
+
+@_NATIVE
+def test_ring_downgrade_tail_failure_chains_native_error(
+    sorted_bam, monkeypatch
+):
+    """Truly corrupt bytes: when the downgrade tail's Python decoder also
+    fails, ITS error surfaces with the NativeDecodeError (and its
+    batch/offset localization) chained as the cause."""
+    from sctools_tpu.guard.errors import NativeDecodeError
+    from sctools_tpu.io import packed as packed_mod
+
+    path, _ = sorted_bam
+    _dying_stream(monkeypatch, fatal_call=3)
+
+    def failing_python_decode(*args, **kwargs):
+        raise ValueError("python decoder also failed")
+        yield  # pragma: no cover - makes this a generator
+
+    monkeypatch.setattr(
+        packed_mod, "iter_frames_from_bam", failing_python_decode
+    )
+    with pytest.raises(ValueError, match="python decoder also failed") as info:
+        for _ in ingest.ring_frames(path, batch_records=16):
+            pass
+    assert isinstance(info.value.__cause__, NativeDecodeError)
+    assert info.value.__cause__.batch_index == 2

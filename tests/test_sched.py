@@ -756,3 +756,179 @@ def test_cli_status_watch_flag_parses(tmp_path, capsys):
          "--frames", "3"]
     ) == 0
     capsys.readouterr()
+
+
+# ------------------------------------- scx-guard satellites (this PR)
+
+def test_retry_quarantined_refuses_changed_chunk(tmp_path, capsys):
+    """retry-quarantined re-verifies the chunk's content signature before
+    requeueing: a task whose input changed (or vanished) since quarantine
+    is REFUSED, not resurrected blind."""
+    chunk = tmp_path / "chunk_0.bam"
+    chunk.write_bytes(b"original chunk bytes")
+    stat = os.stat(chunk)
+    journal_dir = str(tmp_path / "j")
+    journal = Journal(journal_dir, worker_id="w1")
+    good = make_task(
+        "cell_metrics", "chunk0000",
+        {"chunk": str(chunk),
+         "chunk_sig": f"{stat.st_size}:{stat.st_mtime_ns}",
+         "index": 0, "out_dir": str(tmp_path)},
+    )
+    changed = make_task(
+        "cell_metrics", "chunk0001",
+        {"chunk": str(chunk), "chunk_sig": "1:1",
+         "index": 1, "out_dir": str(tmp_path)},
+    )
+    gone = make_task(
+        "cell_metrics", "chunk0002",
+        {"chunk": str(tmp_path / "missing.bam"), "chunk_sig": "9:9",
+         "index": 2, "out_dir": str(tmp_path)},
+    )
+    unsigned = make_task("other", "t-unsigned", {"x": 1})
+    journal.register([good, changed, gone, unsigned])
+    for task in (good, changed, gone, unsigned):
+        journal.record(task.id, "leased", attempt=1)
+        journal.record(task.id, "failed", attempt=1, error="boom")
+        journal.record(task.id, "quarantined", error="boom")
+
+    assert sched_cli.main(["retry-quarantined", journal_dir]) == 1
+    out = capsys.readouterr().out
+    assert "requeued chunk0000" in out
+    assert "requeued t-unsigned" in out  # no signature -> no check
+    assert "REFUSED chunk0001" in out and "changed since quarantine" in out
+    assert "REFUSED chunk0002" in out and "gone" in out
+    assert "2 task(s) requeued, 2 refused" in out
+
+    _, states = Journal(journal_dir, worker_id="probe").replay()
+    by_id = {tid: st.state for tid, st in states.items()}
+    assert by_id[good.id] == "pending"
+    assert by_id[unsigned.id] == "pending"
+    assert by_id[changed.id] == QUARANTINED
+    assert by_id[gone.id] == QUARANTINED
+
+
+def test_retry_quarantined_unchanged_chunk_still_requeues(tmp_path, capsys):
+    """The signature check must not break the happy path (exit 0)."""
+    chunk = tmp_path / "chunk_0.bam"
+    chunk.write_bytes(b"stable bytes")
+    stat = os.stat(chunk)
+    journal_dir = str(tmp_path / "j")
+    journal = Journal(journal_dir, worker_id="w1")
+    task = make_task(
+        "cell_metrics", "chunk0000",
+        {"chunk": str(chunk),
+         "chunk_sig": f"{stat.st_size}:{stat.st_mtime_ns}",
+         "index": 0, "out_dir": str(tmp_path)},
+    )
+    journal.register([task])
+    journal.record(task.id, "quarantined", error="x")
+    assert sched_cli.main(["retry-quarantined", journal_dir]) == 0
+    assert "1 task(s) requeued, 0 refused" in capsys.readouterr().out
+
+
+@pytest.mark.timeout(600)
+def test_sigterm_during_guarded_stall_keeps_lease_semantics(tmp_path):
+    """SIGTERM landing while a worker sits inside a guard retry (injected
+    stall): the flight record captures the open guard retry, the journal
+    shows the task leased with NO failed event (the stall burned no sched
+    attempt), no partial part was published, and a clean relaunch
+    converges byte-identically."""
+    import json
+    import signal
+
+    bam = str(tmp_path / "input.bam")
+    _make_input(bam)
+
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+    from sctools_tpu.platform import GenericPlatform
+
+    single = tmp_path / "single.csv.gz"
+    GatherCellMetrics(bam, str(single), backend="device").extract_metrics()
+
+    chunk_dir = tmp_path / "chunks"
+    chunk_dir.mkdir()
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", str(chunk_dir / "chunk"), "-s", "0.002", "-t", "CB"]
+    )
+    n_chunks = len(list(chunk_dir.glob("*.bam")))
+    assert n_chunks >= 3
+
+    trace_dir = tmp_path / "trace"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["SCTOOLS_TPU_TRACE"] = str(trace_dir)
+    env["SCTOOLS_TPU_TRACE_WORKER"] = "w0"
+    env["SCTOOLS_TPU_FAULTS"] = "stall@gatherer.dispatch:times=1,secs=600"
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, str(tmp_path), "0", "1", "5.0", "3",
+         "0.05"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env,
+    )
+    journal_dir = str(tmp_path / "sched-journal")
+    try:
+        deadline = time.time() + 120
+        leased = False
+        probe = Journal(journal_dir, worker_id="probe")
+        while time.time() < deadline and not leased:
+            if os.path.isdir(journal_dir):
+                _, states = probe.replay()
+                leased = any(st.state == "leased" for st in states.values())
+            time.sleep(0.2)
+        assert leased, "worker never leased a task"
+        time.sleep(1.5)  # let the first dispatch reach the injected stall
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode != 0, out
+
+    # the flight record shows the guarded dispatch mid-recovery (named by
+    # the journal worker id the scheduler put into the obs context)
+    flights = sorted(trace_dir.glob("flight.*.jsonl"))
+    assert flights, list(trace_dir.glob("*"))
+    meta = json.loads(flights[0].read_text().splitlines()[0])
+    assert "gatherer.dispatch" in (
+        (meta.get("sections") or {}).get("guard_retries") or {}
+    ), meta.get("sections")
+
+    # journal: the stalled task is leased, and the stall produced NO
+    # failed event (guard absorbs device faults below the scheduler)
+    tasks, states = Journal(journal_dir, worker_id="probe2").replay()
+    assert any(st.state == "leased" for st in states.values())
+    assert all(st.failures == 0 for st in states.values())
+    # no partial part file exists for the leased (killed) task
+    committed_parts = {
+        os.path.abspath(st.part) for st in states.values()
+        if st.state == COMMITTED and st.part
+    }
+    on_disk = {
+        os.path.abspath(str(p))
+        for p in tmp_path.glob("metrics.part*.csv.gz")
+    }
+    assert on_disk == committed_parts
+
+    # clean relaunch: converges, byte-identical merge
+    env.pop("SCTOOLS_TPU_FAULTS")
+    env["SCTOOLS_TPU_TRACE_WORKER"] = "w1"
+    proc = subprocess.run(
+        [sys.executable, WORKER, str(tmp_path), "0", "1", "2.0", "3",
+         "0.05"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout
+    merged = tmp_path / "merged.csv.gz"
+    merge_sorted_csv_parts(
+        str(tmp_path / "metrics.part*.csv.gz"), str(merged),
+        journal_dir=journal_dir, expected_parts=n_chunks,
+    )
+    with gzip.open(single, "rb") as f:
+        expected = f.read()
+    with gzip.open(merged, "rb") as f:
+        assert f.read() == expected
