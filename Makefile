@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate docs clean
 
-ci: native lint test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate
+ci: native lint racecheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -17,13 +17,26 @@ native:
 # + tsan.supp audit, sctools_tpu/analysis). Both must pass for `make ci`.
 # tests/ is style-checked but excluded from scx-lint: it hosts the
 # deliberately-bad fixture corpus and test-local jax.config setup.
+# --no-race: `make racecheck` owns the SCX4xx pass (same path set), so
+# ci builds the whole-package concurrency model exactly once.
 lint:
 	@if $(PY) -c "import ruff" 2>/dev/null; then \
 		$(PY) -m ruff check sctools_tpu tests bench.py __graft_entry__.py; \
 	else \
 		$(PY) -m compileall -q sctools_tpu tests bench.py __graft_entry__.py; \
 	fi
-	$(PY) -m sctools_tpu.analysis sctools_tpu bench.py __graft_entry__.py
+	$(PY) -m sctools_tpu.analysis --no-race sctools_tpu bench.py __graft_entry__.py
+
+# concurrency gate: the scx-race pass (SCX401-404) on its own — lock
+# inventory, acquisition-order cycles, death-path safety, cross-thread
+# writes, unbounded teardown waits — over the same path set as `make
+# lint` (tests/ excluded as the fixture host). The runtime half of the
+# contract (SCTOOLS_TPU_LOCK_DEBUG=1 lock witness) runs inside
+# guard-smoke and fleet-smoke, which assert observed acquisition order
+# is a subgraph of the static graph this pass emits
+# (docs/static_analysis.md).
+racecheck:
+	$(PY) -m sctools_tpu.analysis --race-only sctools_tpu bench.py __graft_entry__.py
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -64,7 +77,9 @@ sched-smoke:
 # with tracing on, then stitched by obs.fleet — asserts both workers merge
 # onto one timeline, every committed task is attributed to its surviving
 # lineage, the crashed worker's flight record is recovered (open span
-# stack included), and a non-empty critical path is named
+# stack included), and a non-empty critical path is named; the surviving
+# worker's lock-witness dump (SCTOOLS_TPU_LOCK_DEBUG=1) must validate
+# against the static scx-race graph
 # (tests/fleet_smoke.py; docs/observability.md).
 fleet-smoke:
 	rm -rf /tmp/sctools_tpu_fleet_smoke
@@ -100,8 +115,10 @@ ingest-smoke:
 # converge with ZERO failed journal events (guard absorbs device faults
 # below the scheduler), quarantine sidecars naming exactly the injected
 # records, output byte-identical to a fault-free run minus those records,
-# and 0 steady-state retraces from the OOM bisection
-# (tests/guard_smoke.py; docs/robustness.md).
+# and 0 steady-state retraces from the OOM bisection; both workers run
+# under SCTOOLS_TPU_LOCK_DEBUG=1, and the observed lock acquisition
+# order must be a non-empty, violation-free subgraph of the static
+# scx-race lock-order graph (tests/guard_smoke.py; docs/robustness.md).
 guard-smoke:
 	rm -rf /tmp/sctools_tpu_guard_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_GUARD_SMOKE_DIR=/tmp/sctools_tpu_guard_smoke \
@@ -127,26 +144,26 @@ native-ubsan:
 docs:
 	$(PY) docs/generate_cli_reference.py
 
-# deep gate: the threaded native paths under ThreadSanitizer, then the
-# full native suite under Address- and UndefinedBehaviorSanitizer. Each
-# runtime must be preloaded because the python host binary is
-# uninstrumented; the same $(CXX) that built the instrumented lib
-# resolves the runtime so the two cannot mismatch.
+# deep gate: the threaded native paths AND the full native suite under
+# ThreadSanitizer, then the full native suite under Address- and
+# UndefinedBehaviorSanitizer. Each runtime must be preloaded because the
+# python host binary is uninstrumented; the same $(CXX) that built the
+# instrumented lib resolves the runtime so the two cannot mismatch.
 # SCTOOLS_TPU_REQUIRE_NATIVE turns the suite's native-unavailable skip
 # into a hard failure — a gate that cannot load the sanitizer build must
 # fail, not pass vacuously. The asan leg disables leak detection: LSan
 # would report the (uninstrumented) interpreter's arena allocations at
-# exit, drowning real reports from our library. libstdc++ rides the
-# asan/ubsan preloads: python itself doesn't link it, so without the
-# co-preload the sanitizer runtime initializes before any C++ runtime
-# exists and its __cxa_throw interceptor aborts the first time an
-# uninstrumented extension (jaxlib) throws.
+# exit, drowning real reports from our library. libstdc++ co-preload
+# caveat (applies to ALL THREE sanitizers): python itself doesn't link
+# libstdc++, so without the co-preload the sanitizer runtime initializes
+# before any C++ runtime exists and its __cxa_throw interceptor aborts
+# the first time an uninstrumented extension (jaxlib) throws.
 ci-deep: ci native-tsan native-asan native-ubsan
-	LD_PRELOAD=$$($(CXX) -print-file-name=libtsan.so) \
+	LD_PRELOAD="$$($(CXX) -print-file-name=libtsan.so) $$($(CXX) -print-file-name=libstdc++.so)" \
 	TSAN_OPTIONS="report_bugs=1 exitcode=66 suppressions=$(CURDIR)/sctools_tpu/native/tsan.supp" \
 	SCTOOLS_TPU_NATIVE_LIB=$(CURDIR)/sctools_tpu/native/libsctools_native.tsan.so \
 	SCTOOLS_TPU_REQUIRE_NATIVE=1 \
-	$(PY) -m pytest tests/test_native_threads.py -q
+	$(PY) -m pytest tests/test_native_threads.py tests/test_native.py -q
 	LD_PRELOAD="$$($(CXX) -print-file-name=libasan.so) $$($(CXX) -print-file-name=libstdc++.so)" \
 	ASAN_OPTIONS="detect_leaks=0 abort_on_error=0 exitcode=66" \
 	SCTOOLS_TPU_NATIVE_LIB=$(CURDIR)/sctools_tpu/native/libsctools_native.asan.so \

@@ -578,11 +578,26 @@ def bench_guard_overhead(rounds: int = 5, calls: int = 60) -> dict:
     ~0.1 ms cost, cold caches included, is gated against what a real
     batch costs, not against a toy.
     """
+    import threading
     import time
 
     import numpy as np
 
-    from sctools_tpu import guard
+    from sctools_tpu import guard, obs
+    from sctools_tpu.analysis import witness
+
+    # SCTOOLS_TPU_LOCK_DEBUG off must be a TRUE no-op: the library's
+    # hot-path locks are the raw threading primitives, not witness
+    # proxies — otherwise this leg would be measuring the instrumented
+    # cost and the <=1.02 gate would be meaningless
+    if not witness.enabled():
+        for hot_lock in (obs._lock, guard._open_lock):
+            assert not isinstance(hot_lock, witness.WitnessLock), (
+                "lock-witness proxy active without SCTOOLS_TPU_LOCK_DEBUG=1"
+            )
+        assert type(obs._sink_lock) is type(threading.Lock()), (
+            type(obs._sink_lock)
+        )
 
     payload = np.arange(1 << 21, dtype=np.int32)[::-1].copy()
 
@@ -625,6 +640,7 @@ def bench_guard_overhead(rounds: int = 5, calls: int = 60) -> dict:
         "overhead": round(statistics.median(ratios), 4),
         "rounds": rounds,
         "calls_per_round": calls,
+        "lock_debug": witness.enabled(),
     }
 
 

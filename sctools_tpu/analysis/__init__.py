@@ -6,31 +6,81 @@ silent retraces, host-device syncs inside traced code, tracer leaks into
 Python control flow, and drift between the hand-written ctypes tables in
 ``native/__init__.py`` and the ``extern "C"`` sources they bind.
 
-Three passes, one CLI (``python -m sctools_tpu.analysis``), all pure
+Four passes, one CLI (``python -m sctools_tpu.analysis``), all pure
 stdlib — nothing here imports jax, numpy, or the code under analysis:
 
 - :mod:`.jaxlint`  — AST rules SCX101-SCX108 over traced functions;
 - :mod:`.abicheck` — ctypes ABI cross-check, rules SCX201-SCX206;
-- :mod:`.suppaudit` — tsan.supp validity audit, rules SCX301-SCX303.
+- :mod:`.suppaudit` — tsan.supp validity audit, rules SCX301-SCX303;
+- :mod:`.racecheck` — whole-package concurrency model (lock inventory,
+  locksets, acquisition-order graph, death-path safety), rules
+  SCX401-SCX404, paired with the runtime lock witness (:mod:`.witness`,
+  ``SCTOOLS_TPU_LOCK_DEBUG=1``) that validates the static model against
+  live runs.
 
 Findings carry stable rule ids and honor inline
 ``# scx-lint: disable=SCXNNN`` escape hatches (:mod:`.findings`).
 ``make lint`` runs the CLI after ruff/compileall, making a clean scx-lint
-run part of ``make ci`` mergeability.
+run part of ``make ci`` mergeability; ``make racecheck`` runs the
+concurrency pass on its own.
 """
 
-from .abicheck import ABI_RULES, check_abi
-from .findings import Finding, Suppressions
-from .jaxlint import JAX_RULES, lint_file
-from .suppaudit import SUPP_RULES, audit_suppressions
+# Re-exports resolve lazily (PEP 562): every library module imports
+# ..analysis.witness for its lock factories, which executes this
+# package __init__ — eagerly importing the four analyzer passes here
+# would make every worker pay the whole analyzer's parse cost at
+# startup for a facility that is a no-op by default.
+_EXPORTS = {
+    "ABI_RULES": "abicheck",
+    "check_abi": "abicheck",
+    "Finding": "findings",
+    "Suppressions": "findings",
+    "JAX_RULES": "jaxlint",
+    "lint_file": "jaxlint",
+    "RACE_RULES": "racecheck",
+    "check_races": "racecheck",
+    "lock_graph": "racecheck",
+    "SUPP_RULES": "suppaudit",
+    "audit_suppressions": "suppaudit",
+    "make_lock": "witness",
+    "make_rlock": "witness",
+}
+
+_SUBMODULES = frozenset(
+    {"abicheck", "cli", "findings", "jaxlint", "racecheck", "suppaudit",
+     "witness"}
+)
+
+
+def __getattr__(name):
+    import importlib
+
+    submodule = _EXPORTS.get(name)
+    if submodule is not None:
+        value = getattr(
+            importlib.import_module(f".{submodule}", __name__), name
+        )
+        globals()[name] = value
+        return value
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
 
 __all__ = [
     "ABI_RULES",
     "Finding",
     "JAX_RULES",
+    "RACE_RULES",
     "SUPP_RULES",
     "Suppressions",
     "audit_suppressions",
     "check_abi",
+    "check_races",
     "lint_file",
+    "lock_graph",
+    "make_lock",
+    "make_rlock",
 ]

@@ -1,12 +1,17 @@
 """scx-lint CLI: ``python -m sctools_tpu.analysis [paths...]``.
 
-Runs three passes and exits non-zero when any finding survives
+Runs four passes and exits non-zero when any finding survives
 suppressions:
 
 1. JAX lint (SCX1xx) over every ``.py`` file under the given paths;
 2. ctypes ABI check (SCX2xx) over the first ``native/`` package found
    under the paths (or ``--native-dir``);
-3. tsan.supp audit (SCX3xx) over that package's suppression file.
+3. tsan.supp audit (SCX3xx) over that package's suppression file;
+4. concurrency / death-path check (SCX4xx) over the whole package model
+   built from the same paths (``--race-only`` runs just this pass —
+   ``make racecheck`` — and ``--emit-lock-graph FILE`` writes the static
+   lock inventory + acquisition-order graph the runtime witness
+   validates against, docs/static_analysis.md).
 
 The module imports nothing heavyweight (no jax, no numpy), so the gate
 adds milliseconds to ``make lint``.
@@ -15,6 +20,7 @@ adds milliseconds to ``make lint``.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import List, Optional
@@ -22,6 +28,7 @@ from typing import List, Optional
 from .abicheck import ABI_RULES, check_abi
 from .findings import Finding
 from .jaxlint import JAX_RULES, lint_file
+from .racecheck import RACE_RULES, check_races, lock_graph
 from .suppaudit import SUPP_RULES, audit_suppressions
 
 # directory names never worth walking into
@@ -72,6 +79,7 @@ def _print_rules() -> None:
         ("JAX/TPU lint", JAX_RULES),
         ("ctypes ABI", ABI_RULES),
         ("tsan.supp audit", SUPP_RULES),
+        ("concurrency / death path", RACE_RULES),
     ):
         print(f"  {title}:")
         for rule_id, slug in sorted(rules.items()):
@@ -105,6 +113,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--no-supp", action="store_true", help="skip the SCX3xx pass"
     )
     parser.add_argument(
+        "--no-race", action="store_true",
+        help="skip the SCX4xx concurrency pass",
+    )
+    parser.add_argument(
+        "--race-only", action="store_true",
+        help="run ONLY the SCX4xx concurrency pass (make racecheck)",
+    )
+    parser.add_argument(
+        "--emit-lock-graph", metavar="FILE", default=None,
+        help="write the static lock inventory + acquisition-order graph "
+        "as JSON (the SCTOOLS_TPU_LOCK_GRAPH contract file for the "
+        "runtime witness) and exit",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
     parser.add_argument(
@@ -125,6 +147,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"scx-lint: path does not exist: {path}", file=sys.stderr)
         return 2
 
+    if args.emit_lock_graph is not None:
+        graph = lock_graph(args.paths)
+        tmp = f"{args.emit_lock_graph}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(graph, f, sort_keys=True, indent=1)
+            f.write("\n")
+        os.replace(tmp, args.emit_lock_graph)
+        if not args.quiet:
+            print(
+                f"scx-race: wrote {len(graph['locks'])} lock(s), "
+                f"{len(graph['edges'])} order edge(s), "
+                f"{len(graph['entries'])} thread/signal entr(ies) to "
+                f"{args.emit_lock_graph}"
+            )
+        return 0
+
+    if args.race_only:
+        args.no_jax_lint = args.no_abi = args.no_supp = True
+        args.no_race = False
+
     findings: List[Finding] = []
     checked_files = 0
 
@@ -134,6 +176,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings.extend(lint_file(path))
 
     native_dir = args.native_dir or _find_native_dir(args.paths)
+    if args.race_only:
+        native_dir = None
     if native_dir is not None:
         if not args.no_abi:
             findings.extend(check_abi(native_dir))
@@ -150,6 +194,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
 
+    if not args.no_race:
+        findings.extend(check_races(args.paths))
+        if args.race_only:
+            from .racecheck import _collect_py_files as _race_files
+
+            checked_files = len(_race_files(args.paths))
+
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     for finding in findings:
         print(finding.render())
@@ -160,6 +211,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 ("jax-lint", args.no_jax_lint),
                 ("abi", args.no_abi or native_dir is None),
                 ("supp", args.no_supp or native_dir is None),
+                ("race", args.no_race),
             )
             if not skipped
         ]

@@ -47,6 +47,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import obs
+from ..analysis.witness import make_lock
 from . import degrade, quarantine, watchdog
 from .errors import (
     FATAL,
@@ -121,7 +122,7 @@ def _backoff_sleep(attempt: int) -> None:
 # site -> state of the retry ladder currently executing there; captured
 # into flight records so a SIGTERM/crash postmortem shows which guarded
 # calls were mid-recovery when the process died
-_open_lock = threading.Lock()
+_open_lock = make_lock("guard.open_retries")
 _open_retries: Dict[str, Dict[str, Any]] = {}
 
 

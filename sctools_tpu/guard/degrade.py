@@ -33,6 +33,7 @@ import threading
 from typing import Dict
 
 from .. import obs
+from ..analysis.witness import make_lock
 
 ENV_THRESHOLD = "SCTOOLS_TPU_GUARD_DEGRADE_AFTER"
 DEFAULT_THRESHOLD = 3
@@ -48,7 +49,7 @@ RUNGS: Dict[str, str] = {
     "gatherer.dispatch": "cpu",
 }
 
-_lock = threading.Lock()
+_lock = make_lock("guard.degrade")
 _failures: Dict[str, int] = {}
 _degraded: Dict[str, str] = {}  # site -> level name
 

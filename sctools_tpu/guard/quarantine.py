@@ -33,6 +33,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import obs
+from ..analysis.witness import make_lock
 
 ENV_DIR = "SCTOOLS_TPU_GUARD_QUARANTINE"
 
@@ -42,7 +43,7 @@ ENV_DIR = "SCTOOLS_TPU_GUARD_QUARANTINE"
 # value, this is deliberately "approx"
 _APPROX_RECORD_BYTES = 53
 
-_lock = threading.Lock()
+_lock = make_lock("guard.quarantine")
 _dir: Optional[str] = None  # programmatic override (beats the env)
 
 

@@ -37,6 +37,7 @@ import threading
 from typing import Iterable, Iterator, Optional, TypeVar
 
 from .. import obs
+from ..analysis.witness import make_lock
 from .errors import Stall
 
 T = TypeVar("T")
@@ -90,7 +91,7 @@ def deadline(leg: str, site: str = "", seconds: Optional[float] = None):
         yield
         return
     target = threading.get_ident()
-    lock = threading.Lock()
+    lock = make_lock("guard.watchdog.deadline")
     armed = [True]
     fired = [False]
 

@@ -61,6 +61,7 @@ import threading
 from typing import Iterable, Iterator, Optional
 
 from .. import obs
+from ..analysis.witness import make_lock
 from ..guard import degrade
 from ..guard.errors import NativeDecodeError
 from ..guard.watchdog import guarded_iter
@@ -77,7 +78,7 @@ ENV_NATIVE_DOWNGRADE = "SCTOOLS_TPU_GUARD_NATIVE_DOWNGRADE"
 # live ring state for flight records: ring id -> {slot, batches, phase}.
 # Updated by the producer thread (cheap dict stores under one lock);
 # a postmortem reads it through the obs flight-section registry.
-_state_lock = threading.Lock()
+_state_lock = make_lock("ingest.ring_state")
 _ring_state: dict = {}
 _ring_ids = itertools.count()
 

@@ -22,6 +22,7 @@ from typing import List, Optional
 import numpy as np
 
 from .. import obs
+from ..analysis.witness import make_lock
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 # SCTOOLS_TPU_NATIVE_LIB points the loader at an alternate build (the
@@ -31,7 +32,7 @@ _LIB_PATH = os.environ.get(
     "SCTOOLS_TPU_NATIVE_LIB", os.path.join(_DIR, "libsctools_native.so")
 )
 
-_lock = threading.Lock()
+_lock = make_lock("native.loader")
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
@@ -96,7 +97,15 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if os.environ.get("SCTOOLS_TPU_NATIVE", "1") == "0" or not _build():
+        # an explicitly pinned library (SCTOOLS_TPU_NATIVE_LIB — the
+        # ci-deep sanitizer legs) loads as-is: the staleness/fingerprint
+        # rebuild logic owns only the default release build, and forcing
+        # a release rebuild under a sanitizer-preloaded toolchain would
+        # stall the gate for minutes before the pinned lib even loads
+        pinned = bool(os.environ.get("SCTOOLS_TPU_NATIVE_LIB"))
+        if os.environ.get("SCTOOLS_TPU_NATIVE", "1") == "0" or (
+            not pinned and not _build()
+        ):
             _load_failed = True
             return None
         try:

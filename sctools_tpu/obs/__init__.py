@@ -52,6 +52,12 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
+# the lock-witness factories (sctools_tpu.analysis.witness): raw
+# threading primitives unless SCTOOLS_TPU_LOCK_DEBUG=1, in which case
+# every named lock is an instrumented proxy recording acquisition order
+# for validation against the static scx-race model (SCX401-404)
+from ..analysis.witness import make_lock, make_rlock
+
 __all__ = [
     "span",
     "iter_spans",
@@ -84,7 +90,7 @@ RING_CAPACITY = 1 << 16
 _T0 = time.perf_counter()
 
 _enabled = False
-_lock = threading.RLock()
+_lock = make_rlock("obs.ring")
 _ring: "deque[dict]" = deque(maxlen=RING_CAPACITY)
 _counters: Dict[str, float] = {}
 _gauges: Dict[str, float] = {}
@@ -93,7 +99,7 @@ _gauges: Dict[str, float] = {}
 _span_totals: Dict[str, List[float]] = {}
 _sink_path: Optional[str] = None
 _sink_file = None
-_sink_lock = threading.Lock()
+_sink_lock = make_lock("obs.sink")
 _tls = threading.local()
 _jax_hooks_installed = False
 # process-level identity attrs (worker id, current task) stamped onto every

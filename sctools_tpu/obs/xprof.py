@@ -53,6 +53,7 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..analysis.witness import make_rlock
 from . import _stack as _obs_stack
 from . import count as _obs_count
 from . import enabled as _obs_enabled
@@ -79,7 +80,7 @@ __all__ = [
     "render_efficiency",
 ]
 
-_lock = threading.RLock()
+_lock = make_rlock("obs.xprof")
 _tls = threading.local()
 
 # distinct signatures / retrace examples / stage peaks kept per site: the
@@ -550,6 +551,7 @@ def snapshot(lock_timeout: Optional[float] = None) -> Dict[str, Any]:
     degrades it further to empty, never to a hang or a raise.
     """
     if lock_timeout is None:
+        # scx-lint: disable=SCX402 -- death-path callers (obs.flight_dump) pass lock_timeout=1.0 and take the bounded branch below; this branch serves ordinary snapshot/dump callers
         acquired = _lock.acquire()
     else:
         acquired = _lock.acquire(timeout=lock_timeout)

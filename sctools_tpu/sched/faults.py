@@ -75,6 +75,7 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 from .. import obs
+from ..analysis.witness import make_lock
 
 ENV_VAR = "SCTOOLS_TPU_FAULTS"
 KINDS = (
@@ -159,7 +160,7 @@ def parse_spec(text: str) -> List[Clause]:
     return clauses
 
 
-_lock = threading.Lock()
+_lock = make_lock("sched.faults")
 _clauses: Optional[List[Clause]] = None  # None = env not parsed yet
 
 

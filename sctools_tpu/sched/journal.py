@@ -43,6 +43,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from ..analysis.witness import make_lock
+
 # journal event kinds, in the order a task typically sees them
 EVENTS = ("leased", "failed", "committed", "quarantined", "requeued")
 
@@ -165,7 +167,7 @@ class Journal:
     def __init__(self, root: str, worker_id: Optional[str] = None):
         self.root = os.path.abspath(root)
         self.worker_id = worker_id or default_worker_id()
-        self._lock = threading.Lock()
+        self._lock = make_lock("sched.journal")
         self._seq = 0
         self._events_file = None
         self._tasks_file = None

@@ -7,6 +7,32 @@ test strategy (SURVEY.md section 4). Must run before jax is imported anywhere.
 
 import os
 
+# numpy.testing's import probes SVE support by running `lscpu` in a
+# subprocess (numpy gh-22982). fork() deadlocks under the ci-deep
+# ThreadSanitizer leg (TSan's background thread holds runtime locks the
+# fork child inherits frozen, and the parent blocks on the child's err
+# pipe forever), so under TSan the probe's answer is pre-seeded instead
+# of forked for — SVE is an aarch64 feature this leg never exercises.
+# The import itself happens here, before jax spawns its thread pools,
+# so no later (even more fork-hostile) import point exists.
+if "libtsan" in os.environ.get("LD_PRELOAD", ""):
+    import subprocess as _subprocess
+
+    _real_run = _subprocess.run
+
+    def _no_fork_lscpu(cmd, *args, **kwargs):
+        if cmd == "lscpu":
+            return _subprocess.CompletedProcess(cmd, 0, stdout="", stderr="")
+        return _real_run(cmd, *args, **kwargs)
+
+    _subprocess.run = _no_fork_lscpu
+    try:
+        import numpy.testing  # noqa: F401
+    finally:
+        _subprocess.run = _real_run
+else:
+    import numpy.testing  # noqa: F401,E402
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,25 @@
+"""SCX401 bad fixture: two paths acquire the same locks in opposite
+order (ABBA) — the blocking order graph contains a cycle.
+
+Lines expected to fire carry an arrow marker naming the rule (the
+finding anchors at the acquisition that creates the order edge, i.e.
+the INNER ``with``); the test collects them and asserts the findings
+land exactly there.
+"""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def forward():
+    with lock_a:
+        with lock_b:  # <- SCX401
+            return 1
+
+
+def backward():
+    with lock_b:
+        with lock_a:  # <- SCX401
+            return 2

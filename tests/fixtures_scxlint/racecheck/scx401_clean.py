@@ -1,0 +1,32 @@
+"""SCX401 clean fixture: every path honors one global lock order, and
+the only opposite-direction acquisition is BOUNDED (timeout) — a bounded
+acquire cannot deadlock permanently, so it is excluded from cycle
+detection (but still present in the emitted order graph).
+"""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def forward():
+    with lock_a:
+        with lock_b:
+            return 1
+
+
+def also_forward():
+    with lock_a:
+        with lock_b:
+            return 2
+
+
+def bounded_probe():
+    with lock_b:
+        if lock_a.acquire(timeout=0.1):
+            try:
+                return 3
+            finally:
+                lock_a.release()
+    return None

@@ -1,0 +1,22 @@
+"""SCX402 bad fixture: a function reachable from a signal handler takes
+a BLOCKING lock. The signal may have interrupted the holder of that very
+lock on the same thread — the death path deadlocks.
+"""
+
+import signal
+import threading
+
+state_lock = threading.Lock()
+state = {}
+
+
+def snapshot():
+    with state_lock:  # <- SCX402
+        return dict(state)
+
+
+def on_term(signum, frame):
+    snapshot()
+
+
+signal.signal(signal.SIGTERM, on_term)

@@ -1,0 +1,26 @@
+"""SCX402 clean fixture: the signal-handler-reachable snapshot uses a
+BOUNDED acquire with a lockless fallback — the sanctioned death-path
+shape (obs.bounded_snapshot is the library helper for exactly this).
+"""
+
+import signal
+import threading
+
+state_lock = threading.Lock()
+state = {}
+
+
+def snapshot():
+    acquired = state_lock.acquire(timeout=0.5)
+    try:
+        return dict(state)
+    finally:
+        if acquired:
+            state_lock.release()
+
+
+def on_term(signum, frame):
+    snapshot()
+
+
+signal.signal(signal.SIGTERM, on_term)

@@ -1,0 +1,19 @@
+"""SCX403 bad fixture: a mutable module global written from two entry
+roots (main + a spawned thread) with no common lock across the write
+sites — a torn/lost-update race.
+"""
+
+import threading
+
+totals = {}
+
+
+def worker():
+    totals["produced"] = 1  # <- SCX403
+
+
+def run():
+    thread = threading.Thread(target=worker)
+    thread.start()
+    totals["consumed"] = 2  # <- SCX403
+    thread.join(timeout=5.0)

@@ -1,0 +1,21 @@
+"""SCX403 clean fixture: the same cross-thread writes, but every write
+site holds the one lock that guards the dict — no common-lock gap.
+"""
+
+import threading
+
+totals_lock = threading.Lock()
+totals = {}
+
+
+def worker():
+    with totals_lock:
+        totals["produced"] = 1
+
+
+def run():
+    thread = threading.Thread(target=worker)
+    thread.start()
+    with totals_lock:
+        totals["consumed"] = 2
+    thread.join(timeout=5.0)

@@ -1,0 +1,37 @@
+"""SCX404 bad fixture: unbounded ``Thread.join()`` / ``Queue.get()`` on
+teardown/abandonment paths — a peer wedged in I/O hangs the close
+forever.
+"""
+
+import queue
+import threading
+
+
+def _produce(results):
+    results.put(1)
+
+
+def run():
+    results = queue.Queue()
+    thread = threading.Thread(target=_produce, args=(results,))
+    thread.start()
+    try:
+        return compute()
+    finally:
+        thread.join()  # <- SCX404
+
+
+def compute():
+    return 0
+
+
+class Source:
+    def __init__(self):
+        self.queue = queue.Queue()
+        self.thread = threading.Thread(target=self._produce)
+
+    def _produce(self):
+        self.queue.put(None)
+
+    def close(self):
+        self.thread.join()  # <- SCX404

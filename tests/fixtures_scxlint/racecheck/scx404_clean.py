@@ -1,0 +1,36 @@
+"""SCX404 clean fixture: every teardown wait is bounded — the
+utils/prefetch.py abandonment pattern (drain, join with timeout, count
+the abandonment instead of hanging).
+"""
+
+import queue
+import threading
+
+
+def _produce(results):
+    results.put(1)
+
+
+def run():
+    results = queue.Queue()
+    thread = threading.Thread(target=_produce, args=(results,))
+    thread.start()
+    try:
+        return results.get(timeout=30.0)
+    finally:
+        thread.join(timeout=10.0)
+
+
+class Source:
+    def __init__(self):
+        self.queue = queue.Queue()
+        self.thread = threading.Thread(target=self._produce)
+
+    def _produce(self):
+        self.queue.put(None)
+
+    def close(self):
+        self.thread.join(timeout=10.0)
+        # a get() OUTSIDE any teardown path is allowed to block: the
+        # consumer loop owns liveness there
+        return self.queue.get_nowait()

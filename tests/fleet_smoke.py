@@ -14,7 +14,12 @@ aggregator. The gate asserts:
   span stack it died inside (the sink alone cannot: its mid-task span
   never closed);
 - the analysis names a non-empty critical path;
-- the steal shows up in the merged view.
+- the steal shows up in the merged view;
+- the runtime lock witness (``SCTOOLS_TPU_LOCK_DEBUG=1``) engaged in the
+  surviving worker: non-empty observed acquisition-order edges, zero
+  violations, and the observed set is a subgraph of the static scx-race
+  lock-order graph (the crashed worker dies at ``os._exit`` before its
+  atexit dump — only surviving lineages leave ``locks.*.json``).
 
 Exit 0 on success; any assertion failure is a gate failure.
 """
@@ -65,9 +70,15 @@ def main() -> int:
     bam = os.path.join(workdir, "input.bam")
 
     from sched_smoke import make_input
+    from witness_smoke import arm_lock_witness, check_lock_dumps
 
     from sctools_tpu.platform import GenericPlatform
     from sctools_tpu.sched import COMMITTED, Journal
+
+    # arm the runtime lock witness for both workers (launch() inherits
+    # os.environ): observed acquisition order must validate against the
+    # static scx-race graph
+    graph = arm_lock_witness(REPO_ROOT, workdir)
 
     make_input(bam)
     chunk_dir = os.path.join(workdir, "chunks")
@@ -172,6 +183,11 @@ def main() -> int:
     chain = analysis["critical_path"]
     assert chain, "critical path is empty"
     assert all(link["dur"] > 0 for link in chain)
+
+    # lock witness: the surviving worker dumped a violation-free,
+    # non-empty observed edge set that is a subgraph of the static graph
+    # (worker A died at os._exit before its atexit dump could run)
+    check_lock_dumps(os.path.join(workdir, "obs"), graph)
 
     # and the CLI front door renders both forms
     from sctools_tpu.obs.__main__ import main as obs_cli

@@ -9,21 +9,33 @@ The acceptance contract of the analysis subsystem:
   a deliberately corrupted copy of the *real* bindings;
 - the tsan.supp audit passes on the real suppression file and flags the
   bad fixture;
+- each SCX4xx concurrency rule fires EXACTLY on its bad fixture's marked
+  lines and stays silent on the clean twin; the real tree carries no
+  unsuppressed SCX4xx finding, and its static lock graph names the
+  library's witness-factory lock vocabulary;
+- the runtime lock witness proxies record acquisition order, detect a
+  constructed ABBA cycle and a static-graph divergence, and are a TRUE
+  no-op (raw threading primitives) when SCTOOLS_TPU_LOCK_DEBUG is off;
 - the CLI exits 0 on the repository's own tree (the merge gate) and
   non-zero on the bad corpus.
 """
 
+import json
 import os
 import subprocess
 import sys
+import threading
 
 import pytest
 
 from sctools_tpu.analysis import (
     audit_suppressions,
     check_abi,
+    check_races,
     lint_file,
+    lock_graph,
 )
+from sctools_tpu.analysis import witness
 from sctools_tpu.analysis.cli import main as cli_main
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -32,6 +44,7 @@ JAXLINT = os.path.join(FIXTURES, "jaxlint")
 ABI_CLEAN = os.path.join(FIXTURES, "abi", "clean")
 ABI_BAD = os.path.join(FIXTURES, "abi", "bad")
 SUPP = os.path.join(FIXTURES, "supp")
+RACE = os.path.join(FIXTURES, "racecheck")
 NATIVE = os.path.join(REPO, "sctools_tpu", "native")
 
 JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)] + [
@@ -327,6 +340,441 @@ def test_supp_real_tree_is_clean():
     assert findings == [], [f.render() for f in findings]
 
 
+# --------------------------------------------------- scx-race (SCX4xx)
+
+RACE_RULE_IDS = ["SCX401", "SCX402", "SCX403", "SCX404"]
+
+
+def _marked_lines(path: str, rule: str) -> list:
+    """Line numbers carrying the fixture's ``# <- SCXNNN`` markers."""
+    with open(path, encoding="utf-8") as f:
+        return [
+            lineno
+            for lineno, line in enumerate(f, start=1)
+            if f"# <- {rule}" in line
+        ]
+
+
+@pytest.mark.parametrize("rule", RACE_RULE_IDS)
+def test_race_rule_fires_exactly_on_marked_lines(rule):
+    path = os.path.join(RACE, f"{rule.lower()}_bad.py")
+    findings = check_races([path])
+    assert findings, f"{rule} bad fixture produced no findings"
+    assert {f.rule for f in findings} == {rule}
+    expected = _marked_lines(path, rule)
+    assert expected, f"fixture {path} has no # <- {rule} markers"
+    assert sorted(f.line for f in findings) == expected, [
+        f.render() for f in findings
+    ]
+
+
+@pytest.mark.parametrize("rule", RACE_RULE_IDS)
+def test_race_rule_silent_on_clean_fixture(rule):
+    findings = check_races(
+        [os.path.join(RACE, f"{rule.lower()}_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_race_real_tree_is_clean():
+    findings = check_races(
+        [os.path.join(REPO, "sctools_tpu"), os.path.join(REPO, "bench.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_race_inline_suppression(tmp_path):
+    src = (
+        "import threading\n\n"
+        "totals = {}\n\n\n"
+        "def worker():\n"
+        "    totals['k'] = 1  "
+        "# scx-lint: disable=SCX403 -- benign monotonic flag\n\n\n"
+        "def run():\n"
+        "    t = threading.Thread(target=worker)\n"
+        "    t.start()\n"
+        "    totals['k'] = 2  "
+        "# scx-lint: disable=SCX403 -- benign monotonic flag\n"
+        "    t.join(timeout=1.0)\n"
+    )
+    path = tmp_path / "suppressed_race.py"
+    path.write_text(src)
+    assert check_races([str(path)]) == []
+
+
+def test_race_bounded_acquire_is_not_a_death_path_finding(tmp_path):
+    # a with-block acquisition NOT reachable from any death root stays
+    # silent even though it is blocking
+    src = (
+        "import threading\n\n"
+        "lock = threading.Lock()\n\n\n"
+        "def ordinary():\n"
+        "    with lock:\n"
+        "        return 1\n"
+    )
+    path = tmp_path / "no_death_root.py"
+    path.write_text(src)
+    assert check_races([str(path)]) == []
+
+
+def test_lock_graph_names_the_witness_vocabulary():
+    graph = lock_graph([os.path.join(REPO, "sctools_tpu")])
+    # every library lock is created through the witness factories with a
+    # stable name — the vocabulary the runtime witness shares
+    expected = {
+        "obs.ring", "obs.sink", "obs.xprof", "guard.open_retries",
+        "guard.degrade", "guard.quarantine", "guard.watchdog.deadline",
+        "ingest.ring_state", "sched.faults", "sched.journal",
+        "native.loader",
+    }
+    assert expected <= set(graph["locks"]), sorted(graph["locks"])
+    # no derived-name stragglers: a raw threading.Lock() module global
+    # would show up as <module>.<var>
+    derived = {name for name in graph["locks"] if "sctools_tpu." in name}
+    assert derived == set(), derived
+    # the obs.enable() nesting (ring lock held across the sink attach) is
+    # a structural edge every traced run reproduces — pin it
+    edges = {(e["from"], e["to"]) for e in graph["edges"]}
+    assert ("obs.ring", "obs.sink") in edges, sorted(edges)
+    # the registered entry points include the SIGTERM flight recorder,
+    # the scheduler heartbeat, the prefetch producer, and the watchdog
+    kinds = {entry["kind"] for entry in graph["entries"]}
+    assert {"signal", "thread", "timer", "provider"} <= kinds, kinds
+
+
+def test_race_abba_is_rule_401_only():
+    # the ABBA fixture must not double-report as 402/403/404
+    findings = check_races([os.path.join(RACE, "scx401_bad.py")])
+    assert {f.rule for f in findings} == {"SCX401"}
+
+
+def test_race_sees_inside_match_case_bodies(tmp_path):
+    # a blocking acquire inside a match-statement case on a signal path
+    # must fire SCX402 and contribute its lock to the emitted graph
+    src = (
+        "import signal\n"
+        "import threading\n\n"
+        "lock = threading.Lock()\n\n\n"
+        "def handler(signum, frame):\n"
+        "    match signum:\n"
+        "        case 15:\n"
+        "            with lock:\n"
+        "                pass\n\n\n"
+        "signal.signal(signal.SIGTERM, handler)\n"
+    )
+    path = tmp_path / "match_death_path.py"
+    path.write_text(src)
+    findings = check_races([str(path)])
+    assert [(f.rule, f.line) for f in findings] == [("SCX402", 10)]
+    graph = lock_graph([str(path)])
+    assert "match_death_path.lock" in graph["locks"]
+
+
+def test_race_inventories_try_block_module_globals(tmp_path):
+    # the try/except ImportError lock-declaration idiom still binds the
+    # module namespace — an ABBA inversion over it must fire SCX401
+    src = (
+        "import threading\n\n"
+        "try:\n"
+        "    lock_a = threading.Lock()\n"
+        "except Exception:\n"
+        "    lock_a = None\n"
+        "lock_b = threading.Lock()\n\n\n"
+        "def path_one():\n"
+        "    with lock_a:\n"
+        "        with lock_b:\n"
+        "            pass\n\n\n"
+        "def path_two():\n"
+        "    with lock_b:\n"
+        "        with lock_a:\n"
+        "            pass\n"
+    )
+    path = tmp_path / "try_global_lock.py"
+    path.write_text(src)
+    findings = check_races([str(path)])
+    assert {f.rule for f in findings} == {"SCX401"}
+    graph = lock_graph([str(path)])
+    assert {"try_global_lock.lock_a", "try_global_lock.lock_b"} <= set(
+        graph["locks"]
+    )
+
+
+def test_race_local_binding_shadows_module_global(tmp_path):
+    # a thread target's own `totals = {}` makes its subscript write
+    # purely local — it must not count as a cross-thread global write
+    src = (
+        "import threading\n\n"
+        "totals = {}\n\n\n"
+        "def worker():\n"
+        "    totals = {}\n"
+        "    totals['k'] = 1\n"
+        "    return totals\n\n\n"
+        "def main_path():\n"
+        "    totals['k'] = 2\n\n\n"
+        "t = threading.Thread(target=worker)\n"
+    )
+    path = tmp_path / "shadowed_global.py"
+    path.write_text(src)
+    assert check_races([str(path)]) == []
+
+
+def test_race_keyword_nonblocking_probe_is_bounded(tmp_path):
+    # lock.acquire(blocking=False) is the readable spelling of the
+    # sanctioned non-blocking death-path probe — not an SCX402
+    src = (
+        "import signal\n"
+        "import threading\n\n"
+        "lock = threading.Lock()\n\n\n"
+        "def handler(signum, frame):\n"
+        "    got = lock.acquire(blocking=False)\n"
+        "    if got:\n"
+        "        lock.release()\n\n\n"
+        "signal.signal(signal.SIGTERM, handler)\n"
+    )
+    path = tmp_path / "keyword_probe.py"
+    path.write_text(src)
+    assert check_races([str(path)]) == []
+
+
+def test_race_enclosing_scope_binding_shadows_global(tmp_path):
+    # a closure writes the ENCLOSING function's local, not the module
+    # global — the shadow walk must follow the parent chain the same
+    # way lock resolution does
+    src = (
+        "import threading\n\n"
+        "totals = {}\n\n\n"
+        "def run():\n"
+        "    totals = {}\n\n"
+        "    def worker():\n"
+        "        totals['k'] = 1\n\n"
+        "    t = threading.Thread(target=worker)\n"
+        "    t.start()\n"
+        "    totals['k'] = 2\n"
+        "    t.join(timeout=1.0)\n"
+    )
+    path = tmp_path / "closure_shadow.py"
+    path.write_text(src)
+    assert check_races([str(path)]) == []
+
+
+def test_race_positional_thread_target_registers_entry(tmp_path):
+    # threading.Thread(None, worker) — positional target — must create
+    # the same entry root as target=worker
+    src = (
+        "import threading\n\n"
+        "totals = {}\n\n\n"
+        "def worker():\n"
+        "    totals['k'] = 1\n\n\n"
+        "def run():\n"
+        "    t = threading.Thread(None, worker)\n"
+        "    t.start()\n"
+        "    totals['k'] = 2\n"
+        "    t.join(timeout=1.0)\n"
+    )
+    path = tmp_path / "positional_target.py"
+    path.write_text(src)
+    findings = check_races([str(path)])
+    assert {f.rule for f in findings} == {"SCX403"}, [
+        f.render() for f in findings
+    ]
+    graph = lock_graph([str(path)])
+    assert any(
+        entry["kind"] == "thread" for entry in graph["entries"]
+    ), graph["entries"]
+
+
+# ------------------------------------------------- runtime lock witness
+
+@pytest.fixture
+def lock_debug(monkeypatch):
+    monkeypatch.setenv("SCTOOLS_TPU_LOCK_DEBUG", "1")
+    monkeypatch.delenv("SCTOOLS_TPU_LOCK_GRAPH", raising=False)
+    witness.reset()
+    yield
+    witness.reset()
+
+
+def test_witness_off_is_a_true_noop(monkeypatch):
+    # off (unset or =0) must hand back the RAW threading primitives —
+    # not a proxy, not a subclass: zero overhead on the hot path (the
+    # bench.py guard_overhead leg asserts the same on the live library)
+    for value in (None, "0"):
+        if value is None:
+            monkeypatch.delenv("SCTOOLS_TPU_LOCK_DEBUG", raising=False)
+        else:
+            monkeypatch.setenv("SCTOOLS_TPU_LOCK_DEBUG", value)
+        lock = witness.make_lock("test.noop")
+        rlock = witness.make_rlock("test.noop_r")
+        assert type(lock) is type(threading.Lock()), type(lock)
+        assert type(rlock) is type(threading.RLock()), type(rlock)
+        assert not isinstance(lock, witness.WitnessLock)
+
+
+def test_witness_records_order_edges(lock_debug):
+    a = witness.make_lock("test.a")
+    b = witness.make_lock("test.b")
+    assert isinstance(a, witness.WitnessLock)
+    with a:
+        with b:
+            pass
+    edges = witness.observed_edges()
+    assert ("test.a", "test.b") in edges
+    assert edges[("test.a", "test.b")]["count"] == 1
+    assert witness.acquire_counts() == {"test.a": 1, "test.b": 1}
+    assert witness.violations() == []
+
+
+def test_witness_cross_thread_release_leaves_no_stale_entry(lock_debug):
+    # threading.Lock permits release from a thread other than the
+    # acquirer (handoff); the held entry must leave the ACQUIRER's
+    # stack, or its next acquisition mints a phantom order edge
+    handoff = witness.make_lock("test.handoff")
+    victim = witness.make_lock("test.handoff_victim")
+    acquired = threading.Event()
+    released = threading.Event()
+
+    def worker():
+        handoff.acquire()
+        acquired.set()
+        released.wait(timeout=5)
+        with victim:  # after the handoff: this thread holds NOTHING
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    assert acquired.wait(timeout=5)
+    handoff.release()  # cross-thread release on the main thread
+    released.set()
+    t.join(timeout=5)
+    edges = witness.observed_edges()
+    assert ("test.handoff", "test.handoff_victim") not in edges, edges
+    assert witness.violations() == []
+
+
+def test_witness_detects_constructed_abba_cycle(lock_debug):
+    a = witness.make_lock("test.cycle_a")
+    b = witness.make_lock("test.cycle_b")
+    with a:
+        with b:
+            pass
+    # the reverse interleaving closes the cycle (single-threaded is
+    # enough: the order graph is about edges, not liveness)
+    with b:
+        with a:
+            pass
+    kinds = [v["kind"] for v in witness.violations()]
+    assert "cycle" in kinds, witness.violations()
+
+
+def test_witness_flags_edges_unknown_to_the_static_graph(
+    lock_debug, tmp_path, monkeypatch
+):
+    graph_path = tmp_path / "graph.json"
+    graph_path.write_text(
+        json.dumps({"edges": [{"from": "test.g_a", "to": "test.g_b"}]})
+    )
+    monkeypatch.setenv("SCTOOLS_TPU_LOCK_GRAPH", str(graph_path))
+    a = witness.make_lock("test.g_a")
+    b = witness.make_lock("test.g_b")
+    c = witness.make_lock("test.g_c")
+    with a:
+        with b:  # known edge: no violation
+            pass
+    assert witness.violations() == []
+    with a:
+        with c:  # edge absent from the static model: the model lied
+            pass
+    kinds = [v["kind"] for v in witness.violations()]
+    assert kinds == ["unknown-edge"], witness.violations()
+
+
+def test_witness_bounded_acquire_is_exempt_from_order_checks(
+    lock_debug, tmp_path, monkeypatch
+):
+    # bounded acquires are the SANCTIONED death-path pattern: a signal
+    # handler's flight dump bounded-acquires under whatever locks the
+    # interrupted thread held, which no static model can enumerate —
+    # recorded for diagnosis, but neither the cycle nor the
+    # static-graph check applies (the static SCX401 line)
+    graph_path = tmp_path / "graph.json"
+    graph_path.write_text(json.dumps({"edges": []}))
+    monkeypatch.setenv("SCTOOLS_TPU_LOCK_GRAPH", str(graph_path))
+    a = witness.make_lock("test.bnd_a")
+    b = witness.make_lock("test.bnd_b")
+    with a:
+        assert b.acquire(timeout=0.5)
+        b.release()
+    with b:
+        assert a.acquire(timeout=0.5)  # would close a cycle if counted
+        a.release()
+    assert witness.violations() == []
+    edges = witness.observed_edges()
+    assert edges[("test.bnd_a", "test.bnd_b")]["bounded"] is True
+    assert edges[("test.bnd_b", "test.bnd_a")]["bounded"] is True
+    # first BLOCKING observation of a so-far-bounded edge: it now
+    # participates in deadlock analysis and faces the skipped checks
+    with a:
+        with b:
+            pass
+    kinds = [v["kind"] for v in witness.violations()]
+    assert kinds == ["unknown-edge"], witness.violations()
+
+
+def test_witness_rlock_reentry_is_not_an_edge(lock_debug):
+    r = witness.make_rlock("test.reentrant")
+    with r:
+        with r:
+            pass
+    assert witness.observed_edges() == {}
+    assert witness.acquire_counts() == {"test.reentrant": 2}
+
+
+def test_witness_stall_records_violation_then_acquires(
+    lock_debug, monkeypatch
+):
+    monkeypatch.setenv("SCTOOLS_TPU_LOCK_DEBUG_STALL_S", "0.05")
+    lock = witness.make_lock("test.stall")
+    release = threading.Event()
+
+    def holder():
+        lock.acquire()
+        release.wait(timeout=10.0)
+        lock.release()
+
+    thread = threading.Thread(target=holder, daemon=True)
+    thread.start()
+    # let the holder win the lock, then unblock it shortly after the
+    # stall threshold has fired on our blocking acquire
+    deadline_timer = threading.Timer(0.3, release.set)
+    deadline_timer.start()
+    try:
+        assert lock.acquire() is True  # blocks past the 0.05 s threshold
+        lock.release()
+    finally:
+        release.set()
+        thread.join(timeout=10.0)
+        deadline_timer.cancel()
+    kinds = [v["kind"] for v in witness.violations()]
+    assert "stall" in kinds, witness.violations()
+
+
+def test_witness_dump_roundtrip(lock_debug, tmp_path):
+    a = witness.make_lock("test.dump_a")
+    b = witness.make_lock("test.dump_b")
+    with a:
+        with b:
+            pass
+    target = tmp_path / "locks.json"
+    assert witness.dump(str(target)) == str(target)
+    data = json.loads(target.read_text())
+    assert data["enabled"] is True
+    assert {(e["from"], e["to"]) for e in data["edges"]} == {
+        ("test.dump_a", "test.dump_b")
+    }
+    assert data["violations"] == []
+    assert data["acquires"] == {"test.dump_a": 1, "test.dump_b": 1}
+
+
 # -------------------------------------------------------------------- CLI
 
 def test_cli_repo_tree_is_clean(capsys):
@@ -358,3 +806,35 @@ def test_cli_module_invocation():
     )
     assert result.returncode == 0, result.stderr
     assert "SCX101" in result.stdout and "SCX303" in result.stdout
+    assert "SCX404" in result.stdout
+
+
+def test_cli_race_only(capsys):
+    rc = cli_main(
+        ["--race-only", os.path.join(REPO, "sctools_tpu"),
+         os.path.join(REPO, "bench.py")]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "passes: race" in out
+
+
+def test_cli_race_only_fails_on_bad_corpus(capsys):
+    rc = cli_main(["-q", "--race-only", RACE])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for rule in RACE_RULE_IDS:
+        assert rule in out, (rule, out)
+
+
+def test_cli_emit_lock_graph(tmp_path, capsys):
+    target = tmp_path / "graph.json"
+    rc = cli_main(
+        ["--emit-lock-graph", str(target),
+         os.path.join(REPO, "sctools_tpu")]
+    )
+    assert rc == 0, capsys.readouterr().out
+    graph = json.loads(target.read_text())
+    assert graph["version"] == 1
+    assert "obs.ring" in graph["locks"]
+    assert graph["edges"] and graph["entries"]

@@ -1,0 +1,71 @@
+"""Shared lock-witness arming + dump validation for the smoke scripts.
+
+guard-smoke and fleet-smoke both run their workers under
+``SCTOOLS_TPU_LOCK_DEBUG=1`` against the static scx-race graph and then
+assert the same contract over the ``locks.*.json`` dumps; the contract
+lives here once so a dump-schema change has a single place to land.
+"""
+
+import glob
+import json
+import os
+
+
+def arm_lock_witness(repo_root, workdir):
+    """Emit the static scx-race lock-order graph and arm the witness.
+
+    Writes ``lock_graph.json`` under ``workdir`` and sets
+    ``SCTOOLS_TPU_LOCK_DEBUG=1`` / ``SCTOOLS_TPU_LOCK_GRAPH`` in
+    ``os.environ`` (worker ``launch()`` inherits it). Returns the graph
+    dict for the post-run subgraph check.
+    """
+    from sctools_tpu.analysis import lock_graph
+
+    graph = lock_graph([os.path.join(repo_root, "sctools_tpu")])
+    graph_path = os.path.join(workdir, "lock_graph.json")
+    with open(graph_path, "w", encoding="utf-8") as f:
+        json.dump(graph, f)
+    os.environ["SCTOOLS_TPU_LOCK_DEBUG"] = "1"
+    os.environ["SCTOOLS_TPU_LOCK_GRAPH"] = graph_path
+    return graph
+
+
+def check_lock_dumps(dump_dir, graph, expect_dumps=None):
+    """Validate every ``locks.*.json`` dump under ``dump_dir``.
+
+    The witness must have engaged (non-empty observed edge set across
+    the dumps), recorded zero violations, and every observed BLOCKING
+    acquisition-order edge must appear in the static graph — a fresh
+    edge means the static model under-approximates the runtime: fix the
+    model, not this assert. Bounded (timeout=) acquires are recorded for
+    diagnosis but are exempt from the order contract (static SCX401
+    semantics: they cannot deadlock permanently, and a death path's
+    bounded acquire runs under whatever the interrupted thread held).
+
+    ``expect_dumps`` pins the dump count when every worker is expected
+    to reach its atexit hook (a crash-injected worker dies at
+    ``os._exit`` first). Returns the observed blocking-edge set.
+    """
+    lock_dumps = glob.glob(os.path.join(dump_dir, "locks.*.json"))
+    if expect_dumps is not None:
+        assert len(lock_dumps) == expect_dumps, (
+            f"lock witness dumps missing: {lock_dumps}"
+        )
+    else:
+        assert lock_dumps, f"no lock-witness dump under {dump_dir}"
+    static_edges = {(e["from"], e["to"]) for e in graph["edges"]}
+    observed = set()
+    for dump_path in lock_dumps:
+        with open(dump_path, encoding="utf-8") as f:
+            dump = json.load(f)
+        assert dump["enabled"], dump_path
+        assert dump["violations"] == [], (dump_path, dump["violations"])
+        observed |= {
+            (e["from"], e["to"]) for e in dump["edges"] if not e["bounded"]
+        }
+    assert observed, "lock witness observed no acquisition-order edges"
+    unknown = observed - static_edges
+    assert not unknown, (
+        f"observed lock-order edges missing from the static model: {unknown}"
+    )
+    return observed
