@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate docs clean
 
-ci: native lint racecheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate
+ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -17,15 +17,16 @@ native:
 # + tsan.supp audit, sctools_tpu/analysis). Both must pass for `make ci`.
 # tests/ is style-checked but excluded from scx-lint: it hosts the
 # deliberately-bad fixture corpus and test-local jax.config setup.
-# --no-race: `make racecheck` owns the SCX4xx pass (same path set), so
-# ci builds the whole-package concurrency model exactly once.
+# --no-race --no-shard: `make modelcheck` owns the two whole-package
+# passes (SCX4xx + SCX5xx, same path set), so ci builds the package
+# model exactly once.
 lint:
 	@if $(PY) -c "import ruff" 2>/dev/null; then \
 		$(PY) -m ruff check sctools_tpu tests bench.py __graft_entry__.py; \
 	else \
 		$(PY) -m compileall -q sctools_tpu tests bench.py __graft_entry__.py; \
 	fi
-	$(PY) -m sctools_tpu.analysis --no-race sctools_tpu bench.py __graft_entry__.py
+	$(PY) -m sctools_tpu.analysis --no-race --no-shard sctools_tpu bench.py __graft_entry__.py
 
 # concurrency gate: the scx-race pass (SCX401-404) on its own — lock
 # inventory, acquisition-order cycles, death-path safety, cross-thread
@@ -37,6 +38,24 @@ lint:
 # (docs/static_analysis.md).
 racecheck:
 	$(PY) -m sctools_tpu.analysis --race-only sctools_tpu bench.py __graft_entry__.py
+
+# shape/sharding gate: the scx-shard pass (SCX501-505) on its own —
+# PartitionSpec axis/rank vs the mesh universe, device-0 materialization
+# inside mesh paths, retrace-risk scalars reaching static args or jit
+# builders, collective-axis mismatches, host round-trips reachable from
+# traced functions. The runtime half of the contract (the shape-contract
+# file from --emit-shape-contract) runs inside xprof-smoke and
+# ingest-smoke, which assert the merged runtime registries' observed
+# signatures are a subset of the statically predicted universe
+# (docs/static_analysis.md).
+shardcheck:
+	$(PY) -m sctools_tpu.analysis --shard-only sctools_tpu bench.py __graft_entry__.py
+
+# the ci shape of racecheck+shardcheck: both whole-package passes in ONE
+# process (the *-only flags compose), so the package parses once
+# (analysis/astcache) for both gates
+modelcheck:
+	$(PY) -m sctools_tpu.analysis --race-only --shard-only sctools_tpu bench.py __graft_entry__.py
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -91,7 +110,9 @@ fleet-smoke:
 # every registered jit call site with ZERO steady-state retraces, whose
 # transfer ledger reconciles byte-for-byte with the upload/writeback
 # span bytes (gatherer accounting == ledger), and whose fleet timeline
-# shows a populated occupancy column (tests/xprof_smoke.py;
+# shows a populated occupancy column; every observed signature must be
+# a subset of the scx-shard static shape contract — the runtime witness
+# half of `make shardcheck` (tests/xprof_smoke.py;
 # docs/performance.md "Reading an efficiency report").
 xprof-smoke:
 	rm -rf /tmp/sctools_tpu_xprof_smoke
@@ -102,8 +123,9 @@ xprof-smoke:
 # must show the ring rotating (decode spans over >=2 arena slots on the
 # prefetch thread), real overlap (decode spans intersecting upload/compute
 # spans in wall time), zero steady-state retraces in the merged efficiency
-# report, and a transfer ledger that reconciles byte-for-byte with the
-# upload/writeback span bytes AND the gatherers' own accounting
+# report, a transfer ledger that reconciles byte-for-byte with the
+# upload/writeback span bytes AND the gatherers' own accounting, and
+# observed signatures a subset of the scx-shard shape contract
 # (tests/ingest_smoke.py; docs/ingest.md).
 ingest-smoke:
 	rm -rf /tmp/sctools_tpu_ingest_smoke

@@ -6,7 +6,7 @@ silent retraces, host-device syncs inside traced code, tracer leaks into
 Python control flow, and drift between the hand-written ctypes tables in
 ``native/__init__.py`` and the ``extern "C"`` sources they bind.
 
-Four passes, one CLI (``python -m sctools_tpu.analysis``), all pure
+Five passes, one CLI (``python -m sctools_tpu.analysis``), all pure
 stdlib — nothing here imports jax, numpy, or the code under analysis:
 
 - :mod:`.jaxlint`  — AST rules SCX101-SCX108 over traced functions;
@@ -16,13 +16,20 @@ stdlib — nothing here imports jax, numpy, or the code under analysis:
   locksets, acquisition-order graph, death-path safety), rules
   SCX401-SCX404, paired with the runtime lock witness (:mod:`.witness`,
   ``SCTOOLS_TPU_LOCK_DEBUG=1``) that validates the static model against
-  live runs.
+  live runs;
+- :mod:`.shardcheck` — whole-package shape & sharding flow model (jit
+  site inventory, mesh axis universe, bucket/pad vocabulary, retrace
+  taint), rules SCX501-SCX505, paired with the shape contract
+  (``--emit-shape-contract``) that the xprof/ingest smokes validate
+  observed runtime signatures against. Shares one parse per file with
+  racecheck through :mod:`.astcache`.
 
 Findings carry stable rule ids and honor inline
 ``# scx-lint: disable=SCXNNN`` escape hatches (:mod:`.findings`).
 ``make lint`` runs the CLI after ruff/compileall, making a clean scx-lint
-run part of ``make ci`` mergeability; ``make racecheck`` runs the
-concurrency pass on its own.
+run part of ``make ci`` mergeability; ``make racecheck`` / ``make
+shardcheck`` run the whole-package passes on their own, and ``make
+modelcheck`` (the ci leg) runs both in one process.
 """
 
 # Re-exports resolve lazily (PEP 562): every library module imports
@@ -40,6 +47,11 @@ _EXPORTS = {
     "RACE_RULES": "racecheck",
     "check_races": "racecheck",
     "lock_graph": "racecheck",
+    "SHARD_RULES": "shardcheck",
+    "check_shards": "shardcheck",
+    "build_shape_contract": "shardcheck",
+    "check_signatures": "shardcheck",
+    "dim_admissible": "shardcheck",
     "SUPP_RULES": "suppaudit",
     "audit_suppressions": "suppaudit",
     "make_lock": "witness",
@@ -47,8 +59,8 @@ _EXPORTS = {
 }
 
 _SUBMODULES = frozenset(
-    {"abicheck", "cli", "findings", "jaxlint", "racecheck", "suppaudit",
-     "witness"}
+    {"abicheck", "astcache", "cli", "findings", "jaxlint", "racecheck",
+     "shardcheck", "suppaudit", "witness"}
 )
 
 
@@ -74,11 +86,16 @@ __all__ = [
     "Finding",
     "JAX_RULES",
     "RACE_RULES",
+    "SHARD_RULES",
     "SUPP_RULES",
     "Suppressions",
     "audit_suppressions",
+    "build_shape_contract",
     "check_abi",
     "check_races",
+    "check_shards",
+    "check_signatures",
+    "dim_admissible",
     "lint_file",
     "lock_graph",
     "make_lock",

@@ -1,0 +1,87 @@
+"""Shared parse cache for the whole-package analysis passes.
+
+The interprocedural passes (racecheck SCX4xx, shardcheck SCX5xx) each
+build a package-wide model from the same ``.py`` files. One ``make
+shardcheck`` invocation runs both over one model build: this cache makes
+"one build" literal — every file is read and ``ast.parse``d exactly once
+per process, keyed by (path, mtime_ns, size) so a test that rewrites a
+tmp file still reparses.
+
+Pure stdlib, imports nothing under analysis (the scx-lint ground rule).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# directory names never worth walking into — the ONE copy, shared by the
+# cli file walk and every whole-package model build
+SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", "node_modules"}
+
+# (abspath, mtime_ns, size) -> (source text, parsed tree)
+_cache: Dict[Tuple[str, int, int], Tuple[str, ast.Module]] = {}
+
+
+def parse_cached(path: str) -> Optional[Tuple[str, ast.Module]]:
+    """(source, tree) for ``path``, parsed at most once per file version.
+
+    Returns ``None`` on unreadable or syntactically invalid files —
+    reporting those is the jaxlint pass's job (SCX100-adjacent), not a
+    model-build failure.
+    """
+    abspath = os.path.abspath(path)
+    try:
+        stat = os.stat(abspath)
+        key = (abspath, stat.st_mtime_ns, stat.st_size)
+        hit = _cache.get(key)
+        if hit is not None:
+            return hit
+        with open(abspath, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError):
+        return None
+    _cache[key] = (source, tree)
+    return (source, tree)
+
+
+def collect_py_files(
+    paths: Sequence[str], exempt_dirs: Sequence[str] = ()
+) -> List[Tuple[str, str, bool]]:
+    """(file_path, dotted_module_name, is_pkg) for every analyzable file.
+
+    ``exempt_dirs`` names directories (by basename) whose subtrees are
+    the analysis mechanism itself, not the subject, and are pruned.
+    """
+    out: List[Tuple[str, str, bool]] = []
+    exempt = set(exempt_dirs)
+    for root in paths:
+        root = os.path.normpath(root)
+        if os.path.isfile(root):
+            if root.endswith(".py"):
+                out.append((root, os.path.basename(root)[:-3], False))
+            continue
+        base = os.path.dirname(root)
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [
+                d for d in sorted(dirnames)
+                if d not in SKIP_DIRS and not d.startswith(".")
+            ]
+            if os.path.basename(dirpath) in exempt:
+                dirnames[:] = []
+                continue
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                fpath = os.path.join(dirpath, fname)
+                rel = os.path.relpath(fpath, base) if base else fpath
+                parts = rel.split(os.sep)
+                is_pkg = parts[-1] == "__init__.py"
+                if is_pkg:
+                    parts = parts[:-1]
+                else:
+                    parts[-1] = parts[-1][:-3]
+                out.append((fpath, ".".join(parts), is_pkg))
+    return out

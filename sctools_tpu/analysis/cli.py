@@ -1,6 +1,6 @@
 """scx-lint CLI: ``python -m sctools_tpu.analysis [paths...]``.
 
-Runs four passes and exits non-zero when any finding survives
+Runs five passes and exits non-zero when any finding survives
 suppressions:
 
 1. JAX lint (SCX1xx) over every ``.py`` file under the given paths;
@@ -11,10 +11,20 @@ suppressions:
    built from the same paths (``--race-only`` runs just this pass —
    ``make racecheck`` — and ``--emit-lock-graph FILE`` writes the static
    lock inventory + acquisition-order graph the runtime witness
-   validates against, docs/static_analysis.md).
+   validates against, docs/static_analysis.md);
+5. shape & sharding flow check (SCX5xx) over the same whole-package
+   model build (``--shard-only`` runs just this pass — ``make
+   shardcheck`` — and ``--emit-shape-contract FILE`` writes the
+   statically predicted per-site signature universe the xprof/ingest
+   smokes validate the merged runtime registries against).
+
+``--json`` replaces the human-readable output with one machine-readable
+findings array covering every pass that ran (rule, path, line, message).
 
 The module imports nothing heavyweight (no jax, no numpy), so the gate
-adds milliseconds to ``make lint``.
+adds milliseconds to ``make lint``. Passes 4 and 5 share one parse per
+file through :mod:`.astcache`, so ``--race-only``-plus-``--shard-only``
+style CI splits do not pay the package walk twice in one process.
 """
 
 from __future__ import annotations
@@ -26,13 +36,12 @@ import sys
 from typing import List, Optional
 
 from .abicheck import ABI_RULES, check_abi
+from .astcache import SKIP_DIRS as _SKIP_DIRS
 from .findings import Finding
 from .jaxlint import JAX_RULES, lint_file
 from .racecheck import RACE_RULES, check_races, lock_graph
+from .shardcheck import SHARD_RULES, build_shape_contract, check_shards
 from .suppaudit import SUPP_RULES, audit_suppressions
-
-# directory names never worth walking into
-_SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", "node_modules"}
 
 
 def _collect_py_files(paths: List[str]) -> List[str]:
@@ -73,6 +82,15 @@ def _find_native_dir(paths: List[str]) -> Optional[str]:
     return None
 
 
+def _dump_json(payload, dest: str) -> None:
+    """Atomic JSON write (tmp + rename) for the contract/graph files."""
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, sort_keys=True, indent=1)
+        f.write("\n")
+    os.replace(tmp, dest)
+
+
 def _print_rules() -> None:
     print("scx-lint rule catalog (docs/static_analysis.md):")
     for title, rules in (
@@ -80,6 +98,7 @@ def _print_rules() -> None:
         ("ctypes ABI", ABI_RULES),
         ("tsan.supp audit", SUPP_RULES),
         ("concurrency / death path", RACE_RULES),
+        ("shape / sharding flow", SHARD_RULES),
     ):
         print(f"  {title}:")
         for rule_id, slug in sorted(rules.items()):
@@ -121,10 +140,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="run ONLY the SCX4xx concurrency pass (make racecheck)",
     )
     parser.add_argument(
+        "--no-shard", action="store_true",
+        help="skip the SCX5xx shape/sharding pass",
+    )
+    parser.add_argument(
+        "--shard-only", action="store_true",
+        help="run ONLY the SCX5xx shape/sharding pass (make shardcheck)",
+    )
+    parser.add_argument(
         "--emit-lock-graph", metavar="FILE", default=None,
         help="write the static lock inventory + acquisition-order graph "
         "as JSON (the SCTOOLS_TPU_LOCK_GRAPH contract file for the "
         "runtime witness) and exit",
+    )
+    parser.add_argument(
+        "--emit-shape-contract", metavar="FILE", default=None,
+        help="write the statically predicted per-site signature/sharding "
+        "universe as JSON (the shape-contract file the xprof/ingest "
+        "smokes assert the merged runtime registries against) and exit",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit one machine-readable findings array covering every "
+        "pass that ran, instead of the human-readable lines",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
@@ -149,11 +187,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.emit_lock_graph is not None:
         graph = lock_graph(args.paths)
-        tmp = f"{args.emit_lock_graph}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(graph, f, sort_keys=True, indent=1)
-            f.write("\n")
-        os.replace(tmp, args.emit_lock_graph)
+        _dump_json(graph, args.emit_lock_graph)
         if not args.quiet:
             print(
                 f"scx-race: wrote {len(graph['locks'])} lock(s), "
@@ -163,9 +197,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         return 0
 
-    if args.race_only:
+    if args.emit_shape_contract is not None:
+        contract = build_shape_contract(args.paths)
+        _dump_json(contract, args.emit_shape_contract)
+        if not args.quiet:
+            print(
+                f"scx-shard: wrote {len(contract['sites'])} site(s), "
+                f"{len(contract['axis_universe'])} axis name(s), "
+                f"{len(contract['bucket_minimums'])} bucket minimum(s) to "
+                f"{args.emit_shape_contract}"
+            )
+        return 0
+
+    if args.race_only or args.shard_only:
+        # the two *-only flags compose: `--race-only --shard-only` runs
+        # both whole-package passes over ONE astcache model build (the
+        # `make ci` shape — one process, one parse per file)
         args.no_jax_lint = args.no_abi = args.no_supp = True
-        args.no_race = False
+        args.no_race = not args.race_only
+        args.no_shard = not args.shard_only
 
     findings: List[Finding] = []
     checked_files = 0
@@ -176,7 +226,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings.extend(lint_file(path))
 
     native_dir = args.native_dir or _find_native_dir(args.paths)
-    if args.race_only:
+    if args.race_only or args.shard_only:
         native_dir = None
     if native_dir is not None:
         if not args.no_abi:
@@ -196,12 +246,34 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if not args.no_race:
         findings.extend(check_races(args.paths))
-        if args.race_only:
-            from .racecheck import _collect_py_files as _race_files
+    if not args.no_shard:
+        findings.extend(check_shards(args.paths))
+    if (args.race_only or args.shard_only) and not checked_files:
+        from .racecheck import _collect_py_files as _race_files
 
-            checked_files = len(_race_files(args.paths))
+        checked_files = len(_race_files(args.paths))
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if args.json:
+        json.dump(
+            {
+                "findings": [
+                    {
+                        "rule": f.rule,
+                        "path": f.path,
+                        "line": f.line,
+                        "message": f.message,
+                    }
+                    for f in findings
+                ],
+                "checked_files": checked_files,
+            },
+            sys.stdout,
+            indent=1,
+            sort_keys=True,
+        )
+        print()
+        return 1 if findings else 0
     for finding in findings:
         print(finding.render())
     if not args.quiet:
@@ -212,6 +284,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 ("abi", args.no_abi or native_dir is None),
                 ("supp", args.no_supp or native_dir is None),
                 ("race", args.no_race),
+                ("shard", args.no_shard),
             )
             if not skipped
         ]

@@ -73,6 +73,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
 
+from .astcache import collect_py_files, parse_cached
 from .findings import Finding, Suppressions
 
 RACE_RULES = {
@@ -81,9 +82,6 @@ RACE_RULES = {
     "SCX403": "unlocked-cross-thread-write",
     "SCX404": "unbounded-teardown-wait",
 }
-
-# directory names never worth walking into (mirrors cli._SKIP_DIRS)
-_SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", "node_modules"}
 # the analyzer + witness are the mechanism, not the subject: their
 # internal (raw, deliberately un-witnessed) locks are exempt
 RACE_EXEMPT_DIRS = ("analysis",)
@@ -271,36 +269,7 @@ class RaceModel:
 
 def _collect_py_files(paths: Sequence[str]) -> List[Tuple[str, str, bool]]:
     """(file_path, module_name, is_pkg) for every analyzable .py file."""
-    out: List[Tuple[str, str, bool]] = []
-    for root in paths:
-        root = os.path.normpath(root)
-        if os.path.isfile(root):
-            if root.endswith(".py"):
-                name = os.path.basename(root)[:-3]
-                out.append((root, name, False))
-            continue
-        base = os.path.dirname(root)
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = [
-                d for d in sorted(dirnames)
-                if d not in _SKIP_DIRS and not d.startswith(".")
-            ]
-            if os.path.basename(dirpath) in RACE_EXEMPT_DIRS:
-                dirnames[:] = []
-                continue
-            for fname in sorted(filenames):
-                if not fname.endswith(".py"):
-                    continue
-                fpath = os.path.join(dirpath, fname)
-                rel = os.path.relpath(fpath, base) if base else fpath
-                parts = rel.split(os.sep)
-                is_pkg = parts[-1] == "__init__.py"
-                if is_pkg:
-                    parts = parts[:-1]
-                else:
-                    parts[-1] = parts[-1][:-3]
-                out.append((fpath, ".".join(parts), is_pkg))
-    return out
+    return collect_py_files(paths, RACE_EXEMPT_DIRS)
 
 
 def _root_chain(node: ast.AST) -> Tuple[Optional[str], List[str]]:
@@ -445,12 +414,10 @@ class _Analyzer:
 
     def load(self, files: Sequence[Tuple[str, str, bool]]) -> None:
         for path, name, is_pkg in files:
-            try:
-                with open(path, encoding="utf-8") as f:
-                    source = f.read()
-                tree = ast.parse(source, filename=path)
-            except (OSError, SyntaxError):
+            parsed = parse_cached(path)
+            if parsed is None:
                 continue  # SCX100 is the jaxlint pass's job
+            _, tree = parsed
             mod = ModuleInfo(name=name, path=path, is_pkg=is_pkg, tree=tree)
             self.model.modules[name] = mod
         for mod in self.model.modules.values():
@@ -1502,13 +1469,11 @@ def check_races(paths: Sequence[str]) -> List[Finding]:
         by_path.setdefault(finding.path, []).append(finding)
     out: List[Finding] = []
     for path, findings in by_path.items():
-        try:
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-        except OSError:
+        parsed = parse_cached(path)
+        if parsed is None:
             out.extend(findings)
             continue
-        out.extend(Suppressions.from_text(text, "#").apply(findings))
+        out.extend(Suppressions.from_text(parsed[0], "#").apply(findings))
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
 

@@ -1,0 +1,1792 @@
+"""scx-shard: static shape & sharding flow analysis (SCX501-SCX505).
+
+The bench trajectory says end-to-end throughput is gated by *shape
+discipline*, not FLOPs: ``bench.py --check`` holds
+``retraces_steady_state == 0`` and ``occupancy >= 0.25``, and every new
+jit site, PartitionSpec, or pad shape is a chance to silently regress
+those invariants. PR 8 proved the working pattern — a whole-package
+static model validated by a runtime witness in CI — for locks; this pass
+applies it to the other recurring hand-fixed bug class: retrace-inducing
+shapes, whole-batch materialization on device 0, and mesh/PartitionSpec
+mismatches.
+
+Whole-package and interprocedural, like :mod:`.racecheck` (and sharing
+its parse cache, :mod:`.astcache`, so ``make shardcheck`` builds the
+model once for both passes). The model holds:
+
+1. every ``xprof.instrument_jit`` call site (name, wrapped function,
+   ``static_argnames``) and every ``platform.shard_map`` site (mesh,
+   in/out specs, wrapped function);
+2. the bucket/pad vocabulary — ``bucket_size`` minimums, ``pad_to``
+   multiples, ``guard.sub_pad_to``, ``ingest.arena.arena_capacity`` —
+   and which call paths go through it;
+3. the mesh axis-name universe: ``*_AXIS`` module constants, axis-name
+   parameter defaults, literal ``Mesh(..., (names,))`` constructions;
+4. a name-resolved call graph over which mesh context, sanitizer
+   reachability, and traced-function reachability propagate.
+
+Rules:
+
+- **SCX501 partition-spec-axis** — a ``PartitionSpec`` names an axis no
+  mesh in the package declares, or a ``shard_map`` ``in_specs`` tuple's
+  arity does not match the wrapped function's positional parameters
+  (each spec shards one operand section; a miscounted tuple misassigns
+  every section after the gap).
+- **SCX502 unsharded-mesh-upload** — an ``ingest.upload`` in a
+  mesh-context function (a ``mesh`` parameter or ``self._mesh``) without
+  a ``sharding=`` built by ``ingest.mesh_sharding``: the put targets the
+  default device, materializes the whole batch on device 0, and reshards
+  inside the pass — the bug class hand-fixed in the PR 6 review.
+- **SCX503 retrace-risk** — a data-dependent Python scalar (``len()``,
+  ``.shape[i]``, ``int(...)`` of a runtime value) flows into a
+  ``static_argnames`` value at a jit site, or into a jit-*builder* call,
+  without passing through a recognized bucket/pad helper. Every distinct
+  value is a fresh executable; the streaming loop's retrace gate holds
+  only because these scalars are bucketed.
+- **SCX504 collective-axis** — a ``psum``-family collective inside a
+  ``shard_map`` body names an axis absent from the axis universe, or one
+  the site's ``in_specs`` do not partition (an unpartitioned axis makes
+  the collective a silent no-op or a trace-time error on real meshes).
+- **SCX505 host-roundtrip-in-traced-reach** — ``.item()``/``.tolist()``/
+  ``.block_until_ready()``, ``float()``/``bool()`` on parameter-derived
+  values, or ``np.asarray``/``np.array`` on parameter-derived values in
+  a function *reachable from* a traced function through the call graph.
+  jaxlint's SCX101 covers directly-decorated bodies per file; this rule
+  covers the helpers they call, which per-file analysis cannot see.
+
+The runtime half mirrors scx-race's lock witness: ``--emit-shape-contract
+FILE`` writes the statically predicted per-site signature/sharding
+universe (:func:`build_shape_contract`), and ``make xprof-smoke`` /
+``make ingest-smoke`` assert every signature observed in the merged
+runtime registries is admitted by it (:func:`check_signatures`) — a live
+2-worker validation of the model every CI run.
+
+Model limits (deliberate, documented): name-based call resolution (calls
+through arbitrary objects are invisible except for well-known terminal
+names like ``compute_entity_metrics``); taint does not cross function
+boundaries; ``sharding=`` expressions that are neither absent, ``None``,
+nor a recognized ``mesh_sharding`` binding are accepted. The shape
+contract over-approximates (it admits slightly more than the code can
+emit) so the smoke check can never fail on a legal dispatch; it still
+rejects raw unbucketed record counts, unknown sites, unknown axis names,
+and sharded operands at unsharded sites.
+
+Pure stdlib; imports nothing under analysis; honors
+``# scx-lint: disable=SCX5xx`` escapes; ``analysis/`` itself is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .astcache import collect_py_files, parse_cached
+from .findings import Finding, Suppressions
+
+SHARD_RULES = {
+    "SCX501": "partition-spec-axis",
+    "SCX502": "unsharded-mesh-upload",
+    "SCX503": "retrace-risk",
+    "SCX504": "collective-axis",
+    "SCX505": "host-roundtrip-in-traced-reach",
+}
+
+# the analyzer + witness machinery is the mechanism, not the subject
+SHARD_EXEMPT_DIRS = ("analysis",)
+
+# canonical padding/bucketing helpers: a value that went through one of
+# these is shape-disciplined (SCX503 sanitizers; contract bucket grammar)
+SANITIZER_NAMES = frozenset(
+    ("bucket_size", "pad_to", "sub_pad_to", "arena_capacity")
+)
+
+# jax.lax collective family and the positional index of the axis-name arg
+_COLLECTIVE_AXIS_ARG = {
+    "psum": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "pmean": 1,
+    "psum_scatter": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "axis_index": 0,
+}
+
+# host-sync attribute calls (SCX505); jaxlint SCX101 owns the directly
+# traced bodies, this rule owns everything reachable from them
+_HOST_SYNC_ATTRS = frozenset(("item", "tolist", "block_until_ready"))
+_NP_MATERIALIZERS = frozenset(("asarray", "array"))
+
+# parameter names that carry mesh axis identity (axis universe sources)
+_AXIS_PARAM_NAMES = frozenset(("axis_name", "axis", "ici_axis", "dcn_axis"))
+
+# terminal-name fallback resolution: method calls on injected engines
+# (``device_engine.compute_entity_metrics``) dispatch by name to the one
+# package function of that name — without this, the hottest dispatch in
+# the tree would be invisible to the SCX503 sink check
+_DISPATCHY_MIN_NAME_LEN = 6
+
+
+# ------------------------------------------------------------- records
+
+
+@dataclass
+class JitSite:
+    """One ``xprof.instrument_jit`` call site."""
+
+    name: str
+    module: str
+    path: str
+    line: int
+    static_argnames: Tuple[str, ...] = ()
+    fn_qual: Optional[str] = None  # wrapped function, when resolvable
+    kind: str = "jit"  # "jit" | "shard_map"
+    spec_axes: Tuple[str, ...] = ()  # resolved in_specs axis fingerprints
+
+
+@dataclass
+class SmSite:
+    """One ``platform.shard_map`` construction."""
+
+    module: str
+    path: str
+    line: int
+    fn_qual: Optional[str]
+    in_specs_arity: Optional[int]  # len of a literal in_specs tuple
+    spec_axes: Tuple[str, ...] = ()  # axis fingerprints over all specs
+    axes_known: bool = True  # False when any spec axis was unresolvable
+
+
+@dataclass
+class FuncInfo:
+    qual: str
+    module: str
+    path: str
+    name: str
+    line: int
+    cls: Optional[str] = None
+    parent: Optional[str] = None
+    params: Tuple[str, ...] = ()
+    has_mesh_param: bool = False
+    uses_self_mesh: bool = False
+    calls: List[Tuple[Tuple[str, ...], Optional[str]]] = field(
+        default_factory=list
+    )  # (resolved targets, terminal name)
+    calls_sanitizer: bool = False
+
+
+@dataclass
+class ModInfo:
+    name: str
+    path: str
+    is_pkg: bool
+    tree: ast.Module
+    mod_aliases: Dict[str, str] = field(default_factory=dict)
+    from_funcs: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    jax_aliases: Set[str] = field(default_factory=set)
+    lax_aliases: Set[str] = field(default_factory=set)
+    np_aliases: Set[str] = field(default_factory=set)
+    pspec_names: Set[str] = field(default_factory=set)
+    shard_map_names: Set[str] = field(default_factory=set)
+    instrument_names: Set[str] = field(default_factory=set)
+    xprof_mods: Set[str] = field(default_factory=set)
+    ingest_mods: Set[str] = field(default_factory=set)
+    upload_names: Set[str] = field(default_factory=set)
+    mesh_sharding_names: Set[str] = field(default_factory=set)
+    sanitizer_aliases: Set[str] = field(default_factory=set)
+    str_constants: Dict[str, str] = field(default_factory=dict)
+    def_index: Dict[str, List[str]] = field(default_factory=dict)
+    functions: List[FuncInfo] = field(default_factory=list)
+
+
+class ShardModel:
+    """The whole-package shape & sharding model."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.jit_sites: List[JitSite] = []
+        self.sm_sites: List[SmSite] = []
+        self.sm_by_fn: Dict[str, SmSite] = {}
+        self.axis_universe: Set[str] = set()
+        self.bucket_minimums: Set[int] = set()
+        self.pad_multiples: Set[int] = set()
+        self.builder_quals: Set[str] = set()  # functions that build jits
+        self.traced_quals: Set[str] = set()  # jit/shard_map wrapped defs
+        # site name -> static param name -> set of literal values (None in
+        # the set marks "open": a non-literal value was seen)
+        self.static_values: Dict[str, Dict[str, Set[Any]]] = {}
+        # site name -> functions that evidence its dispatch (callers of
+        # the wrapped fn / builder, record_dispatch literals)
+        self.site_callers: Dict[str, Set[str]] = {}
+        # functions from which a canonical bucket/pad helper is reachable
+        self.sanitizer_reach: Set[str] = set()
+        self.findings: List[Finding] = []
+
+
+# --------------------------------------------------------- small helpers
+
+
+def _root_chain(node: ast.AST) -> Tuple[Optional[str], List[str]]:
+    chain: List[str] = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, list(reversed(chain))
+    return None, []
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _const_int(node: Optional[ast.AST]) -> Optional[int]:
+    if (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, int)
+        and not isinstance(node.value, bool)
+    ):
+        return node.value
+    return None
+
+
+def _end(node: ast.AST) -> int:
+    return getattr(node, "end_lineno", node.lineno) or node.lineno
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+# ------------------------------------------------------------ the build
+
+
+class _Analyzer:
+    def __init__(self) -> None:
+        self.model = ShardModel()
+
+    # ------------------------------------------------------- phase A
+
+    def load(self, files: Sequence[Tuple[str, str, bool]]) -> None:
+        for path, name, is_pkg in files:
+            parsed = parse_cached(path)
+            if parsed is None:
+                continue
+            _, tree = parsed
+            self.model.modules[name] = ModInfo(
+                name=name, path=path, is_pkg=is_pkg, tree=tree
+            )
+        for mod in self.model.modules.values():
+            self._collect_imports(mod)
+            self._collect_constants(mod)
+            self._index_functions(mod)
+        self._link_aliases()
+
+    def _link_aliases(self) -> None:
+        """Propagate role bindings through cross-module re-imports.
+
+        ``from .metrics import P`` must make ``P`` a PartitionSpec name in
+        the importer when it is one in the source module (same for the
+        shim/sanitizer/upload names). One round per hop; two rounds cover
+        the package's import depth with margin.
+        """
+        for _ in range(3):
+            changed = False
+            for mod in self.model.modules.values():
+                for bound, (src, attr) in mod.from_funcs.items():
+                    other = self.model.modules.get(src)
+                    if other is None:
+                        continue
+                    for role in (
+                        "pspec_names", "shard_map_names", "instrument_names",
+                        "mesh_sharding_names", "sanitizer_aliases",
+                        "upload_names",
+                    ):
+                        if attr in getattr(other, role) and bound not in getattr(
+                            mod, role
+                        ):
+                            getattr(mod, role).add(bound)
+                            changed = True
+            if not changed:
+                break
+
+    def _collect_imports(self, mod: ModInfo) -> None:
+        known = self.model.modules
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if alias.name == "jax":
+                        mod.jax_aliases.add(bound)
+                    elif alias.name == "numpy":
+                        mod.np_aliases.add(bound)
+                    elif alias.name in known:
+                        mod.mod_aliases[alias.asname or alias.name] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                source = node.module or ""
+                target = self._resolve_from(mod, node)
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    orig = alias.name
+                    # name-keyed bindings work even when the source module
+                    # is outside the analyzed path set (fixtures import the
+                    # library by its installed name)
+                    if orig == "instrument_jit":
+                        mod.instrument_names.add(bound)
+                    elif orig == "shard_map":
+                        mod.shard_map_names.add(bound)
+                    elif orig == "PartitionSpec":
+                        mod.pspec_names.add(bound)
+                    elif orig == "mesh_sharding":
+                        mod.mesh_sharding_names.add(bound)
+                    elif orig in SANITIZER_NAMES:
+                        mod.sanitizer_aliases.add(bound)
+                    elif orig == "lax" and source.split(".")[0] == "jax":
+                        mod.lax_aliases.add(bound)
+                    elif orig == "xprof":
+                        mod.xprof_mods.add(bound)
+                    elif orig == "ingest":
+                        mod.ingest_mods.add(bound)
+                    elif orig == "upload" and "ingest" in source.split("."):
+                        mod.upload_names.add(bound)
+                    if target is not None:
+                        candidate = f"{target}.{orig}" if target else orig
+                        if candidate in known:
+                            mod.mod_aliases[bound] = candidate
+                        else:
+                            mod.from_funcs[bound] = (target, orig)
+
+    def _resolve_from(
+        self, mod: ModInfo, node: ast.ImportFrom
+    ) -> Optional[str]:
+        if node.level == 0:
+            return node.module or None
+        base = mod.name if mod.is_pkg else mod.name.rpartition(".")[0]
+        parts = base.split(".") if base else []
+        if node.level > 1:
+            cut = node.level - 1
+            if cut >= len(parts):
+                return None
+            parts = parts[: len(parts) - cut]
+        if node.module:
+            parts = parts + node.module.split(".")
+        return ".".join(parts) or None
+
+    def _collect_constants(self, mod: ModInfo) -> None:
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for target in stmt.targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                value = stmt.value
+                text = _const_str(value)
+                if text is not None:
+                    mod.str_constants[target.id] = text
+                    if "AXIS" in target.id.upper():
+                        self.model.axis_universe.add(text)
+                # module-level PartitionSpec alias: P = jax.sharding.P...
+                root, chain = _root_chain(value)
+                if (
+                    root in mod.jax_aliases
+                    and chain
+                    and chain[-1] == "PartitionSpec"
+                ):
+                    mod.pspec_names.add(target.id)
+                if root in mod.jax_aliases and chain and chain[-1] == "lax":
+                    mod.lax_aliases.add(target.id)
+
+    def _index_functions(self, mod: ModInfo) -> None:
+        def index(node, prefix, cls, parent):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}.{child.name}"
+                    args = child.args
+                    params = tuple(
+                        a.arg
+                        for a in list(args.posonlyargs) + list(args.args)
+                    )
+                    info = FuncInfo(
+                        qual=qual, module=mod.name, path=mod.path,
+                        name=child.name, line=child.lineno, cls=cls,
+                        parent=parent, params=params,
+                        has_mesh_param="mesh" in params,
+                    )
+                    info._node = child  # type: ignore[attr-defined]
+                    mod.functions.append(info)
+                    mod.def_index.setdefault(child.name, []).append(qual)
+                    self.model.functions[qual] = info
+                    index(child, qual, cls, qual)
+                elif isinstance(child, ast.ClassDef):
+                    index(child, f"{prefix}.{child.name}", child.name, parent)
+                else:
+                    index(child, prefix, cls, parent)
+
+        index(mod.tree, mod.name, None, None)
+        pseudo = FuncInfo(
+            qual=f"{mod.name}.<module>", module=mod.name, path=mod.path,
+            name="<module>", line=1,
+        )
+        pseudo._node = mod.tree  # type: ignore[attr-defined]
+        mod.functions.append(pseudo)
+        self.model.functions[pseudo.qual] = pseudo
+
+    # --------------------------------------------- axis universe (B1)
+
+    def collect_axes(self) -> None:
+        universe = self.model.axis_universe
+        for mod in self.model.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    args = node.args
+                    named = list(args.posonlyargs) + list(args.args)
+                    defaults = list(args.defaults)
+                    # defaults align to the tail of the parameter list
+                    for param, default in zip(named[-len(defaults):], defaults):
+                        if not self._is_axis_param(param.arg):
+                            continue
+                        resolved = self._axis_value(mod, default)
+                        if resolved is not None:
+                            universe.add(resolved)
+                    for param, default in zip(args.kwonlyargs, args.kw_defaults):
+                        if default is None:
+                            continue
+                        if not self._is_axis_param(param.arg):
+                            continue
+                        resolved = self._axis_value(mod, default)
+                        if resolved is not None:
+                            universe.add(resolved)
+                elif isinstance(node, ast.Call):
+                    # Mesh(devices, ("a", "b")) — literal axis-name tuples
+                    terminal = _terminal_name(node.func)
+                    if terminal == "Mesh" and len(node.args) >= 2:
+                        names = node.args[1]
+                        elts = (
+                            names.elts
+                            if isinstance(names, (ast.Tuple, ast.List))
+                            else [names]
+                        )
+                        for elt in elts:
+                            resolved = self._axis_value(mod, elt)
+                            if resolved is not None:
+                                universe.add(resolved)
+                    # axis_name="..." keyword at any call site
+                    for kw in node.keywords:
+                        if kw.arg is not None and self._is_axis_param(kw.arg):
+                            resolved = self._axis_value(mod, kw.value)
+                            if resolved is not None:
+                                universe.add(resolved)
+
+    @staticmethod
+    def _is_axis_param(name: str) -> bool:
+        return name in _AXIS_PARAM_NAMES or name.endswith("_axis")
+
+    def _axis_value(self, mod: ModInfo, expr: ast.AST) -> Optional[str]:
+        text = _const_str(expr)
+        if text is not None:
+            return text
+        if isinstance(expr, ast.Name):
+            if expr.id in mod.str_constants:
+                return mod.str_constants[expr.id]
+            # cross-module constant: from .mesh import DEFAULT_AXIS
+            bound = mod.from_funcs.get(expr.id)
+            if bound is not None:
+                other = self.model.modules.get(bound[0])
+                if other is not None:
+                    return other.str_constants.get(bound[1])
+        if isinstance(expr, ast.Attribute):
+            root, chain = _root_chain(expr)
+            if root in mod.mod_aliases and len(chain) == 1:
+                other = self.model.modules.get(mod.mod_aliases[root])
+                if other is not None:
+                    return other.str_constants.get(chain[0])
+        return None
+
+    # ----------------------------------------------- site inventory (B2)
+
+    def collect_sites(self) -> None:
+        for mod in self.model.modules.values():
+            if mod.name.rpartition(".")[2] == "platform":
+                continue  # the shard_map shim IS the mechanism, not a site
+            for info in mod.functions:
+                node = getattr(info, "_node", None)
+                if node is None or isinstance(node, ast.Module):
+                    continue
+                self._site_from_decorators(mod, info, node)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    self._site_from_call(mod, node)
+        # every shard_map-wrapped or jit-wrapped def is a traced root
+        for site in self.model.jit_sites:
+            if site.fn_qual:
+                self.model.traced_quals.add(site.fn_qual)
+        for sm in self.model.sm_sites:
+            if sm.fn_qual:
+                self.model.traced_quals.add(sm.fn_qual)
+        # link: a jit site whose wrapped def is shard_map-decorated (or was
+        # built from a shard_map call) inherits that site's axes
+        linked: List[JitSite] = []
+        for site in self.model.jit_sites:
+            sm = self.model.sm_by_fn.get(site.fn_qual or "")
+            if sm is not None:
+                site.kind = "shard_map"
+                site.spec_axes = sm.spec_axes
+            linked.append(site)
+        self.model.jit_sites = linked
+
+    def _is_instrument_expr(self, mod: ModInfo, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in mod.instrument_names
+        if isinstance(expr, ast.Attribute):
+            root, chain = _root_chain(expr)
+            return root in mod.xprof_mods and chain == ["instrument_jit"]
+        return False
+
+    def _is_shard_map_expr(self, mod: ModInfo, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in mod.shard_map_names
+        return False
+
+    def _enclosing_qual(self, mod: ModInfo, node: ast.AST) -> Optional[str]:
+        """qual of the function whose body contains ``node`` (by lines)."""
+        best: Optional[FuncInfo] = None
+        for info in mod.functions:
+            fnode = getattr(info, "_node", None)
+            if fnode is None or isinstance(fnode, ast.Module):
+                continue
+            if fnode.lineno <= node.lineno <= _end(fnode):
+                if best is None or fnode.lineno >= best._node.lineno:  # type: ignore[attr-defined]
+                    best = info
+        return best.qual if best else None
+
+    def _site_from_decorators(
+        self, mod: ModInfo, info: FuncInfo, node: ast.AST
+    ) -> None:
+        """jit/shard_map decorations: the ``functools.partial`` forms."""
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            terminal = _terminal_name(dec.func)
+            if terminal == "partial" and dec.args:
+                inner = dec.args[0]
+                if self._is_instrument_expr(mod, inner):
+                    self._add_jit_site(mod, dec, info.qual, default=info.name)
+                elif self._is_shard_map_expr(mod, inner):
+                    self._add_sm_site(mod, dec, info.qual)
+            elif self._is_instrument_expr(mod, dec.func):
+                self._add_jit_site(mod, dec, info.qual, default=info.name)
+            elif self._is_shard_map_expr(mod, dec.func):
+                self._add_sm_site(mod, dec, info.qual)
+
+    def _site_from_call(self, mod: ModInfo, call: ast.Call) -> None:
+        if self._is_instrument_expr(mod, call.func):
+            fn_qual = None
+            default = "jit"
+            if call.args:
+                first = call.args[0]
+                if isinstance(first, ast.Name):
+                    quals = mod.def_index.get(first.id)
+                    # innermost matching def: nested builder functions
+                    # reuse names like `run` across builders
+                    if quals:
+                        fn_qual = self._nearest_qual(quals, call.lineno)
+                        default = first.id
+                elif isinstance(first, ast.Call) and self._is_shard_map_expr(
+                    mod, first.func
+                ):
+                    sm = self._add_sm_site(mod, first, None)
+                    fn_qual = sm.fn_qual
+                    default = "jit"
+            self._add_jit_site(mod, call, fn_qual, default=default)
+        elif self._is_shard_map_expr(mod, call.func) and call.args:
+            # call form: shard_map(fn, mesh=..., in_specs=...)
+            already = any(
+                sm.path == mod.path and sm.line == call.lineno
+                for sm in self.model.sm_sites
+            )
+            if not already:
+                self._add_sm_site(mod, call, None)
+
+    def _nearest_qual(self, quals: List[str], line: int) -> str:
+        best = quals[0]
+        best_line = -1
+        for qual in quals:
+            info = self.model.functions.get(qual)
+            if info is not None and best_line < info.line <= line + 2:
+                best, best_line = qual, info.line
+        return best
+
+    def _add_jit_site(
+        self,
+        mod: ModInfo,
+        call: ast.Call,
+        fn_qual: Optional[str],
+        default: str,
+    ) -> JitSite:
+        name = _const_str(_kw(call, "name")) or default
+        statics: Tuple[str, ...] = ()
+        static_expr = _kw(call, "static_argnames")
+        if isinstance(static_expr, (ast.Tuple, ast.List)):
+            statics = tuple(
+                s for s in (_const_str(e) for e in static_expr.elts)
+                if s is not None
+            )
+        elif static_expr is not None:
+            single = _const_str(static_expr)
+            if single is not None:
+                statics = (single,)
+        site = JitSite(
+            name=name, module=mod.name, path=mod.path, line=call.lineno,
+            static_argnames=statics, fn_qual=fn_qual,
+        )
+        self.model.jit_sites.append(site)
+        return site
+
+    def _add_sm_site(
+        self, mod: ModInfo, call: ast.Call, fn_qual: Optional[str]
+    ) -> SmSite:
+        if fn_qual is None and call.args:
+            first = call.args[0]
+            if isinstance(first, ast.Name):
+                quals = mod.def_index.get(first.id)
+                if quals:
+                    fn_qual = self._nearest_qual(quals, call.lineno)
+        in_specs = _kw(call, "in_specs")
+        arity: Optional[int] = None
+        axes: List[str] = []
+        known = True
+        specs: List[ast.AST] = []
+        if isinstance(in_specs, (ast.Tuple, ast.List)):
+            arity = len(in_specs.elts)
+            specs.extend(in_specs.elts)
+        elif in_specs is not None:
+            specs.append(in_specs)
+        out_specs = _kw(call, "out_specs")
+        if out_specs is not None:
+            if isinstance(out_specs, (ast.Tuple, ast.List)):
+                specs.extend(out_specs.elts)
+            else:
+                specs.append(out_specs)
+        for spec in specs:
+            spec_known, spec_axes = self._spec_axes(mod, spec)
+            known = known and spec_known
+            axes.extend(spec_axes)
+        site = SmSite(
+            module=mod.name, path=mod.path, line=call.lineno,
+            fn_qual=fn_qual, in_specs_arity=arity,
+            spec_axes=tuple(dict.fromkeys(axes)), axes_known=known,
+        )
+        self.model.sm_sites.append(site)
+        if fn_qual:
+            self.model.sm_by_fn[fn_qual] = site
+        return site
+
+    def _spec_axes(
+        self, mod: ModInfo, spec: ast.AST
+    ) -> Tuple[bool, List[str]]:
+        """(fully_resolved, axis fingerprints) for one spec expression.
+
+        A fingerprint is the resolved axis string, or ``~name`` for a
+        symbolic parameter reference (consistency-checkable without a
+        value), or unresolvable (drops ``fully_resolved``).
+        """
+        axes: List[str] = []
+        known = True
+        saw_spec_call = False
+        for node in ast.walk(spec):
+            if isinstance(node, ast.Call) and (
+                _terminal_name(node.func) in mod.pspec_names
+                or _terminal_name(node.func) == "PartitionSpec"
+            ):
+                saw_spec_call = True
+                for arg in node.args:
+                    elts = (
+                        arg.elts
+                        if isinstance(arg, (ast.Tuple, ast.List))
+                        else [arg]
+                    )
+                    for elt in elts:
+                        if isinstance(elt, ast.Constant) and elt.value is None:
+                            continue
+                        fp = self._axis_fingerprint(mod, elt)
+                        if fp is None:
+                            known = False
+                        else:
+                            axes.append(fp)
+        if not saw_spec_call and not (
+            isinstance(spec, ast.Constant) and spec.value is None
+        ):
+            # a spec bound elsewhere (``in_specs=(spec,)``): the axes it
+            # partitions are not visible here — never claim to know them
+            known = False
+        return known, axes
+
+    def _axis_fingerprint(self, mod: ModInfo, expr: ast.AST) -> Optional[str]:
+        resolved = self._axis_value(mod, expr)
+        if resolved is not None:
+            return resolved
+        if isinstance(expr, ast.Name):
+            return f"~{expr.id}"
+        return None
+
+    # ----------------------------------------------------- body walks (C)
+
+    def analyze_bodies(self) -> None:
+        for mod in self.model.modules.values():
+            for info in mod.functions:
+                node = getattr(info, "_node", None)
+                if node is None:
+                    continue
+                self._scan_function(mod, info, node)
+        self._propagate()
+        self._check_spec_axes()
+        self._check_sm_arity()
+        self._check_collectives()
+        self._check_mesh_uploads()
+        self._check_retrace_taint()
+        self._check_traced_reach()
+
+    def _scan_function(self, mod: ModInfo, info: FuncInfo, node) -> None:
+        body = node.body if not isinstance(node, ast.Module) else [
+            s for s in node.body
+            if not isinstance(
+                s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            )
+        ]
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and sub is not node:
+                    # nested defs get their own FuncInfo scan; still record
+                    # the *reference* so closures appear in the call graph
+                    continue
+                if isinstance(sub, ast.Attribute):
+                    root, chain = _root_chain(sub)
+                    if root == "self" and chain and chain[-1] in (
+                        "_mesh", "mesh"
+                    ):
+                        info.uses_self_mesh = True
+                if not isinstance(sub, ast.Call):
+                    continue
+                targets = self._resolve_call(mod, sub.func, info.cls)
+                terminal = _terminal_name(sub.func)
+                if targets or terminal:
+                    info.calls.append((targets, terminal))
+                if self._is_sanitizer_call(mod, sub):
+                    info.calls_sanitizer = True
+                    self._record_bucket_literals(mod, sub)
+                if terminal == "record_dispatch":
+                    site_name = _const_str(
+                        sub.args[0] if sub.args else _kw(sub, "site_name")
+                    )
+                    if site_name:
+                        self.model.site_callers.setdefault(
+                            site_name, set()
+                        ).add(info.qual)
+
+    def _is_sanitizer_call(self, mod: ModInfo, call: ast.Call) -> bool:
+        terminal = _terminal_name(call.func)
+        if terminal in SANITIZER_NAMES:
+            return True
+        return terminal in mod.sanitizer_aliases
+
+    def _record_bucket_literals(self, mod: ModInfo, call: ast.Call) -> None:
+        # resolve an aliased import (`bucket_size as bs`) back to its
+        # canonical helper name so the literal still enters the contract
+        terminal = _terminal_name(call.func)
+        bound = mod.from_funcs.get(terminal or "")
+        canonical = bound[1] if bound else terminal
+        if canonical == "bucket_size":
+            minimum = _const_int(_kw(call, "minimum"))
+            if minimum is None and len(call.args) >= 2:
+                minimum = _const_int(call.args[1])
+            if minimum is not None:
+                self.model.bucket_minimums.add(minimum)
+        if canonical == "pad_to":
+            multiple = _const_int(_kw(call, "multiple"))
+            if multiple is None and len(call.args) >= 2:
+                multiple = _const_int(call.args[1])
+            if multiple is not None:
+                self.model.pad_multiples.add(multiple)
+
+    def _resolve_call(
+        self, mod: ModInfo, func: ast.AST, cls: Optional[str]
+    ) -> Tuple[str, ...]:
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mod.def_index:
+                return tuple(mod.def_index[name])
+            bound = mod.from_funcs.get(name)
+            if bound is not None:
+                qual = f"{bound[0]}.{bound[1]}"
+                if qual in self.model.functions:
+                    return (qual,)
+            return ()
+        if isinstance(func, ast.Attribute):
+            root, chain = _root_chain(func)
+            if root is None or not chain:
+                return ()
+            if root == "self" and cls is not None and len(chain) == 1:
+                qual = f"{mod.name}.{cls}.{chain[0]}"
+                if qual in self.model.functions:
+                    return (qual,)
+                return ()
+            if root in mod.mod_aliases:
+                qual = ".".join([mod.mod_aliases[root]] + chain)
+                if qual in self.model.functions:
+                    return (qual,)
+        return ()
+
+    def _propagate(self) -> None:
+        """Builder set, sanitizer reach, site caller evidence."""
+        model = self.model
+        # builders: a function whose body constructs a jit or sm site
+        for site in model.jit_sites + model.sm_sites:  # type: ignore[operator]
+            mod = model.modules.get(site.module)
+            if mod is None:
+                continue
+            owner = self._enclosing_qual(mod, _LinePoint(site.line))
+            if owner is not None:
+                model.builder_quals.add(owner)
+        # a builder that IS a traced def is not a host-side builder
+        model.builder_quals -= model.traced_quals
+        # sanitizer reach: fixpoint down the call graph
+        reach: Set[str] = {
+            info.qual
+            for info in model.functions.values()
+            if info.calls_sanitizer
+        }
+        changed = True
+        while changed:
+            changed = False
+            for info in model.functions.values():
+                if info.qual in reach:
+                    continue
+                for targets, _ in info.calls:
+                    if any(t in reach for t in targets):
+                        reach.add(info.qual)
+                        changed = True
+                        break
+        model.sanitizer_reach = reach
+        # site caller evidence: callers of the wrapped fn or its builder
+        by_fn: Dict[str, List[str]] = {}
+        for site in model.jit_sites:
+            if site.fn_qual:
+                by_fn.setdefault(site.fn_qual, []).append(site.name)
+                info = model.functions.get(site.fn_qual)
+                if info is not None and info.parent:
+                    by_fn.setdefault(info.parent, []).append(site.name)
+        name_index: Dict[str, List[str]] = {}
+        for qual in by_fn:
+            info = model.functions.get(qual)
+            if info is not None:
+                name_index.setdefault(info.name, []).append(qual)
+        for info in model.functions.values():
+            for targets, terminal in info.calls:
+                hits: List[str] = []
+                for target in targets:
+                    hits.extend(by_fn.get(target, ()))
+                if not hits and terminal in name_index:
+                    for qual in name_index[terminal]:
+                        hits.extend(by_fn.get(qual, ()))
+                for site_name in hits:
+                    model.site_callers.setdefault(site_name, set()).add(
+                        info.qual
+                    )
+
+    # ------------------------------------------------------ rule checks
+
+    def _check_spec_axes(self) -> None:
+        """SCX501 (axis half): resolved PartitionSpec axes must be declared."""
+        universe = self.model.axis_universe
+        reported: Set[Tuple[str, int, str]] = set()
+        for mod in self.model.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                terminal = _terminal_name(node.func)
+                if (
+                    terminal not in mod.pspec_names
+                    and terminal != "PartitionSpec"
+                ):
+                    continue
+                for arg in node.args:
+                    elts = (
+                        arg.elts
+                        if isinstance(arg, (ast.Tuple, ast.List))
+                        else [arg]
+                    )
+                    for elt in elts:
+                        axis = _const_str(elt) or (
+                            self._axis_value(mod, elt)
+                            if isinstance(elt, (ast.Name, ast.Attribute))
+                            else None
+                        )
+                        if axis is None or axis in universe:
+                            continue
+                        key = (mod.path, elt.lineno, axis)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        declared = ", ".join(sorted(universe)) or "(none)"
+                        self.model.findings.append(
+                            Finding(
+                                "SCX501", mod.path, elt.lineno,
+                                f"PartitionSpec names axis `{axis}`, which "
+                                f"no mesh in the package declares (declared "
+                                f"axes: {declared}) — the spec would fail "
+                                "or silently replicate at dispatch",
+                                _end(elt),
+                            )
+                        )
+
+    def _check_sm_arity(self) -> None:
+        """SCX501 (rank half): in_specs arity vs wrapped fn parameters."""
+        for sm in self.model.sm_sites:
+            if sm.in_specs_arity is None or sm.fn_qual is None:
+                continue
+            info = self.model.functions.get(sm.fn_qual)
+            if info is None:
+                continue
+            node = getattr(info, "_node", None)
+            if node is None or node.args.vararg is not None:
+                continue
+            n_params = len(info.params)
+            if info.params and info.params[0] == "self":
+                n_params -= 1
+            if n_params != sm.in_specs_arity:
+                self.model.findings.append(
+                    Finding(
+                        "SCX501", sm.path, sm.line,
+                        f"shard_map in_specs has {sm.in_specs_arity} "
+                        f"spec(s) but `{info.name}` takes {n_params} "
+                        "positional operand(s) — each spec shards one "
+                        "operand section and a miscounted tuple "
+                        "misassigns every section after the gap",
+                    )
+                )
+
+    def _check_collectives(self) -> None:
+        """SCX504: collective axis vs the site's mesh/in_specs."""
+        universe = self.model.axis_universe
+        for sm in self.model.sm_sites:
+            if sm.fn_qual is None:
+                continue
+            info = self.model.functions.get(sm.fn_qual)
+            node = getattr(info, "_node", None) if info else None
+            if node is None:
+                continue
+            mod = self.model.modules.get(sm.module)
+            if mod is None:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                terminal = _terminal_name(sub.func)
+                if terminal not in _COLLECTIVE_AXIS_ARG:
+                    continue
+                root, chain = _root_chain(sub.func)
+                lax_call = (
+                    (root in mod.jax_aliases and chain[:1] == ["lax"])
+                    or (root in mod.lax_aliases and len(chain) == 1)
+                )
+                if not lax_call:
+                    continue
+                index = _COLLECTIVE_AXIS_ARG[terminal]
+                axis_expr = _kw(sub, "axis_name")
+                if axis_expr is None and len(sub.args) > index:
+                    axis_expr = sub.args[index]
+                if axis_expr is None:
+                    continue
+                exprs = (
+                    axis_expr.elts
+                    if isinstance(axis_expr, (ast.Tuple, ast.List))
+                    else [axis_expr]
+                )
+                for expr in exprs:
+                    fp = self._axis_fingerprint(mod, expr)
+                    if fp is None:
+                        continue
+                    if not fp.startswith("~") and fp not in universe:
+                        declared = ", ".join(sorted(universe)) or "(none)"
+                        self.model.findings.append(
+                            Finding(
+                                "SCX504", mod.path, expr.lineno,
+                                f"collective `{terminal}` names axis "
+                                f"`{fp}`, which no mesh in the package "
+                                f"declares (declared axes: {declared})",
+                                _end(expr),
+                            )
+                        )
+                    elif (
+                        sm.axes_known
+                        and sm.spec_axes
+                        and fp not in sm.spec_axes
+                    ):
+                        partitioned = ", ".join(sm.spec_axes)
+                        shown = fp.lstrip("~")
+                        self.model.findings.append(
+                            Finding(
+                                "SCX504", mod.path, expr.lineno,
+                                f"collective `{terminal}` runs over axis "
+                                f"`{shown}` but this shard_map's specs "
+                                f"partition only ({partitioned}) — an "
+                                "unpartitioned axis makes the collective "
+                                "a silent no-op or a trace error",
+                                _end(expr),
+                            )
+                        )
+
+    def _check_mesh_uploads(self) -> None:
+        """SCX502: uploads in mesh-context functions must shard-place."""
+        for mod in self.model.modules.values():
+            for info in mod.functions:
+                if not (info.has_mesh_param or info.uses_self_mesh):
+                    continue
+                node = getattr(info, "_node", None)
+                if node is None or isinstance(node, ast.Module):
+                    continue
+                # local names bound from a mesh_sharding(...) call
+                sharded_names: Set[str] = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and isinstance(
+                        sub.value, ast.Call
+                    ):
+                        if self._is_mesh_sharding(mod, sub.value.func):
+                            for target in sub.targets:
+                                if isinstance(target, ast.Name):
+                                    sharded_names.add(target.id)
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    if not self._is_upload_call(mod, sub):
+                        continue
+                    sharding = _kw(sub, "sharding")
+                    if sharding is not None and not (
+                        isinstance(sharding, ast.Constant)
+                        and sharding.value is None
+                    ):
+                        ok = True
+                        if isinstance(sharding, ast.Call):
+                            ok = self._is_mesh_sharding(mod, sharding.func)
+                        elif isinstance(sharding, ast.Name):
+                            ok = sharding.id in sharded_names
+                        if ok:
+                            continue
+                    self.model.findings.append(
+                        Finding(
+                            "SCX502", mod.path, sub.lineno,
+                            f"device upload in mesh-context "
+                            f"`{info.name}` without "
+                            "`sharding=ingest.mesh_sharding(mesh)`: the "
+                            "put targets the default device, materializes "
+                            "the whole batch on device 0, and reshards "
+                            "inside the pass",
+                            _end(sub),
+                        )
+                    )
+
+    def _is_upload_call(self, mod: ModInfo, call: ast.Call) -> bool:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return func.id in mod.upload_names
+        if isinstance(func, ast.Attribute):
+            root, chain = _root_chain(func)
+            return root in mod.ingest_mods and chain == ["upload"]
+        return False
+
+    def _is_mesh_sharding(self, mod: ModInfo, func: ast.AST) -> bool:
+        if isinstance(func, ast.Name):
+            return func.id in mod.mesh_sharding_names
+        if isinstance(func, ast.Attribute):
+            root, chain = _root_chain(func)
+            return root in mod.ingest_mods and chain == ["mesh_sharding"]
+        return False
+
+    # ------------------------------------------------- SCX503 taint
+
+    def _check_retrace_taint(self) -> None:
+        statics_by_fn: Dict[str, Tuple[str, Tuple[str, ...], str]] = {}
+        statics_by_name: Dict[str, Tuple[str, Tuple[str, ...], str]] = {}
+        for site in self.model.jit_sites:
+            if not site.fn_qual:
+                continue
+            entry = (site.name, site.static_argnames, site.fn_qual)
+            statics_by_fn[site.fn_qual] = entry
+            info = self.model.functions.get(site.fn_qual)
+            if info is not None and len(info.name) >= _DISPATCHY_MIN_NAME_LEN:
+                statics_by_name.setdefault(info.name, entry)
+        builder_names = {
+            self.model.functions[q].name: q
+            for q in self.model.builder_quals
+            if q in self.model.functions
+        }
+        for mod in self.model.modules.values():
+            for info in mod.functions:
+                if info.qual in self.model.traced_quals:
+                    continue  # inside a trace, .shape IS static
+                node = getattr(info, "_node", None)
+                if node is None or isinstance(node, ast.Module):
+                    continue
+                self._taint_walk(
+                    mod, info, node, statics_by_fn, statics_by_name,
+                    builder_names,
+                )
+
+    def _taint_walk(
+        self, mod, info, node, statics_by_fn, statics_by_name, builder_names
+    ) -> None:
+        tainted: Set[str] = set()
+
+        def expr_tainted(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Name):
+                return expr.id in tainted
+            if isinstance(expr, ast.Call):
+                terminal = _terminal_name(expr.func)
+                if self._is_sanitizer_call(mod, expr):
+                    return False
+                if terminal == "len":
+                    return True
+                if terminal == "int" and expr.args and not isinstance(
+                    expr.args[0], ast.Constant
+                ):
+                    return True
+                if terminal in ("min", "max"):
+                    for arg in expr.args:
+                        if isinstance(arg, ast.GeneratorExp):
+                            if expr_tainted(arg.elt):
+                                return True
+                        elif expr_tainted(arg):
+                            return True
+                return False
+            if isinstance(expr, ast.Subscript):
+                value = expr.value
+                if isinstance(value, ast.Attribute) and value.attr == "shape":
+                    return True
+                return expr_tainted(value)
+            if isinstance(expr, ast.BinOp):
+                return expr_tainted(expr.left) or expr_tainted(expr.right)
+            if isinstance(expr, ast.UnaryOp):
+                return expr_tainted(expr.operand)
+            if isinstance(expr, ast.IfExp):
+                return expr_tainted(expr.body) or expr_tainted(expr.orelse)
+            return False
+
+        def visit(stmts) -> None:
+            for stmt in stmts:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue
+                if isinstance(stmt, ast.Assign):
+                    value = stmt.value
+                    is_tainted = expr_tainted(value)
+                    shape_tuple = (
+                        isinstance(value, ast.Attribute)
+                        and value.attr == "shape"
+                    )
+                    for target in stmt.targets:
+                        names = (
+                            [target]
+                            if isinstance(target, ast.Name)
+                            else list(getattr(target, "elts", ()))
+                        )
+                        for name in names:
+                            if not isinstance(name, ast.Name):
+                                continue
+                            if is_tainted or shape_tuple:
+                                tainted.add(name.id)
+                            else:
+                                tainted.discard(name.id)
+                elif isinstance(stmt, ast.AugAssign):
+                    if isinstance(stmt.target, ast.Name) and expr_tainted(
+                        stmt.value
+                    ):
+                        tainted.add(stmt.target.id)
+                # scan every call in the statement for sinks (including
+                # calls inside deferred lambdas: the closure captures the
+                # tainted binding and dispatches with it later)
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        check_sink(sub)
+                # recurse into compound bodies in order
+                for attr in ("body", "orelse", "finalbody"):
+                    inner = getattr(stmt, attr, None)
+                    if inner:
+                        visit(inner)
+                for handler in getattr(stmt, "handlers", ()):
+                    visit(handler.body)
+
+        reported: Set[int] = set()
+
+        def check_sink(call: ast.Call) -> None:
+            targets = self._resolve_call(mod, call.func, info.cls)
+            terminal = _terminal_name(call.func)
+            entry = None
+            for target in targets:
+                if target in statics_by_fn:
+                    entry = statics_by_fn[target]
+                    break
+            if entry is None and terminal in statics_by_name and not targets:
+                entry = statics_by_name[terminal]
+            if entry is not None:
+                site_name, statics, fn_qual = entry
+                target_info = self.model.functions.get(fn_qual)
+                bad: List[str] = []
+                for kw in call.keywords:
+                    if kw.arg in statics and expr_tainted(kw.value):
+                        bad.append(kw.arg)
+                if target_info is not None:
+                    params = list(target_info.params)
+                    for position, arg in enumerate(call.args):
+                        if position < len(params) and params[
+                            position
+                        ] in statics and expr_tainted(arg):
+                            bad.append(params[position])
+                if bad and call.lineno not in reported:
+                    reported.add(call.lineno)
+                    self.model.findings.append(
+                        Finding(
+                            "SCX503", mod.path, call.lineno,
+                            "data-dependent scalar flows into static "
+                            f"argument(s) {', '.join(sorted(set(bad)))} of "
+                            f"jit site `{site_name}` without a bucket/pad "
+                            "helper — every distinct value is a fresh "
+                            "compile (retrace) at this site",
+                            _end(call),
+                        )
+                    )
+                return
+            builder_qual = None
+            for target in targets:
+                if target in self.model.builder_quals:
+                    builder_qual = target
+                    break
+            if builder_qual is None and not targets:
+                builder_qual = builder_names.get(terminal or "")
+            if builder_qual is not None:
+                if any(expr_tainted(arg) for arg in call.args) or any(
+                    expr_tainted(kw.value) for kw in call.keywords
+                ):
+                    if call.lineno in reported:
+                        return
+                    reported.add(call.lineno)
+                    short = builder_qual.rsplit(".", 1)[-1]
+                    self.model.findings.append(
+                        Finding(
+                            "SCX503", mod.path, call.lineno,
+                            "data-dependent scalar flows into jit-builder "
+                            f"`{short}` without a bucket/pad helper — "
+                            "each distinct value builds and compiles a "
+                            "fresh executable",
+                            _end(call),
+                        )
+                    )
+
+        visit(node.body)
+
+    # ----------------------------------------------- SCX505 reachability
+
+    def _check_traced_reach(self) -> None:
+        model = self.model
+        # closure over the name-resolved call graph from traced roots
+        reachable: Set[str] = set()
+        frontier = list(model.traced_quals)
+        while frontier:
+            qual = frontier.pop()
+            info = model.functions.get(qual)
+            if info is None:
+                continue
+            for targets, _ in info.calls:
+                for target in targets:
+                    if target not in reachable and (
+                        target not in model.traced_quals
+                    ):
+                        reachable.add(target)
+                        frontier.append(target)
+        for qual in sorted(reachable):
+            info = model.functions.get(qual)
+            if info is None or qual in model.builder_quals:
+                continue
+            mod = model.modules.get(info.module)
+            node = getattr(info, "_node", None)
+            if mod is None or node is None or isinstance(node, ast.Module):
+                continue
+            params = set(info.params) - {"self"}
+
+            def param_derived(expr: ast.AST) -> bool:
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Name) and sub.id in params:
+                        return True
+                return False
+
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                func = sub.func
+                terminal = _terminal_name(func)
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _HOST_SYNC_ATTRS
+                    and not sub.args
+                ):
+                    self.model.findings.append(
+                        Finding(
+                            "SCX505", mod.path, sub.lineno,
+                            f"`.{func.attr}()` in `{info.name}`, which is "
+                            "reachable from a traced function: under jit "
+                            "this is a trace error or a forced "
+                            "device->host sync per call",
+                            _end(sub),
+                        )
+                    )
+                elif (
+                    terminal in ("float", "bool")
+                    and isinstance(func, ast.Name)
+                    and sub.args
+                    and isinstance(sub.args[0], ast.Subscript)
+                    and param_derived(sub.args[0])
+                ):
+                    # subscripted param values only: ``bool(flags)`` on a
+                    # whole parameter is overwhelmingly a static config
+                    # scalar (SCX101 owns the directly-traced bodies);
+                    # ``float(x[i])`` is unambiguously an element read
+                    self.model.findings.append(
+                        Finding(
+                            "SCX505", mod.path, sub.lineno,
+                            f"`{terminal}()` on a parameter-derived value "
+                            f"in `{info.name}`, which is reachable from a "
+                            "traced function: a tracer here is a "
+                            "ConcretizationTypeError on device",
+                            _end(sub),
+                        )
+                    )
+                elif (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _NP_MATERIALIZERS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in mod.np_aliases
+                    and sub.args
+                    and param_derived(sub.args[0])
+                ):
+                    self.model.findings.append(
+                        Finding(
+                            "SCX505", mod.path, sub.lineno,
+                            f"`np.{func.attr}` on a parameter-derived "
+                            f"value in `{info.name}`, which is reachable "
+                            "from a traced function: materializing a "
+                            "tracer forces a host round-trip (or fails "
+                            "under jit)",
+                            _end(sub),
+                        )
+                    )
+
+
+    # --------------------------------------- static value universes (D)
+
+    def collect_static_values(self) -> None:
+        """Literal values flowing into each site's static parameters.
+
+        Scans every call to a site's wrapped function (resolved or by
+        terminal name): a literal kwarg/positional for a static parameter
+        joins that parameter's closed value set; a non-literal marks the
+        parameter *open* (``None`` sentinel in the set) — the contract
+        then falls back to the dim grammar for ints and accepts
+        strings/bools.
+        """
+        model = self.model
+        by_fn: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+        by_name: Dict[str, Tuple[str, Tuple[str, ...], str]] = {}
+        for site in model.jit_sites:
+            if not site.fn_qual or not site.static_argnames:
+                model.static_values.setdefault(site.name, {})
+                continue
+            model.static_values.setdefault(site.name, {})
+            by_fn[site.fn_qual] = (site.name, site.static_argnames)
+            info = model.functions.get(site.fn_qual)
+            if info is not None and len(info.name) >= _DISPATCHY_MIN_NAME_LEN:
+                by_name.setdefault(
+                    info.name, (site.name, site.static_argnames, site.fn_qual)
+                )
+        for mod in model.modules.values():
+            for info in mod.functions:
+                node = getattr(info, "_node", None)
+                if node is None:
+                    continue
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    targets = self._resolve_call(mod, sub.func, info.cls)
+                    entry = None
+                    fn_qual = None
+                    for target in targets:
+                        if target in by_fn:
+                            entry = by_fn[target]
+                            fn_qual = target
+                            break
+                    if entry is None and not targets:
+                        terminal = _terminal_name(sub.func)
+                        named = by_name.get(terminal or "")
+                        if named is not None:
+                            entry = (named[0], named[1])
+                            fn_qual = named[2]
+                    if entry is None:
+                        continue
+                    site_name, statics = entry
+                    values = model.static_values.setdefault(site_name, {})
+                    seen: Set[str] = set()
+                    target_info = model.functions.get(fn_qual or "")
+                    if target_info is not None:
+                        params = list(target_info.params)
+                        for position, arg in enumerate(sub.args):
+                            if position >= len(params):
+                                break
+                            if params[position] in statics:
+                                self._note_static(
+                                    values, params[position], arg
+                                )
+                                seen.add(params[position])
+                    for kw in sub.keywords:
+                        if kw.arg in statics:
+                            self._note_static(values, kw.arg, kw.value)
+                            seen.add(kw.arg)
+                        elif kw.arg is None:
+                            # **kwargs splat may carry any static: open all
+                            for name in statics:
+                                if name not in seen:
+                                    values.setdefault(name, set()).add(None)
+
+    @staticmethod
+    def _note_static(
+        values: Dict[str, Set[Any]], name: str, expr: ast.AST
+    ) -> None:
+        slot = values.setdefault(name, set())
+        if isinstance(expr, ast.Constant) and isinstance(
+            expr.value, (str, bool, int)
+        ):
+            slot.add(expr.value)
+        else:
+            slot.add(None)  # open: a non-literal value reaches this param
+
+
+class _LinePoint:
+    """Minimal line-carrying stand-in for _enclosing_qual lookups."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+
+
+# ------------------------------------------------------------- public API
+
+
+def build_model(paths: Sequence[str]) -> ShardModel:
+    """Parse + analyze every ``.py`` under ``paths`` into one ShardModel."""
+    analyzer = _Analyzer()
+    analyzer.load(collect_py_files(paths, SHARD_EXEMPT_DIRS))
+    analyzer.collect_axes()
+    analyzer.collect_sites()
+    analyzer.analyze_bodies()
+    analyzer.collect_static_values()
+    return analyzer.model
+
+
+def check_shards(paths: Sequence[str]) -> List[Finding]:
+    """Run the SCX5xx pass; returns suppression-filtered findings."""
+    model = build_model(paths)
+    by_path: Dict[str, List[Finding]] = {}
+    for finding in model.findings:
+        by_path.setdefault(finding.path, []).append(finding)
+    out: List[Finding] = []
+    for path, findings in by_path.items():
+        parsed = parse_cached(path)
+        if parsed is None:
+            out.extend(findings)
+            continue
+        out.extend(Suppressions.from_text(parsed[0], "#").apply(findings))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+# -------------------------------------------------------- shape contract
+
+# the monoblock wire envelope: one leading n_valid word plus
+# per-record-bytes/4 words per padded record, optionally followed by two
+# num_runs-bucket int32 run-key tables (io.packed.wire_layout). The
+# per-record byte width depends on the schema variant; the contract
+# admits the full envelope rather than re-deriving wire_layout's
+# conditionals (over-approximation: sound for the subset check)
+_WIRE_HEADER_WORDS = 1
+_WIRE_RUN_TABLE_LANES = 2
+_WIRE_MIN_RECORD_BYTES = 12
+_WIRE_MAX_RECORD_BYTES = 72
+_POW2_CAP = 1 << 30
+
+CONTRACT_VERSION = 1
+
+
+def build_shape_contract(
+    paths: Sequence[str], model: Optional[ShardModel] = None
+) -> Dict[str, Any]:
+    """The statically predicted per-site signature/sharding universe.
+
+    The runtime half of the pass, mirroring scx-race's
+    ``--emit-lock-graph``: ``make xprof-smoke`` / ``make ingest-smoke``
+    run the pipeline for real and assert every observed signature in the
+    merged xprof registries is admitted (:func:`check_signatures`). The
+    contract is closed over the bucket universe — every shape the
+    bucket/pad tables can emit is admitted for any n (property-tested) —
+    and deliberately over-approximates, so a legal bucketed dispatch can
+    never fail CI; what it rejects is the regression class: raw
+    unbucketed dims, unknown sites, undeclared axis names, sharded
+    operands at unsharded sites, and raw data-dependent static values.
+
+    A site counts as ``"dims": "bucketed"`` when ANY modeled caller
+    reaches a bucket/pad helper. That is a sensitivity choice: a site
+    with one bucketed streaming caller stays gated even if a second
+    dispatch path is modeled without sanitizer reach (fixed shapes in
+    this codebase are small or pow2, both admitted by the dim grammar);
+    weakening to "all callers" would let one thin wrapper un-gate the
+    hot path.
+    """
+    if model is None:
+        model = build_model(paths)
+    minimums = sorted(model.bucket_minimums | {4096}) or [4096]
+    sites: Dict[str, Any] = {}
+    for site in model.jit_sites:
+        callers = model.site_callers.get(site.name, set())
+        bucketed = any(q in model.sanitizer_reach for q in callers)
+        statics: Dict[str, Any] = {}
+        for name, values in (model.static_values.get(site.name) or {}).items():
+            statics[name] = {
+                "open": None in values,
+                "values": sorted(
+                    (repr(v) for v in values if v is not None), key=str
+                ),
+            }
+        axes = sorted(
+            {a.lstrip("~") for a in site.spec_axes if not a.startswith("~")}
+        )
+        entry = {
+            "module": site.module,
+            "kind": site.kind,
+            "static_argnames": list(site.static_argnames),
+            "dims": "bucketed" if bucketed else "any",
+            "statics": statics,
+            "sharded": site.kind == "shard_map",
+            "axes": axes,
+        }
+        existing = sites.get(site.name)
+        if existing is not None:
+            # one site name declared at several code sites (rare): merge
+            # to the weaker (safer) contract
+            if existing["dims"] == "any" or entry["dims"] == "any":
+                entry["dims"] = "any"
+            entry["sharded"] = existing["sharded"] or entry["sharded"]
+            entry["axes"] = sorted(set(existing["axes"]) | set(entry["axes"]))
+        sites[site.name] = entry
+    return {
+        "version": CONTRACT_VERSION,
+        "axis_universe": sorted(model.axis_universe),
+        "bucket_minimums": minimums,
+        "pad_multiples": sorted(model.pad_multiples),
+        "pow2_min": min(minimums + [8]),
+        "small_dim_max": 256,
+        "wire": {
+            "header_words": _WIRE_HEADER_WORDS,
+            "run_table_lanes": _WIRE_RUN_TABLE_LANES,
+            "min_record_bytes": _WIRE_MIN_RECORD_BYTES,
+            "max_record_bytes": _WIRE_MAX_RECORD_BYTES,
+        },
+        "sites": sites,
+    }
+
+
+def _pow2s(minimum: int, cap: int = _POW2_CAP) -> List[int]:
+    out = []
+    p = 1
+    while p < minimum:
+        p *= 2
+    while p <= cap:
+        out.append(p)
+        p *= 2
+    return out
+
+
+def dim_admissible(dim: int, contract: Dict[str, Any]) -> bool:
+    """Whether one shape dimension is in the contract's bucket universe.
+
+    Admissible: tiny structural constants (column counts, scalar lanes),
+    bucket outputs (powers of two >= the smallest literal minimum), and
+    monoblock wire lengths (header + padded * record-bytes / 4 words,
+    optionally + two run-table buckets).
+    """
+    if dim <= int(contract.get("small_dim_max", 256)):
+        return dim >= 0
+    pow2_min = int(contract.get("pow2_min", 8))
+    if dim >= pow2_min and _is_pow2(dim):
+        return True
+    wire = contract.get("wire") or {}
+    header = int(wire.get("header_words", _WIRE_HEADER_WORDS))
+    lanes = int(wire.get("run_table_lanes", _WIRE_RUN_TABLE_LANES))
+    lo = int(wire.get("min_record_bytes", _WIRE_MIN_RECORD_BYTES))
+    hi = int(wire.get("max_record_bytes", _WIRE_MAX_RECORD_BYTES))
+    base = dim - header
+    if base <= 0:
+        return False
+    run_options = [0] + _pow2s(4096, 1 << 26)
+    for padded in _pow2s(4096):
+        if padded * lo // 4 > base:
+            break
+        for runs in run_options:
+            words = base - lanes * runs
+            if words <= 0:
+                continue
+            record_bytes = words * 4
+            if record_bytes % padded:
+                continue
+            if lo <= record_bytes // padded <= hi:
+                return True
+    return False
+
+
+# one abstract leaf of a recorded signature: dtype[d1,d2]@(axes)
+_LEAF = re.compile(
+    r"(?P<dtype>[A-Za-z_][A-Za-z0-9_]*)\[(?P<dims>[0-9,]*)\]"
+    r"(?:@\((?P<axes>[^)]*)\))?"
+)
+_STATIC = re.compile(r"(\w+)=('[^']*'|\"[^\"]*\"|[^,}]+)")
+
+
+def check_signatures(
+    contract: Dict[str, Any], sites: Dict[str, Any]
+) -> List[str]:
+    """Violations of ``observed signatures ⊆ contract`` (empty == OK).
+
+    ``sites`` is the merged registry's per-site dict (``obs efficiency
+    --json``'s ``sites`` / ``xprof.merge_registries(...)["sites"]``).
+    Pure stdlib — the smoke gates and external dashboards can run it on
+    any host against an emitted contract file.
+    """
+    out: List[str] = []
+    contract_sites = contract.get("sites") or {}
+    universe = set(contract.get("axis_universe") or [])
+    for site_name, row in sorted(sites.items()):
+        signatures = row.get("signatures") or {}
+        if not signatures:
+            continue
+        spec = contract_sites.get(site_name)
+        if spec is None:
+            out.append(
+                f"{site_name}: site not present in the static contract "
+                "(an instrument_jit site the model did not see)"
+            )
+            continue
+        for signature in signatures:
+            if signature == "(other signatures)":
+                # the registry's 64-per-site overflow bucket: the exact
+                # signatures are gone, so the subset check CANNOT vouch
+                # for them — and >64 distinct signatures at one site is
+                # itself the shape-flapping regression this gate exists
+                # to catch. Lost coverage is a violation, not a pass.
+                out.append(
+                    f"{site_name}: signature overflow bucket present "
+                    "(>64 distinct signatures at one site; per-signature "
+                    "coverage lost — shape flapping)"
+                )
+                continue
+            out.extend(_check_one(site_name, signature, spec, contract, universe))
+    return out
+
+
+def _check_one(
+    site_name: str,
+    signature: str,
+    spec: Dict[str, Any],
+    contract: Dict[str, Any],
+    universe: Set[str],
+) -> List[str]:
+    out: List[str] = []
+    bucketed = spec.get("dims") == "bucketed"
+    # abstract leaves ---------------------------------------------------
+    body, _, static_text = signature.partition("{")
+    for match in _LEAF.finditer(body):
+        dims = [int(d) for d in match.group("dims").split(",") if d]
+        if bucketed:
+            for dim in dims:
+                if not dim_admissible(dim, contract):
+                    out.append(
+                        f"{site_name}: dim {dim} in `{signature}` is "
+                        "outside the bucket/pad universe (raw unbucketed "
+                        "shape reached a bucketed site)"
+                    )
+        axes_text = match.group("axes")
+        if axes_text:
+            axes = {a.strip() for a in axes_text.split("+") if a.strip()}
+            unknown = axes - universe
+            if unknown:
+                out.append(
+                    f"{site_name}: operand sharded over undeclared "
+                    f"axis(es) {sorted(unknown)} in `{signature}`"
+                )
+            if axes and not spec.get("sharded"):
+                out.append(
+                    f"{site_name}: mesh-sharded operand observed at a "
+                    f"non-shard_map site in `{signature}`"
+                )
+    # static values -----------------------------------------------------
+    declared = set(spec.get("static_argnames") or [])
+    statics = spec.get("statics") or {}
+    for name, raw in _STATIC.findall(static_text):
+        if declared and name not in declared:
+            out.append(
+                f"{site_name}: static kwarg `{name}` not among the "
+                f"declared static_argnames {sorted(declared)}"
+            )
+            continue
+        param = statics.get(name) or {"open": True, "values": []}
+        raw = raw.strip()
+        if not param["open"] and param["values"]:
+            if raw not in param["values"]:
+                out.append(
+                    f"{site_name}: static `{name}={raw}` outside the "
+                    f"closed literal universe {param['values']}"
+                )
+            continue
+        # open parameter: ints are pad/bucket shapes and must obey the
+        # dim grammar at bucketed sites; strings/bools pass
+        if bucketed:
+            if raw in ("True", "False"):
+                continue
+            try:
+                value = int(raw)
+            except ValueError:
+                continue
+            if not dim_admissible(value, contract):
+                out.append(
+                    f"{site_name}: static `{name}={raw}` is a raw "
+                    "unbucketed size (outside the bucket/pad universe)"
+                )
+    return out

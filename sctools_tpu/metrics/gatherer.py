@@ -671,6 +671,7 @@ class MetricGatherer:
             real_rows=frame.n_records,
             padded_rows=num_segments,
         ):
+            # scx-lint: disable=SCX503 -- num_segments is len() of the columns _prepare_batch padded to pad_to/bucket_size, so it is already bucketed (bounded executables per run)
             result = device_engine.compute_entity_metrics(
                 cols,  # already staged on device by ingest.upload
                 num_segments=num_segments,
@@ -691,6 +692,7 @@ class MetricGatherer:
                 n_entities = int(np.unique(key).size)
             k = min(bucket_size(n_entities, minimum=1024), num_segments)
             int_names, float_names = wire_result_names(self.columns)
+            # scx-lint: disable=SCX503 -- k is bucket_size(n_entities) clamped by the already-bucketed num_segments: both min() operands are shape-disciplined
             block = device_engine.compact_results_wire(
                 result, int_names, float_names, k
             )

@@ -42,6 +42,8 @@ from .xprof import (
     load_registries,
     merge_registries,
     render_efficiency,
+    render_suggestions,
+    suggest_buckets,
 )
 
 
@@ -184,6 +186,17 @@ def _efficiency(args, out=None, err=None) -> int:
         for warning in report["warnings"]:
             print(f"obs efficiency: {warning}", file=err)
         return 2
+    if args.suggest:
+        suggestions = suggest_buckets(report, target=args.target)
+        if args.as_json:
+            payload = {"target": args.target, "suggestions": suggestions}
+            print(json.dumps(payload, separators=(",", ":")), file=out)
+        else:
+            print(
+                render_suggestions(suggestions, target=args.target),
+                end="", file=out,
+            )
+        return 0
     if args.as_json:
         print(json.dumps(report, separators=(",", ":")), file=out)
     else:
@@ -239,6 +252,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     efficiency.add_argument(
         "--json", action="store_true", dest="as_json",
         help="the full report dict as one JSON object",
+    )
+    efficiency.add_argument(
+        "--suggest", action="store_true",
+        help="print suggested bucket/pad_to sizes per site (smallest "
+        "power-of-two pad holding the mean dispatch) instead of the "
+        "report; report-only, changes nothing online",
+    )
+    efficiency.add_argument(
+        "--target", type=float, default=0.25,
+        help="occupancy target for --suggest (default: 0.25, the "
+        "bench --check floor)",
     )
     args = parser.parse_args(argv)
     if args.command == "summarize":

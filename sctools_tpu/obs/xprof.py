@@ -12,7 +12,10 @@ shipped. Four instruments, all keyed off the scx-trace enable switch
 
 1. **Jit call-site registry** — :func:`instrument_jit` wraps ``jax.jit``
    at every call site in the library. Per site it records call count,
-   the abstract shape signatures seen, compile count + compile seconds
+   the abstract shape signatures seen (leaf ``dtype[dims]``, tagged
+   ``@(axis+...)`` when the operand is mesh-sharded, so a sharded and an
+   unsharded call of the same shape are distinct signatures — they are
+   distinct executables), compile count + compile seconds
    (attributed from the ``jax.monitoring`` duration events the existing
    obs hook already receives), retraces (a backend compile for a
    signature this site had ALREADY compiled — the thing that must be
@@ -78,6 +81,8 @@ __all__ = [
     "merge_registries",
     "efficiency_report",
     "render_efficiency",
+    "suggest_buckets",
+    "render_suggestions",
 ]
 
 _lock = make_rlock("obs.xprof")
@@ -168,6 +173,35 @@ def active_site() -> Optional[str]:
 
 # ------------------------------------------------------ jit call sites
 
+def _leaf_sharding_tag(leaf) -> str:
+    """``@(axis+...)`` for a mesh-partitioned leaf, ``""`` otherwise.
+
+    Reads the array's ``sharding.spec`` (NamedSharding); any other
+    sharding kind (single-device, fully replicated spec) yields the
+    empty tag. The ``axis1+axis2`` grammar is what
+    ``analysis.shardcheck.check_signatures`` parses back out of the
+    merged registries when validating observed signatures against the
+    static shape contract.
+    """
+    spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+    if spec is None:
+        return ""
+    axes: List[str] = []
+    try:
+        for entry in spec:
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                axes.extend(str(a) for a in entry)
+            else:
+                axes.append(str(entry))
+    except TypeError:  # a spec-like object that does not iterate
+        return ""
+    if not axes:
+        return ""
+    return "@(" + "+".join(axes) + ")"
+
+
 class _InstrumentedJit:
     """A ``jax.jit`` callable with per-call-site registry accounting.
 
@@ -189,6 +223,16 @@ class _InstrumentedJit:
         self.__wrapped__ = fn
 
     def _signature(self, args, kwargs) -> str:
+        """Abstract signature key: leaf ``dtype[dims]@(axes)`` + statics.
+
+        The sharding tag makes a mesh-sharded and an unsharded call of
+        the same shape DISTINCT signatures — they compile distinct
+        executables, so conflating them under-reports retraces and hides
+        sharding regressions from the shape contract. A replicated
+        NamedSharding and a plain single-device array both render as no
+        tag (same executable either way, and it keeps pre-sharding
+        registry keys stable).
+        """
         import jax
 
         static = []
@@ -206,7 +250,10 @@ class _InstrumentedJit:
             if shape is None or dtype is None:
                 parts.append(repr(leaf))
             else:
-                parts.append(f"{dtype}[{','.join(str(d) for d in shape)}]")
+                parts.append(
+                    f"{dtype}[{','.join(str(d) for d in shape)}]"
+                    f"{_leaf_sharding_tag(leaf)}"
+                )
         sig = "(" + ", ".join(parts) + ")"
         if static:
             static.sort()
@@ -857,6 +904,104 @@ def efficiency_report(run_dir: str) -> Dict[str, Any]:
         },
         "warnings": warnings,
     }
+
+
+def suggest_buckets(
+    report: Dict[str, Any], target: float = 0.25
+) -> List[Dict[str, Any]]:
+    """Offline bucket/pad suggestions from recorded dispatch telemetry.
+
+    Seeds the occupancy-autotuned bucketing roadmap item as a pure
+    report: per site with occupancy telemetry, the smallest power-of-two
+    pad that holds the site's mean real rows per dispatch — the tightest
+    bucket floor that fits the observed traffic, and (because a pow2
+    ceiling is < 2x the mean) one that always clears any occupancy
+    target <= 0.5. ``projected_occupancy`` is what the mean dispatch
+    would score at that pad; ``meets_target`` compares it against
+    ``target`` (the ``bench.py --check`` floor by default). No online
+    behavior changes here — the numbers are inputs for a human editing
+    ``pad_to``/``bucket_size`` minimums, with the usual trade stated in
+    the render: a lower floor raises occupancy but lets more distinct
+    shapes through to the compiler.
+    """
+    rows: List[Dict[str, Any]] = []
+    for name in sorted(report.get("sites") or {}):
+        row = report["sites"][name]
+        dispatches = int(row.get("dispatches") or 0)
+        real = int(row.get("real_rows") or 0)
+        padded = int(row.get("padded_rows") or 0)
+        if not dispatches or not real or not padded:
+            continue
+        mean_real = real / dispatches
+        suggested = 1
+        while suggested < mean_real:
+            suggested *= 2
+        projected = mean_real / suggested
+        rows.append(
+            {
+                "site": name,
+                "dispatches": dispatches,
+                "mean_real_rows": round(mean_real, 1),
+                "mean_padded_rows": round(padded / dispatches, 1),
+                "occupancy": row.get("occupancy"),
+                "suggested_pad": suggested,
+                "projected_occupancy": round(projected, 4),
+                "meets_target": projected >= target,
+            }
+        )
+    return rows
+
+
+def render_suggestions(
+    suggestions: List[Dict[str, Any]], target: float = 0.25
+) -> str:
+    """The human-facing ``obs efficiency --suggest`` report."""
+    lines: List[str] = []
+    lines.append(
+        f"bucket/pad suggestions (occupancy target {100 * target:.0f}%; "
+        "report-only — edit pad_to/bucket_size minimums by hand):"
+    )
+    if not suggestions:
+        lines.append(
+            "  no sites with dispatch telemetry: run with SCTOOLS_TPU_TRACE "
+            "set so record_dispatch feeds the registry"
+        )
+        return "\n".join(lines) + "\n"
+    headers = (
+        "call site", "dispatches", "mean real", "mean padded",
+        "occupancy", "suggest pad_to", "projected",
+    )
+    table = [headers]
+    for row in suggestions:
+        occupancy = row.get("occupancy")
+        table.append(
+            (
+                str(row["site"]),
+                str(row["dispatches"]),
+                f"{row['mean_real_rows']:.0f}",
+                f"{row['mean_padded_rows']:.0f}",
+                f"{100 * occupancy:.1f}%" if occupancy is not None else "-",
+                str(row["suggested_pad"]),
+                f"{100 * row['projected_occupancy']:.1f}%"
+                + ("" if row["meets_target"] else " (!)"),
+            )
+        )
+    widths = [max(len(r[i]) for r in table) for i in range(len(headers))]
+    for index, row in enumerate(table):
+        lines.append(
+            "  ".join(
+                cell.ljust(widths[i]) if i == 0 else cell.rjust(widths[i])
+                for i, cell in enumerate(row)
+            )
+        )
+        if index == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    lines.append(
+        "note: a lower pad floor raises occupancy but admits more distinct "
+        "shapes to the compiler — check retraces stay 0 after any edit "
+        "(the shape contract gate will catch a raw size)"
+    )
+    return "\n".join(lines) + "\n"
 
 
 def _fmt_bytes(n: Optional[float]) -> str:

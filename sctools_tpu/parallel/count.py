@@ -43,6 +43,7 @@ def sharded_count_molecules(
     """
     n_shards, shard_size = stacked_cols["qname"].shape
     _check_shard_count(n_shards, mesh, axis_name)
+    # scx-lint: disable=SCX503 -- shard_size is the stacked batch's trailing dim, which partition_columns bucketed to a power of two before any caller reaches here (bounded executables per run)
     return _build_sharded_count(mesh, axis_name, shard_size)(stacked_cols)
 
 

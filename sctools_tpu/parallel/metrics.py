@@ -174,6 +174,7 @@ def sharded_entity_metrics(
     # must not win this max
     shard_size = max(v.shape[1] for v in stacked_cols.values())
     _check_shard_count(n_shards, mesh, axis_name)
+    # scx-lint: disable=SCX503 -- shard_size is the stacked batch's trailing dim, which partition_columns bucketed to a power of two before any caller reaches here (bounded executables per run)
     return _build_sharded_metrics(
         mesh, axis_name, shard_size, kind,
         tuple(sorted(engine_flags.items())), compact,
@@ -313,6 +314,7 @@ def distributed_metrics_step(
         cap = capacity
 
     axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    # scx-lint: disable=SCX503 -- cap is caller-pinned capacity, a bucket_size() output, or the shard_size partition_columns already bucketed; shard_size itself is the bucketed stacked trailing dim
     cell_out, gene_out, dropped = _build_distributed_step(
         mesh, axes, n_shards, shard_size, cap
     )(stacked_cols)

@@ -1,0 +1,28 @@
+"""SCX501 bad fixture: a PartitionSpec naming an axis no mesh declares,
+and a shard_map whose in_specs arity does not match the wrapped
+function's positional operands.
+
+Lines expected to fire carry arrow markers naming the rule; the axis
+half anchors at the offending axis element, the arity half at the
+shard_map decoration.
+"""
+
+import functools
+
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.platform import shard_map
+
+SHARD_AXIS = "shard"  # the fixture's whole declared axis universe
+
+BAD_SPEC = P("rows")  # <- SCX501 (axis `rows` undeclared)
+
+
+@functools.partial(  # <- SCX501 (1 spec for 2 operands)
+    shard_map,
+    mesh=None,
+    in_specs=(P(SHARD_AXIS),),
+    out_specs=P(SHARD_AXIS),
+)
+def kernel(cols, scale):
+    return cols

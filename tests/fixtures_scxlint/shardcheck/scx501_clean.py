@@ -1,0 +1,24 @@
+"""SCX501 clean fixture: every PartitionSpec axis is declared by the
+mesh universe (a ``*_AXIS`` constant), and the shard_map's in_specs
+arity matches the wrapped function's positional operands exactly.
+"""
+
+import functools
+
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.platform import shard_map
+
+SHARD_AXIS = "shard"
+
+GOOD_SPEC = P(SHARD_AXIS)
+
+
+@functools.partial(
+    shard_map,
+    mesh=None,
+    in_specs=(P(SHARD_AXIS), P(None)),
+    out_specs=P(SHARD_AXIS),
+)
+def kernel(cols, scale):
+    return cols

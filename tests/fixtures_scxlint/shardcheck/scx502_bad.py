@@ -1,0 +1,23 @@
+"""SCX502 bad fixture: a device upload inside a mesh-context function
+(one taking a ``mesh`` parameter, one using ``self._mesh``) without a
+``sharding=`` built by ``ingest.mesh_sharding`` — the put targets the
+default device and materializes the whole batch on device 0.
+"""
+
+from sctools_tpu.ingest import upload
+
+
+def stage_batch(cols, mesh):
+    staged, _ = upload(cols, site="fixture.stage")  # <- SCX502
+    return staged
+
+
+class Stager:
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def stage(self, cols):
+        if self._mesh is None:
+            raise ValueError("mesh required")
+        staged, _ = upload(cols, site="fixture.stager")  # <- SCX502
+        return staged

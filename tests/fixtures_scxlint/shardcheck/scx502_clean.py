@@ -1,0 +1,25 @@
+"""SCX502 clean fixture: mesh-context uploads go through
+``ingest.mesh_sharding`` — either inline or via a local binding — so the
+batch lands shard-placed instead of materializing on device 0. A
+mesh-free helper's plain upload is also fine (no mesh context at all).
+"""
+
+from sctools_tpu.ingest import mesh_sharding, upload
+
+
+def stage_batch(cols, mesh):
+    staged, _ = upload(
+        cols, site="fixture.stage", sharding=mesh_sharding(mesh)
+    )
+    return staged
+
+
+def stage_batch_bound(cols, mesh):
+    sharding = mesh_sharding(mesh)
+    staged, _ = upload(cols, site="fixture.stage", sharding=sharding)
+    return staged
+
+
+def stage_single_device(cols):
+    staged, _ = upload(cols, site="fixture.single")
+    return staged

@@ -1,0 +1,45 @@
+"""SCX503 bad fixture: a data-dependent Python scalar (``len()`` of a
+runtime value, a ``.shape[i]`` read) flows into a jit site's
+``static_argnames`` value and into a jit-builder call without passing
+through a bucket/pad helper — every distinct value is a fresh compile.
+"""
+
+import functools
+
+from sctools_tpu.obs.xprof import instrument_jit
+
+
+@functools.partial(
+    instrument_jit,
+    name="fixture.kernel",
+    static_argnames=("num_segments",),
+)
+def kernel(cols, num_segments):
+    return cols
+
+
+def _step(cols, capacity=0):
+    return cols
+
+
+def _build_fixture_step(capacity):
+    # a jit *builder*: each distinct capacity builds + compiles a fresh
+    # executable, so its arguments are SCX503 sinks too
+    return instrument_jit(
+        functools.partial(_step, capacity=capacity), name="fixture.step"
+    )
+
+
+def dispatch(frame):
+    n = len(frame)
+    return kernel(frame, num_segments=n)  # <- SCX503
+
+
+def dispatch_shape(cols):
+    rows = cols.shape[0]
+    return kernel(cols, num_segments=rows)  # <- SCX503
+
+
+def dispatch_builder(frame):
+    n = len(frame)
+    return _build_fixture_step(n)(frame)  # <- SCX503

@@ -1,0 +1,43 @@
+"""SCX503 clean fixture: every data-dependent scalar reaching a static
+argument or a jit-builder passes through a recognized bucket/pad helper
+first (``bucket_size``/``pad_to``), so the compiled-shape universe stays
+bounded; compile-time literals are fine as-is.
+"""
+
+import functools
+
+from sctools_tpu.obs.xprof import instrument_jit
+from sctools_tpu.ops.segments import bucket_size
+
+
+@functools.partial(
+    instrument_jit,
+    name="fixture.kernel",
+    static_argnames=("num_segments",),
+)
+def kernel(cols, num_segments):
+    return cols
+
+
+def _step(cols, capacity=0):
+    return cols
+
+
+def _build_fixture_step(capacity):
+    return instrument_jit(
+        functools.partial(_step, capacity=capacity), name="fixture.step"
+    )
+
+
+def dispatch(frame):
+    n = bucket_size(len(frame))
+    return kernel(frame, num_segments=n)
+
+
+def dispatch_pinned(frame):
+    return kernel(frame, num_segments=4096)
+
+
+def dispatch_builder(frame):
+    n = bucket_size(len(frame), minimum=1024)
+    return _build_fixture_step(n)(frame)

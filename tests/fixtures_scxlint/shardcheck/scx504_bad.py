@@ -1,0 +1,27 @@
+"""SCX504 bad fixture: collectives inside a shard_map body naming (a) an
+axis no mesh in the package declares and (b) a declared axis the site's
+in_specs do not partition — the first fails at dispatch, the second is a
+silent no-op or trace error on a real mesh.
+"""
+
+import functools
+
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.platform import shard_map
+
+SHARD_AXIS = "shard"
+DCN_AXIS = "dcn"
+
+
+@functools.partial(
+    shard_map,
+    mesh=None,
+    in_specs=(P(SHARD_AXIS),),
+    out_specs=P(SHARD_AXIS),
+)
+def kernel(cols):
+    total = lax.psum(cols, "rows")  # <- SCX504 (axis `rows` undeclared)
+    peer = lax.pmax(total, DCN_AXIS)  # <- SCX504 (dcn not partitioned here)
+    return peer

@@ -1,0 +1,25 @@
+"""SCX504 clean fixture: every collective inside the shard_map body runs
+over the axis its in_specs partition (directly or via the module's axis
+constant) — the reduce actually spans the shards it claims to.
+"""
+
+import functools
+
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.platform import shard_map
+
+SHARD_AXIS = "shard"
+
+
+@functools.partial(
+    shard_map,
+    mesh=None,
+    in_specs=(P(SHARD_AXIS),),
+    out_specs=P(SHARD_AXIS),
+)
+def kernel(cols):
+    total = lax.psum(cols, SHARD_AXIS)
+    index = lax.axis_index(SHARD_AXIS)
+    return total + index

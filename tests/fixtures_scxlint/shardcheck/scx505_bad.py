@@ -1,0 +1,24 @@
+"""SCX505 bad fixture: host round-trips in a helper REACHABLE FROM a
+traced function through the call graph — ``.item()``, ``float()`` on a
+parameter-derived element, ``np.asarray`` on a parameter. jaxlint's
+SCX101 sees only directly-decorated bodies; this is the interprocedural
+hole it cannot see into.
+"""
+
+import functools
+
+import numpy as np
+
+from sctools_tpu.obs.xprof import instrument_jit
+
+
+@functools.partial(instrument_jit, name="fixture.outer")
+def outer(cols):
+    return summarize(cols)
+
+
+def summarize(cols):
+    first = float(cols[0])  # <- SCX505
+    host = np.asarray(cols)  # <- SCX505
+    total = cols.sum().item()  # <- SCX505
+    return first + host.sum() + total

@@ -202,6 +202,37 @@ def main() -> int:
                 "the ring pipeline"
             )
 
+    # ---- observed signatures ⊆ the static shape contract: the runtime
+    # witness half of `make shardcheck` (scx-shard SCX5xx) — the ring
+    # pipeline's real dispatch shapes validate the static model live
+    from sctools_tpu.analysis.shardcheck import (
+        build_shape_contract,
+        check_signatures,
+    )
+
+    contract = build_shape_contract(
+        [
+            os.path.join(REPO_ROOT, "sctools_tpu"),
+            os.path.join(REPO_ROOT, "bench.py"),
+            os.path.join(REPO_ROOT, "__graft_entry__.py"),
+        ]
+    )
+    observed_signatures = sum(
+        len(row.get("signatures") or {}) for row in report["sites"].values()
+    )
+    if not observed_signatures:
+        fail("no signatures observed — the shape-contract witness never engaged")
+    violations = check_signatures(contract, report["sites"])
+    if violations:
+        fail(
+            "observed signature(s) escape the static shape contract:\n  "
+            + "\n  ".join(violations)
+        )
+    print(
+        f"ingest-smoke: {observed_signatures} observed signature(s) within "
+        f"the static shape contract ({len(contract['sites'])} site(s))"
+    )
+
     # ---- ledger == span bytes == gatherer accounting
     ledger = report["ledger"]
     ledger_h2d = (

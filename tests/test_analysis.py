@@ -30,8 +30,12 @@ import pytest
 
 from sctools_tpu.analysis import (
     audit_suppressions,
+    build_shape_contract,
     check_abi,
     check_races,
+    check_shards,
+    check_signatures,
+    dim_admissible,
     lint_file,
     lock_graph,
 )
@@ -45,7 +49,13 @@ ABI_CLEAN = os.path.join(FIXTURES, "abi", "clean")
 ABI_BAD = os.path.join(FIXTURES, "abi", "bad")
 SUPP = os.path.join(FIXTURES, "supp")
 RACE = os.path.join(FIXTURES, "racecheck")
+SHARD = os.path.join(FIXTURES, "shardcheck")
 NATIVE = os.path.join(REPO, "sctools_tpu", "native")
+TREE = [
+    os.path.join(REPO, "sctools_tpu"),
+    os.path.join(REPO, "bench.py"),
+    os.path.join(REPO, "__graft_entry__.py"),
+]
 
 JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)] + [
     "SCX110", "SCX111", "SCX112", "SCX113",
@@ -583,6 +593,270 @@ def test_race_positional_thread_target_registers_entry(tmp_path):
     ), graph["entries"]
 
 
+# --------------------------------------------------- scx-shard (SCX5xx)
+
+SHARD_RULE_IDS = ["SCX501", "SCX502", "SCX503", "SCX504", "SCX505"]
+
+
+@pytest.mark.parametrize("rule", SHARD_RULE_IDS)
+def test_shard_rule_fires_exactly_on_marked_lines(rule):
+    path = os.path.join(SHARD, f"{rule.lower()}_bad.py")
+    findings = check_shards([path])
+    assert findings, f"{rule} bad fixture produced no findings"
+    assert {f.rule for f in findings} == {rule}
+    expected = _marked_lines(path, rule)
+    assert expected, f"fixture {path} has no # <- {rule} markers"
+    assert sorted(f.line for f in findings) == expected, [
+        f.render() for f in findings
+    ]
+
+
+@pytest.mark.parametrize("rule", SHARD_RULE_IDS)
+def test_shard_rule_silent_on_clean_fixture(rule):
+    findings = check_shards(
+        [os.path.join(SHARD, f"{rule.lower()}_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_shard_real_tree_is_clean():
+    # audited inline suppressions allowed (each carries a justification);
+    # anything else is a merge blocker, same contract as make shardcheck
+    findings = check_shards(TREE)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_shard_inline_suppression(tmp_path):
+    src = (
+        "import functools\n\n"
+        "from sctools_tpu.obs.xprof import instrument_jit\n\n\n"
+        "@functools.partial(\n"
+        "    instrument_jit, name='t.kernel', static_argnames=('n',)\n"
+        ")\n"
+        "def kernel(cols, n):\n"
+        "    return cols\n\n\n"
+        "def dispatch(frame):\n"
+        "    n = len(frame)\n"
+        "    return kernel(frame, n=n)  "
+        "# scx-lint: disable=SCX503 -- bucketed upstream by construction\n"
+    )
+    path = tmp_path / "suppressed_shard.py"
+    path.write_text(src)
+    assert check_shards([str(path)]) == []
+
+
+def test_shard_taint_cleared_by_reassignment(tmp_path):
+    # a name rebound to a shape-independent value is no longer tainted
+    src = (
+        "import functools\n\n"
+        "from sctools_tpu.obs.xprof import instrument_jit\n\n\n"
+        "@functools.partial(\n"
+        "    instrument_jit, name='t.kernel', static_argnames=('n',)\n"
+        ")\n"
+        "def kernel(cols, n):\n"
+        "    return cols\n\n\n"
+        "def dispatch(frame):\n"
+        "    n = len(frame)\n"
+        "    n = 4096\n"
+        "    return kernel(frame, n=n)\n"
+    )
+    path = tmp_path / "retainted_shard.py"
+    path.write_text(src)
+    assert check_shards([str(path)]) == []
+
+
+# ------------------------------------------------- shape contract (witness)
+
+def test_contract_models_the_real_tree():
+    contract = build_shape_contract(TREE)
+    sites = contract["sites"]
+    for needed in (
+        "metrics.compute_entity_metrics",
+        "metrics.compact_results_wire",
+        "ops.count_molecules",
+        "parallel.sharded_metrics",
+    ):
+        assert needed in sites, sorted(sites)
+    # the mesh axis universe carries the library's axis vocabulary
+    assert "shard" in contract["axis_universe"]
+    # the streaming sites are recognized as bucketed (their dispatchers
+    # reach a bucket/pad helper), so raw dims are rejected there
+    assert sites["metrics.compute_entity_metrics"]["dims"] == "bucketed"
+    # the sharded merge site is marked sharded (its specs are symbolic —
+    # P(axis_name) — so per-site axes stay empty and the observed axis
+    # names validate against the global universe instead)
+    assert sites["parallel.sharded_metrics"]["sharded"] is True
+    assert set(sites["parallel.sharded_metrics"]["axes"]) <= set(
+        contract["axis_universe"]
+    )
+    assert 4096 in contract["bucket_minimums"]
+
+
+def test_contract_closed_over_bucket_universe():
+    # the property the smokes rely on: EVERY size the bucket tables can
+    # emit, for every literal minimum the package uses, is admitted —
+    # a legal dispatch can never fail the runtime witness
+    from sctools_tpu.ops.segments import bucket_size
+
+    contract = build_shape_contract(TREE)
+    ns = (
+        list(range(1, 300))
+        + [1000, 4095, 4096, 4097, 12345, 1 << 17, (1 << 20) + 7]
+    )
+    for minimum in contract["bucket_minimums"]:
+        for n in ns:
+            dim = bucket_size(n, minimum=minimum)
+            assert dim_admissible(dim, contract), (minimum, n, dim)
+
+
+def test_contract_closed_over_wire_universe():
+    # monoblock wire lengths: every (schema variant, padded bucket,
+    # run-table bucket) combination the packer can produce is admitted
+    from sctools_tpu.io.packed import wire_layout
+
+    contract = build_shape_contract(TREE)
+    for wide in (False, True):
+        for small in (False, True):
+            for run_keys in (False, True):
+                for with_cb in (False, True):
+                    widths = sum(
+                        w for _, w in wire_layout(
+                            wide, small, run_keys=run_keys, with_cb=with_cb
+                        )
+                    )
+                    runs_options = [0] if not run_keys else [4096, 1 << 16]
+                    for exp in range(12, 21):
+                        padded = 1 << exp
+                        for runs in runs_options:
+                            dim = 1 + padded * widths // 4 + 2 * runs
+                            assert dim_admissible(dim, contract), (
+                                wide, small, run_keys, with_cb, padded,
+                                runs, dim,
+                            )
+
+
+def test_dim_admissible_rejects_raw_sizes():
+    contract = build_shape_contract(TREE)
+    for raw in (300, 4097, 5000, 12345, 999_999):
+        assert not dim_admissible(raw, contract), raw
+    for legal in (0, 1, 37, 256, 4096, 8192, 1 << 20):
+        assert dim_admissible(legal, contract), legal
+
+
+def _toy_contract():
+    return {
+        "version": 1,
+        "axis_universe": ["shard"],
+        "bucket_minimums": [4096],
+        "pad_multiples": [],
+        "pow2_min": 8,
+        "small_dim_max": 256,
+        "wire": {
+            "header_words": 1, "run_table_lanes": 2,
+            "min_record_bytes": 12, "max_record_bytes": 72,
+        },
+        "sites": {
+            "m.kernel": {
+                "module": "m", "kind": "jit",
+                "static_argnames": ["kind", "k"],
+                "dims": "bucketed",
+                "statics": {
+                    "kind": {"open": False, "values": ["'cell'", "'gene'"]},
+                    "k": {"open": True, "values": []},
+                },
+                "sharded": False, "axes": [],
+            },
+            "m.sharded": {
+                "module": "m", "kind": "shard_map", "static_argnames": [],
+                "dims": "any", "statics": {},
+                "sharded": True, "axes": ["shard"],
+            },
+        },
+    }
+
+
+def test_signatures_subset_accepts_legal_observations():
+    sites = {
+        "m.kernel": {
+            "signatures": {"(int32[4096,16]) {k=8192, kind='cell'}": 1}
+        },
+        "m.sharded": {"signatures": {"(float32[2,4096]@(shard))": 1}},
+        "m.idle": {"signatures": {}},  # declared-but-never-ran: skipped
+    }
+    assert check_signatures(_toy_contract(), sites) == []
+
+
+def test_signatures_reject_unknown_site():
+    sites = {"m.rogue": {"signatures": {"(int32[4096])": 1}}}
+    violations = check_signatures(_toy_contract(), sites)
+    assert len(violations) == 1 and "not present" in violations[0]
+
+
+def test_signatures_reject_raw_dim_at_bucketed_site():
+    sites = {"m.kernel": {"signatures": {"(int32[12345]) {kind='cell'}": 1}}}
+    violations = check_signatures(_toy_contract(), sites)
+    assert violations and "12345" in violations[0]
+
+
+def test_signatures_accept_raw_dim_at_any_site():
+    sites = {"m.sharded": {"signatures": {"(int32[12345]@(shard))": 1}}}
+    assert check_signatures(_toy_contract(), sites) == []
+
+
+def test_signatures_reject_undeclared_axis():
+    sites = {"m.sharded": {"signatures": {"(int32[4096]@(rows))": 1}}}
+    violations = check_signatures(_toy_contract(), sites)
+    assert violations and "rows" in violations[0]
+
+
+def test_signatures_reject_sharded_operand_at_unsharded_site():
+    sites = {
+        "m.kernel": {"signatures": {"(int32[4096]@(shard)) {kind='cell'}": 1}}
+    }
+    violations = check_signatures(_toy_contract(), sites)
+    assert violations and "non-shard_map" in violations[0]
+
+
+def test_signatures_reject_static_outside_closed_universe():
+    sites = {"m.kernel": {"signatures": {"(int32[4096]) {kind='umi'}": 1}}}
+    violations = check_signatures(_toy_contract(), sites)
+    assert violations and "kind" in violations[0]
+
+
+def test_signatures_reject_raw_open_static_int():
+    sites = {"m.kernel": {"signatures": {"(int32[4096]) {k=5000}": 1}}}
+    violations = check_signatures(_toy_contract(), sites)
+    assert violations and "k=5000" in violations[0]
+
+
+def test_signatures_reject_undeclared_static_name():
+    sites = {"m.kernel": {"signatures": {"(int32[4096]) {rows=4096}": 1}}}
+    violations = check_signatures(_toy_contract(), sites)
+    assert violations and "rows" in violations[0]
+
+
+def test_signatures_flag_overflow_marker_as_lost_coverage():
+    # >64 distinct signatures at one site collapses into the registry's
+    # overflow bucket — the exact signatures are gone, so the subset
+    # check cannot vouch for them, and that many signatures IS the
+    # shape-flapping regression this gate exists to catch
+    sites = {"m.kernel": {"signatures": {"(other signatures)": 3}}}
+    violations = check_signatures(_toy_contract(), sites)
+    assert len(violations) == 1 and "overflow" in violations[0]
+
+
+def test_contract_records_aliased_bucket_minimums(tmp_path):
+    src = (
+        "from sctools_tpu.ops.segments import bucket_size as bs\n\n\n"
+        "def dispatch(frame):\n"
+        "    return bs(len(frame), minimum=512)\n"
+    )
+    path = tmp_path / "aliased_bucket.py"
+    path.write_text(src)
+    contract = build_shape_contract([str(path)])
+    assert 512 in contract["bucket_minimums"]
+
+
 # ------------------------------------------------- runtime lock witness
 
 @pytest.fixture
@@ -806,7 +1080,7 @@ def test_cli_module_invocation():
     )
     assert result.returncode == 0, result.stderr
     assert "SCX101" in result.stdout and "SCX303" in result.stdout
-    assert "SCX404" in result.stdout
+    assert "SCX404" in result.stdout and "SCX505" in result.stdout
 
 
 def test_cli_race_only(capsys):
@@ -838,3 +1112,57 @@ def test_cli_emit_lock_graph(tmp_path, capsys):
     assert graph["version"] == 1
     assert "obs.ring" in graph["locks"]
     assert graph["edges"] and graph["entries"]
+
+
+def test_cli_shard_only(capsys):
+    rc = cli_main(["--shard-only"] + TREE)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "passes: shard" in out
+
+
+def test_cli_shard_only_fails_on_bad_corpus(capsys):
+    rc = cli_main(["-q", "--shard-only", SHARD])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for rule in SHARD_RULE_IDS:
+        assert rule in out, (rule, out)
+
+
+def test_cli_race_and_shard_only_compose(capsys):
+    # the `make modelcheck` shape: both whole-package passes, one process
+    rc = cli_main(["--race-only", "--shard-only", RACE, SHARD])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "SCX401" in out and "SCX501" in out
+    assert "passes: race, shard" in out
+
+
+def test_cli_emit_shape_contract(tmp_path, capsys):
+    target = tmp_path / "contract.json"
+    rc = cli_main(["--emit-shape-contract", str(target)] + TREE)
+    assert rc == 0, capsys.readouterr().out
+    contract = json.loads(target.read_text())
+    assert contract["version"] == 1
+    assert "shard" in contract["axis_universe"]
+    assert "metrics.compute_entity_metrics" in contract["sites"]
+
+
+def test_cli_json_findings_cover_all_passes(capsys):
+    # one machine-readable array across passes (racecheck + shardcheck)
+    rc = cli_main(["--json", "--race-only", "--shard-only", RACE, SHARD])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    rules = {f["rule"] for f in payload["findings"]}
+    assert {"SCX401", "SCX501", "SCX505"} <= rules, rules
+    for finding in payload["findings"]:
+        assert finding["path"] and finding["line"] > 0 and finding["message"]
+    assert payload["checked_files"] > 0
+
+
+def test_cli_json_clean_tree_is_empty(capsys):
+    rc = cli_main(["--json", "--shard-only"] + TREE)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert json.loads(out)["findings"] == []

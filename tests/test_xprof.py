@@ -151,6 +151,67 @@ def test_instrument_jit_static_kwargs_in_signature(recording):
     assert any("k=2" in sig for sig in site["signatures"])
 
 
+def test_sharding_distinguishes_signatures(recording):
+    # a mesh-sharded and an unsharded call of the SAME shape compile
+    # distinct executables, so they must be distinct signatures — both in
+    # retrace reports and in the scx-shard shape contract; a replicated
+    # NamedSharding keys like the plain array (pre-sharding keys stable)
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    fn = xprof.instrument_jit(lambda x: x + 1, name="test.shardsig")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("shard",))
+    x = np.ones((2, 8), np.float32)
+    fn(x)
+    fn(jax.device_put(x, NamedSharding(mesh, P("shard"))))
+    fn(jax.device_put(x, NamedSharding(mesh, P())))
+    site = xprof.snapshot()["sites"]["test.shardsig"]
+    assert set(site["signatures"]) == {
+        "(float32[2,8])",
+        "(float32[2,8]@(shard))",
+    }
+
+
+def test_suggest_buckets_names_smallest_fitting_pow2(recording, tmp_path):
+    fn = xprof.instrument_jit(lambda x: x * 2, name="test.suggest")
+    fn(np.ones(4096, np.float32))
+    # 10 dispatches of ~900 real rows padded to 4096: occupancy 22%,
+    # the smallest pow2 holding the mean batch is 1024 (projected 88%)
+    for _ in range(10):
+        xprof.record_dispatch("test.suggest", 900, 4096)
+    xprof.dump(os.path.join(tmp_path, "xprof.w0.json"), worker="w0")
+    report = xprof.efficiency_report(str(tmp_path))
+    rows = xprof.suggest_buckets(report, target=0.25)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["site"] == "test.suggest"
+    assert row["suggested_pad"] == 1024
+    assert row["meets_target"] is True
+    assert row["projected_occupancy"] > row["occupancy"]
+    text = xprof.render_suggestions(rows, target=0.25)
+    assert "test.suggest" in text and "1024" in text
+
+
+def test_efficiency_suggest_cli(recording, tmp_path, capsys):
+    from sctools_tpu.obs.__main__ import main as obs_cli
+
+    fn = xprof.instrument_jit(lambda x: x * 2, name="test.suggest")
+    fn(np.ones(4096, np.float32))
+    xprof.record_dispatch("test.suggest", 900, 4096)
+    xprof.dump(os.path.join(tmp_path, "xprof.w0.json"), worker="w0")
+    rc = obs_cli(["efficiency", str(tmp_path), "--suggest"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "test.suggest" in out and "1024" in out
+    assert "report-only" in out
+    rc = obs_cli(["efficiency", str(tmp_path), "--suggest", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["target"] == 0.25
+    assert payload["suggestions"][0]["suggested_pad"] == 1024
+
+
 def test_instrument_jit_cost_analysis(recording):
     fn = xprof.instrument_jit(lambda x: x * 2 + 1, name="test.cost")
     fn(np.ones(16, np.float32))
