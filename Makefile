@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate docs clean
 
 ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate
 
@@ -17,16 +17,16 @@ native:
 # + tsan.supp audit, sctools_tpu/analysis). Both must pass for `make ci`.
 # tests/ is style-checked but excluded from scx-lint: it hosts the
 # deliberately-bad fixture corpus and test-local jax.config setup.
-# --no-race --no-shard: `make modelcheck` owns the two whole-package
-# passes (SCX4xx + SCX5xx, same path set), so ci builds the package
-# model exactly once.
+# --no-race --no-shard --no-life: `make modelcheck` owns the three
+# whole-package passes (SCX4xx + SCX5xx + SCX6xx, same path set), so ci
+# builds the package model exactly once.
 lint:
 	@if $(PY) -c "import ruff" 2>/dev/null; then \
 		$(PY) -m ruff check sctools_tpu tests bench.py __graft_entry__.py; \
 	else \
 		$(PY) -m compileall -q sctools_tpu tests bench.py __graft_entry__.py; \
 	fi
-	$(PY) -m sctools_tpu.analysis --no-race --no-shard sctools_tpu bench.py __graft_entry__.py
+	$(PY) -m sctools_tpu.analysis --no-race --no-shard --no-life sctools_tpu bench.py __graft_entry__.py
 
 # concurrency gate: the scx-race pass (SCX401-404) on its own — lock
 # inventory, acquisition-order cycles, death-path safety, cross-thread
@@ -51,11 +51,21 @@ racecheck:
 shardcheck:
 	$(PY) -m sctools_tpu.analysis --shard-only sctools_tpu bench.py __graft_entry__.py
 
-# the ci shape of racecheck+shardcheck: both whole-package passes in ONE
-# process (the *-only flags compose), so the package parses once
-# (analysis/astcache) for both gates
+# frame-lifetime gate: the scx-life pass (SCX601-605) on its own —
+# zero-copy frame escapes, retention-window overflow, mutate-under-
+# async-upload, use-after-donation, views across arena refills. The
+# runtime half of the contract (the SCTOOLS_TPU_FRAME_DEBUG=1 generation
+# witness) runs inside ingest-smoke and guard-smoke, which assert a
+# non-empty stamped-frame count and zero stale-generation violations
+# over live 2-worker pipelines (docs/static_analysis.md).
+lifecheck:
+	$(PY) -m sctools_tpu.analysis --life-only sctools_tpu bench.py __graft_entry__.py
+
+# the ci shape of racecheck+shardcheck+lifecheck: all three whole-package
+# passes in ONE process (the *-only flags compose), so the package parses
+# once (analysis/astcache) for all three gates
 modelcheck:
-	$(PY) -m sctools_tpu.analysis --race-only --shard-only sctools_tpu bench.py __graft_entry__.py
+	$(PY) -m sctools_tpu.analysis --race-only --shard-only --life-only sctools_tpu bench.py __graft_entry__.py
 
 test:
 	$(PY) -m pytest tests/ -q

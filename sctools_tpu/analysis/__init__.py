@@ -6,7 +6,7 @@ silent retraces, host-device syncs inside traced code, tracer leaks into
 Python control flow, and drift between the hand-written ctypes tables in
 ``native/__init__.py`` and the ``extern "C"`` sources they bind.
 
-Five passes, one CLI (``python -m sctools_tpu.analysis``), all pure
+Six passes, one CLI (``python -m sctools_tpu.analysis``), all pure
 stdlib — nothing here imports jax, numpy, or the code under analysis:
 
 - :mod:`.jaxlint`  — AST rules SCX101-SCX108 over traced functions;
@@ -22,14 +22,20 @@ stdlib — nothing here imports jax, numpy, or the code under analysis:
   taint), rules SCX501-SCX505, paired with the shape contract
   (``--emit-shape-contract``) that the xprof/ingest smokes validate
   observed runtime signatures against. Shares one parse per file with
-  racecheck through :mod:`.astcache`.
+  racecheck through :mod:`.astcache`;
+- :mod:`.lifecheck` — whole-package frame-lifetime & aliasing model
+  (zero-copy frame sources, copy/view discipline, escape summaries,
+  donation inventory), rules SCX601-SCX605, paired with the runtime
+  generation witness (:mod:`sctools_tpu.ingest.framedebug`,
+  ``SCTOOLS_TPU_FRAME_DEBUG=1``) that the ingest/guard smokes validate
+  live. Same shared parse (:mod:`.astcache`).
 
 Findings carry stable rule ids and honor inline
 ``# scx-lint: disable=SCXNNN`` escape hatches (:mod:`.findings`).
 ``make lint`` runs the CLI after ruff/compileall, making a clean scx-lint
 run part of ``make ci`` mergeability; ``make racecheck`` / ``make
-shardcheck`` run the whole-package passes on their own, and ``make
-modelcheck`` (the ci leg) runs both in one process.
+shardcheck`` / ``make lifecheck`` run the whole-package passes on their
+own, and ``make modelcheck`` (the ci leg) runs all three in one process.
 """
 
 # Re-exports resolve lazily (PEP 562): every library module imports
@@ -44,6 +50,8 @@ _EXPORTS = {
     "Suppressions": "findings",
     "JAX_RULES": "jaxlint",
     "lint_file": "jaxlint",
+    "LIFE_RULES": "lifecheck",
+    "check_life": "lifecheck",
     "RACE_RULES": "racecheck",
     "check_races": "racecheck",
     "lock_graph": "racecheck",
@@ -59,8 +67,8 @@ _EXPORTS = {
 }
 
 _SUBMODULES = frozenset(
-    {"abicheck", "astcache", "cli", "findings", "jaxlint", "racecheck",
-     "shardcheck", "suppaudit", "witness"}
+    {"abicheck", "astcache", "cli", "findings", "jaxlint", "lifecheck",
+     "racecheck", "shardcheck", "suppaudit", "witness"}
 )
 
 
@@ -85,6 +93,7 @@ __all__ = [
     "ABI_RULES",
     "Finding",
     "JAX_RULES",
+    "LIFE_RULES",
     "RACE_RULES",
     "SHARD_RULES",
     "SUPP_RULES",
@@ -92,6 +101,7 @@ __all__ = [
     "audit_suppressions",
     "build_shape_contract",
     "check_abi",
+    "check_life",
     "check_races",
     "check_shards",
     "check_signatures",

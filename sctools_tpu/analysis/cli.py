@@ -1,6 +1,6 @@
 """scx-lint CLI: ``python -m sctools_tpu.analysis [paths...]``.
 
-Runs five passes and exits non-zero when any finding survives
+Runs six passes and exits non-zero when any finding survives
 suppressions:
 
 1. JAX lint (SCX1xx) over every ``.py`` file under the given paths;
@@ -16,15 +16,21 @@ suppressions:
    model build (``--shard-only`` runs just this pass — ``make
    shardcheck`` — and ``--emit-shape-contract FILE`` writes the
    statically predicted per-site signature universe the xprof/ingest
-   smokes validate the merged runtime registries against).
+   smokes validate the merged runtime registries against);
+6. frame lifetime & aliasing check (SCX6xx) over the same model build
+   (``--life-only`` runs just this pass — ``make lifecheck``; the
+   runtime half is the ingest generation witness,
+   ``SCTOOLS_TPU_FRAME_DEBUG=1``, validated by the ingest/guard
+   smokes).
 
 ``--json`` replaces the human-readable output with one machine-readable
 findings array covering every pass that ran (rule, path, line, message).
 
 The module imports nothing heavyweight (no jax, no numpy), so the gate
-adds milliseconds to ``make lint``. Passes 4 and 5 share one parse per
-file through :mod:`.astcache`, so ``--race-only``-plus-``--shard-only``
-style CI splits do not pay the package walk twice in one process.
+adds milliseconds to ``make lint``. Passes 4-6 share one parse per file
+through :mod:`.astcache`, so ``--race-only --shard-only --life-only``
+style CI splits (``make modelcheck``) do not pay the package walk three
+times in one process.
 """
 
 from __future__ import annotations
@@ -39,6 +45,7 @@ from .abicheck import ABI_RULES, check_abi
 from .astcache import SKIP_DIRS as _SKIP_DIRS
 from .findings import Finding
 from .jaxlint import JAX_RULES, lint_file
+from .lifecheck import LIFE_RULES, check_life
 from .racecheck import RACE_RULES, check_races, lock_graph
 from .shardcheck import SHARD_RULES, build_shape_contract, check_shards
 from .suppaudit import SUPP_RULES, audit_suppressions
@@ -99,6 +106,7 @@ def _print_rules() -> None:
         ("tsan.supp audit", SUPP_RULES),
         ("concurrency / death path", RACE_RULES),
         ("shape / sharding flow", SHARD_RULES),
+        ("frame lifetime / aliasing", LIFE_RULES),
     ):
         print(f"  {title}:")
         for rule_id, slug in sorted(rules.items()):
@@ -146,6 +154,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--shard-only", action="store_true",
         help="run ONLY the SCX5xx shape/sharding pass (make shardcheck)",
+    )
+    parser.add_argument(
+        "--no-life", action="store_true",
+        help="skip the SCX6xx frame-lifetime pass",
+    )
+    parser.add_argument(
+        "--life-only", action="store_true",
+        help="run ONLY the SCX6xx frame-lifetime pass (make lifecheck)",
     )
     parser.add_argument(
         "--emit-lock-graph", metavar="FILE", default=None,
@@ -209,13 +225,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         return 0
 
-    if args.race_only or args.shard_only:
-        # the two *-only flags compose: `--race-only --shard-only` runs
-        # both whole-package passes over ONE astcache model build (the
-        # `make ci` shape — one process, one parse per file)
+    only_flags = args.race_only or args.shard_only or args.life_only
+    if only_flags:
+        # the *-only flags compose: `--race-only --shard-only
+        # --life-only` runs all three whole-package passes over ONE
+        # astcache model build (the `make modelcheck` shape — one
+        # process, one parse per file)
         args.no_jax_lint = args.no_abi = args.no_supp = True
         args.no_race = not args.race_only
         args.no_shard = not args.shard_only
+        args.no_life = not args.life_only
 
     findings: List[Finding] = []
     checked_files = 0
@@ -226,7 +245,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             findings.extend(lint_file(path))
 
     native_dir = args.native_dir or _find_native_dir(args.paths)
-    if args.race_only or args.shard_only:
+    if only_flags:
         native_dir = None
     if native_dir is not None:
         if not args.no_abi:
@@ -248,7 +267,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.extend(check_races(args.paths))
     if not args.no_shard:
         findings.extend(check_shards(args.paths))
-    if (args.race_only or args.shard_only) and not checked_files:
+    if not args.no_life:
+        findings.extend(check_life(args.paths))
+    if only_flags and not checked_files:
         from .racecheck import _collect_py_files as _race_files
 
         checked_files = len(_race_files(args.paths))
@@ -285,6 +306,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 ("supp", args.no_supp or native_dir is None),
                 ("race", args.no_race),
                 ("shard", args.no_shard),
+                ("life", args.no_life),
             )
             if not skipped
         ]

@@ -34,6 +34,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..io.packed import PAD_FILLS, ReadFrame
+from . import framedebug
 
 # capacity granularity: every section offset stays 64-byte aligned for any
 # capacity that is a multiple of this (lane widths descend 4 -> 2 -> 1)
@@ -107,6 +108,19 @@ class ColumnArena:
         self.nbytes = arena_nbytes(capacity)  # validates capacity
         self.buf = np.empty(self.nbytes, dtype=np.uint8)
         self.n = 0
+        # slot lifecycle accounting (the scx-life generation witness):
+        # ``generation`` bumps every reclaim — always on, one integer add
+        # per batch, surfaced in the ring's flight-record section.
+        # ``slot`` is the ring's index for this arena (postmortem label).
+        self.generation = 0
+        self.poisoned = False
+        self.slot: Optional[int] = None
+        # witness mode is latched at construction (one env read per
+        # arena, not per batch): the ring builds its arenas after env
+        # setup, and a bench-gated hot path must not pay an environ
+        # lookup per handout. framedebug.enabled() stays the source of
+        # truth everywhere off the per-batch path.
+        self._debug = framedebug.enabled()
         self._views = {}
         offset = 0
         for name, dt in ARENA_SPEC:
@@ -120,13 +134,32 @@ class ColumnArena:
         """Full-capacity zero-copy view of one column section."""
         return self._views[name]
 
+    def reclaim(self) -> None:
+        """Recycle the slot: every outstanding frame of it goes stale.
+
+        Bumps the generation counter (stamped frames from earlier
+        generations now fail their witness check) and, under
+        ``SCTOOLS_TPU_FRAME_DEBUG=1``, poisons the whole buffer with
+        sentinel bytes so a raw retained view reads deterministic
+        garbage during the refill window instead of plausible stale
+        data.
+        """
+        self.generation += 1
+        if self._debug:
+            self.buf[:] = framedebug.POISON_BYTE
+            self.poisoned = True
+
     def fill(self, stream) -> int:
         """Decode ``stream``'s current batch into this arena (native write).
 
         ``stream`` is a :class:`sctools_tpu.native.NativeBatchStream` whose
         ``next()`` already parsed a batch. Returns the record count.
+        Reclaims the slot first: a refill IS a recycle, and any frame
+        still aliasing the previous batch is stale from here on.
         """
+        self.reclaim()
         self.n = stream.fill_arena(self.buf, self.capacity)
+        self.poisoned = False
         return self.n
 
     def pad_in_place(self, n: int, padded: int) -> None:
@@ -150,13 +183,17 @@ class ColumnArena:
         umi_names: List[str],
         gene_names: List[str],
         qname_names: Optional[List[str]] = None,
+        batch_index: Optional[int] = None,
     ) -> ReadFrame:
         """Zero-copy ReadFrame over rows [0:n) of this arena.
 
         Every per-record array is a view into the arena buffer; the two
         native-prepacked columns (``flags`` bits 0..11 and ``ps``) ride
         ``ReadFrame.extras`` for the gatherer's padder to finish and
-        consume.
+        consume. Under ``SCTOOLS_TPU_FRAME_DEBUG=1`` the frame is
+        stamped with this arena's current generation (``batch_index``
+        labels it in violation reports); otherwise it is the same plain
+        ReadFrame as always.
         """
         if not 0 <= n <= self.capacity:
             raise ValueError(f"{n} records outside capacity {self.capacity}")
@@ -164,10 +201,16 @@ class ColumnArena:
         kwargs["extras"] = {
             name: self._views[name][:n] for name in _EXTRA_FIELDS
         }
-        return ReadFrame(
+        kwargs.update(
             cell_names=cell_names,
             umi_names=umi_names,
             gene_names=gene_names,
             qname_names=qname_names if qname_names is not None else [""],
-            **kwargs,
         )
+        if self._debug:
+            # the generation witness: a stamped frame whose column reads
+            # verify the slot has not been recycled underneath it
+            return framedebug.stamp_frame(
+                kwargs, self, batch_index=batch_index
+            )
+        return ReadFrame(**kwargs)

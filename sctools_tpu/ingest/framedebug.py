@@ -1,0 +1,290 @@
+"""Runtime frame-generation witness: the dynamic half of scx-life.
+
+The static pass (:mod:`sctools_tpu.analysis.lifecheck`, SCX601-605)
+proves properties about a MODEL of the package's zero-copy frame
+lifetimes; this module validates the model against live runs, exactly
+the way the lock witness (:mod:`sctools_tpu.analysis.witness`) validates
+the scx-race lock-order model.
+
+Every :class:`~sctools_tpu.ingest.arena.ColumnArena` carries a
+monotonically increasing **generation counter**, bumped each time the
+slot is reclaimed for refill (``fill()`` -> ``reclaim()``). That much is
+always on — one integer add per batch, surfaced in the ring's
+flight-record section so a postmortem shows how far each slot had
+rotated.
+
+Off by default, and off means OFF: with ``SCTOOLS_TPU_FRAME_DEBUG``
+unset (or anything but ``1``) ``arena.frame()`` returns the plain
+:class:`~sctools_tpu.io.packed.ReadFrame` it always returned — not a
+proxy, not a subclass — so the hot path holds exactly the object it held
+before this module existed (pinned by tests/test_ingest.py and the
+``frame_debug`` bench assertion).
+
+With ``SCTOOLS_TPU_FRAME_DEBUG=1``:
+
+- each handed-out frame is a :class:`WitnessFrame` **stamped** with its
+  arena, slot, and the generation it was built from; view-preserving
+  derivations (``slice_frame``/``compact_frame``) inherit the stamp, a
+  ``copy_frame`` sheds it (the copy owns its memory);
+- recycled slots are **poisoned** with :data:`POISON_BYTE` sentinel
+  bytes before refill, so a raw retained view reads deterministic
+  garbage during the refill window instead of plausible stale data;
+- any column access on a frame whose slot has since been reclaimed
+  records a violation, announces it on stderr, fires an
+  ``obs.flight_dump`` (the postmortem names frame batch, slot, stamped
+  vs current generation, and the touching site), and raises
+  :class:`StaleFrameError` — the retention-window breach becomes a
+  crash at the exact line that read recycled memory, not a silent
+  wrong-number three stages later.
+
+At interpreter exit (when a trace dir is configured) the witness writes
+``frames.<worker>.json`` beside the trace capture:
+``{"enabled": ..., "stamped": N, "violations": [...]}`` — the file
+``make ingest-smoke`` / ``make guard-smoke`` read to assert the witness
+engaged (non-empty stamped count) and observed zero stale touches.
+
+Like the lock witness, bookkeeping state lives under one named lock
+(``ingest.framedebug``) that is never held while acquiring another lock
+or firing a flight dump.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+from ..analysis.witness import make_lock
+from ..io.packed import _PER_RECORD_FIELDS, ReadFrame
+
+ENV_FLAG = "SCTOOLS_TPU_FRAME_DEBUG"
+
+# the sentinel recycled slots are filled with before refill: 0xAB in
+# every lane makes int32 columns read -1414812757 and bools read True —
+# values no decoded batch produces as a full column, so poison shows up
+# unmistakably in a postmortem dump
+POISON_BYTE = 0xAB
+
+__all__ = [
+    "POISON_BYTE",
+    "StaleFrameError",
+    "WitnessFrame",
+    "enabled",
+    "stamped_count",
+    "violations",
+    "snapshot",
+    "dump",
+    "reset",
+]
+
+
+def enabled() -> bool:
+    """Whether frame witnessing is on (``SCTOOLS_TPU_FRAME_DEBUG=1``)."""
+    return os.environ.get(ENV_FLAG, "") == "1"
+
+
+class StaleFrameError(RuntimeError):
+    """A consumer touched a frame whose arena slot was since recycled."""
+
+
+# witness bookkeeping. The lock is named so the scx-race static model
+# inventories it; it is held only for counter/list updates — never while
+# acquiring another lock or dumping — so it cannot join any cycle.
+_lock = make_lock("ingest.framedebug")
+_stamped = 0
+_violations: List[Dict[str, Any]] = []
+_dump_registered = False
+_tls = threading.local()
+
+# attribute reads that constitute "touching the frame's record data":
+# every per-record column plus the native-extras dict. Vocabulary reads
+# (cell_names etc.) stay unchecked — the name lists are owned python
+# objects, not arena views.
+_CHECKED_FIELDS = frozenset(_PER_RECORD_FIELDS) | {"extras"}
+
+
+def _touch_site() -> str:
+    """file:line of the consumer frame that touched the stale data."""
+    here = os.path.basename(__file__)
+    for entry in reversed(traceback.extract_stack()):
+        base = os.path.basename(entry.filename)
+        if base != here:
+            return f"{entry.filename}:{entry.lineno}"
+    return "<unknown>"
+
+
+def _record_violation(detail: Dict[str, Any]) -> None:
+    with _lock:
+        _violations.append(detail)
+    try:
+        sys.stderr.write(
+            "sctools-tpu frame-witness: stale-generation: "
+            f"{json.dumps(detail, sort_keys=True, default=str)}\n"
+        )
+        sys.stderr.flush()
+    except OSError:
+        pass
+    # persist the postmortem NOW: the raise below may unwind the whole
+    # pipeline. The recursion guard stops a violation inside the dump's
+    # own snapshot providers from re-entering.
+    if getattr(_tls, "announcing", False):
+        return
+    _tls.announcing = True
+    try:
+        from .. import obs
+
+        obs.flight_dump(reason="frame-witness:stale-generation")
+    except Exception:  # noqa: BLE001 - diagnosis must never be fatal
+        pass
+    finally:
+        _tls.announcing = False
+
+
+class WitnessFrame(ReadFrame):
+    """A stamped zero-copy frame: column reads verify slot generation.
+
+    Same surface as :class:`ReadFrame` (it IS one); every per-record
+    column access first checks that the backing arena has not been
+    reclaimed since the stamp. View-preserving derivations
+    (``slice_frame``/``compact_frame``) return another stamped frame
+    over the same slot; ``copy_frame`` returns a plain ReadFrame.
+    """
+
+    def _stamp(
+        self, arena: Any, generation: int, batch_index: Optional[int]
+    ) -> "WitnessFrame":
+        d = object.__getattribute__(self, "__dict__")
+        d["_arena"] = arena
+        d["_generation"] = generation
+        d["_batch_index"] = batch_index
+        return self
+
+    def __getattribute__(self, name: str):
+        if name in _CHECKED_FIELDS:
+            d = object.__getattribute__(self, "__dict__")
+            arena = d.get("_arena")
+            if arena is not None and arena.generation != d["_generation"]:
+                detail = {
+                    "slot": getattr(arena, "slot", None),
+                    "batch_index": d.get("_batch_index"),
+                    "stamped_generation": d["_generation"],
+                    "arena_generation": arena.generation,
+                    "column": name,
+                    "site": _touch_site(),
+                }
+                _record_violation(detail)
+                raise StaleFrameError(
+                    f"frame of batch {d.get('_batch_index')} (slot "
+                    f"{getattr(arena, 'slot', '?')}, generation "
+                    f"{d['_generation']}) touched after the slot was "
+                    f"recycled to generation {arena.generation} at "
+                    f"{detail['site']} — the consumer held it past the "
+                    "ring's retention window; copy_frame() a carry "
+                    "(docs/ingest.md)"
+                )
+        return object.__getattribute__(self, name)
+
+    def _view(self, **kwargs) -> ReadFrame:
+        """Stamped view derivation: the alias inherits the stamp."""
+        d = object.__getattribute__(self, "__dict__")
+        out = WitnessFrame(**kwargs)
+        return out._stamp(
+            d.get("_arena"), d.get("_generation", 0), d.get("_batch_index")
+        )
+
+
+def stamp_frame(
+    frame_kwargs: Dict[str, Any], arena: Any, batch_index: Optional[int]
+) -> WitnessFrame:
+    """Build + stamp a WitnessFrame over ``arena`` (the ring handout)."""
+    global _stamped
+    out = WitnessFrame(**frame_kwargs)._stamp(
+        arena, arena.generation, batch_index
+    )
+    with _lock:
+        _stamped += 1
+    _ensure_dump_registered()
+    return out
+
+
+# ------------------------------------------------------------- read side
+
+
+def stamped_count() -> int:
+    """How many frames have been handed out stamped (this process)."""
+    with _lock:
+        return _stamped
+
+
+def violations() -> List[Dict[str, Any]]:
+    """Snapshot of recorded stale-generation violations."""
+    with _lock:
+        return [dict(v) for v in _violations]
+
+
+def snapshot() -> Dict[str, Any]:
+    """The whole witness state as one JSON-safe dict (the dump payload)."""
+    with _lock:
+        return {
+            "enabled": enabled(),
+            "stamped": _stamped,
+            "violations": [dict(v) for v in _violations],
+        }
+
+
+def dump(path: Optional[str] = None) -> Optional[str]:
+    """Write the witness snapshot to ``path`` (default: the trace dir).
+
+    Returns the path written, or None when no destination is available.
+    Atomic (tmp + replace), like every other capture artifact.
+    """
+    target = path
+    if target is None:
+        from .. import obs
+
+        base = obs.configured_trace_dir()
+        if base is None:
+            return None
+        target = os.path.join(
+            base, f"frames.{obs.configured_worker_name()}.json"
+        )
+    tmp = f"{target}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snapshot(), f, sort_keys=True, indent=1)
+            f.write("\n")
+        os.replace(tmp, target)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return None
+    return target
+
+
+def _ensure_dump_registered() -> None:
+    global _dump_registered
+    if _dump_registered:
+        return
+    _dump_registered = True
+    atexit.register(_dump_at_exit)
+
+
+def _dump_at_exit() -> None:
+    try:
+        dump()
+    except Exception:  # noqa: BLE001 - exit hook must never raise
+        pass
+
+
+def reset() -> None:
+    """Clear stamped counts and violations (tests)."""
+    global _stamped
+    with _lock:
+        _stamped = 0
+        _violations.clear()

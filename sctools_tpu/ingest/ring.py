@@ -143,14 +143,26 @@ def _produce_arena_frames(stream, arenas, batch_records: int, want_qname: bool):
     """
     n_slots = len(arenas)
     ring_id = next(_ring_ids)
+    for index, arena in enumerate(arenas):
+        arena.slot = index  # postmortem + frame-witness label
     _set_ring_state(ring_id, slots=n_slots, batches=0, phase="starting")
     consumed = 0
+
+    def _slot_state():
+        # per-slot generation counters + poison flags for the flight
+        # section: a postmortem shows how far each slot rotated and
+        # whether a FRAME_DEBUG run died inside a poisoned refill window
+        return {
+            "generations": [a.generation for a in arenas],
+            "poisoned": [a.poisoned for a in arenas],
+        }
+
     try:
         for k in itertools.count():
             arena = arenas[k % n_slots]
             _set_ring_state(
                 ring_id, slot=k % n_slots, batches=k, phase="filling",
-                record_offset=consumed,
+                record_offset=consumed, **_slot_state(),
             )
             with obs.span("decode", slot=k % n_slots) as sp:
                 try:
@@ -168,6 +180,7 @@ def _produce_arena_frames(stream, arenas, batch_records: int, want_qname: bool):
                         qname_names=(
                             stream.vocab("qname") if want_qname else None
                         ),
+                        batch_index=k,
                     )
                 except NativeDecodeError:
                     raise
@@ -178,7 +191,7 @@ def _produce_arena_frames(stream, arenas, batch_records: int, want_qname: bool):
                     ) from error
                 sp.add(records=n)
             obs.count("ingest_arena_batches")
-            _set_ring_state(ring_id, phase="queued")
+            _set_ring_state(ring_id, phase="queued", **_slot_state())
             consumed += n
             yield frame
     finally:

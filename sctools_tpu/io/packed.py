@@ -242,6 +242,17 @@ class ReadFrame:
     def n_records(self) -> int:
         return len(self.cell)
 
+    def _view(self, **kwargs) -> "ReadFrame":
+        """A frame whose arrays VIEW this frame's (slice/compact).
+
+        The class hook the ingest frame witness rides: a stamped
+        zero-copy frame (``SCTOOLS_TPU_FRAME_DEBUG=1``,
+        ingest.framedebug.WitnessFrame) overrides this so view-preserving
+        derivations inherit the generation stamp, while ``copy_frame`` —
+        which owns its memory — always constructs a plain ReadFrame.
+        """
+        return ReadFrame(**kwargs)
+
     # ---- derived float views (compat: parallel/synth paths, tests) -------
 
     @property
@@ -427,7 +438,7 @@ def slice_frame(frame: ReadFrame, start: int, stop: int) -> ReadFrame:
     for name in _CODED_FIELDS:
         kwargs[f"{name}_names"] = getattr(frame, f"{name}_names")
     kwargs["extras"] = {k: v[start:stop] for k, v in frame.extras.items()}
-    return ReadFrame(**kwargs)
+    return frame._view(**kwargs)
 
 
 def copy_frame(frame: ReadFrame) -> ReadFrame:
@@ -470,7 +481,7 @@ def compact_frame(frame: ReadFrame) -> ReadFrame:
         remap[used] = np.arange(len(used), dtype=np.int32)
         kwargs[name] = remap[codes]
         kwargs[f"{name}_names"] = [names[int(code)] for code in used]
-    return ReadFrame(**kwargs)
+    return frame._view(**kwargs)
 
 
 def _merge_coded(codes_a, names_a, codes_b, names_b):

@@ -7,6 +7,19 @@ location mix, NH multi-mapping, duplicate/spliced flags. The reference's
 equivalent is its synthetic BAM generator used for count-matrix property
 tests (src/sctools/test/test_count.py:154+); here generation happens at the
 packed-tensor level so device passes can be driven at any scale.
+
+Generation rides the scx-ingest arena discipline (ROADMAP item 1's
+leftover): the integer record columns are staged in a
+:class:`~sctools_tpu.ingest.arena.ColumnArena` — the same pre-allocated
+packed struct-of-arrays buffer the native decoder fills — padded in place
+with the shared PAD_FILLS policy, and COPIED out before the arena goes
+out of scope (``np.copy``, the copy_frame rule for anything that outlives
+its staging buffer). That keeps this module inside the scx-life analyzer's
+model (SCX601-605): synthetic batches obey the same buffer-lifetime rules
+as decoded ones, instead of being a suppressed special case. The float
+quality-summary columns are not arena lanes (the arena carries the packed
+integer forms) and are drawn directly, exactly as before — output values
+are unchanged for any given seed.
 """
 
 from __future__ import annotations
@@ -15,8 +28,13 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..ingest.arena import ColumnArena, arena_capacity
 from ..io.packed import pack_flags
 from ..ops.segments import bucket_size
+
+# the synth output columns that are ALSO arena lanes: these stage through
+# the packed column arena (native-decode layout) and copy out
+_ARENA_STAGED = ("cell", "umi", "gene", "ref", "pos", "flags")
 
 
 def make_synthetic_columns(
@@ -34,6 +52,8 @@ def make_synthetic_columns(
     fields are packed into the int16 ``flags`` column exactly as
     metrics.gatherer._pad_columns packs them. Returns a dict ready for
     metrics.device.compute_entity_metrics / parallel.partition_columns.
+    Deterministic per ``seed``; the arena staging below does not perturb
+    the draw order, so values are stable across the staging refactor.
     """
     rng = np.random.default_rng(seed)
     n_umis = n_umis if n_umis is not None else max(n_records // 4, 4)
@@ -42,33 +62,35 @@ def make_synthetic_columns(
     valid = np.zeros(size, dtype=bool)
     valid[:n_records] = True
 
-    def column(draw, dtype, fill=0):
-        out = np.full(size, fill, dtype=dtype)
-        out[:n_records] = draw
-        return out
+    # the staging arena: one packed buffer, recycled nowhere (fresh per
+    # call), written once and copied out — the same lifecycle the scx-life
+    # rules enforce for ring slots
+    arena = ColumnArena(arena_capacity(max(size, 1)))
+
+    def stage(name, draw):
+        arena.column(name)[:n_records] = draw
 
     unmapped = rng.random(n_records) < 0.04
-    cols = {
-        "cell": column(rng.integers(0, n_cells, n_records), np.int32),
-        "umi": column(rng.integers(0, n_umis, n_records), np.int32),
-        "gene": column(rng.integers(0, n_genes, n_records), np.int32),
-        "ref": column(np.where(unmapped, -1, rng.integers(0, 4, n_records)), np.int32),
-        "pos": column(np.where(unmapped, -1, rng.integers(0, 100_000, n_records)), np.int32),
-        "umi_frac30": column(
-            rng.random(n_records).astype(np.float32), np.float32
+    stage("cell", rng.integers(0, n_cells, n_records))
+    stage("umi", rng.integers(0, n_umis, n_records))
+    stage("gene", rng.integers(0, n_genes, n_records))
+    stage("ref", np.where(unmapped, -1, rng.integers(0, 4, n_records)))
+    stage("pos", np.where(unmapped, -1, rng.integers(0, 100_000, n_records)))
+    floats = {
+        "umi_frac30": _padded(
+            rng.random(n_records).astype(np.float32), size
         ),
-        "cb_frac30": column(
-            rng.random(n_records).astype(np.float32), np.float32
+        "cb_frac30": _padded(
+            rng.random(n_records).astype(np.float32), size
         ),
-        "genomic_frac30": column(
-            rng.random(n_records).astype(np.float32), np.float32
+        "genomic_frac30": _padded(
+            rng.random(n_records).astype(np.float32), size
         ),
-        "genomic_mean": column(
-            (rng.random(n_records) * 40).astype(np.float32), np.float32
+        "genomic_mean": _padded(
+            (rng.random(n_records) * 40).astype(np.float32), size
         ),
-        "valid": valid,
     }
-    gene_codes = cols["gene"][:n_records]
+    gene_codes = np.copy(arena.column("gene")[:n_records])
     # a fixed slice of genes is "mitochondrial"
     is_mito_gene = np.zeros(max(n_genes, 1), dtype=bool)
     is_mito_gene[: max(n_genes // 16, 1)] = True
@@ -87,5 +109,28 @@ def make_synthetic_columns(
         nh=rng.choice([1, 1, 1, 2, 4], size=n_records),
         is_mito=is_mito_gene[gene_codes],
     )
-    cols["flags"] = column(flags, np.int16)
-    return cols
+    stage("flags", flags)
+    # pad the staged lanes in place with the shared sentinel policy
+    # (these columns all pad to 0 under PAD_FILLS, matching the device
+    # schema's "padding row" convention the valid mask gates)
+    arena.pad_in_place(n_records, size)
+
+    cols = {
+        name: np.copy(arena.column(name)[:size]) for name in _ARENA_STAGED
+    }
+    cols.update(floats)
+    cols["valid"] = valid
+    # output order is part of the de-facto schema some callers zip over
+    return {
+        name: cols[name]
+        for name in (
+            "cell", "umi", "gene", "ref", "pos", "umi_frac30", "cb_frac30",
+            "genomic_frac30", "genomic_mean", "valid", "flags",
+        )
+    }
+
+
+def _padded(values: np.ndarray, size: int) -> np.ndarray:
+    out = np.zeros(size, dtype=values.dtype)
+    out[: len(values)] = values
+    return out

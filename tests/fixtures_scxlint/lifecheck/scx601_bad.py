@@ -1,0 +1,33 @@
+"""SCX601 bad fixture: zero-copy ring frames (and views derived from
+their columns) escape the consumer-loop iteration — stored into an
+attribute, appended to a long-lived container, captured by a closure,
+and passed to a helper that retains its parameter — all without an
+intervening ``copy_frame``/``np.copy``. The next slot refill rewrites
+every one of them in place.
+"""
+
+from sctools_tpu.ingest import ring_frames
+from sctools_tpu.io.packed import slice_frame
+
+
+def stash(target, frame):
+    # the interprocedural half: this helper RETAINS its parameter, so
+    # passing a live ring frame to it is an escape at the call site
+    target.archive.append(frame)
+
+
+class Consumer:
+    def __init__(self):
+        self.last = None
+        self.kept = []
+        self.archive = []
+        self.callbacks = []
+
+    def consume(self, bam):
+        for frame in ring_frames(bam, 4096):
+            self.last = frame  # <- SCX601
+            self.kept.append(slice_frame(frame, 0, 4))  # <- SCX601
+            stash(self, frame)  # <- SCX601
+
+            def report():  # <- SCX601
+                return frame.n_records

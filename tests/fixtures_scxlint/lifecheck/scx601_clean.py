@@ -1,0 +1,34 @@
+"""SCX601 clean twin: the same retention shapes, copy-disciplined.
+
+Every value that outlives the loop iteration owns its memory —
+``copy_frame`` for frames, ``np.copy`` for column views — and values
+that stay inside the iteration (slices passed to a non-retaining
+callee, per-iteration locals) are free.
+"""
+
+import numpy as np
+
+from sctools_tpu.ingest import ring_frames
+from sctools_tpu.io.packed import copy_frame, slice_frame
+
+
+def measure(frame):
+    # reads its parameter, retains nothing: not an escape target
+    return frame.n_records
+
+
+class Consumer:
+    def __init__(self):
+        self.last = None
+        self.kept = []
+        self.totals = []
+
+    def consume(self, bam):
+        for frame in ring_frames(bam, 4096):
+            self.last = copy_frame(frame)
+            self.kept.append(copy_frame(slice_frame(frame, 0, 4)))
+            self.totals.append(measure(frame))
+            head = np.copy(frame.cell)
+            self.kept.append(head)
+            scratch = []
+            scratch.append(slice_frame(frame, 0, 2))

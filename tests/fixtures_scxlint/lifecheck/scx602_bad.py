@@ -1,0 +1,37 @@
+"""SCX602 bad fixture: consumer loops whose live-frame count exceeds the
+ring's 2-frame retention window. The first holds the loop frame, a
+``next()`` look-ahead, AND an uncopied cross-iteration carry (3 slots);
+the second (the while-pull shape) holds the carried frame plus two
+look-aheads. The ring budgets headroom for exactly 2 consumer-held
+frames — the third is a recycled slot waiting to happen.
+"""
+
+from sctools_tpu.ingest import ring_frames
+
+
+def use(frame):
+    return frame.n_records
+
+
+def carry_plus_lookahead(bam):
+    frames = ring_frames(bam, 4096)
+    it = iter(frames)
+    prev = None
+    for frame in frames:  # <- SCX602
+        following = next(it, None)
+        if prev is not None:
+            use(prev)
+        use(following)
+        prev = frame
+
+
+def double_lookahead(bam):
+    frames = ring_frames(bam, 4096)
+    it = iter(frames)
+    frame = next(it, None)
+    while frame is not None:  # <- SCX602
+        look1 = next(it, None)
+        look2 = next(it, None)
+        use(frame)
+        use(look1)
+        frame = look2

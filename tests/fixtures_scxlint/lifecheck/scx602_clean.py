@@ -1,0 +1,35 @@
+"""SCX602 clean twin: the two real pipeline shapes, inside the window.
+
+The for-loop gatherer shape copies its carry (a copy owns its memory and
+holds no ring slot); the while-pull count shape holds exactly the
+current frame plus one look-ahead — the 2-frame budget the ring's
+``slots = depth + 3`` accounting reserves.
+"""
+
+from sctools_tpu.ingest import ring_frames
+from sctools_tpu.io.packed import concat_frames, copy_frame, slice_frame
+
+
+def use(frame):
+    return frame.n_records
+
+
+def gatherer_shape(bam):
+    frames = ring_frames(bam, 4096)
+    carry = None
+    for frame in frames:
+        if carry is not None:
+            frame = concat_frames(carry, frame)
+            carry = None
+        use(frame)
+        carry = copy_frame(slice_frame(frame, 0, 2))
+
+
+def count_shape(bam):
+    frames = ring_frames(bam, 4096)
+    it = iter(frames)
+    frame = next(it, None)
+    while frame is not None:
+        following = next(it, None)
+        use(frame)
+        frame = following

@@ -1,0 +1,25 @@
+"""SCX603 bad fixture: an arena slot is mutated (padded in place /
+column-written) while an async ``ingest.upload`` of values from the same
+slot may still be reading it — no ``block_until_ready`` barrier between
+the dispatch and the mutation. ``upload`` is an async ``device_put``:
+the H2D engine can observe the mutation mid-transfer.
+"""
+
+from sctools_tpu.ingest import upload
+from sctools_tpu.ingest.arena import ColumnArena, arena_capacity
+
+
+def pad_under_upload(n):
+    arena = ColumnArena(arena_capacity(n))
+    cols = {"cell": arena.column("cell"), "gene": arena.column("gene")}
+    device_value, nbytes = upload(cols, site="fixture.stage")
+    arena.pad_in_place(n, arena.capacity)  # <- SCX603
+    return device_value
+
+
+def write_under_upload(n):
+    arena = ColumnArena(arena_capacity(n))
+    view = arena.column("pos")
+    staged, nbytes = upload({"pos": view}, site="fixture.poke")
+    view[:4] = 0  # <- SCX603
+    return staged

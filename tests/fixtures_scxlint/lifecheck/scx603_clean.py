@@ -1,0 +1,27 @@
+"""SCX603 clean twin: the same stage-then-reuse shapes with a completion
+barrier — ``jax.block_until_ready`` on the staged value — between the
+async upload and the slot mutation, plus the pad-before-upload ordering
+(the sanctioned arena-resident dispatch pattern: pad, then stage).
+"""
+
+import jax
+
+from sctools_tpu.ingest import upload
+from sctools_tpu.ingest.arena import ColumnArena, arena_capacity
+
+
+def pad_after_barrier(n):
+    arena = ColumnArena(arena_capacity(n))
+    cols = {"cell": arena.column("cell"), "gene": arena.column("gene")}
+    device_value, nbytes = upload(cols, site="fixture.stage")
+    jax.block_until_ready(device_value)
+    arena.pad_in_place(n, arena.capacity)
+    return device_value
+
+
+def pad_then_upload(n):
+    arena = ColumnArena(arena_capacity(n))
+    arena.pad_in_place(n, arena.capacity)
+    view = arena.column("pos")
+    staged, nbytes = upload({"pos": view}, site="fixture.poke")
+    return staged

@@ -1,0 +1,43 @@
+"""SCX604 bad fixture: arrays passed at a donated position of a
+``donate_argnums``/``donate_argnames`` jit site and then read afterwards
+— the interprocedural upgrade of jaxlint's syntactic SCX105 (which only
+checks the jit def itself). The donated buffer is dead the moment the
+call dispatches; XLA may already have reused its memory for the result.
+"""
+
+import functools
+
+from sctools_tpu.obs.xprof import instrument_jit
+
+
+@functools.partial(
+    instrument_jit, name="fixture.step", donate_argnums=(0,)
+)
+def step(state, delta):
+    return state
+
+
+STEP_INLINE = instrument_jit(
+    lambda state: state, name="fixture.step2", donate_argnums=(0,)
+)
+
+STEP_NAMED = instrument_jit(
+    lambda buf: buf, name="fixture.step3", donate_argnames=("buf",)
+)
+
+
+def advance(state, delta):
+    out = step(state, delta)
+    return out + state.sum()  # <- SCX604
+
+
+def advance_inline(state):
+    out = STEP_INLINE(state)
+    if state is not None:  # <- SCX604
+        return out
+    return out
+
+
+def advance_named(buf):
+    out = STEP_NAMED(buf=buf)
+    return out, buf.shape  # <- SCX604

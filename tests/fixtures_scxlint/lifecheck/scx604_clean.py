@@ -1,0 +1,38 @@
+"""SCX604 clean twin: donation used the sanctioned way — the donated
+operand is never read after dispatch. Rebinding the name to the result
+(the in-place-update idiom donation exists for) or simply not touching
+the dead operand again both pass.
+"""
+
+import functools
+
+from sctools_tpu.obs.xprof import instrument_jit
+
+
+@functools.partial(
+    instrument_jit, name="fixture.step", donate_argnums=(0,)
+)
+def step(state, delta):
+    return state
+
+
+STEP_NAMED = instrument_jit(
+    lambda buf: buf, name="fixture.step3", donate_argnames=("buf",)
+)
+
+
+def advance(state, delta):
+    state = step(state, delta)
+    return state + delta
+
+
+def advance_named(buf):
+    out = STEP_NAMED(buf=buf)
+    return out
+
+
+def undonated_operand_read(state, delta):
+    # only position 0 is donated: reading the second operand afterwards
+    # is free
+    out = step(state, delta)
+    return out + delta

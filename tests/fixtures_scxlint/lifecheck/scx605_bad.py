@@ -1,0 +1,25 @@
+"""SCX605 bad fixture: ``np.frombuffer``/``.column()`` views of an arena
+captured BEFORE a ``pad_in_place``/``fill`` of that arena and read
+AFTER it. The read observes post-mutation bytes (pad sentinels, the next
+batch), not the values the view was captured for — re-derive the view
+after the mutation.
+"""
+
+import numpy as np
+
+from sctools_tpu.ingest.arena import ColumnArena, arena_capacity
+
+
+def stale_frombuffer(n):
+    arena = ColumnArena(arena_capacity(n))
+    cells = np.frombuffer(arena.buf, dtype=np.int32, count=n)
+    arena.pad_in_place(n, arena.capacity)
+    return int(cells.sum())  # <- SCX605
+
+
+def stale_column(n, stream):
+    arena = ColumnArena(arena_capacity(n))
+    pos = arena.column("pos")
+    arena.fill(stream)
+    total = int(pos[0])  # <- SCX605
+    return total

@@ -1,0 +1,30 @@
+"""SCX605 clean twin: views re-derived after the mutation, or copied out
+before it — both own (or correctly re-observe) their bytes. The
+read-before-mutation ordering is free, as is padding after every read.
+"""
+
+import numpy as np
+
+from sctools_tpu.ingest.arena import ColumnArena, arena_capacity
+
+
+def rederive_after_pad(n):
+    arena = ColumnArena(arena_capacity(n))
+    arena.pad_in_place(n, arena.capacity)
+    cells = np.frombuffer(arena.buf, dtype=np.int32, count=n)
+    return int(cells.sum())
+
+
+def copy_before_fill(n, stream):
+    arena = ColumnArena(arena_capacity(n))
+    pos = np.copy(arena.column("pos"))
+    arena.fill(stream)
+    return int(pos[0])
+
+
+def read_then_pad(n):
+    arena = ColumnArena(arena_capacity(n))
+    cells = arena.column("cell")
+    total = int(cells[0])
+    arena.pad_in_place(n, arena.capacity)
+    return total

@@ -22,7 +22,14 @@ prove record-level isolation and below-scheduler absorption:
   lock acquisition-order edges are NON-EMPTY, contain ZERO violations
   (no cycles, no stalls, no edges unknown to the static model), and
   form a subgraph of the static scx-race lock-order graph — the live
-  validation of the SCX401-404 model (docs/static_analysis.md).
+  validation of the SCX401-404 model (docs/static_analysis.md);
+- the frame-generation witness (``SCTOOLS_TPU_FRAME_DEBUG=1``,
+  sctools_tpu.ingest.framedebug) engaged in every worker of the FAULTED
+  run: a non-empty stamped-frame count and ZERO stale-generation
+  violations — the device-fault cocktail (OOM bisection slicing frames,
+  transient retries re-dispatching them, poison isolation filtering
+  them) all stayed inside the ring's retention window, the live
+  validation of the SCX601-605 scx-life model.
 
 Exit 0 on success; any assertion failure is a gate failure.
 """
@@ -177,7 +184,12 @@ def main() -> int:
     bam = os.path.join(workdir, "input.bam")
     make_input(bam)
 
-    from witness_smoke import arm_lock_witness, check_lock_dumps
+    from witness_smoke import (
+        arm_frame_witness,
+        arm_lock_witness,
+        check_frame_dumps,
+        check_lock_dumps,
+    )
 
     from sctools_tpu.guard.quarantine import load_quarantine
     from sctools_tpu.obs import xprof
@@ -187,6 +199,12 @@ def main() -> int:
     # with SCTOOLS_TPU_LOCK_DEBUG=1 and validates its observed
     # acquisition order against this file (launch() inherits os.environ)
     graph = arm_lock_witness(REPO_ROOT, workdir)
+    # and the scx-life frame witness: ring frames generation-stamped
+    # over poisoned recycled slots in every worker (both runs inherit it;
+    # the faulted run is the one whose dumps are asserted below — the
+    # recovery ladders slicing/retrying/filtering frames under faults is
+    # exactly where a retention-window bug would hide)
+    arm_frame_witness()
 
     # ---- the chunk set, and its expected-output twin -------------------
     fault_dir = os.path.join(workdir, "faulted")
@@ -299,6 +317,13 @@ def main() -> int:
         os.path.join(fault_dir, "trace"), graph, expect_dumps=2
     )
 
+    # the frame witness engaged in both workers of the faulted run:
+    # stamped frames, zero stale-generation touches through the whole
+    # fault cocktail (bisection, retries, poison filtering)
+    stamped = check_frame_dumps(
+        os.path.join(fault_dir, "trace"), expect_dumps=2
+    )
+
     # `sched status` surfaces the quarantined records and still exits 0
     # (tasks all committed)
     from io import StringIO
@@ -327,6 +352,7 @@ def main() -> int:
                 "witness_edges": sorted(
                     f"{a} -> {b}" for a, b in observed
                 ),
+                "frames_stamped": stamped,
             }
         )
     )

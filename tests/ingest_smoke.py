@@ -12,7 +12,13 @@ the ingest contracts are held:
 - ZERO steady-state retraces across both workers' merged efficiency
   report (the ring's fixed-capacity batches exist to make this 0);
 - the transfer ledger reconciles byte-for-byte with the upload/writeback
-  span bytes in the traces (gatherer accounting == ledger == spans).
+  span bytes in the traces (gatherer accounting == ledger == spans);
+- the scx-life generation witness (``SCTOOLS_TPU_FRAME_DEBUG=1``,
+  sctools_tpu.ingest.framedebug) engaged in every worker: a non-empty
+  stamped-frame count and ZERO stale-generation violations — the live
+  validation of the SCX601-605 frame-lifetime model
+  (docs/static_analysis.md): every consumer loop stayed inside the
+  ring's retention window with poisoned recycled slots underneath it.
 
 Exit 0 on success; any assertion failure is a gate failure. Run a worker
 directly with: python tests/ingest_smoke.py worker <bam> <out_stem>.
@@ -152,11 +158,19 @@ def main() -> int:
     ) or tempfile.mkdtemp(prefix="sctools_tpu_ingest_smoke.")
     os.makedirs(workdir, exist_ok=True)
 
+    from witness_smoke import arm_frame_witness, check_frame_dumps
+
     from sctools_tpu import native
     from sctools_tpu.obs import xprof
 
     if not native.available():
         fail("native layer unavailable — the arena ring cannot be gated")
+
+    # scx-life runtime witness: both workers run with FRAME_DEBUG=1
+    # (launch() inherits os.environ) — ring frames come out generation-
+    # stamped over poisoned recycled slots, so any retention-window
+    # breach in the pipeline raises in the worker instead of passing
+    arm_frame_witness()
 
     bams = []
     for i in range(2):
@@ -255,6 +269,13 @@ def main() -> int:
             f"d2h reconciliation broke: ledger={ledger_d2h}, "
             f"spans={span_totals['writeback']}, gatherers={gatherer_d2h}"
         )
+
+    # ---- the frame witness engaged, violation-free, in both workers
+    stamped = check_frame_dumps(os.path.join(workdir, "obs"), expect_dumps=2)
+    print(
+        f"ingest-smoke: frame witness stamped {stamped} frame(s), "
+        "0 stale-generation violations"
+    )
 
     print(
         f"ingest-smoke: OK (h2d {ledger_h2d} bytes == spans == gatherers; "

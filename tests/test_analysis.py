@@ -16,6 +16,11 @@ The acceptance contract of the analysis subsystem:
 - the runtime lock witness proxies record acquisition order, detect a
   constructed ABBA cycle and a static-graph divergence, and are a TRUE
   no-op (raw threading primitives) when SCTOOLS_TPU_LOCK_DEBUG is off;
+- each SCX6xx frame-lifetime rule fires EXACTLY on its bad fixture's
+  marked lines and stays silent on the clean twin; the real tree carries
+  no unsuppressed SCX6xx finding; the ingest package is ownership-exempt
+  (its runtime twin, the generation witness, is pinned in
+  tests/test_ingest.py);
 - the CLI exits 0 on the repository's own tree (the merge gate) and
   non-zero on the bad corpus.
 """
@@ -32,6 +37,7 @@ from sctools_tpu.analysis import (
     audit_suppressions,
     build_shape_contract,
     check_abi,
+    check_life,
     check_races,
     check_shards,
     check_signatures,
@@ -1081,6 +1087,7 @@ def test_cli_module_invocation():
     assert result.returncode == 0, result.stderr
     assert "SCX101" in result.stdout and "SCX303" in result.stdout
     assert "SCX404" in result.stdout and "SCX505" in result.stdout
+    assert "SCX605" in result.stdout
 
 
 def test_cli_race_only(capsys):
@@ -1166,3 +1173,173 @@ def test_cli_json_clean_tree_is_empty(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert json.loads(out)["findings"] == []
+
+
+# ----------------------------------------------------- lifecheck (SCX6xx)
+
+LIFE = os.path.join(FIXTURES, "lifecheck")
+LIFE_RULE_IDS = ["SCX601", "SCX602", "SCX603", "SCX604", "SCX605"]
+
+
+@pytest.mark.parametrize("rule", LIFE_RULE_IDS)
+def test_life_rule_fires_exactly_on_marked_lines(rule):
+    path = os.path.join(LIFE, f"{rule.lower()}_bad.py")
+    findings = check_life([path])
+    assert findings, f"{rule} bad fixture produced no findings"
+    assert {f.rule for f in findings} == {rule}
+    expected = _marked_lines(path, rule)
+    assert expected, f"fixture {path} has no # <- {rule} markers"
+    assert sorted(f.line for f in findings) == expected, [
+        f.render() for f in findings
+    ]
+
+
+@pytest.mark.parametrize("rule", LIFE_RULE_IDS)
+def test_life_rule_silent_on_clean_fixture(rule):
+    findings = check_life(
+        [os.path.join(LIFE, f"{rule.lower()}_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_life_real_tree_is_clean():
+    # the audit contract: every SCX601-605 finding on the real tree is
+    # fixed or carries a justified inline suppression — currently zero of
+    # either, and this pin keeps it that way
+    findings = check_life(TREE)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_life_inline_suppression(tmp_path):
+    src = (
+        "from sctools_tpu.ingest import ring_frames\n\n\n"
+        "class Holder:\n"
+        "    def __init__(self):\n"
+        "        self.last = None\n\n"
+        "    def consume(self, bam):\n"
+        "        for frame in ring_frames(bam, 4096):\n"
+        "            self.last = frame  "
+        "# scx-lint: disable=SCX601 -- single-batch tool, ring exhausted\n"
+    )
+    path = tmp_path / "suppressed_life.py"
+    path.write_text(src)
+    assert check_life([str(path)]) == []
+
+
+def test_life_ingest_dir_is_exempt(tmp_path):
+    # the ingest package OWNS the buffer lifecycle (arena recycling, the
+    # slot budget, the generation witness): its own view handling is the
+    # mechanism, not a violation — the same immediate-parent ownership
+    # line SCX112/SCX113 draw
+    src = (
+        "from sctools_tpu.ingest import ring_frames\n\n\n"
+        "class Holder:\n"
+        "    def __init__(self):\n"
+        "        self.last = None\n\n"
+        "    def consume(self, bam):\n"
+        "        for frame in ring_frames(bam, 4096):\n"
+        "            self.last = frame\n"
+    )
+    ingest_dir = tmp_path / "ingest"
+    ingest_dir.mkdir()
+    (ingest_dir / "staging.py").write_text(src)
+    assert check_life([str(ingest_dir / "staging.py")]) == []
+    outside = tmp_path / "staging.py"
+    outside.write_text(src)
+    findings = check_life([str(outside)])
+    assert {f.rule for f in findings} == {"SCX601"}
+    # only the IMMEDIATE parent confers ownership
+    nested = ingest_dir / "sub"
+    nested.mkdir()
+    (nested / "staging.py").write_text(src)
+    findings = check_life([str(nested / "staging.py")])
+    assert {f.rule for f in findings} == {"SCX601"}
+
+
+def test_life_frame_iter_taint_crosses_calls(tmp_path):
+    # the gatherer pattern: ring_frames() is consumed by a helper the
+    # iterable is PASSED to — the consumer loop lives in the callee, so
+    # frame-source-ness must follow the argument through the call graph
+    src = (
+        "from sctools_tpu.ingest import ring_frames\n\n\n"
+        "class Pipeline:\n"
+        "    def __init__(self):\n"
+        "        self.tail = None\n\n"
+        "    def run(self, bam):\n"
+        "        frames = ring_frames(bam, 4096)\n"
+        "        self._drain(frames)\n\n"
+        "    def _drain(self, frames):\n"
+        "        for frame in frames:\n"
+        "            self.tail = frame\n"
+    )
+    path = tmp_path / "taint_life.py"
+    path.write_text(src)
+    findings = check_life([str(path)])
+    assert [(f.rule, f.line) for f in findings] == [("SCX601", 14)], [
+        f.render() for f in findings
+    ]
+
+
+def test_life_copy_launders_the_carry(tmp_path):
+    # an uncopied cross-iteration carry overflows the window; the same
+    # loop with copy_frame on the carry is inside it
+    bad = (
+        "from sctools_tpu.ingest import ring_frames\n\n\n"
+        "def consume(bam):\n"
+        "    frames = ring_frames(bam, 4096)\n"
+        "    it = iter(frames)\n"
+        "    prev = None\n"
+        "    for frame in frames:\n"
+        "        look = next(it, None)\n"
+        "        if prev is not None:\n"
+        "            print(prev.n_records)\n"
+        "        prev = frame\n"
+    )
+    path = tmp_path / "overflow_life.py"
+    path.write_text(bad)
+    assert {f.rule for f in check_life([str(path)])} == {"SCX602"}
+    good = bad.replace(
+        "from sctools_tpu.ingest import ring_frames\n",
+        "from sctools_tpu.ingest import ring_frames\n"
+        "from sctools_tpu.io.packed import copy_frame\n",
+    ).replace("prev = frame\n", "prev = copy_frame(frame)\n")
+    path.write_text(good)
+    assert check_life([str(path)]) == []
+
+
+def test_cli_life_only(capsys):
+    rc = cli_main(["--life-only"] + TREE)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "passes: life" in out
+
+
+def test_cli_life_only_fails_on_bad_corpus(capsys):
+    rc = cli_main(["-q", "--life-only", LIFE])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for rule in LIFE_RULE_IDS:
+        assert rule in out, (rule, out)
+
+
+def test_cli_three_model_passes_compose(capsys):
+    # the `make modelcheck` shape: all three whole-package passes in one
+    # process over one shared parse
+    rc = cli_main(
+        ["--race-only", "--shard-only", "--life-only", RACE, SHARD, LIFE]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "SCX401" in out and "SCX501" in out and "SCX601" in out
+    assert "passes: race, shard, life" in out
+
+
+def test_cli_json_covers_life_pass(capsys):
+    rc = cli_main(["--json", "--life-only", LIFE])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    rules = {f["rule"] for f in payload["findings"]}
+    assert set(LIFE_RULE_IDS) <= rules, rules
+    for finding in payload["findings"]:
+        assert finding["path"] and finding["line"] > 0 and finding["message"]

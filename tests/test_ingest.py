@@ -637,3 +637,151 @@ def test_ring_downgrade_tail_failure_chains_native_error(
             pass
     assert isinstance(info.value.__cause__, NativeDecodeError)
     assert info.value.__cause__.batch_index == 2
+
+
+# ---------------------------------------------------------------------------
+# scx-life runtime generation witness (SCTOOLS_TPU_FRAME_DEBUG)
+
+
+class _FakeFillStream:
+    """Minimal NativeBatchStream stand-in for arena/ring lifecycle tests."""
+
+    def __init__(self, batches=2, n=8):
+        self.batches = batches
+        self.n = n
+
+    def next(self, batch_records):
+        self.batches -= 1
+        return self.n if self.batches >= 0 else 0
+
+    def fill_arena(self, buf, capacity):
+        return self.n
+
+    def vocab(self, kind):
+        return ["x"]
+
+    def close(self):
+        pass
+
+
+def _debug_arena(monkeypatch, capacity=64):
+    from sctools_tpu.ingest import framedebug
+
+    monkeypatch.setenv(framedebug.ENV_FLAG, "1")
+    framedebug.reset()
+    return ColumnArena(capacity)
+
+
+def test_frame_debug_off_is_raw_frames(monkeypatch):
+    # off means OFF: the arena hands out the very ReadFrame class it
+    # handed out before the witness existed, reclaim does not poison,
+    # and a stale touch passes silently (the pre-witness behavior)
+    from sctools_tpu.ingest import framedebug
+    from sctools_tpu.io.packed import ReadFrame
+
+    monkeypatch.delenv(framedebug.ENV_FLAG, raising=False)
+    arena = ColumnArena(64)
+    arena.column("cell")[:4] = [1, 2, 3, 4]
+    frame = arena.frame(4, ["a"], ["b"], ["c"])
+    assert type(frame) is ReadFrame
+    arena.reclaim()
+    assert not arena.poisoned
+    assert list(frame.cell) == [1, 2, 3, 4]  # no raise, raw view
+    assert arena.generation == 1  # the counter itself is always on
+
+
+def test_frame_debug_stale_touch_raises(monkeypatch):
+    from sctools_tpu.ingest import framedebug
+
+    arena = _debug_arena(monkeypatch)
+    arena.slot = 2
+    arena.column("cell")[:4] = [1, 2, 3, 4]
+    frame = arena.frame(4, ["a"], ["b"], ["c"], batch_index=5)
+    assert isinstance(frame, framedebug.WitnessFrame)
+    assert list(frame.cell) == [1, 2, 3, 4]  # live: passes the check
+    arena.reclaim()
+    with pytest.raises(framedebug.StaleFrameError, match="slot 2"):
+        _ = frame.cell
+    (violation,) = framedebug.violations()
+    assert violation["slot"] == 2
+    assert violation["batch_index"] == 5
+    assert violation["stamped_generation"] == 0
+    assert violation["arena_generation"] == 1
+    assert violation["column"] == "cell"
+    assert "test_ingest" in violation["site"]
+
+
+def test_frame_debug_poison_sentinel_visible(monkeypatch):
+    from sctools_tpu.ingest import framedebug
+
+    arena = _debug_arena(monkeypatch)
+    arena.column("cell")[:8] = np.arange(8)
+    raw = np.frombuffer(arena.buf, dtype=np.uint8, count=64)
+    arena.reclaim()
+    # a raw retained view reads deterministic sentinel bytes during the
+    # refill window, not plausible stale data
+    assert arena.poisoned
+    assert (raw == framedebug.POISON_BYTE).all()
+    arena.fill(_FakeFillStream(n=8))
+    assert not arena.poisoned  # refilled: real data again
+
+
+def test_frame_debug_slice_inherits_copy_sheds(monkeypatch):
+    from sctools_tpu.ingest import framedebug
+    from sctools_tpu.io.packed import ReadFrame, slice_frame
+
+    arena = _debug_arena(monkeypatch)
+    arena.column("cell")[:4] = [9, 8, 7, 6]
+    frame = arena.frame(4, ["a"], ["b"], ["c"])
+    part = slice_frame(frame, 0, 2)
+    assert isinstance(part, framedebug.WitnessFrame)
+    kept = copy_frame(frame)
+    assert type(kept) is ReadFrame  # the copy owns its memory: no stamp
+    arena.reclaim()
+    with pytest.raises(framedebug.StaleFrameError):
+        _ = part.umi  # the view inherited the stamp
+    assert list(kept.cell) == [9, 8, 7, 6]  # the copy survives recycling
+
+
+def test_frame_debug_stamped_count_and_dump_roundtrip(monkeypatch, tmp_path):
+    from sctools_tpu.ingest import framedebug
+
+    arena = _debug_arena(monkeypatch)
+    arena.frame(4, ["a"], ["b"], ["c"])
+    arena.frame(2, ["a"], ["b"], ["c"])
+    assert framedebug.stamped_count() == 2
+    target = tmp_path / "frames.test.json"
+    written = framedebug.dump(str(target))
+    assert written == str(target)
+    import json
+
+    payload = json.loads(target.read_text())
+    assert payload["enabled"] is True
+    assert payload["stamped"] == 2
+    assert payload["violations"] == []
+
+
+def test_ring_flight_section_carries_generations(monkeypatch):
+    # the ring's flight-record section now names per-slot generation
+    # counters and poison state, so a postmortem shows how far each slot
+    # rotated (and, under FRAME_DEBUG, whether the process died inside a
+    # poisoned refill window)
+    from sctools_tpu.ingest import ring
+
+    monkeypatch.delenv("SCTOOLS_TPU_FRAME_DEBUG", raising=False)
+    arenas = [ColumnArena(64) for _ in range(3)]
+    produced = ring._produce_arena_frames(
+        _FakeFillStream(batches=2), arenas, 8, False
+    )
+    try:
+        next(produced)
+        entries = ring._ring_snapshot()
+        assert entries, "ring state missing from the flight section"
+        entry = entries[-1]
+        assert entry["generations"][0] >= 1
+        assert entry["generations"][1:] == [0, 0]
+        assert entry["poisoned"] == [False, False, False]
+        assert [a.slot for a in arenas] == [0, 1, 2]
+    finally:
+        produced.close()
+    assert ring._ring_snapshot() == []  # state dropped on close

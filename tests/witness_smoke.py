@@ -1,8 +1,11 @@
-"""Shared lock-witness arming + dump validation for the smoke scripts.
+"""Shared witness arming + dump validation for the smoke scripts.
 
 guard-smoke and fleet-smoke both run their workers under
 ``SCTOOLS_TPU_LOCK_DEBUG=1`` against the static scx-race graph and then
-assert the same contract over the ``locks.*.json`` dumps; the contract
+assert the same contract over the ``locks.*.json`` dumps; guard-smoke
+and ingest-smoke likewise run under ``SCTOOLS_TPU_FRAME_DEBUG=1`` (the
+scx-life generation witness) and assert the ``frames.*.json`` dumps show
+the witness engaged with zero stale-generation violations. Each contract
 lives here once so a dump-schema change has a single place to land.
 """
 
@@ -69,3 +72,44 @@ def check_lock_dumps(dump_dir, graph, expect_dumps=None):
         f"observed lock-order edges missing from the static model: {unknown}"
     )
     return observed
+
+
+def arm_frame_witness():
+    """Arm the scx-life generation witness for worker subprocesses.
+
+    Sets ``SCTOOLS_TPU_FRAME_DEBUG=1`` in ``os.environ`` (worker
+    ``launch()`` inherits it): ring frames come out stamped with their
+    slot generation, recycled slots are poisoned, and a consumer touch
+    past the retention window raises instead of reading recycled memory.
+    """
+    os.environ["SCTOOLS_TPU_FRAME_DEBUG"] = "1"
+
+
+def check_frame_dumps(dump_dir, expect_dumps=None):
+    """Validate every ``frames.*.json`` dump under ``dump_dir``.
+
+    The witness must have ENGAGED (a non-empty stamped-frame count
+    across the dumps — a run that never stamped a frame validated
+    nothing) and observed ZERO stale-generation violations: every
+    consumer loop stayed inside the ring's retention window, live proof
+    of the scx-life SCX601/602 model. Returns the total stamped count.
+    """
+    frame_dumps = glob.glob(os.path.join(dump_dir, "frames.*.json"))
+    if expect_dumps is not None:
+        assert len(frame_dumps) == expect_dumps, (
+            f"frame witness dumps missing: {frame_dumps}"
+        )
+    else:
+        assert frame_dumps, f"no frame-witness dump under {dump_dir}"
+    stamped = 0
+    for dump_path in frame_dumps:
+        with open(dump_path, encoding="utf-8") as f:
+            dump = json.load(f)
+        assert dump["enabled"], dump_path
+        assert dump["violations"] == [], (dump_path, dump["violations"])
+        stamped += int(dump["stamped"])
+    assert stamped > 0, (
+        "frame witness stamped no frames — the ring's native arena path "
+        "never engaged, so the run validated nothing"
+    )
+    return stamped
