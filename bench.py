@@ -26,6 +26,12 @@ Prints ONE JSON line. Flags:
               H2D-only, and overlapped-ring legs with ledger-derived MB/s
               each (docs/ingest.md); --check then holds the ring's
               steady-state H2D to >= 50% of the bulk-probe roofline
+  --wire      include the scx-wire writeback microbench: naive per-column
+              pull vs monoblock vs entity-bucket-compacted vs overlapped
+              D2H legs, each pull paired with an adjacent same-size bulk
+              probe (docs/ingest.md); --check then holds pull_vs_probe
+              (compacted monoblock vs probe, median of pairs) to >= 50%
+              of the bulk-probe roofline (writeback_roofline)
   --check     perf-regression gate: after the run (or over --result FILE,
               skipping the run) compare the headline against BASELINE.json
               and the BENCH_r*.json trajectory; exit 4 when the value
@@ -69,6 +75,11 @@ OCCUPANCY_FLOOR = 0.25
 # overheads (packing stalls, small transfers, queue bubbles) are eating
 # the link again
 INGEST_ROOFLINE_FLOOR = 0.5
+# writeback-roofline floor (ROADMAP item 5, scx-wire): the compacted
+# monoblock D2H pull must reach at least half of what an adjacent bulk
+# probe of the same byte count sustains — below that, the pull side has
+# re-fragmented (per-column pulls, pad-inflated blocks, serialization)
+WRITEBACK_ROOFLINE_FLOOR = 0.5
 # scx-guard no-fault ceiling: routing every batch through the recovery
 # ladder (run_batch: armed-faults check + attempt loop + flight-state
 # bookkeeping) must cost <= 2% of a representative batch's wall — the
@@ -253,9 +264,14 @@ def bench_compute_only() -> float:
         result = compute_entity_metrics(
             device_cols, num_segments=num_segments, kind="cell"
         )
-        # pull a scalar: block_until_ready alone under-reports on tunneled
-        # backends (readiness can be acknowledged before remote completion)
-        return int(np.asarray(result["n_entities"]))
+        # pull a scalar through the D2H door: block_until_ready alone
+        # under-reports on tunneled backends (readiness can be
+        # acknowledged before remote completion); record=False — this leg
+        # isolates compute
+        host, _ = ingest.pull(
+            result["n_entities"], site="bench.compute_only", record=False
+        )
+        return int(host)
 
     run()  # compile + warm
     times = []
@@ -311,7 +327,10 @@ def bench_link_bandwidth() -> dict:
         )
         float(device[0])
         with obs.span("bench:d2h_probe", bytes=buf.nbytes) as timer:
-            np.asarray(device)
+            # record=False: the ledger entry below carries the measured
+            # seconds (the span's own duration) instead of pull-internal
+            # timing, keeping the probe's span and ledger in lockstep
+            ingest.pull(device, site="bench.d2h_probe", record=False)
         xprof.record_transfer(
             "d2h", buf.nbytes, seconds=timer.duration,
             site="bench.d2h_probe",
@@ -478,6 +497,183 @@ def bench_ingest(bam_path: str) -> dict:
     )
     legs["ring_h2d_MBps"] = round(statistics.median(ring_rates), 1)
     legs["ring_vs_probe"] = round(statistics.median(pair_ratios), 3)
+    return legs
+
+
+def bench_wire() -> dict:
+    """scx-wire microbench: the writeback legs of the transfer wall.
+
+    One D2H rate per transport shape, so a writeback regression names its
+    shape instead of hiding in the e2e headline. Every pull is timed
+    through the ``ingest.pull`` ledger and immediately paired with a bulk
+    probe pull of the SAME byte count (one contiguous device-resident
+    buffer), the weather-cancelling discipline of ``--ingest``:
+
+    - ``naive_MBps``: one pull per result column at padded record length
+      — the pre-monoblock shape (~38 buffers, each paying the link's
+      fixed per-buffer toll);
+    - ``monoblock_MBps``: the fused [columns, k] int32 block at the
+      padded record count (one buffer, still pad-inflated);
+    - ``compacted_MBps``: the same block at the ENTITY bucket
+      (ops.segments.entity_bucket) — the production shape: one buffer,
+      sized to occupied rows;
+    - ``overlapped_drain_ms``: the compacted block's residual drain time
+      when its D2H was kicked at dispatch time (WritebackRing.stage) and
+      the next batch's compute ran in between — the production pipeline
+      shape;
+    - ``pull_vs_probe``: median of per-pair ``t_probe / t_pull`` ratios
+      for the COMPACTED leg. This is the number ROADMAP item 5 gates:
+      ``--check`` holds it >= 0.5 (``writeback_roofline``) when the
+      microbench rides a result.
+    """
+    import numpy as np
+
+    from sctools_tpu import ingest
+    from sctools_tpu.metrics.device import (
+        compact_results_wire,
+        compute_entity_metrics,
+    )
+    from sctools_tpu.metrics.gatherer import wire_result_names
+    from sctools_tpu.metrics.schema import CELL_COLUMNS
+    from sctools_tpu.ops.segments import bucket_size, entity_bucket
+    from sctools_tpu.utils import make_synthetic_columns
+
+    cols = make_synthetic_columns(
+        BATCH_RECORDS, n_cells=N_CELLS, n_genes=N_GENES, seed=7
+    )
+    # already a bucket (make_synthetic_columns pads); the explicit
+    # bucket_size keeps the static shape discipline visible to scx-shard
+    num_segments = bucket_size(len(cols["valid"]))
+    device_cols, _ = ingest.upload(cols, site="bench.wire_setup", record=False)
+    result = compute_entity_metrics(
+        device_cols, num_segments=num_segments, kind="cell"
+    )
+    n_entities = int(
+        ingest.pull(
+            result["n_entities"], site="bench.wire_setup", record=False
+        )[0]
+    )
+    int_names, float_names = wire_result_names(CELL_COLUMNS)
+    k_compact = entity_bucket(n_entities, num_segments)
+    n_cols = len(int_names) + len(float_names)
+    legs = {
+        "n_entities": n_entities,
+        "k_compacted": k_compact,
+        "k_monoblock": num_segments,
+        "result_columns": n_cols,
+    }
+
+    import jax
+
+    def timed_pull(site: str, value) -> float:
+        before = _ledger_site_entry("d2h", site)["seconds"]
+        ingest.pull(value, site=site, timed=True)
+        return _ledger_site_entry("d2h", site)["seconds"] - before
+
+    probe_host = {}
+
+    def fresh_probe(nbytes: int):
+        # a FRESH device-resident bulk buffer per pull: jax.Array caches
+        # its host copy after the first materialization, so re-pulling
+        # one buffer would time a cache lookup, not a transfer. The host
+        # staging buffer is reused; only the device value is fresh.
+        if nbytes not in probe_host:
+            probe_host[nbytes] = np.zeros(max(nbytes // 4, 1), np.int32)
+        device, _ = ingest.upload(
+            probe_host[nbytes], site="bench.wire_probe", record=False
+        )
+        float(device[0])  # ensure the upload landed before the timed pull
+        return device
+
+    def fresh_block(k: int):
+        # a fresh compacted device block per pull (new dispatch -> new
+        # output buffer, same cache-hit rationale as fresh_probe), made
+        # READY before timing so the pull measures transfer, not compute
+        block = compact_results_wire(result, int_names, float_names, k)
+        jax.block_until_ready(block)
+        return block
+
+    def paired(site: str, k: int, rounds: int = 3):
+        rates, ratios = [], []
+        nbytes = 0
+        for _ in range(rounds):
+            block = fresh_block(k)
+            nbytes = int(block.nbytes)
+            t_pull = timed_pull(site, block)
+            t_probe = timed_pull("bench.wire_probe", fresh_probe(nbytes))
+            rates.append(nbytes / 1e6 / max(t_pull, 1e-9))
+            ratios.append(max(t_probe, 1e-9) / max(t_pull, 1e-9))
+        return (
+            nbytes,
+            round(statistics.median(rates), 1),
+            round(statistics.median(ratios), 3),
+        )
+
+    # ---- naive: one pull per result column at padded length (a fresh
+    # compute dispatch per round — fresh output buffers, made ready so
+    # the pulls time transfers)
+    names = (*int_names, *float_names)
+    naive_rates = []
+    naive_bytes = 0
+    for _ in range(3):
+        fresh = compute_entity_metrics(
+            device_cols, num_segments=num_segments, kind="cell"
+        )
+        column_values = [fresh[name] for name in names]
+        jax.block_until_ready(column_values)
+        naive_bytes = sum(int(v.nbytes) for v in column_values)
+        with obs.span("bench:wire_naive", bytes=naive_bytes) as timer:
+            for value in column_values:
+                ingest.pull(
+                    value, site="bench.wire_naive", timed=True
+                )
+        naive_rates.append(naive_bytes / 1e6 / max(timer.duration, 1e-9))
+        timed_pull("bench.wire_probe", fresh_probe(naive_bytes))
+    legs["naive_bytes"] = naive_bytes
+    legs["naive_MBps"] = round(statistics.median(naive_rates), 1)
+
+    # ---- monoblock at the padded record count (one buffer, pad-heavy)
+    (
+        legs["monoblock_bytes"],
+        legs["monoblock_MBps"],
+        _,
+    ) = paired("bench.wire_mono", num_segments)
+
+    # ---- compacted at the entity bucket (the production shape) + the
+    # gated pull-vs-probe ratio
+    (
+        legs["compacted_bytes"],
+        legs["compacted_MBps"],
+        legs["pull_vs_probe"],
+    ) = paired("bench.wire_compact", k_compact)
+
+    # ---- overlapped: stage (async copy) -> next batch's compute -> drain
+    ring = ingest.WritebackRing(name="bench.wire", slots=2)
+    try:
+        drains = []
+        for _ in range(3):
+            block = compact_results_wire(
+                result, int_names, float_names, k_compact
+            )
+            block = ring.stage(block)
+            # the next batch's compute, dispatched while the copy runs
+            next_result = compute_entity_metrics(
+                device_cols, num_segments=num_segments, kind="cell"
+            )
+            with obs.span("bench:wire_drain") as timer:
+                ring.collect(
+                    block, site="bench.wire_overlap", record=False
+                )
+            drains.append(timer.duration)
+            ingest.pull(
+                next_result["n_entities"], site="bench.wire_setup",
+                record=False,
+            )
+        legs["overlapped_drain_ms"] = round(
+            statistics.median(drains) * 1e3, 3
+        )
+    finally:
+        ring.close()
     return legs
 
 
@@ -875,6 +1071,19 @@ def check_result(
             value=ingest_legs["ring_vs_probe"],
             floor=INGEST_ROOFLINE_FLOOR,
         )
+    # scx-wire writeback roofline, held whenever the result carries the
+    # microbench (bench --wire): the compacted monoblock pull vs the bulk
+    # probe of the same byte count — the D2H mirror of ingest_roofline
+    wire_legs = result.get("wire")
+    if isinstance(wire_legs, dict) and isinstance(
+        wire_legs.get("pull_vs_probe"), (int, float)
+    ):
+        add(
+            "writeback_roofline",
+            wire_legs["pull_vs_probe"] >= WRITEBACK_ROOFLINE_FLOOR,
+            value=wire_legs["pull_vs_probe"],
+            floor=WRITEBACK_ROOFLINE_FLOOR,
+        )
     # scx-guard no-fault overhead, held whenever the result carries the
     # microbench: the recovery ladder wraps every batch dispatch, so its
     # idle cost regressing past ~2% is a hot-path regression
@@ -950,6 +1159,14 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         "ingest": {"ring_h2d_MBps": 80.0, "h2d_MBps": 100.0,
                    "ring_vs_probe": 0.8},
     }
+    wire_stalled = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "wire": {"compacted_MBps": 5.0, "pull_vs_probe": 0.1},
+    }
+    wire_healthy = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "wire": {"compacted_MBps": 80.0, "pull_vs_probe": 0.9},
+    }
     guard_heavy = {
         "metric": metric, "value": reference, "vs_baseline": 5.0,
         "guard": {"overhead": 1.25},
@@ -989,6 +1206,10 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         failures.append("below-roofline ingest result passed the gate")
     if not check_result(ingest_healthy, repo_dir)["ok"]:
         failures.append("healthy ingest result failed the gate")
+    if check_result(wire_stalled, repo_dir)["ok"]:
+        failures.append("below-roofline writeback result passed the gate")
+    if not check_result(wire_healthy, repo_dir)["ok"]:
+        failures.append("healthy writeback result failed the gate")
     if check_result(guard_heavy, repo_dir)["ok"]:
         failures.append("over-ceiling guard overhead passed the gate")
     if not check_result(guard_light, repo_dir)["ok"]:
@@ -1018,6 +1239,7 @@ def main(argv=None):
     parser.add_argument("--breakdown", action="store_true")
     parser.add_argument("--sched", action="store_true")
     parser.add_argument("--ingest", action="store_true")
+    parser.add_argument("--wire", action="store_true")
     parser.add_argument("--check", action="store_true")
     parser.add_argument(
         "--result", metavar="FILE",
@@ -1104,6 +1326,8 @@ def main(argv=None):
         result["sched_overhead"] = bench_sched_overhead()
     if args.ingest:
         result["ingest"] = bench_ingest(bam_path)
+    if args.wire:
+        result["wire"] = bench_wire()
     # always measured (cheap): the guard ladder's no-fault cost and the
     # frame witness's off-mode handout cost ride the trajectory so
     # --check can hold both to their <= 2% ceilings

@@ -77,6 +77,21 @@ Rule catalog (docs/static_analysis.md has the rationale for each):
   ``guard.retrying`` instead. Handlers that re-raise (cleanup-then-raise,
   e.g. the gatherers' discard-on-error) are fine; files under ``guard/``
   (the recovery ladder itself) are exempt.
+- SCX114 device-pull-outside-wire: the SCX112 pattern mirrored to the
+  pull side. Bare ``jax.device_get`` (attribute or import form), any
+  ``.copy_to_host_async`` access, or ``np.asarray``/``np.array`` applied
+  to a DEVICE value outside the scx-ingest subsystem. A pull outside
+  ``ingest/`` is a device->host crossing the transfer ledger never sees
+  — the D2H reconciliation gates and the writeback roofline go blind to
+  its bytes — and it skips the guard transient ladder and the ``pull``
+  stall watchdog. Materialize through ``sctools_tpu.ingest.pull(value,
+  site=...)`` instead. "Device value" is tracked syntactically, per
+  scope: a name assigned from an engine dispatch
+  (``compute_entity_metrics``, ``count_molecules``, the sharded/sort
+  variants, ``compact_results[_wire]``) or from ``ingest.upload``'s
+  staged result — plus subscripts of such names. ``np.asarray`` on host
+  arrays is everywhere and stays legal. Files under ``ingest/`` and
+  ``platform.py`` are exempt.
 """
 
 from __future__ import annotations
@@ -102,6 +117,7 @@ JAX_RULES = {
     "SCX111": "uninstrumented-jit",
     "SCX112": "device-put-outside-ingest",
     "SCX113": "unguarded-device-boundary",
+    "SCX114": "device-pull-outside-wire",
 }
 
 # files allowed to mutate process-global jax.config (SCX106)
@@ -120,6 +136,11 @@ DEVICE_PUT_OWNER_DIRS = ("ingest",)
 _DEVICE_PUT_NAMES = (
     "device_put", "device_put_replicated", "device_put_sharded",
 )
+# files / owning directory allowed bare device->host pulls (SCX114): the
+# scx-ingest subsystem IS the boundary (ingest/wire.py implements the
+# pull choke point every other call site must use)
+DEVICE_PULL_OWNERS = ("platform.py",)
+DEVICE_PULL_OWNER_DIRS = ("ingest",)
 # the recovery ladder itself owns its try/except (SCX113): its attempt
 # loops ARE the sanctioned broad handlers every other call site routes
 # through
@@ -137,6 +158,12 @@ _BOUNDARY_CALL_NAMES = frozenset(
         "distributed_sort",
     )
 )
+# calls whose result is a DEVICE value (SCX114 taint sources): the engine
+# dispatches above plus the on-device result compactors
+_DEVICE_PRODUCER_NAMES = _BOUNDARY_CALL_NAMES | {
+    "compact_results",
+    "compact_results_wire",
+}
 
 _JNP_CONSTRUCTORS = {
     "array", "asarray", "zeros", "ones", "full", "arange", "empty",
@@ -941,6 +968,185 @@ class JaxLinter:
                         "sctools_tpu.ingest instead",
                     )
 
+    # -- SCX114 ------------------------------------------------------------
+
+    def _is_producer_call(self, node: ast.AST) -> bool:
+        """Whether ``node`` is a call returning a device value."""
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        if isinstance(func, ast.Name):
+            return func.id in _DEVICE_PRODUCER_NAMES
+        if isinstance(func, ast.Attribute):
+            return func.attr in _DEVICE_PRODUCER_NAMES
+        return False
+
+    def _is_upload_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        if isinstance(func, ast.Name):
+            return func.id in self.aliases.upload_names
+        return (
+            isinstance(func, ast.Attribute)
+            and func.attr == "upload"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in self.aliases.ingest_mods
+        )
+
+    @staticmethod
+    def _scope_walk(scope: ast.AST):
+        """Walk a scope's own statements.
+
+        For a module scope, function bodies are excluded (their names are
+        local); for a function scope everything inside walks, nested defs
+        included (closures see the enclosing names).
+        """
+        if isinstance(scope, ast.Module):
+            stack = list(ast.iter_child_nodes(scope))
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue
+                yield node
+                stack.extend(ast.iter_child_nodes(node))
+        else:
+            yield from ast.walk(scope)
+
+    def _tainted_names(self, scope: ast.AST) -> Set[str]:
+        """Names bound to device values within one scope (syntactic).
+
+        Sources: ``x = <producer>(...)`` (and subscripts of that call),
+        ``x, n = ingest.upload(...)`` / ``x = ingest.upload(...)[0]``
+        (the staged device value), and alias copies of tainted names.
+        Two passes so order of definition within the scope cannot hide a
+        late alias. Deliberately per-scope and rebind-insensitive —
+        documented model limits; the fixture twins pin the behavior.
+        """
+        tainted: Set[str] = set()
+        for _ in range(2):
+            for node in self._scope_walk(scope):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                target = node.targets[0]
+                value = node.value
+                base = value
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if self._is_producer_call(base):
+                    names = (
+                        [e for e in target.elts if isinstance(e, ast.Name)]
+                        if isinstance(target, ast.Tuple)
+                        else [target] if isinstance(target, ast.Name) else []
+                    )
+                    tainted.update(n.id for n in names)
+                elif self._is_upload_call(base):
+                    if (
+                        isinstance(target, ast.Tuple)
+                        and target.elts
+                        and isinstance(target.elts[0], ast.Name)
+                    ):
+                        # x, nbytes = ingest.upload(...): x is on device
+                        tainted.add(target.elts[0].id)
+                    elif isinstance(value, ast.Subscript) and isinstance(
+                        target, ast.Name
+                    ):
+                        tainted.add(target.id)  # x = ingest.upload(...)[0]
+                elif (
+                    isinstance(base, ast.Name)
+                    and base.id in tainted
+                    and isinstance(target, ast.Name)
+                ):
+                    tainted.add(target.id)
+        return tainted
+
+    def _check_device_pull(self) -> None:
+        """Bare device->host pulls outside the ingest subsystem (SCX114).
+
+        The SCX112 pattern mirrored to the pull side: a pull outside
+        ``ingest/`` is a D2H crossing the transfer ledger never sees (the
+        reconciliation gates and the writeback roofline go blind to its
+        bytes) and it skips the guard transient ladder and the ``pull``
+        watchdog. Materialize through ``sctools_tpu.ingest.pull``.
+        """
+        if os.path.basename(self.path) in DEVICE_PULL_OWNERS:
+            return
+        parts = os.path.normpath(self.path).split(os.sep)
+        # only the IMMEDIATE parent directory confers ownership (the
+        # SCX112 line: an "ingest" ancestor elsewhere in the checkout
+        # path must not disable the rule repo-wide)
+        if len(parts) >= 2 and parts[-2] in DEVICE_PULL_OWNER_DIRS:
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute):
+                if self.aliases.is_jax_attr(node, ("device_get",)):
+                    self._report(
+                        "SCX114", node,
+                        "bare `jax.device_get`: this device->host crossing "
+                        "bypasses the transfer ledger and the guard pull "
+                        "ladder; materialize through "
+                        "sctools_tpu.ingest.pull(value, site=...)",
+                    )
+                elif node.attr == "copy_to_host_async":
+                    self._report(
+                        "SCX114", node,
+                        "bare `.copy_to_host_async`: async D2H staging "
+                        "belongs to the scx-wire writeback ring "
+                        "(sctools_tpu.ingest.WritebackRing), where the "
+                        "completing pull is ledger-recorded and guarded",
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "jax" and any(
+                    alias.name == "device_get" for alias in node.names
+                ):
+                    self._report(
+                        "SCX114", node,
+                        "importing device_get from jax bypasses the "
+                        "transfer ledger; import pull from "
+                        "sctools_tpu.ingest instead",
+                    )
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (
+                    isinstance(func, ast.Name)
+                    and func.id in self.aliases.device_get_names
+                ):
+                    self._report(
+                        "SCX114", node,
+                        "bare `device_get` call: this device->host "
+                        "crossing bypasses the transfer ledger; "
+                        "materialize through sctools_tpu.ingest.pull",
+                    )
+        # np.asarray/np.array on device-tainted names, per scope
+        scopes: List[ast.AST] = [self.tree]
+        for defs in self.defs.values():
+            scopes.extend(defs)
+        for scope in scopes:
+            tainted = self._tainted_names(scope)
+            if not tainted:
+                continue
+            for node in self._scope_walk(scope):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                np_fn = self.aliases.is_np_call(node.func)
+                if np_fn not in ("asarray", "array"):
+                    continue
+                base = node.args[0]
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id in tainted:
+                    self._report(
+                        "SCX114", node,
+                        f"`np.{np_fn}` on device value `{base.id}` "
+                        "(result of an engine dispatch / ingest.upload): "
+                        "this pull bypasses the transfer ledger and the "
+                        "guard transient ladder; materialize through "
+                        "sctools_tpu.ingest.pull(value, site=...)",
+                    )
+
     # -- SCX113 ------------------------------------------------------------
 
     def _is_boundary_call(self, node: ast.Call) -> Optional[str]:
@@ -1028,6 +1234,7 @@ class JaxLinter:
         self._check_shardmap_shim()
         self._check_uninstrumented_jit()
         self._check_device_put()
+        self._check_device_pull()
         self._check_unguarded_boundary()
         return self.findings
 

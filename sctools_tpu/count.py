@@ -123,11 +123,22 @@ class _MoleculeAccumulator:
         cols, _ = ingest.upload(cols, site="count.upload")
         # scx-lint: disable=SCX503 -- num_segments is len() of the pad_to-padded columns device_count_columns built, so it is already bucketed (bounded executables per run)
         out = count_molecules(cols, num_segments=num_segments)
-        is_molecule = np.asarray(out["is_molecule"])
-        cells = np.asarray(out["cell"])[is_molecule]
-        umis = np.asarray(out["umi"])[is_molecule]
-        genes = np.asarray(out["gene"])[is_molecule]
-        first = np.asarray(out["first_index"])[is_molecule].astype(np.int64)
+        # ONE guarded pull for every result column (the ingest.pull choke
+        # point: ledger-recorded, transient re-pull in place; a failure
+        # strikes the dispatch site's degradation ladder)
+        out, _ = ingest.pull(
+            {
+                k: out[k]
+                for k in ("is_molecule", "cell", "umi", "gene", "first_index")
+            },
+            site="count.writeback",
+            degrade_site="count.dispatch",
+        )
+        is_molecule = out["is_molecule"].astype(bool)
+        cells = out["cell"][is_molecule]
+        umis = out["umi"][is_molecule]
+        genes = out["gene"][is_molecule]
+        first = out["first_index"][is_molecule].astype(np.int64)
         self._append_molecules(frame, cells, umis, genes, first, offset)
 
     def _add_batch_sharded(self, frame, offset: int, pad_to: int) -> None:
@@ -163,22 +174,33 @@ class _MoleculeAccumulator:
             sharding=ingest.mesh_sharding(self._mesh),
         )
         out = sharded_count_molecules(stacked, self._mesh)
-        is_molecule = np.asarray(out["is_molecule"])
+        # two phases, deliberately: ALL shard pulls land in ONE guarded
+        # ingest.pull attempt (one coalesced D2H per result column instead
+        # of four small pulls per shard, each paying the link's fixed
+        # per-buffer toll), host mutation only after everything landed.
+        # The guard ladder may re-run this whole batch on a transient/OOM
+        # surfacing at the pull — an append interleaved with per-shard
+        # pulls would leave the earlier shards' molecules double-counted
+        # on retry.
+        out, _ = ingest.pull(
+            {
+                k: out[k]
+                for k in ("is_molecule", "cell", "umi", "gene", "first_index")
+            },
+            site="count.writeback",
+            degrade_site="count.dispatch",
+        )
+        is_molecule = out["is_molecule"]
         gene_vocab_cols = self._gene_vocab_cols(frame)
-        # two phases, deliberately: ALL device pulls first, host mutation
-        # only after every shard landed. The guard ladder may re-run this
-        # whole batch on a transient/OOM surfacing at any pull — a
-        # per-shard append interleaved with pulls would leave the earlier
-        # shards' molecules double-counted on retry.
         staged = []
         for shard in range(self._n_shards):
             mask = is_molecule[shard]
             if not mask.any():
                 continue
-            cells = np.asarray(out["cell"][shard])[mask]
-            umis = np.asarray(out["umi"][shard])[mask]
-            genes = np.asarray(out["gene"][shard])[mask]
-            local_first = np.asarray(out["first_index"][shard])[mask]
+            cells = out["cell"][shard][mask]
+            umis = out["umi"][shard][mask]
+            genes = out["gene"][shard][mask]
+            local_first = out["first_index"][shard][mask]
             first = orig[shard][local_first.astype(np.int64)]
             staged.append((cells, umis, genes, first))
         for cells, umis, genes, first in staged:

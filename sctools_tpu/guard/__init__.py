@@ -181,19 +181,25 @@ def retrying(
     name: str = "",
     retries: Optional[int] = None,
     leg: Optional[str] = None,
+    degrade_site: Optional[str] = None,
 ) -> Any:
     """Run ``fn()`` under the transient retry ladder (no frame semantics).
 
     The lightweight guard for device crossings that have no record-range
-    structure to bisect (uploads, the distributed sort's compiled step,
-    whitelist kernels): transient failures retry in place with jittered
-    backoff; resource exhaustion and exhausted retries note a device
-    failure toward the site's degradation threshold and re-raise; fatal
-    errors propagate untouched. ``leg`` names the stall-watchdog deadline
-    ("upload"/"compute") covering the attempt — INCLUDING any injected
-    stall fault, which fires inside the deadline so the chaos grammar
-    exercises the same interrupt path a real stall takes. Zero overhead
-    on the no-fault path beyond one armed-faults check.
+    structure to bisect (uploads, pulls, the distributed sort's compiled
+    step, whitelist kernels): transient failures retry in place with
+    jittered backoff; resource exhaustion and exhausted retries note a
+    device failure toward the site's degradation threshold and re-raise;
+    fatal errors propagate untouched. ``leg`` names the stall-watchdog
+    deadline ("upload"/"compute"/"pull") covering the attempt —
+    INCLUDING any injected stall fault, which fires inside the deadline
+    so the chaos grammar exercises the same interrupt path a real stall
+    takes. ``degrade_site`` redirects the device-failure strikes to a
+    different site's degradation ladder (``ingest.pull`` counts a
+    writeback failure toward the OWNING dispatch site's CPU rung while
+    faults, retry counters, and the ledger stay on the pull site);
+    default: the strikes land on ``site`` itself. Zero overhead on the
+    no-fault path beyond one armed-faults check.
     """
     limit = configured_retries() if retries is None else retries
     timeout = watchdog.leg_timeout(leg) if leg else 0.0
@@ -223,7 +229,7 @@ def retrying(
                 _backoff_sleep(attempt)
                 continue
             if kind in (TRANSIENT, RESOURCE_EXHAUSTED):
-                degrade.note_device_failure(site)
+                degrade.note_device_failure(degrade_site or site)
             raise
 
 

@@ -18,6 +18,7 @@ Deadlines are OFF by default (0 = disabled) and configured per leg::
     SCTOOLS_TPU_GUARD_TIMEOUT_DECODE=30   # ring frame pull, seconds
     SCTOOLS_TPU_GUARD_TIMEOUT_UPLOAD=30   # ingest.upload H2D staging
     SCTOOLS_TPU_GUARD_TIMEOUT_COMPUTE=120 # guarded batch dispatch
+    SCTOOLS_TPU_GUARD_TIMEOUT_PULL=60     # ingest.pull D2H materialization
 
 Limitation (by design, documented): the asynchronous raise lands between
 Python bytecodes, so a leg blocked inside ONE long uninterruptible C
@@ -43,7 +44,7 @@ from .errors import Stall
 T = TypeVar("T")
 
 ENV_PREFIX = "SCTOOLS_TPU_GUARD_TIMEOUT_"
-LEGS = ("decode", "upload", "compute")
+LEGS = ("decode", "upload", "compute", "pull")
 
 
 def leg_timeout(leg: str) -> float:

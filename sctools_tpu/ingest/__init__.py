@@ -13,6 +13,13 @@ Owns everything between the native decoder and the first compiled pass:
   staging in the library goes through it, so each crossing lands in the
   scx-xprof transfer ledger exactly once, and scx-lint rule SCX112 can ban
   bare ``jax.device_put`` everywhere else.
+- :mod:`.wire` — the symmetric device->host side (scx-wire):
+  :func:`pull` is THE materialization choke point (ledger + guard retry
+  + ``pull`` watchdog; SCX114 bans bare ``np.asarray``/``jax.device_get``
+  on device values elsewhere), and :class:`wire.WritebackRing` overlaps
+  each batch's compacted D2H with the next batch's compute via
+  ``copy_to_host_async`` (``SCTOOLS_TPU_WIRE_OVERLAP=0`` restores the
+  blocking path, byte-identical by construction).
 
 Knobs: ``SCTOOLS_TPU_PREFETCH_DEPTH`` (decode-ahead depth, default 2;
 validated 1..64 in :func:`sctools_tpu.utils.prefetch.prefetch_depth`)
@@ -30,14 +37,19 @@ from .. import guard
 from ..obs import xprof
 from ..utils.prefetch import prefetch_depth
 from .ring import ring_frames, ring_slots
+from .wire import WritebackRing, pull, timed_pulls, wire_overlap_enabled
 
 __all__ = [
+    "WritebackRing",
     "mesh_sharding",
     "prefetch_depth",
+    "pull",
     "ring_frames",
     "ring_slots",
+    "timed_pulls",
     "timed_uploads",
     "upload",
+    "wire_overlap_enabled",
 ]
 
 # measurement mode (bench --ingest): every upload blocks until the

@@ -1,0 +1,279 @@
+"""scx-wire: the device->host boundary (the D2H mirror of the upload side).
+
+scx-ingest made every host->device crossing go through ONE door
+(:func:`sctools_tpu.ingest.upload`); this module is the symmetric door
+for the pull direction, plus the machinery that keeps the pull off the
+critical path:
+
+- :func:`pull` — THE device->host choke point. Every materialization of
+  a device value on the host (the gatherer writeback, the count kernel's
+  result pulls, whitelist correction results, bench probes) goes through
+  it, so each crossing lands in the scx-xprof transfer ledger exactly
+  once, rides the guard transient ladder (a D2H blip re-pulls the
+  device-resident value in place) under the ``pull`` stall watchdog, and
+  scx-lint rule SCX114 can ban bare ``np.asarray``/``jax.device_get`` on
+  device values everywhere else.
+- :class:`WritebackRing` — slot accounting for device-resident result
+  blocks awaiting their D2H. ``stage()`` kicks the copy with
+  ``jax.Array.copy_to_host_async()`` the moment a batch's compacted
+  result block exists (so the transfer runs while the NEXT batch
+  computes — the download-side mirror of the upload ring's overlap), and
+  ``collect()`` drains blocks in FIFO order through :func:`pull`. The
+  async kick is a hint, never the authority: the blocking pull inside
+  ``collect`` is what completes (and, on a transient, retries) the
+  transfer, so the overlapped and blocking paths are byte-identical by
+  construction. Ring states register as the ``writeback_slots``
+  flight-record section (mirroring the decode ring's ``ring_slots``), so
+  a SIGTERM postmortem shows which batches were mid-writeback.
+
+``SCTOOLS_TPU_WIRE_OVERLAP=0`` disables the async kick (the blocking
+path, for parity testing and weird backends); the default is overlapped.
+A backend whose arrays lack a working ``copy_to_host_async`` degrades to
+the blocking path once, loudly (``wire_async_copy_unsupported`` counter),
+for the rest of the process.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import time
+from typing import Any, Optional, Tuple
+
+from .. import guard, obs
+from ..analysis.witness import make_lock
+from ..obs import xprof
+
+ENV_OVERLAP = "SCTOOLS_TPU_WIRE_OVERLAP"
+
+# measurement mode (bench --wire): every pull records its measured
+# seconds so the ledger's per-site D2H MB/s is real link time. A hot-path
+# pull records seconds=0 instead: its wall includes compute wait (and,
+# overlapped, almost no link time at all), which would corrupt the
+# ledger-derived rate the roofline gates read.
+_TIMED_PULLS = False
+
+# one-way latch: flipped when a backend's copy_to_host_async raises, so
+# the ring stops paying a doomed call per batch (counted + stderr once)
+_async_copy_broken = False
+
+
+@contextlib.contextmanager
+def timed_pulls():
+    """Force every ``pull`` in the block to run ``timed=True``."""
+    global _TIMED_PULLS
+    previous = _TIMED_PULLS
+    _TIMED_PULLS = True
+    try:
+        yield
+    finally:
+        _TIMED_PULLS = previous
+
+
+def wire_overlap_enabled() -> bool:
+    """Whether writeback rings kick ``copy_to_host_async`` at stage time
+    (default) instead of leaving the whole D2H to the blocking drain
+    (``SCTOOLS_TPU_WIRE_OVERLAP=0``)."""
+    return os.environ.get(ENV_OVERLAP, "") != "0"
+
+
+def pull(
+    value: Any,
+    site: str,
+    record: bool = True,
+    timed: bool = False,
+    wasted: int = 0,
+    degrade_site: Optional[str] = None,
+    name: str = "",
+) -> Tuple[Any, int]:
+    """Materialize device arrays on the host: the one D2H call site.
+
+    The mirror of :func:`sctools_tpu.ingest.upload`. ``value`` is an
+    array or any pytree of arrays (a result dict pulls as one guarded
+    attempt — everything lands, or the whole attempt retries, so callers
+    can stage all pulls before any host mutation). Returns
+    ``(host_value, nbytes)``; callers keep their own byte accounting
+    (``MetricGatherer.bytes_d2h``) from the same number the ledger
+    records, so the two reconcile by construction.
+
+    The guard ladder wraps the blocking materialization: a transient link
+    failure re-pulls the device-resident value in place under the
+    ``pull`` stall watchdog (``SCTOOLS_TPU_GUARD_TIMEOUT_PULL``); a
+    poisoned computation surfacing here re-raises to the caller (the
+    async recovery boundary — docs/robustness.md). ``degrade_site``
+    redirects the device-failure strikes of exhausted retries to the
+    owning dispatch site (the gatherer counts writeback failures toward
+    ``gatherer.dispatch``'s CPU rung), while faults, retry counters, and
+    the ledger entry stay on ``site``.
+
+    ``record=False`` skips the ledger write for callers that attach their
+    own timing afterwards (bench probes). ``timed=True`` records the
+    measured seconds of the materialization — microbench mode; on the
+    hot path the pull's wall includes compute wait and must not pollute
+    the ledger-derived MB/s. ``wasted`` counts the pad bytes inside
+    ``nbytes`` (compacted-but-still-padded result rows); it feeds the
+    wasted-D2H column of ``obs efficiency``.
+    """
+    import jax
+    import numpy as np
+
+    timed = timed or _TIMED_PULLS
+    measured = [0.0]
+
+    def _get():
+        # the retried unit: the blocking materialization of every leaf.
+        # A transient mid-pull re-materializes from the device-resident
+        # value; a completed earlier attempt's host copy is replaced.
+        start = time.perf_counter() if timed else 0.0
+        host = jax.tree_util.tree_map(np.asarray, value)
+        if timed:
+            measured[0] = time.perf_counter() - start
+        return host
+
+    # the D2H deadline: the dedicated `pull` leg when configured, else
+    # the `compute` leg's. The gatherer writeback rode leg="compute"
+    # before scx-wire existed, so a deployment that only sets
+    # SCTOOLS_TPU_GUARD_TIMEOUT_COMPUTE must keep its stall coverage on
+    # a wedged link — a silently-uncovered writeback would hang a lease
+    # to TTL exactly the way the watchdog exists to prevent.
+    leg = "pull" if guard.watchdog.leg_timeout("pull") > 0 else "compute"
+    host = guard.retrying(
+        _get, site=site, name=name, leg=leg, degrade_site=degrade_site
+    )
+    nbytes = int(
+        sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(host))
+    )
+    if record:
+        xprof.record_transfer(
+            "d2h", nbytes, seconds=measured[0], site=site, wasted=wasted
+        )
+    return host, nbytes
+
+
+# ----------------------------------------------------- writeback ring
+
+# live writeback-ring state for flight records: ring id -> {...}.
+# Updated by the consumer thread (cheap dict stores under one lock); a
+# postmortem reads it through the obs flight-section registry.
+_state_lock = make_lock("ingest.wire_state")
+_ring_state: dict = {}
+_ring_ids = itertools.count()
+
+
+# death-path safe (obs.bounded_snapshot): the flight dump may run inside
+# a signal handler that interrupted a state-update holder on this thread
+_wire_snapshot = obs.bounded_snapshot(
+    _state_lock,
+    lambda: [dict(v, ring=k) for k, v in sorted(_ring_state.items())],
+    [],
+)
+
+obs.register_flight_section("writeback_slots", _wire_snapshot)
+
+
+class WritebackRing:
+    """Slot accounting for device-resident result blocks awaiting D2H.
+
+    The download mirror of the decode ring's slot discipline: the
+    gatherer's pipelined ``pending`` queue owns ordering and depth; this
+    class owns (1) the async-copy kick at stage time and (2) the
+    postmortem-visible slot states. ``slots`` is the accounting width
+    (pipeline depth + the entry being staged/drained), not a buffer
+    count — the blocks themselves stay wherever the caller holds them.
+
+    FIFO by contract: ``collect`` drains the oldest staged batch, which
+    is exactly the order the gatherers' pending deques pop — the
+    documented CSV row order never depends on transfer completion order.
+    """
+
+    def __init__(self, name: str = "", slots: int = 4):
+        self._id = next(_ring_ids)
+        self._slots = max(1, int(slots))
+        self._staged = 0
+        self._drained = 0
+        with _state_lock:
+            _ring_state[self._id] = {
+                "name": name,
+                "slots": self._slots,
+                "staged": 0,
+                "drained": 0,
+                "inflight": [],
+                "phase": "idle",
+            }
+
+    def _update(self, **fields) -> None:
+        with _state_lock:
+            state = _ring_state.get(self._id)
+            if state is not None:
+                state.update(fields)
+
+    def stage(self, value: Any) -> Any:
+        """Kick the async D2H for one batch's result block(s).
+
+        Returns ``value`` unchanged (the device arrays; the blocking
+        ``collect`` is what produces host memory). With overlap off — or
+        on a backend whose arrays cannot async-copy — this is pure slot
+        accounting and the D2H happens entirely in ``collect``.
+        """
+        global _async_copy_broken
+        self._staged += 1
+        self._update(
+            staged=self._staged,
+            inflight=self._inflight(),
+            phase="copying" if wire_overlap_enabled() else "staged",
+        )
+        if wire_overlap_enabled() and not _async_copy_broken:
+            import jax
+
+            for leaf in jax.tree_util.tree_leaves(value):
+                kick = getattr(leaf, "copy_to_host_async", None)
+                if kick is None:
+                    continue
+                try:
+                    kick()
+                except Exception:  # noqa: BLE001 - hint only; pull completes
+                    # degrade once, loudly: the blocking drain still
+                    # moves every byte, so nothing is lost but overlap
+                    _async_copy_broken = True
+                    obs.count("wire_async_copy_unsupported")
+                    import sys
+
+                    sys.stderr.write(
+                        "sctools-tpu wire: copy_to_host_async unsupported "
+                        "on this backend; writeback falls back to the "
+                        "blocking drain\n"
+                    )
+                    break
+        obs.count("wire_writeback_staged")
+        return value
+
+    def _inflight(self) -> list:
+        return list(range(self._drained, self._staged))
+
+    def collect(
+        self,
+        value: Any,
+        site: str,
+        record: bool = True,
+        timed: bool = False,
+        wasted: int = 0,
+        degrade_site: Optional[str] = None,
+        name: str = "",
+    ) -> Tuple[Any, int]:
+        """Drain the oldest staged batch through :func:`pull`."""
+        self._update(phase="draining")
+        host, nbytes = pull(
+            value, site, record=record, timed=timed, wasted=wasted,
+            degrade_site=degrade_site, name=name,
+        )
+        self._drained += 1
+        self._update(
+            drained=self._drained, inflight=self._inflight(), phase="idle"
+        )
+        obs.count("wire_writeback_drained")
+        return host, nbytes
+
+    def close(self) -> None:
+        with _state_lock:
+            _ring_state.pop(self._id, None)

@@ -574,15 +574,23 @@ def compact_results_wire(
     float_names: Tuple[str, ...],
     k: int,
 ) -> jnp.ndarray:
-    """compact_results fused into ONE [k, n_int + n_float] int32 pull.
+    """compact_results fused into ONE [n_int + n_float, k] int32 pull.
 
     The float block travels as its exact float32 bit pattern
     (``bitcast_convert_type``) so a single device->host transfer replaces
     two — each buffer pays ~85 ms of fixed tunnel overhead regardless of
     size (BASELINE.md) — with zero precision risk: the host views the
     float columns back via ``ndarray.view(np.float32)``, bit-identical.
+
+    Column-major on purpose: with columns as the LEADING axis the host's
+    float half is a contiguous row block of the pulled buffer, so
+    ``block[n_int:].view(np.float32)`` is a zero-copy reinterpretation.
+    The old [k, columns] layout forced ``np.ascontiguousarray`` — a full
+    copy of the float half per batch — before the view
+    (metrics.gatherer._do_finalize_device_batch pins the no-copy
+    property).
     """
     ints, floats = compact_results(result, int_names, float_names, k)
     return jnp.concatenate(
-        [ints, jax.lax.bitcast_convert_type(floats, jnp.int32)], axis=1
+        [ints.T, jax.lax.bitcast_convert_type(floats, jnp.int32).T], axis=0
     )

@@ -34,7 +34,7 @@ from ..io.packed import (
     wire_layout,
 )
 from ..io.sam import AlignmentReader
-from ..ops.segments import bucket_size
+from ..ops.segments import bucket_size, entity_bucket
 from .aggregator import CellMetrics, GeneMetrics
 from .schema import CELL_COLUMNS, GENE_COLUMNS, INT_COLUMNS
 from .writer import MetricCSVWriter
@@ -400,6 +400,13 @@ class MetricGatherer:
                 mode if mode != "rb" else None,
             )
         out = MetricCSVWriter(self._output_stem, self._compress)
+        # the writeback ring (scx-wire): each dispatched batch's compacted
+        # result block starts its D2H at dispatch time and drains in FIFO
+        # order in finalize; slot states ride flight records so a SIGTERM
+        # postmortem shows which batches were mid-writeback
+        self._writeback = ingest.WritebackRing(
+            name=type(self).__name__, slots=self._PIPELINE_DEPTH + 2
+        )
         try:
             out.write_header({c: None for c in self.columns})
             self._stream_device_batches(frames, device_engine, out)
@@ -411,6 +418,8 @@ class MetricGatherer:
             raise
         else:
             out.close()
+        finally:
+            self._writeback.close()
 
     # batches in flight on the device before the oldest result is pulled.
     # Depth 2 lets the main thread prep + dispatch batch k+2 while k's pull
@@ -690,12 +699,27 @@ class MetricGatherer:
                 n_entities = int(np.count_nonzero(key[1:] != key[:-1])) + 1
             else:
                 n_entities = int(np.unique(key).size)
-            k = min(bucket_size(n_entities, minimum=1024), num_segments)
+            # occupied-row compaction: the pull is sized by the ENTITY
+            # bucket vocabulary (pow2, floor 64), not the record-count
+            # floor of 1024 — result rows are ~an order of magnitude
+            # fewer than records, so the old floor made most writeback
+            # bytes pad on small/tail batches
+            k = entity_bucket(n_entities, num_segments)
             int_names, float_names = wire_result_names(self.columns)
-            # scx-lint: disable=SCX503 -- k is bucket_size(n_entities) clamped by the already-bucketed num_segments: both min() operands are shape-disciplined
+            # the pull's own occupancy telemetry: real entity rows vs the
+            # bucketed slice — what the wasted-D2H column and `obs
+            # efficiency --suggest`'s entity-bucket advice read
+            xprof.record_dispatch(
+                "metrics.compact_results_wire", n_entities, k
+            )
+            # scx-lint: disable=SCX503 -- k is entity_bucket(n_entities) clamped by the already-bucketed num_segments: both operands are shape-disciplined
             block = device_engine.compact_results_wire(
                 result, int_names, float_names, k
             )
+            # overlapped writeback: the block's D2H starts NOW and runs
+            # while batch k+1 decodes/computes; finalize's pull merely
+            # completes (or, on a transient, redoes) it
+            block = self._writeback.stage(block)
             # watermark sample while the batch's buffers are live on
             # device (peak attribution = the open `compute` span)
             xprof.sample_memory()
@@ -717,25 +741,26 @@ class MetricGatherer:
             "writeback", records=n_records, entities=n_entities
         ) as wb:
             # under async dispatch, a device-side failure for this batch
-            # surfaces HERE, at the first blocking pull — after the
+            # surfaces HERE, at the drain of the staged D2H — after the
             # guarded dispatch returned and the frame was released. The
-            # transient ladder still applies (a d2h blip re-pulls the
-            # device-resident result in place); a poisoned computation
+            # pull choke point applies the transient ladder (a d2h blip
+            # re-pulls the device-resident result in place, whether or
+            # not the async copy had started); a poisoned computation
             # re-raises identically, notes a device failure toward the
-            # dispatch site's CPU rung, and escalates to the scheduler's
-            # task retry — the documented async recovery boundary
-            # (docs/robustness.md).
-            block = guard.retrying(
-                lambda: np.asarray(block), site=self._GUARD_SITE,
-                leg="compute",
+            # dispatch site's CPU rung (degrade_site), and escalates to
+            # the scheduler's task retry — the documented async recovery
+            # boundary (docs/robustness.md).
+            wasted = (
+                (block.shape[1] - n_entities) * block.shape[0] * 4
             )
-            self.bytes_d2h += block.nbytes
-            wb.add(bytes=block.nbytes)
-            xprof.record_transfer(
-                "d2h", block.nbytes, site="gatherer.writeback"
+            block, batch_d2h = self._writeback.collect(
+                block, site="gatherer.writeback", wasted=wasted,
+                degrade_site=self._GUARD_SITE, name=str(self._bam_file),
             )
+            self.bytes_d2h += batch_d2h
+            wb.add(bytes=batch_d2h)
             xprof.sample_memory()
-            obs.count("d2h_bytes", block.nbytes)
+            obs.count("d2h_bytes", batch_d2h)
             obs.count("entities_written", n_entities)
             self._do_finalize_device_batch(
                 entity_names, block, n_entities, int_names, float_names, out
@@ -745,10 +770,14 @@ class MetricGatherer:
         self, entity_names, block, n_entities: int, int_names, float_names,
         out,
     ) -> None:
-        ints = block[:, : len(int_names)]
-        floats = np.ascontiguousarray(
-            block[:, len(int_names):]
-        ).view(np.float32)
+        # the wire block is column-major ([columns, k]) precisely so both
+        # halves are zero-copy VIEWS of the pulled buffer: the float half
+        # is a contiguous row block, so .view(np.float32) reinterprets in
+        # place (the old row-major layout forced an ascontiguousarray
+        # copy of the float half every batch; pinned by a shares-memory
+        # test in tests/test_metrics.py)
+        ints = block[: len(int_names)]
+        floats = block[len(int_names):].view(np.float32)
         self._write_device_rows(
             entity_names, n_entities, int_names, float_names,
             ints, floats, out,
@@ -777,25 +806,28 @@ class MetricGatherer:
         65k-entity scale; the writer's block path renders the same bytes
         (``str(float(x))`` of the engine's float32 results upcast to
         float64) through the native formatter in ~1/10 the time.
+
+        ``ints``/``floats`` arrive column-major ([columns, k] — the wire
+        block's zero-copy halves); every accessor below slices a row.
         """
         names = np.asarray(entity_names, dtype=object)
         int_of = {n: i for i, n in enumerate(int_names)}
         float_of = {n: i for i, n in enumerate(float_names)}
-        codes = ints[:n_entities, int_of["entity_code"]].astype(np.int64)
+        codes = ints[int_of["entity_code"], :n_entities].astype(np.int64)
         row_names = names[codes]
         keep = self._filter_rows(row_names)
         if keep is None:
             keep = slice(None)
         index = np.where(row_names == "", "None", row_names)[keep]
         def int_col(column):
-            return ints[:n_entities, int_of[column]][keep].astype(np.int64)
+            return ints[int_of[column], :n_entities][keep].astype(np.int64)
 
         f32_cache: Dict[str, np.ndarray] = {}
 
         def f32_of(column):
             # shared across the derived ratios; computed once per batch
             if column not in f32_cache:
-                f32_cache[column] = ints[:n_entities, int_of[column]][
+                f32_cache[column] = ints[int_of[column], :n_entities][
                     keep
                 ].astype(np.float32)
             return f32_cache[column]
@@ -830,7 +862,7 @@ class MetricGatherer:
             if column in int_of:
                 return int_col(column)
             if column in float_of:
-                return floats[:n_entities, float_of[column]][keep].astype(
+                return floats[float_of[column], :n_entities][keep].astype(
                     np.float64
                 )
             if column in _WIRE_ZERO_INTS:

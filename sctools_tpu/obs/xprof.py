@@ -71,6 +71,7 @@ __all__ = [
     "observe_event",
     "record_dispatch",
     "record_transfer",
+    "record_transfer_waste",
     "sample_memory",
     "ledger_totals",
     "snapshot",
@@ -448,7 +449,8 @@ def record_dispatch(
 # ------------------------------------------------------ transfer ledger
 
 def record_transfer(
-    direction: str, nbytes: int, seconds: float = 0.0, site: str = ""
+    direction: str, nbytes: int, seconds: float = 0.0, site: str = "",
+    wasted: int = 0,
 ) -> None:
     """Count bytes (and, when timed, seconds) crossing the device link.
 
@@ -458,17 +460,44 @@ def record_transfer(
     source of truth that other accounting (``MetricGatherer.bytes_h2d``,
     ``bench.py``'s transfer floor) must reconcile with. No-op while
     recording is off.
+
+    ``wasted`` counts the PAD bytes inside ``nbytes`` — result rows
+    pulled only because the transfer was sized to a bucket (the
+    gatherer's compacted writeback: pad rows x row bytes). It feeds the
+    wasted-D2H column of ``obs efficiency``; bytes stay fully counted in
+    ``nbytes`` so the reconciliation gates are unaffected.
     """
     if direction not in ("h2d", "d2h"):
         raise ValueError(f"direction must be 'h2d' or 'd2h', got {direction!r}")
     if not _obs_enabled():
         return
     with _lock:
-        entry = _ledger.setdefault((direction, site), [0, 0.0, 0])
+        entry = _ledger.setdefault((direction, site), [0, 0.0, 0, 0])
         entry[0] += int(nbytes)
         entry[1] += float(seconds)
         entry[2] += 1
+        entry[3] += int(wasted)
     _obs_count(f"xprof_transfer_bytes_{direction}", int(nbytes))
+    if wasted:
+        _obs_count(f"xprof_transfer_wasted_bytes_{direction}", int(wasted))
+
+
+def record_transfer_waste(direction: str, site: str, wasted: int) -> None:
+    """Attribute pad bytes to an ALREADY-recorded transfer.
+
+    For pulls whose pad fraction is only host-knowable after the bytes
+    landed (the sharded writeback learns per-shard entity counts from the
+    pull itself). Adds to the entry's waste accumulator without touching
+    bytes/seconds/events, so reconciliation and rates stay exact.
+    """
+    if direction not in ("h2d", "d2h"):
+        raise ValueError(f"direction must be 'h2d' or 'd2h', got {direction!r}")
+    if not _obs_enabled() or not wasted:
+        return
+    with _lock:
+        entry = _ledger.setdefault((direction, site), [0, 0.0, 0, 0])
+        entry[3] += int(wasted)
+    _obs_count(f"xprof_transfer_wasted_bytes_{direction}", int(wasted))
 
 
 def ledger_totals() -> Dict[str, Dict[str, Any]]:
@@ -480,15 +509,23 @@ def ledger_totals() -> Dict[str, Dict[str, Any]]:
 def _ledger_totals_locked() -> Dict[str, Dict[str, Any]]:
     out: Dict[str, Dict[str, Any]] = {}
     items = [(k, list(v)) for k, v in _ledger.items()]
-    for (direction, site), (nbytes, seconds, events) in items:
+    for (direction, site), entry in items:
+        nbytes, seconds, events = entry[0], entry[1], entry[2]
+        wasted = entry[3] if len(entry) > 3 else 0
         total = out.setdefault(
-            direction, {"bytes": 0, "seconds": 0.0, "events": 0, "by_site": {}}
+            direction,
+            {
+                "bytes": 0, "seconds": 0.0, "events": 0, "wasted": 0,
+                "by_site": {},
+            },
         )
         total["bytes"] += int(nbytes)
         total["seconds"] += seconds
         total["events"] += events
+        total["wasted"] += int(wasted)
         total["by_site"][site or "(unlabeled)"] = {
             "bytes": int(nbytes), "seconds": seconds, "events": events,
+            "wasted": int(wasted),
         }
     return out
 
@@ -794,18 +831,24 @@ def merge_registries(registries: List[Dict[str, Any]]) -> Dict[str, Any]:
         for direction, total in (registry.get("ledger") or {}).items():
             out = ledger.setdefault(
                 direction,
-                {"bytes": 0, "seconds": 0.0, "events": 0, "by_site": {}},
+                {
+                    "bytes": 0, "seconds": 0.0, "events": 0, "wasted": 0,
+                    "by_site": {},
+                },
             )
             out["bytes"] += int(total.get("bytes") or 0)
             out["seconds"] += float(total.get("seconds") or 0.0)
             out["events"] += int(total.get("events") or 0)
+            out["wasted"] += int(total.get("wasted") or 0)
             for site, entry in (total.get("by_site") or {}).items():
                 slot = out["by_site"].setdefault(
-                    site, {"bytes": 0, "seconds": 0.0, "events": 0}
+                    site,
+                    {"bytes": 0, "seconds": 0.0, "events": 0, "wasted": 0},
                 )
                 slot["bytes"] += int(entry.get("bytes") or 0)
                 slot["seconds"] += float(entry.get("seconds") or 0.0)
                 slot["events"] += int(entry.get("events") or 0)
+                slot["wasted"] += int(entry.get("wasted") or 0)
         mem = registry.get("memory") or {}
         memory["samples"] += int(mem.get("samples") or 0)
         memory["supported"] = memory["supported"] or bool(mem.get("supported"))
@@ -900,6 +943,12 @@ def efficiency_report(run_dir: str) -> Dict[str, Any]:
                 total_real / total_padded if total_padded else None
             ),
             "est_wasted_flops": wasted_flops,
+            # pad rows x row bytes across every D2H pull that reported
+            # its pad fraction (the compacted writeback): bytes the link
+            # moved for rows nobody reads
+            "wasted_d2h_bytes": int(
+                (ledger.get("d2h") or {}).get("wasted") or 0
+            ),
             "unattributed_compiles": merged["unattributed_compiles"],
         },
         "warnings": warnings,
@@ -1104,15 +1153,27 @@ def render_efficiency(report: Dict[str, Any]) -> str:
             rate = ""
             if f"{direction}_MBps" in measured:
                 rate = f" @ {measured[f'{direction}_MBps']} MB/s measured"
+            wasted_total = int(total.get("wasted") or 0)
             lines.append(
                 f"  {direction}: {_fmt_bytes(total['bytes'])} MB in "
                 f"{total['events']} transfer(s){rate}"
+                + (
+                    f"; {_fmt_bytes(wasted_total)} MB pad (wasted)"
+                    if wasted_total
+                    else ""
+                )
             )
             for site in sorted(total["by_site"]):
                 entry = total["by_site"][site]
+                wasted = int(entry.get("wasted") or 0)
                 lines.append(
                     f"    {site}: {_fmt_bytes(entry['bytes'])} MB "
                     f"({entry['events']})"
+                    + (
+                        f", {_fmt_bytes(wasted)} MB pad"
+                        if wasted
+                        else ""
+                    )
                 )
         lines.append("")
     if totals["padded_rows"]:

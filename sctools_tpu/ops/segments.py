@@ -248,3 +248,19 @@ def bucket_size(n: int, minimum: int = 4096) -> int:
     while size < n:
         size *= 2
     return size
+
+
+# entity counts get their OWN small bucket vocabulary: result rows are an
+# order of magnitude fewer than records (~32 reads/entity on the bench
+# workload), so sizing the compacted writeback to the record-count floor
+# of 1024 made most pulled bytes pad on small/tail batches. The floor
+# bounds distinct compiled slice shapes exactly like the record buckets
+# do — pow2s >= 64 are inside the shape contract's bucket universe
+# (pinned by tests/test_xprof.py).
+ENTITY_BUCKET_MIN = 64
+
+
+def entity_bucket(n_entities: int, cap: int) -> int:
+    """Pow2 bucket for an entity-count-sized device slice, capped at the
+    (already bucketed) padded record count ``cap``."""
+    return min(bucket_size(n_entities, minimum=ENTITY_BUCKET_MIN), cap)

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .. import guard, ingest, obs
+from .. import ingest, obs
 from ..obs import xprof
 from ..io.packed import KEY_HI_SHIFT
 from ..sched import faults
@@ -30,7 +30,7 @@ from ..metrics.gatherer import (
     GatherGeneMetrics,
     wire_result_names,
 )
-from ..ops.segments import bucket_size
+from ..ops.segments import entity_bucket
 from .metrics import sharded_entity_metrics
 from .shard import partition_columns
 
@@ -128,15 +128,25 @@ class _ShardedMixin:
             per_shard = np.bincount(
                 unique_codes % self._n_shards, minlength=self._n_shards
             )
-            k = min(
-                bucket_size(int(per_shard.max(initial=1)), minimum=1024),
-                shard_size,
-            )
+            # occupied-row compaction: the per-shard slice is sized by the
+            # entity bucket vocabulary (pow2, floor 64), the same schema
+            # decision as the single-device path
+            k = entity_bucket(int(per_shard.max(initial=1)), shard_size)
             int_names, float_names = wire_result_names(self.columns)
+            # the pull's occupancy telemetry (same site as single-device:
+            # one series for entity-bucket advice however the batch ran)
+            xprof.record_dispatch(
+                "metrics.compact_results_wire",
+                int(unique_codes.size),
+                self._n_shards * k,
+            )
             blocks, n_entities = sharded_entity_metrics(
                 stacked, self._mesh, kind=self.entity_kind,
                 compact=(int_names, float_names, k), **engine_flags,
             )
+            # overlapped writeback: both pulls' D2H starts now, while the
+            # next batch partitions/uploads/computes
+            blocks, n_entities = self._writeback.stage((blocks, n_entities))
         return (
             self._entity_names(frame), blocks, n_entities,
             int_names, float_names, frame.n_records,
@@ -148,44 +158,47 @@ class _ShardedMixin:
     ) -> None:
         with obs.span("writeback", records=n_records) as wb:
             # the async recovery boundary, same as the single-device path:
-            # device failures for this batch surface at the first blocking
-            # pull — BOTH pulls ride one transient-ladder attempt, so a
-            # blip at either lands in the same retry
-            device_blocks, device_counts = blocks, n_entities
-            blocks, n_entities = guard.retrying(
-                lambda: (
-                    np.asarray(device_blocks),
-                    np.asarray(device_counts).reshape(-1),
-                ),
-                site=self._GUARD_SITE,
-                leg="compute",
+            # device failures for this batch surface at the drain of the
+            # staged D2H — BOTH pulls ride one guarded attempt through the
+            # ingest.pull choke point, so a blip at either lands in the
+            # same retry and everything stages before any host use
+            (blocks, n_entities), batch_d2h = self._writeback.collect(
+                (blocks, n_entities), site="gatherer.writeback",
+                degrade_site=self._GUARD_SITE, name=str(self._bam_file),
             )
-            batch_d2h = blocks.nbytes + n_entities.nbytes
+            n_entities = np.asarray(n_entities).reshape(-1)
             self.bytes_d2h += batch_d2h
             wb.add(bytes=batch_d2h)
-            xprof.record_transfer(
-                "d2h", batch_d2h, site="gatherer.writeback"
+            # pad rows pulled beyond the real entity rows: blocks is
+            # [n_shards, columns, k] column-major, so each pad row costs
+            # one column-slice of 4-byte lanes
+            wasted = int(
+                (blocks.shape[0] * blocks.shape[2] - int(n_entities.sum()))
+                * blocks.shape[1] * 4
             )
+            xprof.record_transfer_waste("d2h", "gatherer.writeback", wasted)
             xprof.sample_memory()
             obs.count("d2h_bytes", batch_d2h)
-            rows = np.concatenate(
-                [
-                    blocks[s, : int(n_entities[s])]
-                    for s in range(len(n_entities))
-                ]
-            )
             # entity vocabulary order == ascending codes == the
             # single-device row order (codes preserve string order); shards
-            # are disjoint so this sort is the whole merge
-            rows = rows[np.argsort(rows[:, 0])]
-            ints = rows[:, : len(int_names)]
-            floats = np.ascontiguousarray(
-                rows[:, len(int_names):]
-            ).view(np.float32)
-            wb.add(entities=int(rows.shape[0]))
-            obs.count("entities_written", int(rows.shape[0]))
+            # are disjoint so this sort is the whole merge. Column-major
+            # throughout: the concat is along the entity axis (axis 1) and
+            # the fancy reorder yields a fresh C-contiguous block whose
+            # float half views back in place.
+            cols = np.concatenate(
+                [
+                    blocks[s][:, : int(n_entities[s])]
+                    for s in range(len(n_entities))
+                ],
+                axis=1,
+            )
+            cols = cols[:, np.argsort(cols[0])]
+            ints = cols[: len(int_names)]
+            floats = cols[len(int_names):].view(np.float32)
+            wb.add(entities=int(cols.shape[1]))
+            obs.count("entities_written", int(cols.shape[1]))
             self._write_device_rows(
-                entity_names, rows.shape[0], int_names, float_names,
+                entity_names, cols.shape[1], int_names, float_names,
                 ints, floats, out,
             )
 

@@ -163,10 +163,11 @@ def sharded_entity_metrics(
     per-record quality floats identically — the byte-identity contract.
 
     ``compact=(int_names, float_names, k)`` compacts each shard's result
-    ON DEVICE into the fused [k, ints+floats] int32 block the single-device
-    path pulls (metrics.device.compact_results_wire) and returns
-    ``(blocks [n_shards, k, C], n_entities [n_shards])`` — record-scale
-    result arrays never cross the host link.
+    ON DEVICE into the fused COLUMN-MAJOR [ints+floats, k] int32 block
+    the single-device path pulls (metrics.device.compact_results_wire)
+    and returns ``(blocks [n_shards, C, k], n_entities [n_shards])`` —
+    record-scale result arrays never cross the host link, and the
+    pulled blocks' halves view back zero-copy on the host.
     """
     first = next(iter(stacked_cols.values()))
     n_shards = first.shape[0]

@@ -264,7 +264,7 @@ def distributed_sort(
                 # device pull of the valid column) must not ride the
                 # disabled serving path.
                 real_records = int(
-                    np.count_nonzero(np.asarray(stacked_cols["valid"]))
+                    np.count_nonzero(np.asarray(stacked_cols["valid"]))  # scx-lint: disable=SCX114 -- runs BEFORE the ingest.upload rebind below: reads the caller's host-side columns (the taint model is deliberately rebind-order-insensitive)
                 )
                 sort_span.add(
                     records=real_records,
@@ -308,7 +308,7 @@ def distributed_sort(
         # runtime hiccup in the collectives retries in place instead of
         # failing the task (no record-range structure to bisect here —
         # OOM propagates to the scheduler)
-        from .. import guard
+        from .. import guard, ingest
 
         out, dropped = guard.retrying(
             # scx-lint: disable=SCX503 -- capacity is caller-pinned, a bucket_size() output, or the already-bucketed shard_size, so the compiled-program universe stays bounded
@@ -319,7 +319,8 @@ def distributed_sort(
             leg="compute",
         )
         if not isinstance(dropped, jax.core.Tracer):
-            n_dropped = int(np.asarray(dropped).sum())
+            dropped_host, _ = ingest.pull(dropped, site="sort.writeback")
+            n_dropped = int(dropped_host.sum())
             if n_dropped:
                 raise RuntimeError(
                     f"distributed sort dropped {n_dropped} records: raise "

@@ -1,5 +1,6 @@
 """SCX101 positive: host syncs inside a traced function."""
 # scx-lint: disable-file=SCX111 -- fixture exercises other rules via bare jit
+# scx-lint: disable-file=SCX114 -- the device_get here exercises the traced-context rule; the pull-side rule has its own fixture twins
 
 import jax
 import numpy as np

@@ -1,0 +1,43 @@
+"""SCX114 positive fixture: bare device->host pulls outside ingest/.
+
+Every marked line is a D2H crossing the transfer ledger never sees:
+``jax.device_get`` (attribute and import forms), a bare
+``.copy_to_host_async`` kick, and ``np.asarray``/``np.array`` on device
+values (results of an engine dispatch or of ``ingest.upload``).
+"""
+import jax
+import numpy as np
+from jax import device_get  # noqa: F401
+
+from sctools_tpu import ingest
+from sctools_tpu.metrics.device import compute_entity_metrics
+from sctools_tpu.ops.counting import count_molecules
+
+
+def pull_get(value):
+    return jax.device_get(value)
+
+
+def pull_imported(value):
+    return device_get(value)
+
+
+def pull_async(block):
+    block.copy_to_host_async()
+    return block
+
+
+def pull_dispatch_result(cols, n):
+    result = compute_entity_metrics(cols, num_segments=n, kind="cell")
+    return np.asarray(result["n_reads"])
+
+
+def pull_subscripted(cols, n):
+    out = count_molecules(cols, num_segments=n)
+    mask = np.array(out["is_molecule"])
+    return mask
+
+
+def pull_staged(cols):
+    device_cols, _ = ingest.upload(cols, site="fixture.pull")
+    return np.asarray(device_cols)

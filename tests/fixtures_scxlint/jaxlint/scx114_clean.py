@@ -1,0 +1,36 @@
+"""SCX114 negative fixture: every materialization rides ingest.pull.
+
+Host-side ``np.asarray`` (padding, vocabulary scans, columns that never
+saw the device) stays legal — the rule taints only names bound to engine
+dispatches / ``ingest.upload`` results. The last function shows the
+inline escape hatch for a deliberate bare pull.
+"""
+import numpy as np
+
+from sctools_tpu import ingest
+from sctools_tpu.metrics.device import compute_entity_metrics
+
+
+def pull_result(cols, n):
+    result = compute_entity_metrics(cols, num_segments=n, kind="cell")
+    host, nbytes = ingest.pull(result["n_reads"], site="fixture.pull")
+    return host, nbytes
+
+
+def pull_ring(block):
+    ring = ingest.WritebackRing(name="fixture")
+    block = ring.stage(block)
+    host, _ = ring.collect(block, site="fixture.writeback")
+    ring.close()
+    return host
+
+
+def host_side_asarray(records):
+    # plain host numpy: no device value involved, no finding
+    padded = np.asarray(records, dtype=np.int32)
+    return np.array([padded.size])
+
+
+def pull_escaped(cols, n):
+    result = compute_entity_metrics(cols, num_segments=n, kind="cell")
+    return np.asarray(result["n_reads"])  # scx-lint: disable=SCX114 -- deliberate

@@ -1,8 +1,12 @@
 """guard-smoke: the CI gate for scx-guard (`make guard-smoke`).
 
 A 2-worker run under the full device-fault cocktail — ``device_oom``,
-``xla_transient``, ``stall``, and two ``corrupt_record`` poisons — must
-prove record-level isolation and below-scheduler absorption:
+``xla_transient`` (at BOTH the dispatch and the writeback-pull sites),
+``stall``, and two ``corrupt_record`` poisons — must prove record-level
+isolation and below-scheduler absorption. The fault-free expected twin
+runs with ``SCTOOLS_TPU_WIRE_OVERLAP=0`` while the faulted run keeps the
+default overlapped writeback, so the byte-identity assertion also proves
+overlapped == blocking writeback under faults (scx-wire parity):
 
 - the run CONVERGES: every task commits, both workers exit 0;
 - the journal shows ZERO ``failed`` events — every injected device fault
@@ -108,8 +112,13 @@ def filter_chunk(src: str, dst: str, drop: set) -> int:
     return kept
 
 
-def launch(workdir: str, process_id: int, fault_spec: str, trace_dir: str):
+def launch(
+    workdir: str, process_id: int, fault_spec: str, trace_dir: str,
+    extra_env: dict = None,
+):
     env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
@@ -134,10 +143,11 @@ def launch(workdir: str, process_id: int, fault_spec: str, trace_dir: str):
     )
 
 
-def run_pair(workdir: str, fault_spec: str) -> None:
+def run_pair(workdir: str, fault_spec: str, extra_env: dict = None) -> None:
     trace_dir = os.path.join(workdir, "trace")
     procs = [
-        launch(workdir, pid, fault_spec, trace_dir) for pid in (0, 1)
+        launch(workdir, pid, fault_spec, trace_dir, extra_env=extra_env)
+        for pid in (0, 1)
     ]
     outputs = []
     for proc in procs:
@@ -226,7 +236,11 @@ def main() -> int:
             shutil.copyfile(chunk, dst)
 
     # ---- the fault-free twin run --------------------------------------
-    run_pair(expect_dir, "")
+    # run on the BLOCKING writeback path (SCTOOLS_TPU_WIRE_OVERLAP=0)
+    # while the faulted run keeps the default overlapped path: the final
+    # byte-identity assertion then also proves overlapped == blocking
+    # writeback under the full device-fault cocktail (scx-wire parity)
+    run_pair(expect_dir, "", extra_env={"SCTOOLS_TPU_WIRE_OVERLAP": "0"})
     expected_csv = merge(expect_dir, n_chunks)
 
     # ---- the faulted run ----------------------------------------------
@@ -237,6 +251,9 @@ def main() -> int:
         [
             f"device_oom@gatherer.dispatch:match={chunk1},times=1",
             "xla_transient@gatherer.dispatch:times=1",
+            # a transient at the PULL site: the overlapped writeback's
+            # async recovery boundary — the staged D2H re-pulls in place
+            "xla_transient@gatherer.writeback:times=1",
             f"stall@gatherer.dispatch:match={chunk2},times=1,secs=60",
         ]
         + [

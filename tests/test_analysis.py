@@ -64,7 +64,7 @@ TREE = [
 ]
 
 JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)] + [
-    "SCX110", "SCX111", "SCX112", "SCX113",
+    "SCX110", "SCX111", "SCX112", "SCX113", "SCX114",
 ]
 
 
@@ -115,6 +115,70 @@ def test_scx112_ingest_dir_is_exempt(tmp_path):
     )
     findings = lint_file(str(deep))
     assert {f.rule for f in findings} == {"SCX112"}
+
+
+def test_scx114_ingest_dir_is_exempt(tmp_path):
+    # SCX114 is about ownership, like SCX112: ingest/ IS the sanctioned
+    # pull site (wire.py implements the choke point)
+    src = (
+        "import jax\n\n\ndef down(value):\n    return jax.device_get(value)\n"
+    )
+    ingest_dir = tmp_path / "ingest"
+    ingest_dir.mkdir()
+    (ingest_dir / "wirelike.py").write_text(src)
+    assert lint_file(str(ingest_dir / "wirelike.py")) == []
+    (tmp_path / "wirelike.py").write_text(src)
+    findings = lint_file(str(tmp_path / "wirelike.py"))
+    assert {f.rule for f in findings} == {"SCX114"}
+    # only the IMMEDIATE parent confers ownership (the SCX112 line)
+    nested = ingest_dir / "sub"
+    nested.mkdir()
+    (nested / "wirelike.py").write_text(src)
+    assert {f.rule for f in lint_file(str(nested / "wirelike.py"))} == {
+        "SCX114"
+    }
+
+
+def test_scx114_bad_fixture_marks_exact_lines():
+    path = os.path.join(JAXLINT, "scx114_bad.py")
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    findings = lint_file(path)
+    # one finding per offending construct: the two device_get forms, the
+    # async kick, and the three tainted np.asarray/np.array pulls (the
+    # import line additionally flags)
+    lines = sorted({f.line for f in findings})
+    assert len(lines) >= 6, [f.render() for f in findings]
+    flagged_snippets = [
+        source.splitlines()[line - 1] for line in lines
+    ]
+    for snippet in flagged_snippets:
+        assert any(
+            marker in snippet
+            for marker in (
+                "device_get", "copy_to_host_async", "np.asarray", "np.array",
+            )
+        ), snippet
+
+
+def test_scx114_taint_is_per_scope(tmp_path):
+    # a dispatch result tainting `out` in one function must not flag a
+    # host-side np.asarray(out) in ANOTHER function
+    src = (
+        "import numpy as np\n"
+        "from sctools_tpu.ops.counting import count_molecules\n\n\n"
+        "def device_fn(cols, n):\n"
+        "    out = count_molecules(cols, num_segments=n)\n"
+        "    return out\n\n\n"
+        "def host_fn(records):\n"
+        "    out = list(records)\n"
+        "    return np.asarray(out)\n"
+    )
+    path = tmp_path / "scoped.py"
+    path.write_text(src)
+    assert lint_file(str(path)) == [], [
+        f.render() for f in lint_file(str(path))
+    ]
 
 
 def test_inline_and_file_suppressions():

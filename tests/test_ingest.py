@@ -785,3 +785,258 @@ def test_ring_flight_section_carries_generations(monkeypatch):
     finally:
         produced.close()
     assert ring._ring_snapshot() == []  # state dropped on close
+
+
+# ---------------------------------------------------------------------------
+# scx-wire: the device->host choke point + overlapped writeback ring
+
+
+def test_pull_records_ledger_and_returns_host(recording):
+    from sctools_tpu.obs import xprof
+
+    buf = np.arange(1 << 12, dtype=np.int32)
+    device, _ = ingest.upload(buf, site="test.wire")
+    host, nbytes = ingest.pull(device, site="test.wire_pull")
+    assert isinstance(host, np.ndarray)
+    assert np.array_equal(host, buf)
+    assert nbytes == buf.nbytes
+    entry = xprof.ledger_totals()["d2h"]["by_site"]["test.wire_pull"]
+    assert entry["bytes"] == buf.nbytes
+    assert entry["events"] == 1
+    assert entry["seconds"] == 0.0  # hot-path pulls record no seconds
+
+
+def test_pull_tree_and_wasted_accounting(recording):
+    from sctools_tpu.obs import xprof
+
+    device, _ = ingest.upload(
+        {"a": np.zeros(64, np.int32), "b": np.ones(32, np.float32)},
+        site="test.wire",
+    )
+    host, nbytes = ingest.pull(device, site="test.wire_tree", wasted=128)
+    assert set(host) == {"a", "b"}
+    assert nbytes == 64 * 4 + 32 * 4
+    entry = xprof.ledger_totals()["d2h"]["by_site"]["test.wire_tree"]
+    assert entry["wasted"] == 128
+    # waste can also be attributed after the fact (the sharded writeback
+    # learns its pad fraction from the pull itself)
+    xprof.record_transfer_waste("d2h", "test.wire_tree", 64)
+    entry = xprof.ledger_totals()["d2h"]["by_site"]["test.wire_tree"]
+    assert entry["wasted"] == 192
+    assert entry["events"] == 1  # waste attribution is not a transfer
+
+
+def test_pull_timed_records_seconds(recording):
+    from sctools_tpu.obs import xprof
+
+    device, _ = ingest.upload(np.zeros(1 << 16, np.int32), site="test.wire")
+    ingest.pull(device, site="test.wire_timed", timed=True)
+    assert (
+        xprof.ledger_totals()["d2h"]["by_site"]["test.wire_timed"]["seconds"]
+        > 0
+    )
+    with ingest.timed_pulls():
+        ingest.pull(device, site="test.wire_timed_ctx")
+    assert (
+        xprof.ledger_totals()["d2h"]["by_site"]["test.wire_timed_ctx"][
+            "seconds"
+        ]
+        > 0
+    )
+
+
+def test_pull_retries_transient_in_place(recording, monkeypatch):
+    # a transient mid-materialization re-pulls the device-resident value
+    calls = {"n": 0}
+    device, _ = ingest.upload(np.arange(16, dtype=np.int32), site="test.wire")
+    import jax
+
+    real_tree_map = jax.tree_util.tree_map
+
+    def flaky_tree_map(fn, value):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            from sctools_tpu.guard import Transient
+
+            raise Transient("d2h blip")
+        return real_tree_map(fn, value)
+
+    monkeypatch.setattr(jax.tree_util, "tree_map", flaky_tree_map)
+    host, _ = ingest.pull(device, site="test.wire_retry")
+    assert np.array_equal(host, np.arange(16))
+    assert calls["n"] == 2
+
+
+def test_writeback_ring_flight_section_and_fifo(recording):
+    from sctools_tpu.ingest import wire
+
+    ring = ingest.WritebackRing(name="test", slots=3)
+    try:
+        device, _ = ingest.upload(np.arange(8, dtype=np.int32), site="t")
+        staged = ring.stage(device)
+        entries = [e for e in wire._wire_snapshot() if e["name"] == "test"]
+        assert entries and entries[-1]["staged"] == 1
+        assert entries[-1]["inflight"] == [0]
+        host, nbytes = ring.collect(staged, site="test.wire_ring")
+        assert np.array_equal(host, np.arange(8))
+        assert nbytes == 32
+        entries = [e for e in wire._wire_snapshot() if e["name"] == "test"]
+        assert entries[-1]["drained"] == 1
+        assert entries[-1]["inflight"] == []
+        assert entries[-1]["phase"] == "idle"
+    finally:
+        ring.close()
+    assert [e for e in wire._wire_snapshot() if e["name"] == "test"] == []
+
+
+def test_wire_overlap_env_knob(monkeypatch):
+    monkeypatch.delenv("SCTOOLS_TPU_WIRE_OVERLAP", raising=False)
+    assert ingest.wire_overlap_enabled()
+    monkeypatch.setenv("SCTOOLS_TPU_WIRE_OVERLAP", "0")
+    assert not ingest.wire_overlap_enabled()
+
+
+@_NATIVE
+def test_overlapped_vs_blocking_writeback_byte_identity(
+    sorted_bam, tmp_path, monkeypatch
+):
+    """The tentpole parity contract: the overlapped (copy_to_host_async)
+    and blocking writeback paths publish byte-identical CSVs — the async
+    kick is a hint, the guarded blocking pull is the authority."""
+    import gzip
+
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    path, _ = sorted_bam
+    monkeypatch.delenv("SCTOOLS_TPU_WIRE_OVERLAP", raising=False)
+    GatherCellMetrics(
+        path, str(tmp_path / "overlapped"), backend="device",
+        batch_records=32,
+    ).extract_metrics()
+    monkeypatch.setenv("SCTOOLS_TPU_WIRE_OVERLAP", "0")
+    GatherCellMetrics(
+        path, str(tmp_path / "blocking"), backend="device",
+        batch_records=32,
+    ).extract_metrics()
+    with gzip.open(tmp_path / "overlapped.csv.gz", "rb") as f:
+        overlapped = f.read()
+    with gzip.open(tmp_path / "blocking.csv.gz", "rb") as f:
+        blocking = f.read()
+    assert overlapped == blocking
+
+
+@_NATIVE
+@pytest.mark.timeout(300)
+def test_sigterm_mid_writeback_ring_flight_then_recovery(
+    tmp_path, sorted_bam
+):
+    """SIGTERM landing while the writeback ring holds staged blocks (the
+    first drain stalled at the pull site): the flight record's
+    ``writeback_slots`` section names the in-flight batches, no partial
+    CSV is published, and a clean re-run merges byte-identically."""
+    import gzip
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    path, _ = sorted_bam
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "guard_sigterm_worker.py"
+    )
+    trace_dir = tmp_path / "trace"
+    stem = str(tmp_path / "out")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["SCTOOLS_TPU_TRACE"] = str(trace_dir)
+    env["SCTOOLS_TPU_TRACE_WORKER"] = "w0"
+    # the FIRST drain stalls at the pull site, far longer than the test:
+    # by then three batches have dispatched, so the writeback ring holds
+    # staged blocks whose D2H was kicked but never drained
+    env["SCTOOLS_TPU_FAULTS"] = "stall@gatherer.writeback:secs=600"
+
+    proc = subprocess.Popen(
+        [sys.executable, worker, path, stem, "16"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    try:
+        trace_file = trace_dir / "trace.w0.jsonl"
+        deadline = time.time() + 120
+        computes = 0
+        while time.time() < deadline and computes < 3:
+            if trace_file.exists():
+                computes = trace_file.read_text().count('"compute"')
+            time.sleep(0.2)
+        assert computes >= 3, "worker never filled the writeback pipeline"
+        time.sleep(1.5)  # let the first drain enter the injected stall
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode != 0, out
+
+    flight = trace_dir / "flight.w0.jsonl"
+    assert flight.exists(), "SIGTERM must leave a flight record"
+    meta = json.loads(flight.read_text().splitlines()[0])
+    sections = meta.get("sections") or {}
+    # the writeback ring was mid-flight: staged blocks not yet drained
+    wb = sections.get("writeback_slots") or []
+    assert wb, sections.keys()
+    ring_entry = wb[-1]
+    assert ring_entry["staged"] >= 1, ring_entry
+    assert ring_entry["staged"] > ring_entry["drained"], ring_entry
+    assert ring_entry["inflight"], ring_entry
+    # no partial CSV was published (the atomic-commit contract held)
+    assert not os.path.exists(stem + ".csv.gz")
+
+    # a clean re-run converges and matches an in-process clean run
+    env_clean = dict(env)
+    env_clean.pop("SCTOOLS_TPU_FAULTS", None)
+    env_clean["SCTOOLS_TPU_TRACE_WORKER"] = "w1"
+    proc = subprocess.run(
+        [sys.executable, worker, path, stem, "16"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env_clean, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    clean = str(tmp_path / "clean")
+    GatherCellMetrics(
+        path, clean, backend="device", batch_records=16
+    ).extract_metrics()
+    with gzip.open(stem + ".csv.gz", "rb") as f:
+        got = f.read()
+    with gzip.open(clean + ".csv.gz", "rb") as f:
+        assert got == f.read()
+
+
+def test_pull_leg_falls_back_to_compute_deadline(monkeypatch):
+    """Watchdog coverage must not silently regress for deployments that
+    only set SCTOOLS_TPU_GUARD_TIMEOUT_COMPUTE (the leg that covered the
+    blocking writeback before scx-wire): with PULL unset the pull rides
+    the compute deadline; with PULL set it gets its own leg."""
+    from sctools_tpu import guard
+    from sctools_tpu.ingest import wire
+
+    captured = {}
+    real_retrying = guard.retrying
+
+    def spying_retrying(fn, **kwargs):
+        captured["leg"] = kwargs.get("leg")
+        return real_retrying(fn, **kwargs)
+
+    monkeypatch.setattr(wire.guard, "retrying", spying_retrying)
+    monkeypatch.delenv("SCTOOLS_TPU_GUARD_TIMEOUT_PULL", raising=False)
+    wire.pull(np.zeros(4, np.int32), site="test.leg")
+    assert captured["leg"] == "compute"
+    monkeypatch.setenv("SCTOOLS_TPU_GUARD_TIMEOUT_PULL", "30")
+    wire.pull(np.zeros(4, np.int32), site="test.leg")
+    assert captured["leg"] == "pull"

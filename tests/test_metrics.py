@@ -258,3 +258,41 @@ def test_uncompressed_output(tmp_path):
     GatherCellMetrics(bam, out, compress=False, backend="device").extract_metrics()
     text = open(out + ".csv").read()
     assert text.startswith(",n_reads,")
+
+
+def test_wire_block_views_back_without_copy(tmp_path):
+    """The compacted wire block's column-major layout means BOTH halves
+    of the pulled buffer are zero-copy views: _do_finalize_device_batch
+    must hand _write_device_rows arrays that share memory with the block
+    (the old row-major layout forced an ascontiguousarray copy of the
+    float half every batch)."""
+    from sctools_tpu.metrics.gatherer import wire_result_names
+    from sctools_tpu.metrics.schema import CELL_COLUMNS
+
+    int_names, float_names = wire_result_names(CELL_COLUMNS)
+    n_cols = len(int_names) + len(float_names)
+    k = 128
+    block = np.arange(n_cols * k, dtype=np.int32).reshape(n_cols, k)
+    captured = {}
+
+    class _Spy(GatherCellMetrics):
+        def _write_device_rows(
+            self, entity_names, n_entities, ints_names, flts_names,
+            ints, floats, out,
+        ):
+            captured["ints"] = ints
+            captured["floats"] = floats
+
+    gatherer = _Spy.__new__(_Spy)
+    gatherer._do_finalize_device_batch(
+        ["e"], block, 1, int_names, float_names, out=None
+    )
+    assert captured["ints"].dtype == np.int32
+    assert captured["floats"].dtype == np.float32
+    assert np.shares_memory(captured["ints"], block)
+    assert np.shares_memory(captured["floats"], block)
+    # and the float half is the exact bit pattern of the int lanes
+    assert (
+        captured["floats"].view(np.int32).tobytes()
+        == block[len(int_names):].tobytes()
+    )

@@ -517,3 +517,98 @@ def test_fleet_task_occupancy_and_diagnosis(tmp_path):
     assert "occupancy" in stragglers[0]["diagnosis"], stragglers[0]
     rendered = fleet.render_timeline(run, analysis)
     assert "occ%" in rendered and "slow because" in rendered
+
+
+# --------------------------------------------------- scx-wire telemetry
+
+
+def test_entity_buckets_inside_contract_universe():
+    """The new entity-bucket vocabulary stays statically closed: every
+    entity_bucket output is admissible under the emitted shape contract
+    (pow2s from the ENTITY_BUCKET_MIN floor), so the compacted pull can
+    never trip the signature gate."""
+    from sctools_tpu.analysis.shardcheck import (
+        build_shape_contract,
+        dim_admissible,
+    )
+    from sctools_tpu.ops.segments import ENTITY_BUCKET_MIN, entity_bucket
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    contract = build_shape_contract(
+        [
+            os.path.join(repo, "sctools_tpu"),
+            os.path.join(repo, "bench.py"),
+            os.path.join(repo, "__graft_entry__.py"),
+        ]
+    )
+    assert contract["pow2_min"] <= ENTITY_BUCKET_MIN
+    cap = 1 << 20
+    for n in (0, 1, 63, 64, 65, 1000, 4097, 65536, 1 << 19, (1 << 20) + 5):
+        k = entity_bucket(n, cap)
+        assert k >= min(max(n, 1), cap)
+        assert dim_admissible(k, contract), (n, k)
+        # the <= 2x waste property extends to the entity vocabulary
+        if n >= ENTITY_BUCKET_MIN and n <= cap:
+            assert k < 2 * n or k == ENTITY_BUCKET_MIN
+
+
+def test_wasted_d2h_rides_ledger_report_and_render(recording, tmp_path):
+    """record_transfer(wasted=) + record_transfer_waste land in the
+    ledger, survive dump/merge, surface as the efficiency report's
+    wasted_d2h_bytes total, and render in the ledger section."""
+    xprof.record_transfer("d2h", 1000, site="gatherer.writeback", wasted=400)
+    xprof.record_transfer_waste("d2h", "gatherer.writeback", 100)
+    totals = xprof.ledger_totals()
+    assert totals["d2h"]["wasted"] == 500
+    entry = totals["d2h"]["by_site"]["gatherer.writeback"]
+    assert entry == {
+        "bytes": 1000, "seconds": 0.0, "events": 1, "wasted": 500,
+    }
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    assert xprof.dump(str(run_dir / "xprof.w0.json"), worker="w0")
+    report = xprof.efficiency_report(str(run_dir))
+    assert report["totals"]["wasted_d2h_bytes"] == 500
+    assert (
+        report["ledger"]["d2h"]["by_site"]["gatherer.writeback"]["wasted"]
+        == 500
+    )
+    rendered = xprof.render_efficiency(report)
+    assert "pad" in rendered  # the wasted-D2H column rendered
+
+
+def test_gatherer_compact_site_feeds_suggest(recording, tmp_path):
+    """The compacted writeback records entity-bucket occupancy telemetry
+    under metrics.compact_results_wire, so `obs efficiency --suggest`
+    covers the new entity buckets, and its pad waste lands in the
+    wasted-D2H ledger column."""
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    bam = str(tmp_path / "t.bam")
+    _small_bam(bam)
+    gatherer = GatherCellMetrics(
+        bam, str(tmp_path / "out"), backend="device", batch_records=24
+    )
+    gatherer.extract_metrics()
+    snap = xprof.snapshot()
+    site = snap["sites"]["metrics.compact_results_wire"]
+    assert site["dispatches"] >= 2
+    assert site["real_rows"] >= 1
+    assert site["padded_rows"] >= site["real_rows"]
+    # suggest covers the entity-bucket site
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    assert xprof.dump(str(run_dir / "xprof.w0.json"), worker="w0")
+    report = xprof.efficiency_report(str(run_dir))
+    suggestions = xprof.suggest_buckets(report)
+    assert any(
+        s["site"] == "metrics.compact_results_wire" for s in suggestions
+    )
+    # pad rows x row bytes of the compacted pull landed as waste
+    wasted = xprof.ledger_totals()["d2h"]["by_site"][
+        "gatherer.writeback"
+    ]["wasted"]
+    assert wasted >= 0
+    padded_beyond_real = site["padded_rows"] > site["real_rows"]
+    if padded_beyond_real:
+        assert wasted > 0
