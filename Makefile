@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate docs clean
 
 ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate
 
@@ -17,16 +17,16 @@ native:
 # + tsan.supp audit, sctools_tpu/analysis). Both must pass for `make ci`.
 # tests/ is style-checked but excluded from scx-lint: it hosts the
 # deliberately-bad fixture corpus and test-local jax.config setup.
-# --no-race --no-shard --no-life: `make modelcheck` owns the three
-# whole-package passes (SCX4xx + SCX5xx + SCX6xx, same path set), so ci
-# builds the package model exactly once.
+# --no-race --no-shard --no-life --no-cost: `make modelcheck` owns the
+# four whole-package passes (SCX4xx + SCX5xx + SCX6xx + SCX7xx, same
+# path set), so ci builds the package model exactly once.
 lint:
 	@if $(PY) -c "import ruff" 2>/dev/null; then \
 		$(PY) -m ruff check sctools_tpu tests bench.py __graft_entry__.py; \
 	else \
 		$(PY) -m compileall -q sctools_tpu tests bench.py __graft_entry__.py; \
 	fi
-	$(PY) -m sctools_tpu.analysis --no-race --no-shard --no-life sctools_tpu bench.py __graft_entry__.py
+	$(PY) -m sctools_tpu.analysis --no-race --no-shard --no-life --no-cost sctools_tpu bench.py __graft_entry__.py
 
 # concurrency gate: the scx-race pass (SCX401-404) on its own — lock
 # inventory, acquisition-order cycles, death-path safety, cross-thread
@@ -61,11 +61,25 @@ shardcheck:
 lifecheck:
 	$(PY) -m sctools_tpu.analysis --life-only sctools_tpu bench.py __graft_entry__.py
 
-# the ci shape of racecheck+shardcheck+lifecheck: all three whole-package
-# passes in ONE process (the *-only flags compose), so the package parses
-# once (analysis/astcache) for all three gates
+# device-cost gate: the scx-cost pass (SCX701-705) on its own —
+# transfer-in-hot-loop, redundant device recompute, syncs inside the
+# writeback overlap window, provable pad waste at the bucket vocabulary,
+# ledger-unmetered transfers. The runtime half of the contract (the
+# static transfer-site inventory) runs inside xprof-smoke, which asserts
+# the observed ledger site set of a live 2-worker run is a subset of
+# the inventory with matching directions (docs/static_analysis.md). The
+# acting half is the offline autotuner:
+#   python -m sctools_tpu.analysis --retune <run_dir>
+costcheck:
+	$(PY) -m sctools_tpu.analysis --cost-only sctools_tpu bench.py __graft_entry__.py
+
+# the ci shape of racecheck+shardcheck+lifecheck+costcheck: all four
+# whole-package passes in ONE process (the *-only flags compose), so the
+# package parses once (analysis/astcache — and at most once across
+# processes too: the parse cache persists content-hash-keyed under
+# .scx_cache/) for all four gates
 modelcheck:
-	$(PY) -m sctools_tpu.analysis --race-only --shard-only --life-only sctools_tpu bench.py __graft_entry__.py
+	$(PY) -m sctools_tpu.analysis --race-only --shard-only --life-only --cost-only sctools_tpu bench.py __graft_entry__.py
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -209,3 +223,4 @@ ci-deep: ci native-tsan native-asan native-ubsan
 
 clean:
 	$(MAKE) -C sctools_tpu/native clean
+	rm -rf .scx_cache

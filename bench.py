@@ -40,7 +40,7 @@ Prints ONE JSON line. Flags:
               default absorbs the tunneled link's ~3x day-to-day swing
               (BASELINE.md caveats) while still catching a real cliff.
               Results carrying the scx-xprof fields are also held to
-              retraces_steady_state == 0 and occupancy >= 0.25 — the
+              retraces_steady_state == 0 and occupancy >= 0.35 — the
               device-efficiency regressions link weather cannot excuse —
               and the scx-guard no-fault overhead (measured every run) to
               <= 2% of a representative batch (guard_overhead gate), and
@@ -67,8 +67,11 @@ DEFAULT_TOLERANCE = 0.5
 # padding-occupancy floor for the gate: the bench workload cuts batches at
 # entity boundaries near capacity and buckets its tail, so healthy runs
 # sit far above this; falling below it means the batch cutting or
-# bucketing regressed into mostly-padding dispatches
-OCCUPANCY_FLOOR = 0.25
+# bucketing regressed into mostly-padding dispatches. Raised 0.25 -> 0.35
+# with the scx-cost autotuned bucket floors (ROADMAP item 4's success
+# criterion: the floor rises with retraces still 0; the autotuner can
+# only tighten pads, so healthy occupancy moves up, never down)
+OCCUPANCY_FLOOR = 0.35
 # ingest-roofline floor (ROADMAP item 1's success bar): the overlapped
 # ring's ledger-measured steady-state H2D must reach at least half of what
 # a bulk probe of the same buffer size sustains — below that, per-batch
@@ -258,7 +261,7 @@ def bench_compute_only() -> float:
     # as pipeline bytes in the ledger the transfer floor reads
     device_cols, _ = ingest.upload(
         cols, site="bench.compute_only", record=False
-    )
+    )  # scx-lint: disable=SCX705 -- compute-isolation staging: this leg measures the kernel, and its one-time setup bytes must not count as pipeline traffic in the ledger the transfer floor reads
 
     def run():
         result = compute_entity_metrics(
@@ -270,7 +273,7 @@ def bench_compute_only() -> float:
         # isolates compute
         host, _ = ingest.pull(
             result["n_entities"], site="bench.compute_only", record=False
-        )
+        )  # scx-lint: disable=SCX705 -- compute-isolation scalar sync: part of the same deliberately-unmetered leg as the setup upload above
         return int(host)
 
     run()  # compile + warm
@@ -544,13 +547,14 @@ def bench_wire() -> dict:
     # already a bucket (make_synthetic_columns pads); the explicit
     # bucket_size keeps the static shape discipline visible to scx-shard
     num_segments = bucket_size(len(cols["valid"]))
-    device_cols, _ = ingest.upload(cols, site="bench.wire_setup", record=False)
+    device_cols, _ = ingest.upload(cols, site="bench.wire_setup", record=False)  # scx-lint: disable=SCX705 -- one-time wire-microbench setup staging, deliberately outside the ledger the writeback roofline reads
     result = compute_entity_metrics(
         device_cols, num_segments=num_segments, kind="cell"
     )
     n_entities = int(
         ingest.pull(
             result["n_entities"], site="bench.wire_setup", record=False
+            # scx-lint: disable=SCX705 -- same deliberately-unmetered setup leg: sizes the compacted block, moves no measured bytes
         )[0]
     )
     int_names, float_names = wire_result_names(CELL_COLUMNS)
@@ -581,7 +585,7 @@ def bench_wire() -> dict:
             probe_host[nbytes] = np.zeros(max(nbytes // 4, 1), np.int32)
         device, _ = ingest.upload(
             probe_host[nbytes], site="bench.wire_probe", record=False
-        )
+        )  # scx-lint: disable=SCX705 -- probe staging: the timed pull that follows is the metered crossing; recording the H2D here would double-count every probe pair
         float(device[0])  # ensure the upload landed before the timed pull
         return device
 
@@ -663,12 +667,12 @@ def bench_wire() -> dict:
             with obs.span("bench:wire_drain") as timer:
                 ring.collect(
                     block, site="bench.wire_overlap", record=False
-                )
+                )  # scx-lint: disable=SCX705 -- drain-wall measurement leg: the same bytes were already metered by the compacted leg, so recording the overlap drain would double-count them
             drains.append(timer.duration)
             ingest.pull(
                 next_result["n_entities"], site="bench.wire_setup",
                 record=False,
-            )
+            )  # scx-lint: disable=SCX705 -- scalar sync that retires the overlap compute, not a measured transfer
         legs["overlapped_drain_ms"] = round(
             statistics.median(drains) * 1e3, 3
         )
@@ -1145,6 +1149,12 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         "metric": metric, "value": reference, "vs_baseline": 5.0,
         "occupancy": 0.05, "retraces_steady_state": 0,
     }
+    # legal under the old 0.25 floor, below the autotuned 0.35 one: the
+    # raised-floor semantics are part of the gate's tested contract
+    below_raised_floor = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "occupancy": 0.30, "retraces_steady_state": 0,
+    }
     efficient = {
         "metric": metric, "value": reference, "vs_baseline": 5.0,
         "occupancy": 0.8, "retraces_steady_state": 0,
@@ -1200,6 +1210,10 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         failures.append("steady-state-retracing result passed the gate")
     if check_result(padded_out, repo_dir)["ok"]:
         failures.append("collapsed-occupancy result passed the gate")
+    if check_result(below_raised_floor, repo_dir)["ok"]:
+        failures.append(
+            "below-raised-floor occupancy (0.30 < 0.35) passed the gate"
+        )
     if not check_result(efficient, repo_dir)["ok"]:
         failures.append("healthy result with efficiency fields failed")
     if check_result(ingest_stalled, repo_dir)["ok"]:

@@ -6,7 +6,7 @@ silent retraces, host-device syncs inside traced code, tracer leaks into
 Python control flow, and drift between the hand-written ctypes tables in
 ``native/__init__.py`` and the ``extern "C"`` sources they bind.
 
-Six passes, one CLI (``python -m sctools_tpu.analysis``), all pure
+Seven passes, one CLI (``python -m sctools_tpu.analysis``), all pure
 stdlib — nothing here imports jax, numpy, or the code under analysis:
 
 - :mod:`.jaxlint`  — AST rules SCX101-SCX108 over traced functions;
@@ -28,14 +28,24 @@ stdlib — nothing here imports jax, numpy, or the code under analysis:
   donation inventory), rules SCX601-SCX605, paired with the runtime
   generation witness (:mod:`sctools_tpu.ingest.framedebug`,
   ``SCTOOLS_TPU_FRAME_DEBUG=1``) that the ingest/guard smokes validate
-  live. Same shared parse (:mod:`.astcache`).
+  live. Same shared parse (:mod:`.astcache`);
+- :mod:`.costcheck` — whole-package device-cost & transfer-discipline
+  model (transfer-site inventory, loop-invariance, overlap windows,
+  bucket floors, ledger completeness), rules SCX701-SCX705, paired with
+  the transfer-site inventory witness (``make xprof-smoke`` asserts the
+  observed ledger site set sits inside :func:`transfer_inventory`) and
+  the acting half — :mod:`.retune`, the offline bucket autotuner behind
+  ``--retune``. Same shared parse, which is also PERSISTENT now
+  (:mod:`.astcache` pickles trees content-hash-keyed under
+  ``.scx_cache/``).
 
 Findings carry stable rule ids and honor inline
 ``# scx-lint: disable=SCXNNN`` escape hatches (:mod:`.findings`).
 ``make lint`` runs the CLI after ruff/compileall, making a clean scx-lint
 run part of ``make ci`` mergeability; ``make racecheck`` / ``make
-shardcheck`` / ``make lifecheck`` run the whole-package passes on their
-own, and ``make modelcheck`` (the ci leg) runs all three in one process.
+shardcheck`` / ``make lifecheck`` / ``make costcheck`` run the
+whole-package passes on their own, and ``make modelcheck`` (the ci leg)
+runs all four in one process over one shared parse.
 """
 
 # Re-exports resolve lazily (PEP 562): every library module imports
@@ -46,6 +56,10 @@ own, and ``make modelcheck`` (the ci leg) runs all three in one process.
 _EXPORTS = {
     "ABI_RULES": "abicheck",
     "check_abi": "abicheck",
+    "COST_RULES": "costcheck",
+    "check_cost": "costcheck",
+    "check_transfer_sites": "costcheck",
+    "transfer_inventory": "costcheck",
     "Finding": "findings",
     "Suppressions": "findings",
     "JAX_RULES": "jaxlint",
@@ -67,8 +81,9 @@ _EXPORTS = {
 }
 
 _SUBMODULES = frozenset(
-    {"abicheck", "astcache", "cli", "findings", "jaxlint", "lifecheck",
-     "racecheck", "shardcheck", "suppaudit", "witness"}
+    {"abicheck", "astcache", "cli", "costcheck", "findings", "jaxlint",
+     "lifecheck", "racecheck", "retune", "shardcheck", "suppaudit",
+     "witness"}
 )
 
 
@@ -91,6 +106,7 @@ def __getattr__(name):
 
 __all__ = [
     "ABI_RULES",
+    "COST_RULES",
     "Finding",
     "JAX_RULES",
     "LIFE_RULES",
@@ -101,13 +117,16 @@ __all__ = [
     "audit_suppressions",
     "build_shape_contract",
     "check_abi",
+    "check_cost",
     "check_life",
     "check_races",
     "check_shards",
     "check_signatures",
+    "check_transfer_sites",
     "dim_admissible",
     "lint_file",
     "lock_graph",
     "make_lock",
     "make_rlock",
+    "transfer_inventory",
 ]

@@ -1,11 +1,25 @@
 """Shared parse cache for the whole-package analysis passes.
 
-The interprocedural passes (racecheck SCX4xx, shardcheck SCX5xx) each
-build a package-wide model from the same ``.py`` files. One ``make
-shardcheck`` invocation runs both over one model build: this cache makes
-"one build" literal — every file is read and ``ast.parse``d exactly once
-per process, keyed by (path, mtime_ns, size) so a test that rewrites a
-tmp file still reparses.
+The interprocedural passes (racecheck SCX4xx, shardcheck SCX5xx,
+lifecheck SCX6xx, costcheck SCX7xx) each build a package-wide model from
+the same ``.py`` files. One ``make modelcheck`` invocation runs all four
+over one model build: the in-memory layer makes "one build" literal —
+every file is read and ``ast.parse``d exactly once per process, keyed by
+(path, mtime_ns, size) so a test that rewrites a tmp file still
+reparses.
+
+The cache is also PERSISTENT across invocations: parsed trees pickle to
+a content-hash-keyed store (``.scx_cache/`` under the working directory,
+or ``SCTOOLS_TPU_SCX_CACHE`` when set; ``SCTOOLS_TPU_SCX_CACHE=0``
+disables). ``make lint`` followed by ``make modelcheck`` runs two
+processes over the same ~100 files; with the store warm the second pays
+unpickles instead of parses, which is what keeps four whole-package
+passes inside the wall-clock budget three passes used to have. Keys
+carry the interpreter version (pickled AST layout is not stable across
+Pythons) and the exact source hash, so an edited file can never hit
+stale; corrupt or unreadable store entries silently fall back to a real
+parse. :data:`stats` counts parsed / disk-hit / memory-hit so the CLI
+can print cache effectiveness.
 
 Pure stdlib, imports nothing under analysis (the scx-lint ground rule).
 """
@@ -13,15 +27,74 @@ Pure stdlib, imports nothing under analysis (the scx-lint ground rule).
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
+import pickle
+import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # directory names never worth walking into — the ONE copy, shared by the
 # cli file walk and every whole-package model build
-SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", "node_modules"}
+SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", "node_modules",
+             ".scx_cache"}
+
+CACHE_ENV = "SCTOOLS_TPU_SCX_CACHE"
+_DEFAULT_CACHE_DIR = ".scx_cache"
 
 # (abspath, mtime_ns, size) -> (source text, parsed tree)
 _cache: Dict[Tuple[str, int, int], Tuple[str, ast.Module]] = {}
+
+# per-process effectiveness counters (the CLI prints them):
+# parsed = real ast.parse calls; disk_hits = unpickled from the
+# persistent store; memory_hits = same-process re-reads
+stats = {"parsed": 0, "disk_hits": 0, "memory_hits": 0}
+
+
+def _store_dir() -> Optional[str]:
+    configured = os.environ.get(CACHE_ENV)
+    if configured is not None:
+        if configured in ("", "0"):
+            return None
+        return configured
+    return _DEFAULT_CACHE_DIR
+
+
+def _store_path(source: str) -> Optional[str]:
+    directory = _store_dir()
+    if directory is None:
+        return None
+    digest = hashlib.sha256(source.encode("utf-8")).hexdigest()
+    version = f"py{sys.version_info[0]}{sys.version_info[1]}"
+    return os.path.join(directory, f"{digest}.{version}.ast.pkl")
+
+
+def _store_load(source: str) -> Optional[ast.Module]:
+    path = _store_path(source)
+    if path is None:
+        return None
+    try:
+        with open(path, "rb") as f:
+            tree = pickle.load(f)
+    except Exception:  # noqa: BLE001 - any corrupt entry means reparse
+        return None
+    return tree if isinstance(tree, ast.Module) else None
+
+
+def _store_save(source: str, tree: ast.Module) -> None:
+    path = _store_path(source)
+    if path is None:
+        return
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "wb") as f:
+            pickle.dump(tree, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
 
 
 def parse_cached(path: str) -> Optional[Tuple[str, ast.Module]]:
@@ -37,10 +110,17 @@ def parse_cached(path: str) -> Optional[Tuple[str, ast.Module]]:
         key = (abspath, stat.st_mtime_ns, stat.st_size)
         hit = _cache.get(key)
         if hit is not None:
+            stats["memory_hits"] += 1
             return hit
         with open(abspath, encoding="utf-8") as f:
             source = f.read()
-        tree = ast.parse(source, filename=path)
+        tree = _store_load(source)
+        if tree is not None:
+            stats["disk_hits"] += 1
+        else:
+            tree = ast.parse(source, filename=path)
+            stats["parsed"] += 1
+            _store_save(source, tree)
     except (OSError, SyntaxError):
         return None
     _cache[key] = (source, tree)

@@ -1,6 +1,6 @@
 """scx-lint CLI: ``python -m sctools_tpu.analysis [paths...]``.
 
-Runs six passes and exits non-zero when any finding survives
+Runs seven passes and exits non-zero when any finding survives
 suppressions:
 
 1. JAX lint (SCX1xx) over every ``.py`` file under the given paths;
@@ -21,16 +21,26 @@ suppressions:
    (``--life-only`` runs just this pass — ``make lifecheck``; the
    runtime half is the ingest generation witness,
    ``SCTOOLS_TPU_FRAME_DEBUG=1``, validated by the ingest/guard
-   smokes).
+   smokes);
+7. device-cost & transfer-discipline check (SCX7xx) over the same model
+   build (``--cost-only`` runs just this pass — ``make costcheck``;
+   ``--emit-transfer-inventory FILE`` writes the static transfer-site
+   inventory the xprof smoke validates the observed ledger against, and
+   ``--retune <run_dir>`` is the acting half: the offline autotuner
+   that rewrites the pinned bucket floors in ``ops/segments.py`` from
+   recorded registries, double-gated by shardcheck + shape-contract
+   coverage).
 
 ``--json`` replaces the human-readable output with one machine-readable
 findings array covering every pass that ran (rule, path, line, message).
 
 The module imports nothing heavyweight (no jax, no numpy), so the gate
-adds milliseconds to ``make lint``. Passes 4-6 share one parse per file
-through :mod:`.astcache`, so ``--race-only --shard-only --life-only``
-style CI splits (``make modelcheck``) do not pay the package walk three
-times in one process.
+adds milliseconds to ``make lint``. Passes 4-7 share one parse per file
+through :mod:`.astcache` — in-process AND across invocations (the
+content-hash-keyed ``.scx_cache/`` store; the summary line reports
+parse-cache effectiveness) — so ``--race-only --shard-only --life-only
+--cost-only`` style CI splits (``make modelcheck``) do not pay the
+package parse four times.
 """
 
 from __future__ import annotations
@@ -43,6 +53,12 @@ from typing import List, Optional
 
 from .abicheck import ABI_RULES, check_abi
 from .astcache import SKIP_DIRS as _SKIP_DIRS
+from .astcache import stats as _parse_stats
+from .costcheck import (
+    COST_RULES,
+    check_cost,
+    transfer_inventory,
+)
 from .findings import Finding
 from .jaxlint import JAX_RULES, lint_file
 from .lifecheck import LIFE_RULES, check_life
@@ -107,6 +123,7 @@ def _print_rules() -> None:
         ("concurrency / death path", RACE_RULES),
         ("shape / sharding flow", SHARD_RULES),
         ("frame lifetime / aliasing", LIFE_RULES),
+        ("device cost / transfer discipline", COST_RULES),
     ):
         print(f"  {title}:")
         for rule_id, slug in sorted(rules.items()):
@@ -164,6 +181,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="run ONLY the SCX6xx frame-lifetime pass (make lifecheck)",
     )
     parser.add_argument(
+        "--no-cost", action="store_true",
+        help="skip the SCX7xx device-cost pass",
+    )
+    parser.add_argument(
+        "--cost-only", action="store_true",
+        help="run ONLY the SCX7xx device-cost pass (make costcheck)",
+    )
+    parser.add_argument(
         "--emit-lock-graph", metavar="FILE", default=None,
         help="write the static lock inventory + acquisition-order graph "
         "as JSON (the SCTOOLS_TPU_LOCK_GRAPH contract file for the "
@@ -174,6 +199,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="write the statically predicted per-site signature/sharding "
         "universe as JSON (the shape-contract file the xprof/ingest "
         "smokes assert the merged runtime registries against) and exit",
+    )
+    parser.add_argument(
+        "--emit-transfer-inventory", metavar="FILE", default=None,
+        help="write the statically-enumerated transfer-site inventory as "
+        "JSON (the set the xprof smoke asserts the observed ledger "
+        "sites against) and exit",
+    )
+    parser.add_argument(
+        "--retune", metavar="RUN_DIR", default=None,
+        help="the scx-cost autotuner: read the recorded xprof "
+        "registries under RUN_DIR, derive tightened bucket floors "
+        "(obs efficiency --suggest is the advice engine), rewrite the "
+        "pinned RECORD_BUCKET_MIN/ENTITY_BUCKET_MIN in ops/segments.py "
+        "under the given paths, and gate the edit (shardcheck must stay "
+        "green; the regenerated shape contract must cover every "
+        "observed signature — exit 5 and restore on rejection)",
+    )
+    parser.add_argument(
+        "--retune-target", type=float, default=0.35,
+        help="occupancy target handed to the suggestion engine "
+        "(default: 0.35, the bench --check floor)",
+    )
+    parser.add_argument(
+        "--retune-dry-run", action="store_true",
+        help="with --retune: derive and report the constants but write "
+        "nothing",
+    )
+    parser.add_argument(
+        "--segments-file", metavar="FILE", default=None,
+        help="with --retune: the segments file holding the pinned "
+        "floors (default: the ops/segments.py found under paths)",
     )
     parser.add_argument(
         "--json", action="store_true",
@@ -225,16 +281,47 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         return 0
 
-    only_flags = args.race_only or args.shard_only or args.life_only
+    if args.emit_transfer_inventory is not None:
+        inventory = transfer_inventory(args.paths)
+        _dump_json(inventory, args.emit_transfer_inventory)
+        if not args.quiet:
+            occurrences = sum(
+                len(entry["occurrences"])
+                for entry in inventory["sites"].values()
+            )
+            print(
+                f"scx-cost: wrote {len(inventory['sites'])} transfer "
+                f"site(s) across {occurrences} call site(s) to "
+                f"{args.emit_transfer_inventory}"
+            )
+        return 0
+
+    if args.retune is not None:
+        from .retune import retune
+
+        code, _ = retune(
+            args.retune,
+            args.paths,
+            target=args.retune_target,
+            segments_file=args.segments_file,
+            apply=not args.retune_dry_run,
+        )
+        return code
+
+    only_flags = (
+        args.race_only or args.shard_only or args.life_only
+        or args.cost_only
+    )
     if only_flags:
         # the *-only flags compose: `--race-only --shard-only
-        # --life-only` runs all three whole-package passes over ONE
-        # astcache model build (the `make modelcheck` shape — one
-        # process, one parse per file)
+        # --life-only --cost-only` runs all four whole-package passes
+        # over ONE astcache model build (the `make modelcheck` shape —
+        # one process, one parse per file)
         args.no_jax_lint = args.no_abi = args.no_supp = True
         args.no_race = not args.race_only
         args.no_shard = not args.shard_only
         args.no_life = not args.life_only
+        args.no_cost = not args.cost_only
 
     findings: List[Finding] = []
     checked_files = 0
@@ -269,6 +356,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.extend(check_shards(args.paths))
     if not args.no_life:
         findings.extend(check_life(args.paths))
+    if not args.no_cost:
+        findings.extend(check_cost(args.paths))
     if only_flags and not checked_files:
         from .racecheck import _collect_py_files as _race_files
 
@@ -307,11 +396,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                 ("race", args.no_race),
                 ("shard", args.no_shard),
                 ("life", args.no_life),
+                ("cost", args.no_cost),
             )
             if not skipped
         ]
+        cache_note = ""
+        if _parse_stats["parsed"] or _parse_stats["disk_hits"]:
+            cache_note = (
+                f"; parse cache: {_parse_stats['parsed']} parsed, "
+                f"{_parse_stats['disk_hits']} disk hit(s), "
+                f"{_parse_stats['memory_hits']} in-memory hit(s)"
+            )
         print(
             f"scx-lint: {len(findings)} finding(s) across {checked_files} "
             f"python file(s); passes: {', '.join(passes) or 'none'}"
+            + cache_note
         )
     return 1 if findings else 0

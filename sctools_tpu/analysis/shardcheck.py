@@ -215,6 +215,14 @@ class ShardModel:
         self.axis_universe: Set[str] = set()
         self.bucket_minimums: Set[int] = set()
         self.pad_multiples: Set[int] = set()
+        # the pinned record-bucket floor from ops/segments.py — the
+        # bucket_size default, and therefore the padded-record base the
+        # monoblock wire envelope builds on. The scx-cost autotuner
+        # (--retune) rewrites the pin, so the contract must READ it
+        # rather than hardcode 4096: a retuned tree's next live run
+        # emits wire dims at the new floor and the smokes' subset check
+        # has to keep admitting them.
+        self.record_bucket_min: int = 4096
         self.builder_quals: Set[str] = set()  # functions that build jits
         self.traced_quals: Set[str] = set()  # jit/shard_map wrapped defs
         # site name -> static param name -> set of literal values (None in
@@ -395,6 +403,7 @@ class _Analyzer:
         return ".".join(parts) or None
 
     def _collect_constants(self, mod: ModInfo) -> None:
+        is_segments = mod.name.endswith("segments")
         for stmt in mod.tree.body:
             if not isinstance(stmt, ast.Assign):
                 continue
@@ -402,6 +411,13 @@ class _Analyzer:
                 if not isinstance(target, ast.Name):
                     continue
                 value = stmt.value
+                if (
+                    is_segments
+                    and target.id == "RECORD_BUCKET_MIN"
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, int)
+                ):
+                    self.model.record_bucket_min = int(value.value)
                 text = _const_str(value)
                 if text is not None:
                     mod.str_constants[target.id] = text
@@ -1573,7 +1589,9 @@ def build_shape_contract(
     """
     if model is None:
         model = build_model(paths)
-    minimums = sorted(model.bucket_minimums | {4096}) or [4096]
+    minimums = sorted(
+        model.bucket_minimums | {model.record_bucket_min}
+    ) or [4096]
     sites: Dict[str, Any] = {}
     for site in model.jit_sites:
         callers = model.site_callers.get(site.name, set())
@@ -1619,6 +1637,9 @@ def build_shape_contract(
             "run_table_lanes": _WIRE_RUN_TABLE_LANES,
             "min_record_bytes": _WIRE_MIN_RECORD_BYTES,
             "max_record_bytes": _WIRE_MAX_RECORD_BYTES,
+            # the padded-record base of the wire envelope = the pinned
+            # bucket_size floor (autotuner-rewritten; 4096 by default)
+            "pad_min": model.record_bucket_min,
         },
         "sites": sites,
     }
@@ -1656,8 +1677,9 @@ def dim_admissible(dim: int, contract: Dict[str, Any]) -> bool:
     base = dim - header
     if base <= 0:
         return False
-    run_options = [0] + _pow2s(4096, 1 << 26)
-    for padded in _pow2s(4096):
+    pad_min = int(wire.get("pad_min", 4096))
+    run_options = [0] + _pow2s(pad_min, 1 << 26)
+    for padded in _pow2s(pad_min):
         if padded * lo // 4 > base:
             break
         for runs in run_options:

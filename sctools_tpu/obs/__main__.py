@@ -257,11 +257,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--suggest", action="store_true",
         help="print suggested bucket/pad_to sizes per site (smallest "
         "power-of-two pad holding the mean dispatch) instead of the "
-        "report; report-only, changes nothing online",
+        "report; --json emits the same rows machine-readably — the "
+        "exact advice the scx-cost autotuner (python -m "
+        "sctools_tpu.analysis --retune) consumes",
     )
     efficiency.add_argument(
-        "--target", type=float, default=0.25,
-        help="occupancy target for --suggest (default: 0.25, the "
+        "--target", type=float, default=0.35,
+        help="occupancy target for --suggest (default: 0.35, the "
         "bench --check floor)",
     )
     args = parser.parse_args(argv)

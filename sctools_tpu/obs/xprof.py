@@ -955,23 +955,35 @@ def efficiency_report(run_dir: str) -> Dict[str, Any]:
     }
 
 
+# dispatch sites sized by the ENTITY bucket vocabulary
+# (ops.segments.entity_bucket / ENTITY_BUCKET_MIN); every other site
+# rides the record vocabulary (bucket_size / RECORD_BUCKET_MIN). The
+# `constant` each suggestion row carries is what the scx-cost autotuner
+# (`python -m sctools_tpu.analysis --retune`) folds the advice onto.
+ENTITY_BUCKET_SITES = frozenset({"metrics.compact_results_wire"})
+
+
 def suggest_buckets(
-    report: Dict[str, Any], target: float = 0.25
+    report: Dict[str, Any], target: float = 0.35
 ) -> List[Dict[str, Any]]:
     """Offline bucket/pad suggestions from recorded dispatch telemetry.
 
-    Seeds the occupancy-autotuned bucketing roadmap item as a pure
-    report: per site with occupancy telemetry, the smallest power-of-two
-    pad that holds the site's mean real rows per dispatch — the tightest
-    bucket floor that fits the observed traffic, and (because a pow2
-    ceiling is < 2x the mean) one that always clears any occupancy
-    target <= 0.5. ``projected_occupancy`` is what the mean dispatch
-    would score at that pad; ``meets_target`` compares it against
-    ``target`` (the ``bench.py --check`` floor by default). No online
-    behavior changes here — the numbers are inputs for a human editing
-    ``pad_to``/``bucket_size`` minimums, with the usual trade stated in
-    the render: a lower floor raises occupancy but lets more distinct
-    shapes through to the compiler.
+    The single source of truth for bucket advice: ``obs efficiency
+    --suggest`` renders these rows for humans, ``--suggest --json``
+    emits them verbatim for machines, and the scx-cost autotuner
+    (``python -m sctools_tpu.analysis --retune``,
+    :mod:`sctools_tpu.analysis.retune`) consumes them to rewrite the
+    pinned floors in ``ops/segments.py``. Per site with occupancy
+    telemetry: the smallest power-of-two pad that holds the site's mean
+    real rows per dispatch — the tightest bucket floor that fits the
+    observed traffic, and (because a pow2 ceiling is < 2x the mean) one
+    that always clears any occupancy target <= 0.5.
+    ``projected_occupancy`` is what the mean dispatch would score at
+    that pad; ``meets_target`` compares it against ``target`` (the
+    ``bench.py --check`` floor by default); ``unit``/``constant`` name
+    the bucket vocabulary the site dispatches under and the pinned
+    constant the advice applies to. The schema is pinned by
+    tests/test_xprof.py — the autotuner parses these exact keys.
     """
     rows: List[Dict[str, Any]] = []
     for name in sorted(report.get("sites") or {}):
@@ -986,6 +998,7 @@ def suggest_buckets(
         while suggested < mean_real:
             suggested *= 2
         projected = mean_real / suggested
+        unit = "entity" if name in ENTITY_BUCKET_SITES else "record"
         rows.append(
             {
                 "site": name,
@@ -996,19 +1009,26 @@ def suggest_buckets(
                 "suggested_pad": suggested,
                 "projected_occupancy": round(projected, 4),
                 "meets_target": projected >= target,
+                "unit": unit,
+                "constant": (
+                    "ENTITY_BUCKET_MIN"
+                    if unit == "entity"
+                    else "RECORD_BUCKET_MIN"
+                ),
             }
         )
     return rows
 
 
 def render_suggestions(
-    suggestions: List[Dict[str, Any]], target: float = 0.25
+    suggestions: List[Dict[str, Any]], target: float = 0.35
 ) -> str:
     """The human-facing ``obs efficiency --suggest`` report."""
     lines: List[str] = []
     lines.append(
         f"bucket/pad suggestions (occupancy target {100 * target:.0f}%; "
-        "report-only — edit pad_to/bucket_size minimums by hand):"
+        "apply with `python -m sctools_tpu.analysis --retune <run_dir>` "
+        "— double-gated by shardcheck + shape-contract coverage):"
     )
     if not suggestions:
         lines.append(

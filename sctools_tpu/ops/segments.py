@@ -13,7 +13,7 @@ out via the ``valid`` array.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -235,20 +235,16 @@ def pad_to(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
-def bucket_size(n: int, minimum: int = 4096) -> int:
-    """Power-of-two padded size >= max(n, minimum).
-
-    Bucketing record counts to powers of two bounds the number of distinct
-    compiled shapes (jit specializes per shape) while wasting at most 2x:
-    for n >= minimum the result is < 2n (property-tested by
-    tests/test_xprof.py; the live waste per dispatch is what scx-xprof's
-    occupancy telemetry measures).
-    """
-    size = minimum
-    while size < n:
-        size *= 2
-    return size
-
+# --- pinned bucket floors (scx-cost autotuner targets) ----------------
+# These two constants ARE the bucket vocabulary's tunable surface: the
+# record floor under `bucket_size` and the entity floor under
+# `entity_bucket`. `python -m sctools_tpu.analysis --retune <run_dir>`
+# rewrites them in place from recorded xprof occupancy registries
+# (docs/performance.md), so keep each on its own `NAME = <int>` line —
+# the rewriter matches that shape exactly. Every edit is double-gated:
+# `make shardcheck` must stay green and the regenerated shape contract
+# must cover the recorded signatures before the new values land.
+RECORD_BUCKET_MIN = 4096
 
 # entity counts get their OWN small bucket vocabulary: result rows are an
 # order of magnitude fewer than records (~32 reads/entity on the bench
@@ -258,6 +254,23 @@ def bucket_size(n: int, minimum: int = 4096) -> int:
 # do — pow2s >= 64 are inside the shape contract's bucket universe
 # (pinned by tests/test_xprof.py).
 ENTITY_BUCKET_MIN = 64
+
+
+def bucket_size(n: int, minimum: Optional[int] = None) -> int:
+    """Power-of-two padded size >= max(n, minimum).
+
+    Bucketing record counts to powers of two bounds the number of distinct
+    compiled shapes (jit specializes per shape) while wasting at most 2x:
+    for n >= minimum the result is < 2n (property-tested by
+    tests/test_xprof.py; the live waste per dispatch is what scx-xprof's
+    occupancy telemetry measures). ``minimum`` defaults to the pinned
+    ``RECORD_BUCKET_MIN`` — read at call time, so an autotuned rewrite
+    (or a test monkeypatch) takes effect without re-importing callers.
+    """
+    size = RECORD_BUCKET_MIN if minimum is None else minimum
+    while size < n:
+        size *= 2
+    return size
 
 
 def entity_bucket(n_entities: int, cap: int) -> int:

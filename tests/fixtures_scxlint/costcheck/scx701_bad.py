@@ -1,0 +1,33 @@
+"""SCX701 bad fixture: loop-invariant transfers inside hot loops.
+
+The operand never changes across iterations, so the same bytes cross
+the link once per batch — the hoist/coalesce class PR 11 fixed by hand
+in count.py's per-shard pulls.
+"""
+
+from sctools_tpu.ingest import pull, upload
+
+
+def per_batch_table(batches, table):
+    staged = []
+    for batch in batches:
+        device, _ = upload(table, site="fix.table")  # <- SCX701
+        staged.append((batch.n_records, device))
+    return staged
+
+
+def re_pull_result(frames, device_result):
+    out = []
+    for frame in frames:
+        host, _ = pull(device_result, site="fix.result")  # <- SCX701
+        out.append((frame.n_records, host))
+    return out
+
+
+def nested_loops(chunks, anchor):
+    totals = []
+    for chunk in chunks:
+        while chunk.advance():
+            device, _ = upload(anchor, site="fix.anchor")  # <- SCX701
+            totals.append(device)
+    return totals

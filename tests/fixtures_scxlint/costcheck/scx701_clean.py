@@ -1,0 +1,31 @@
+"""SCX701 clean twin: the invariant transfer is hoisted above the loop,
+and in-loop transfers stage loop-varying operands only."""
+
+from sctools_tpu.ingest import pull, upload
+
+
+def hoisted_table(batches, table):
+    device_table, _ = upload(table, site="fix.table")
+    staged = []
+    for batch in batches:
+        cols = batch.columns()
+        device_batch, _ = upload(cols, site="fix.batch")
+        staged.append((device_batch, device_table))
+    return staged
+
+
+def per_batch_pull(frames, engine):
+    out = []
+    for frame in frames:
+        result = engine(frame)
+        host, _ = pull(result, site="fix.result")
+        out.append(host)
+    return out
+
+
+def loop_target_operand(device_blocks):
+    hosts = []
+    for block in device_blocks:
+        host, _ = pull(block, site="fix.block")
+        hosts.append(host)
+    return hosts

@@ -1,0 +1,28 @@
+"""SCX702 bad fixture: per-iteration recompute of content-stable device
+work — a jit-bound callable invoked with loop-invariant arguments, and a
+helper that re-uploads a pure function of its parameters with no
+content-hash cache guard (the whitelist-table pattern before its cache
+existed).
+"""
+
+from sctools_tpu.ingest import upload
+from sctools_tpu.obs.xprof import instrument_jit
+
+STEP = instrument_jit(lambda x: x * 2, name="fix.step")
+
+
+def upload_expanded(table):
+    # a pure derivation of the parameter: same input -> same bytes, yet
+    # every call pays the H2D again
+    expanded = table * 3
+    device, _ = upload(expanded, site="fix.expanded")
+    return device
+
+
+def drive(batches, table, anchor):
+    outs = []
+    for batch in batches:
+        device = upload_expanded(table)  # <- SCX702
+        stepped = STEP(anchor)  # <- SCX702
+        outs.append((batch.n_records, device, stepped))
+    return outs

@@ -1,0 +1,30 @@
+"""SCX702 clean twin: the helper's upload sits behind a content-hash
+cache (the sanctioned whitelist-table shape), and the jit callable is
+fed loop-varying operands."""
+
+from sctools_tpu.ingest import upload
+from sctools_tpu.obs.xprof import instrument_jit
+
+STEP = instrument_jit(lambda x: x * 2, name="fix.step")
+
+_TABLE_CACHE = {}
+
+
+def upload_expanded(table, key):
+    cached = _TABLE_CACHE.get(key)
+    if cached is not None:
+        return cached
+    expanded = table * 3
+    device, _ = upload(expanded, site="fix.expanded")
+    _TABLE_CACHE[key] = device
+    return device
+
+
+def drive(batches, table, key):
+    outs = []
+    for batch in batches:
+        device = upload_expanded(table, key)
+        cols = batch.columns()
+        stepped = STEP(cols)
+        outs.append((device, stepped))
+    return outs

@@ -1,0 +1,21 @@
+"""SCX703 bad fixture: synchronization inside the writeback overlap
+window — the ring's stage() kicked an async D2H precisely so it could
+run under the next batch's compute, and the sync serializes it."""
+
+import jax
+
+from sctools_tpu.ingest import WritebackRing, pull, timed_pulls
+
+
+def drain_serialized(device_blocks, compute):
+    ring = WritebackRing(name="fix", slots=4)
+    out = []
+    for block in device_blocks:
+        staged = ring.stage(block)
+        following = compute(block)
+        jax.block_until_ready(following)  # <- SCX703
+        with timed_pulls():  # <- SCX703
+            probed, _ = pull(following, site="fix.probe")
+        host, _ = ring.collect(staged, site="fix.drain")
+        out.append((host, probed))
+    return out

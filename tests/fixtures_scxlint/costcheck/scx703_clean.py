@@ -1,0 +1,20 @@
+"""SCX703 clean twin: syncs land before the stage() kick or after the
+collect() drain — the overlap window itself stays sync-free."""
+
+import jax
+
+from sctools_tpu.ingest import WritebackRing, pull, timed_pulls
+
+
+def drain_overlapped(device_blocks, compute):
+    ring = WritebackRing(name="fix", slots=4)
+    out = []
+    for block in device_blocks:
+        jax.block_until_ready(block)
+        staged = ring.stage(block)
+        following = compute(block)
+        host, _ = ring.collect(staged, site="fix.drain")
+        with timed_pulls():
+            probed, _ = pull(following, site="fix.probe")
+        out.append((host, probed))
+    return out

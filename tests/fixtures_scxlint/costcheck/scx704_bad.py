@@ -1,0 +1,12 @@
+"""SCX704 bad fixture: statically provable >= 2x pad waste — constant
+dispatch sizes sitting under half their bucket floor."""
+
+from sctools_tpu.ops.segments import bucket_size, entity_bucket, pad_to
+
+
+def tiny_dispatches():
+    a = bucket_size(12)  # <- SCX704
+    b = bucket_size(100, minimum=1024)  # <- SCX704
+    c = entity_bucket(7, 4096)  # <- SCX704
+    d = pad_to(3, 256)  # <- SCX704
+    return a, b, c, d

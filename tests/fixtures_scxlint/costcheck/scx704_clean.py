@@ -1,0 +1,14 @@
+"""SCX704 clean twin: constant sizes that fill their buckets past half,
+and dynamic sizes the rule never judges (occupancy telemetry owns
+those)."""
+
+from sctools_tpu.ops.segments import bucket_size, entity_bucket, pad_to
+
+
+def snug_dispatches(n):
+    a = bucket_size(9000)
+    b = bucket_size(600, minimum=512)
+    c = entity_bucket(40, 64)
+    d = pad_to(100, 128)
+    e = bucket_size(n)
+    return a, b, c, d, e

@@ -1,0 +1,16 @@
+"""SCX705 bad fixture: transfers the ledger/inventory cannot account —
+a dynamically-built site string, and record=False crossings with no
+adjacent record_transfer."""
+
+from sctools_tpu.ingest import pull, upload
+
+
+def dynamic_site(cols, label):
+    device, _ = upload(cols, site="fix." + label)  # <- SCX705
+    return device
+
+
+def unrecorded(cols, result):
+    device, _ = upload(cols, site="fix.stage", record=False)  # <- SCX705
+    host, _ = pull(result, site="fix.result", record=False)  # <- SCX705
+    return device, host

@@ -1,0 +1,23 @@
+"""SCX705 clean twin: literal sites, the sanctioned probe shape
+(record=False paired with an explicit record_transfer), and a forwarding
+helper whose callers hand it literal sites."""
+
+from sctools_tpu.ingest import upload
+from sctools_tpu.obs.xprof import record_transfer
+
+
+def probe(cols):
+    device, _ = upload(cols, site="fix.probe", record=False)
+    record_transfer("h2d", 123, seconds=0.5, site="fix.probe")
+    return device
+
+
+def timed_entry(site, value):
+    # a forwarding door: the site is this helper's parameter, so the
+    # literals live (and inventory) at the call sites below
+    device, _ = upload(value, site=site)
+    return device
+
+
+def drive(cols):
+    return timed_entry("fix.forwarded", cols)

@@ -37,13 +37,16 @@ from sctools_tpu.analysis import (
     audit_suppressions,
     build_shape_contract,
     check_abi,
+    check_cost,
     check_life,
     check_races,
     check_shards,
     check_signatures,
+    check_transfer_sites,
     dim_admissible,
     lint_file,
     lock_graph,
+    transfer_inventory,
 )
 from sctools_tpu.analysis import witness
 from sctools_tpu.analysis.cli import main as cli_main
@@ -1407,3 +1410,464 @@ def test_cli_json_covers_life_pass(capsys):
     assert set(LIFE_RULE_IDS) <= rules, rules
     for finding in payload["findings"]:
         assert finding["path"] and finding["line"] > 0 and finding["message"]
+
+
+# ----------------------------------------------------- costcheck (SCX7xx)
+
+COST = os.path.join(FIXTURES, "costcheck")
+COST_RULE_IDS = ["SCX701", "SCX702", "SCX703", "SCX704", "SCX705"]
+
+
+@pytest.mark.parametrize("rule", COST_RULE_IDS)
+def test_cost_rule_fires_exactly_on_marked_lines(rule):
+    path = os.path.join(COST, f"{rule.lower()}_bad.py")
+    findings = check_cost([path])
+    assert findings, f"{rule} bad fixture produced no findings"
+    assert {f.rule for f in findings} == {rule}
+    expected = _marked_lines(path, rule)
+    assert expected, f"fixture {path} has no # <- {rule} markers"
+    assert sorted(f.line for f in findings) == expected, [
+        f.render() for f in findings
+    ]
+
+
+@pytest.mark.parametrize("rule", COST_RULE_IDS)
+def test_cost_rule_silent_on_clean_fixture(rule):
+    findings = check_cost(
+        [os.path.join(COST, f"{rule.lower()}_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_cost_real_tree_is_clean():
+    # the audit contract: every SCX701-705 finding on the real tree is
+    # fixed or carries a justified inline suppression (the bench
+    # microbench's deliberately-unmetered setup/probe staging), and this
+    # pin keeps it that way
+    findings = check_cost(TREE)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_cost_inline_suppression(tmp_path):
+    src = (
+        "from sctools_tpu.ingest import upload\n\n\n"
+        "def per_batch(batches, table):\n"
+        "    for batch in batches:\n"
+        "        upload(table, site='fix.table')  "
+        "# scx-lint: disable=SCX701 -- two-batch tool, link idle\n"
+    )
+    path = tmp_path / "suppressed_cost.py"
+    path.write_text(src)
+    assert check_cost([str(path)]) == []
+
+
+def test_cost_ingest_dir_is_exempt(tmp_path):
+    # ingest/ OWNS the choke points: its internal forwarding of dynamic
+    # caller sites is the mechanism, not a violation — the same
+    # immediate-parent ownership line SCX112/SCX114 draw
+    src = (
+        "from sctools_tpu.obs import xprof\n\n\n"
+        "def door(value, site):\n"
+        "    staged = value\n"
+        "    xprof.record_transfer('h2d', 8, site=str(site) + '!')\n"
+        "    return staged\n"
+    )
+    ingest_dir = tmp_path / "ingest"
+    ingest_dir.mkdir()
+    (ingest_dir / "staging.py").write_text(src)
+    assert check_cost([str(ingest_dir / "staging.py")]) == []
+    outside = tmp_path / "staging.py"
+    outside.write_text(src)
+    findings = check_cost([str(outside)])
+    assert {f.rule for f in findings} == {"SCX705"}
+    # only the IMMEDIATE parent confers ownership
+    nested = ingest_dir / "sub"
+    nested.mkdir()
+    (nested / "staging.py").write_text(src)
+    findings = check_cost([str(nested / "staging.py")])
+    assert {f.rule for f in findings} == {"SCX705"}
+
+
+def test_cost_site_forwarding_crosses_helpers(tmp_path):
+    # the bench probe shape: literals live at the callers of a
+    # forwarding helper (two hops), inventory there, and a non-literal
+    # argument is where SCX705 lands
+    src = (
+        "from sctools_tpu.ingest import pull\n\n\n"
+        "def timed_pull(site, value):\n"
+        "    return pull(value, site=site)\n\n\n"
+        "def paired(site, block):\n"
+        "    return timed_pull(site, block)\n\n\n"
+        "def drive(block, label):\n"
+        "    good = paired('fix.compact', block)\n"
+        "    bad = paired('fix.' + label, block)\n"
+        "    return good, bad\n"
+    )
+    path = tmp_path / "forwarding_cost.py"
+    path.write_text(src)
+    findings = check_cost([str(path)])
+    assert [(f.rule, f.line) for f in findings] == [("SCX705", 14)], [
+        f.render() for f in findings
+    ]
+    inventory = transfer_inventory([str(path)])
+    assert inventory["sites"]["fix.compact"]["directions"] == ["d2h"]
+
+
+def test_cost_forwarding_helper_still_held_to_record(tmp_path):
+    # the forwarding excuse covers ONLY the non-literal-site branch: a
+    # forwarding helper whose transfer is record=False (and nobody calls
+    # record_transfer) still ships unledgered bytes — SCX705 must land
+    # on the helper's own transfer
+    src = (
+        "from sctools_tpu.ingest import pull\n\n\n"
+        "def timed_pull(site, value):\n"
+        "    return pull(value, site=site, record=False)\n\n\n"
+        "def drive(block):\n"
+        "    return timed_pull('fix.compact', block)\n"
+    )
+    path = tmp_path / "forwarding_unrecorded.py"
+    path.write_text(src)
+    findings = check_cost([str(path)])
+    assert [(f.rule, f.line) for f in findings] == [("SCX705", 5)], [
+        f.render() for f in findings
+    ]
+
+
+def test_transfer_inventory_names_core_sites():
+    inventory = transfer_inventory(TREE)
+    sites = inventory["sites"]
+    assert "h2d" in sites["gatherer.upload"]["directions"]
+    assert "d2h" in sites["gatherer.writeback"]["directions"]
+    assert "h2d" in sites["count.upload"]["directions"]
+    assert "d2h" in sites["count.writeback"]["directions"]
+    assert "h2d" in sites["whitelist.table"]["directions"]
+    # the bench probe sites arrive through the forwarding closure
+    assert "d2h" in sites["bench.wire_compact"]["directions"]
+    for entry in sites.values():
+        assert entry["occurrences"], entry
+
+
+def test_check_transfer_sites_flags_phantoms_and_directions():
+    inventory = transfer_inventory(TREE)
+    clean_ledger = {
+        "h2d": {"by_site": {"gatherer.upload": {"bytes": 10}}},
+        "d2h": {"by_site": {"gatherer.writeback": {"bytes": 10}}},
+    }
+    assert check_transfer_sites(inventory, clean_ledger) == []
+    phantom = {"h2d": {"by_site": {"nowhere.site": {"bytes": 1}}}}
+    violations = check_transfer_sites(inventory, phantom)
+    assert len(violations) == 1 and "phantom" in violations[0]
+    flipped = {"h2d": {"by_site": {"gatherer.writeback": {"bytes": 1}}}}
+    violations = check_transfer_sites(inventory, flipped)
+    assert len(violations) == 1 and "direction" in violations[0]
+
+
+def test_cli_cost_only(capsys):
+    rc = cli_main(["--cost-only"] + TREE)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "passes: cost" in out
+
+
+def test_cli_cost_only_fails_on_bad_corpus(capsys):
+    rc = cli_main(["-q", "--cost-only", COST])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for rule in COST_RULE_IDS:
+        assert rule in out, (rule, out)
+
+
+def test_cli_four_model_passes_compose(capsys):
+    # the `make modelcheck` shape: all four whole-package passes in one
+    # process over one shared parse
+    rc = cli_main(
+        ["--race-only", "--shard-only", "--life-only", "--cost-only",
+         RACE, SHARD, LIFE, COST]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "SCX401" in out and "SCX501" in out
+    assert "SCX601" in out and "SCX701" in out
+    assert "passes: race, shard, life, cost" in out
+
+
+def test_cli_json_covers_cost_pass(capsys):
+    rc = cli_main(["--json", "--cost-only", COST])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    rules = {f["rule"] for f in payload["findings"]}
+    assert set(COST_RULE_IDS) <= rules, rules
+
+
+def test_cli_emit_transfer_inventory(tmp_path, capsys):
+    dest = tmp_path / "inventory.json"
+    rc = cli_main(["--emit-transfer-inventory", str(dest)] + TREE)
+    assert rc == 0
+    payload = json.loads(dest.read_text())
+    assert "gatherer.upload" in payload["sites"]
+    assert payload["sites"]["gatherer.upload"]["directions"] == ["h2d"]
+
+
+def test_cli_summary_reports_parse_cache(capsys):
+    rc = cli_main(["--cost-only"] + TREE)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "parse cache:" in out
+
+
+# ------------------------------------------------ astcache persistence
+
+
+def test_parse_cache_persists_across_processes(tmp_path, monkeypatch):
+    from sctools_tpu.analysis import astcache
+
+    store = tmp_path / "store"
+    monkeypatch.setenv(astcache.CACHE_ENV, str(store))
+    target = tmp_path / "mod.py"
+    target.write_text("def f(x):\n    return x + 1\n")
+
+    before = dict(astcache.stats)
+    parsed = astcache.parse_cached(str(target))
+    assert parsed is not None
+    assert astcache.stats["parsed"] == before["parsed"] + 1
+
+    # same process, same content: the in-memory layer answers
+    astcache.parse_cached(str(target))
+    assert astcache.stats["memory_hits"] == before["memory_hits"] + 1
+
+    # a fresh process (simulated: cleared memory layer) hits the
+    # persistent content-hash store instead of reparsing
+    astcache._cache.clear()
+    astcache.parse_cached(str(target))
+    assert astcache.stats["disk_hits"] == before["disk_hits"] + 1
+
+    # an edit can never hit stale: new content, new hash, real parse
+    target.write_text("def f(x):\n    return x + 2\n")
+    astcache._cache.clear()
+    source, tree = astcache.parse_cached(str(target))
+    assert astcache.stats["parsed"] == before["parsed"] + 2
+    assert "x + 2" in source
+
+
+def test_parse_cache_disabled_by_env(tmp_path, monkeypatch):
+    from sctools_tpu.analysis import astcache
+
+    monkeypatch.setenv(astcache.CACHE_ENV, "0")
+    target = tmp_path / "mod.py"
+    target.write_text("VALUE = 1\n")
+    before = astcache.stats["parsed"]
+    astcache.parse_cached(str(target))
+    astcache._cache.clear()
+    astcache.parse_cached(str(target))
+    assert astcache.stats["parsed"] == before + 2  # no store, reparses
+
+
+def test_parse_cache_survives_corrupt_store_entry(tmp_path, monkeypatch):
+    from sctools_tpu.analysis import astcache
+
+    store = tmp_path / "store"
+    monkeypatch.setenv(astcache.CACHE_ENV, str(store))
+    target = tmp_path / "mod.py"
+    target.write_text("VALUE = 3\n")
+    astcache.parse_cached(str(target))
+    entries = list(store.glob("*.pkl"))
+    assert entries
+    entries[0].write_bytes(b"corrupt")
+    astcache._cache.clear()
+    before = astcache.stats["parsed"]
+    parsed = astcache.parse_cached(str(target))
+    assert parsed is not None and astcache.stats["parsed"] == before + 1
+
+
+# ----------------------------------------------------- retune (autotuner)
+
+
+def _retune_registry(run_dir, record_mean=300, entity_mean=20,
+                     signature=None):
+    registry = {
+        "version": 1,
+        "worker": "w0",
+        "sites": {
+            "metrics.compute_entity_metrics": {
+                "calls": 40, "compiles": 1, "retraces": 0,
+                "compile_s": 1.0, "dispatches": 40,
+                "real_rows": record_mean * 40, "padded_rows": 4096 * 40,
+                "signatures": {
+                    signature
+                    or "(int32[512], bool[512]) {kind='cell'}": 40
+                },
+                "retrace_signatures": [],
+            },
+            "metrics.compact_results_wire": {
+                "calls": 40, "compiles": 1, "retraces": 0,
+                "compile_s": 0.2, "dispatches": 40,
+                "real_rows": entity_mean * 40, "padded_rows": 64 * 40,
+                "signatures": {"(int32[14,64])": 40},
+                "retrace_signatures": [],
+            },
+        },
+        "declared_sites": [
+            "metrics.compute_entity_metrics",
+            "metrics.compact_results_wire",
+        ],
+        "ledger": {},
+        "memory": {},
+    }
+    with open(os.path.join(run_dir, "xprof.w0.json"), "w") as f:
+        json.dump(registry, f)
+
+
+@pytest.fixture
+def retune_tree(tmp_path):
+    """A disposable copy of the real tree the autotuner may rewrite."""
+    import shutil
+
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    shutil.copytree(
+        os.path.join(REPO, "sctools_tpu"), str(tree / "sctools_tpu"),
+        ignore=shutil.ignore_patterns(
+            "__pycache__", "*.so", "*.o", "*.buildhost"
+        ),
+    )
+    shutil.copy(os.path.join(REPO, "bench.py"), str(tree / "bench.py"))
+    shutil.copy(
+        os.path.join(REPO, "__graft_entry__.py"),
+        str(tree / "__graft_entry__.py"),
+    )
+    return tree
+
+
+def _tree_paths(tree):
+    return [
+        str(tree / "sctools_tpu"),
+        str(tree / "bench.py"),
+        str(tree / "__graft_entry__.py"),
+    ]
+
+
+def test_retune_roundtrip_rewrites_and_gates(tmp_path, retune_tree):
+    # recorded registry -> derived floors -> rewrite -> shardcheck green
+    # -> contract covers observed signatures -> occupancy improves
+    from sctools_tpu.analysis import retune as retune_mod
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _retune_registry(str(run_dir))
+    segments = retune_tree / "sctools_tpu" / "ops" / "segments.py"
+    assert retune_mod.read_constants(str(segments)) == {
+        "RECORD_BUCKET_MIN": 4096, "ENTITY_BUCKET_MIN": 64,
+    }
+    lines = []
+    code, report = retune_mod.retune(
+        str(run_dir), _tree_paths(retune_tree), out=lines.append
+    )
+    assert code == 0, lines
+    assert report["applied"] is True
+    assert report["gates"]["shardcheck"]["ok"]
+    assert report["gates"]["shape_contract"]["ok"]
+    written = retune_mod.read_constants(str(segments))
+    # mean 300 real rows -> smallest pow2 is 512; mean 20 entities -> 32
+    assert written == {"RECORD_BUCKET_MIN": 512, "ENTITY_BUCKET_MIN": 32}
+    record = report["constants"]["RECORD_BUCKET_MIN"]
+    assert record["projected_occupancy"] > record["observed_occupancy"]
+
+    # the pinned floor is live behavior: a small dispatch pads an order
+    # of magnitude tighter under the autotuned constant
+    from sctools_tpu.ops import segments as seg
+
+    assert seg.bucket_size(300) == 4096  # repo pin unchanged
+    assert seg.bucket_size(300, minimum=written["RECORD_BUCKET_MIN"]) == 512
+
+
+def test_retune_never_raises_a_floor(tmp_path, retune_tree):
+    # traffic whose mean dispatch exceeds the pin must leave it alone:
+    # raising a floor can only lower occupancy
+    from sctools_tpu.analysis import retune as retune_mod
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _retune_registry(str(run_dir), record_mean=500000, entity_mean=4000)
+    lines = []
+    code, report = retune_mod.retune(
+        str(run_dir), _tree_paths(retune_tree), out=lines.append
+    )
+    assert code == 0
+    assert report["applied"] is False and report["changed"] == {}
+    segments = retune_tree / "sctools_tpu" / "ops" / "segments.py"
+    assert retune_mod.read_constants(str(segments)) == {
+        "RECORD_BUCKET_MIN": 4096, "ENTITY_BUCKET_MIN": 64,
+    }
+
+
+def test_retune_clamps_to_hard_floor(tmp_path, retune_tree):
+    from sctools_tpu.analysis import retune as retune_mod
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _retune_registry(str(run_dir), record_mean=3, entity_mean=1)
+    code, report = retune_mod.retune(
+        str(run_dir), _tree_paths(retune_tree), out=lambda s: None
+    )
+    assert code == 0
+    written = retune_mod.read_constants(
+        str(retune_tree / "sctools_tpu" / "ops" / "segments.py")
+    )
+    assert written == {
+        "RECORD_BUCKET_MIN": retune_mod.HARD_FLOORS["RECORD_BUCKET_MIN"],
+        "ENTITY_BUCKET_MIN": retune_mod.HARD_FLOORS["ENTITY_BUCKET_MIN"],
+    }
+
+
+def test_retune_gate_rejects_uncovered_signature(tmp_path, retune_tree):
+    # an observed signature the regenerated contract cannot admit must
+    # refuse the edit and restore the file byte-for-byte
+    from sctools_tpu.analysis import retune as retune_mod
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _retune_registry(
+        str(run_dir), signature="(int32[12345], bool[12345])"
+    )
+    segments = retune_tree / "sctools_tpu" / "ops" / "segments.py"
+    original = segments.read_text()
+    lines = []
+    code, report = retune_mod.retune(
+        str(run_dir), _tree_paths(retune_tree), out=lines.append
+    )
+    assert code == 5, lines
+    assert report["applied"] is False
+    assert not report["gates"]["shape_contract"]["ok"]
+    assert segments.read_text() == original
+
+
+def test_retune_dry_run_writes_nothing(tmp_path, retune_tree):
+    from sctools_tpu.analysis import retune as retune_mod
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _retune_registry(str(run_dir))
+    segments = retune_tree / "sctools_tpu" / "ops" / "segments.py"
+    original = segments.read_text()
+    code, report = retune_mod.retune(
+        str(run_dir), _tree_paths(retune_tree), apply=False,
+        out=lambda s: None,
+    )
+    assert code == 0
+    assert report["applied"] is False
+    assert report["changed"] == {
+        "RECORD_BUCKET_MIN": 512, "ENTITY_BUCKET_MIN": 32,
+    }
+    assert segments.read_text() == original
+
+
+def test_retune_without_registries_fails_loudly(tmp_path, retune_tree):
+    from sctools_tpu.analysis import retune as retune_mod
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    code, _ = retune_mod.retune(
+        str(empty), _tree_paths(retune_tree), out=lambda s: None
+    )
+    assert code == 2

@@ -204,12 +204,59 @@ def test_efficiency_suggest_cli(recording, tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "test.suggest" in out and "1024" in out
-    assert "report-only" in out
+    assert "--retune" in out  # the acting half the advice now feeds
     rc = obs_cli(["efficiency", str(tmp_path), "--suggest", "--json"])
     payload = json.loads(capsys.readouterr().out)
     assert rc == 0
-    assert payload["target"] == 0.25
+    assert payload["target"] == 0.35  # the raised bench --check floor
     assert payload["suggestions"][0]["suggested_pad"] == 1024
+
+
+# the exact machine-readable advice the scx-cost autotuner consumes
+# (analysis/retune.py groups rows by `constant`): key set and types are
+# a schema other tools parse, so drift is a test failure, not a surprise
+_SUGGESTION_SCHEMA = {
+    "site": str,
+    "dispatches": int,
+    "mean_real_rows": (int, float),
+    "mean_padded_rows": (int, float),
+    "occupancy": (int, float, type(None)),
+    "suggested_pad": int,
+    "projected_occupancy": (int, float),
+    "meets_target": bool,
+    "unit": str,
+    "constant": str,
+}
+
+
+def test_suggest_json_schema_is_pinned(recording, tmp_path, capsys):
+    from sctools_tpu.obs.__main__ import main as obs_cli
+
+    record_fn = xprof.instrument_jit(lambda x: x * 2, name="test.suggest")
+    record_fn(np.ones(4096, np.float32))
+    xprof.record_dispatch("test.suggest", 900, 4096)
+    # the entity-bucket site classifies onto the OTHER pinned constant
+    xprof.record_dispatch("metrics.compact_results_wire", 20, 64)
+    xprof.dump(os.path.join(tmp_path, "xprof.w0.json"), worker="w0")
+    rc = obs_cli(["efficiency", str(tmp_path), "--suggest", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    rows = {row["site"]: row for row in payload["suggestions"]}
+    assert set(rows) == {"test.suggest", "metrics.compact_results_wire"}
+    for row in rows.values():
+        assert set(row) == set(_SUGGESTION_SCHEMA), row
+        for key, types in _SUGGESTION_SCHEMA.items():
+            assert isinstance(row[key], types), (key, row[key])
+    assert rows["test.suggest"]["unit"] == "record"
+    assert rows["test.suggest"]["constant"] == "RECORD_BUCKET_MIN"
+    wire = rows["metrics.compact_results_wire"]
+    assert wire["unit"] == "entity"
+    assert wire["constant"] == "ENTITY_BUCKET_MIN"
+    assert wire["suggested_pad"] == 32
+    # pow2 invariant: the autotuner mins these into the pinned floors
+    for row in rows.values():
+        pad = row["suggested_pad"]
+        assert pad > 0 and (pad & (pad - 1)) == 0
 
 
 def test_instrument_jit_cost_analysis(recording):

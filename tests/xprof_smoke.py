@@ -213,6 +213,52 @@ def main() -> int:
         f"the static shape contract ({len(contract['sites'])} site(s))"
     )
 
+    # ---- observed ledger sites ⊆ the static transfer inventory: the
+    # runtime witness half of `make costcheck` (scx-cost SCX7xx) — every
+    # site the live 2-worker run's ledger saw must be statically
+    # inventoried with a matching direction (no phantom sites, no
+    # transfer path the model missed), and the core pipeline sites must
+    # actually have been observed (the witness engaged)
+    from sctools_tpu.analysis.costcheck import (
+        check_transfer_sites,
+        transfer_inventory,
+    )
+
+    inventory = transfer_inventory(
+        [
+            os.path.join(REPO_ROOT, "sctools_tpu"),
+            os.path.join(REPO_ROOT, "bench.py"),
+            os.path.join(REPO_ROOT, "__graft_entry__.py"),
+        ]
+    )
+    observed_sites = {
+        direction: sorted((total.get("by_site") or {}))
+        for direction, total in ledger.items()
+    }
+    if not any(observed_sites.values()):
+        fail("ledger carries no per-site entries — the transfer-site "
+             "witness never engaged")
+    transfer_violations = check_transfer_sites(inventory, ledger)
+    if transfer_violations:
+        fail(
+            "observed ledger site(s) escape the static transfer "
+            "inventory:\n  " + "\n  ".join(transfer_violations)
+        )
+    for direction, needed in (
+        ("h2d", "gatherer.upload"), ("d2h", "gatherer.writeback"),
+    ):
+        if needed not in observed_sites.get(direction, []):
+            fail(
+                f"core transfer site {needed} absent from the observed "
+                f"{direction} ledger: {observed_sites}"
+            )
+    observed_count = sum(len(v) for v in observed_sites.values())
+    print(
+        f"xprof-smoke: {observed_count} observed ledger site(s) within "
+        f"the static transfer inventory ({len(inventory['sites'])} "
+        "site(s))"
+    )
+
     # ---- the fleet timeline's occupancy column is populated
     analysis = analyze(discover(workdir))
     committed = {
