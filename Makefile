@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke perf-gate docs clean
 
-ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke perf-gate
+ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -169,6 +169,17 @@ guard-smoke:
 	rm -rf /tmp/sctools_tpu_guard_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_GUARD_SMOKE_DIR=/tmp/sctools_tpu_guard_smoke \
 	$(PY) tests/guard_smoke.py
+
+# live-telemetry gate: a traced 2-worker run with scx-pulse ON must
+# leave per-worker heartbeat rings where every committed task has >= 1
+# heartbeat, the windowed cells/sec agrees with the journal-derived
+# rate within 2x, bubble attribution names a limiting stage, and the
+# HTTP exporter serves valid Prometheus exposition of it all
+# (tests/pulse_smoke.py; docs/observability.md "scx-pulse").
+pulse-smoke:
+	rm -rf /tmp/sctools_tpu_pulse_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_PULSE_SMOKE_DIR=/tmp/sctools_tpu_pulse_smoke \
+	$(PY) tests/pulse_smoke.py
 
 # perf-regression gate self-test: bench.py --check must fail a
 # synthetically-degraded result and pass a trajectory-consistent one

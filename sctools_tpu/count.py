@@ -28,7 +28,7 @@ import scipy.sparse as sp
 from . import consts
 from .bam import get_tag_or_default
 from .io.sam import AlignmentReader
-from .obs import xprof
+from .obs import pulse, xprof
 
 _DEFAULT_TAGS = (
     consts.CELL_BARCODE_TAG_KEY,
@@ -114,19 +114,27 @@ class _MoleculeAccumulator:
             return
         from . import ingest
 
+        # scx-pulse heartbeat: the count kernel's per-batch record
+        hb = pulse.heartbeat("count")
+        hb.decode_from_ring()
+        hb.begin("h2d")
         cols = device_count_columns(frame, pad_to=pad_to)
         num_segments = len(cols["valid"])
         xprof.record_dispatch("ops.count_molecules", n, num_segments)
         # explicit staging through the ingest choke point: the H2D lands
         # in the transfer ledger and overlaps the previous batch's kernel
         # scx-lint: disable=SCX502 -- single-device path only: the mesh branch returned at the top of add_batch, so this upload never runs under a mesh
-        cols, _ = ingest.upload(cols, site="count.upload")
+        cols, batch_h2d = ingest.upload(cols, site="count.upload")
+        hb.end("h2d")
+        hb.begin("compute")
         # scx-lint: disable=SCX503 -- num_segments is len() of the pad_to-padded columns device_count_columns built, so it is already bucketed (bounded executables per run)
         out = count_molecules(cols, num_segments=num_segments)
+        hb.end("compute")
+        hb.begin("d2h")
         # ONE guarded pull for every result column (the ingest.pull choke
         # point: ledger-recorded, transient re-pull in place; a failure
         # strikes the dispatch site's degradation ladder)
-        out, _ = ingest.pull(
+        out, batch_d2h = ingest.pull(
             {
                 k: out[k]
                 for k in ("is_molecule", "cell", "umi", "gene", "first_index")
@@ -134,7 +142,14 @@ class _MoleculeAccumulator:
             site="count.writeback",
             degrade_site="count.dispatch",
         )
+        hb.end("d2h")
         is_molecule = out["is_molecule"].astype(bool)
+        hb.add(
+            real_rows=n, padded_rows=num_segments,
+            entities=int(is_molecule.sum()),
+            bytes_h2d=batch_h2d, bytes_d2h=batch_d2h,
+        )
+        hb.emit()
         cells = out["cell"][is_molecule]
         umis = out["umi"][is_molecule]
         genes = out["gene"][is_molecule]
@@ -154,6 +169,9 @@ class _MoleculeAccumulator:
         from .parallel.count import sharded_count_molecules
         from .parallel.shard import partition_columns
 
+        hb = pulse.heartbeat("count.sharded")
+        hb.decode_from_ring()
+        hb.begin("h2d")
         # pad_to=0: the partition drops padding rows and re-pads per shard
         # anyway (shard_size derives from per-shard occupancy), so batch-
         # level capacity padding would be pure wasted allocation here
@@ -162,18 +180,20 @@ class _MoleculeAccumulator:
         cols["_orig"] = np.arange(n_padded, dtype=np.int64)
         stacked = partition_columns(cols, self._n_shards, key="cell")
         orig = stacked.pop("_orig")
+        padded_rows = int(stacked["qname"].size)
         xprof.record_dispatch(
-            "parallel.sharded_count",
-            frame.n_records,
-            int(stacked["qname"].size),
+            "parallel.sharded_count", frame.n_records, padded_rows
         )
         # shard-per-device placement: each stacked row lands on its own
         # mesh device instead of piling onto device 0
-        stacked, _ = ingest.upload(
+        stacked, batch_h2d = ingest.upload(
             stacked, site="count.upload",
             sharding=ingest.mesh_sharding(self._mesh),
         )
+        hb.end("h2d")
+        hb.begin("compute")
         out = sharded_count_molecules(stacked, self._mesh)
+        hb.end("compute")
         # two phases, deliberately: ALL shard pulls land in ONE guarded
         # ingest.pull attempt (one coalesced D2H per result column instead
         # of four small pulls per shard, each paying the link's fixed
@@ -182,7 +202,8 @@ class _MoleculeAccumulator:
         # surfacing at the pull — an append interleaved with per-shard
         # pulls would leave the earlier shards' molecules double-counted
         # on retry.
-        out, _ = ingest.pull(
+        hb.begin("d2h")
+        out, batch_d2h = ingest.pull(
             {
                 k: out[k]
                 for k in ("is_molecule", "cell", "umi", "gene", "first_index")
@@ -190,7 +211,15 @@ class _MoleculeAccumulator:
             site="count.writeback",
             degrade_site="count.dispatch",
         )
+        hb.end("d2h")
         is_molecule = out["is_molecule"]
+        hb.add(
+            real_rows=frame.n_records,
+            padded_rows=padded_rows,
+            entities=int(np.count_nonzero(is_molecule)),
+            bytes_h2d=batch_h2d, bytes_d2h=batch_d2h,
+        )
+        hb.emit()
         gene_vocab_cols = self._gene_vocab_cols(frame)
         staged = []
         for shard in range(self._n_shards):

@@ -61,6 +61,7 @@ import threading
 from typing import Iterable, Iterator, Optional
 
 from .. import obs
+from ..obs import pulse
 from ..analysis.witness import make_lock
 from ..guard import degrade
 from ..guard.errors import NativeDecodeError
@@ -125,7 +126,13 @@ def _wrap_source(source: Iterable[ReadFrame], depth: int) -> Iterator[ReadFrame]
     """The fallback ring: Python-decoded frames behind the prefetch queue."""
     return guarded_iter(
         prefetch_iterator(
-            obs.iter_spans("decode", source, records=lambda f: f.n_records),
+            # pulse sees each decoded batch's wall interval even on the
+            # Python-decoder path (the native path notes it explicitly)
+            pulse.iter_decode(
+                obs.iter_spans(
+                    "decode", source, records=lambda f: f.n_records
+                )
+            ),
             depth=depth,
         ),
         leg="decode",
@@ -164,6 +171,7 @@ def _produce_arena_frames(stream, arenas, batch_records: int, want_qname: bool):
                 ring_id, slot=k % n_slots, batches=k, phase="filling",
                 record_offset=consumed, **_slot_state(),
             )
+            decode_start = pulse.clock() if pulse.enabled() else 0.0
             with obs.span("decode", slot=k % n_slots) as sp:
                 try:
                     n = stream.next(batch_records)
@@ -190,6 +198,12 @@ def _produce_arena_frames(stream, arenas, batch_records: int, want_qname: bool):
                         str(error), batch_index=k, record_offset=consumed
                     ) from error
                 sp.add(records=n)
+            if pulse.enabled():
+                # the heartbeat of the dispatch that consumes this batch
+                # adopts the interval (pulse.Heartbeat.decode_from_ring)
+                pulse.note_decode(
+                    decode_start, pulse.clock(), slot=k % n_slots
+                )
             obs.count("ingest_arena_batches")
             _set_ring_state(ring_id, phase="queued", **_slot_state())
             consumed += n

@@ -208,6 +208,17 @@ class WritebackRing:
             if state is not None:
                 state.update(fields)
 
+    def phase_code(self) -> int:
+        """The current phase as the scx-pulse one-byte enum
+        (:data:`sctools_tpu.obs.pulse.WB_PHASES`) — what heartbeat
+        records carry so a live reader sees where the writeback is."""
+        from ..obs.pulse import WB_PHASES
+
+        with _state_lock:
+            state = _ring_state.get(self._id) or {}
+            phase = state.get("phase", "idle")
+        return WB_PHASES.get(phase, 0)
+
     def stage(self, value: Any) -> Any:
         """Kick the async D2H for one batch's result block(s).
 

@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Set
 import numpy as np
 
 from .. import guard, ingest, obs
-from ..obs import xprof
+from ..obs import pulse, xprof
 from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
 from ..io.packed import (
     FLAG_MITO,
@@ -645,6 +645,14 @@ class MetricGatherer:
                 run_keys_bucket = self._runs_bucket
                 self.run_keyed_batches += 1
                 obs.count("run_keyed_batches")
+        # scx-pulse heartbeat: one fixed-width record per dispatched
+        # batch (decode interval adopted from the ring's notes; h2d spans
+        # pack+stage; compute spans the device dispatch; finalize adds
+        # the d2h drain and emits) — the live telemetry the TUI/exporter
+        # read while the run is still going
+        hb = pulse.heartbeat(f"gatherer.{self.entity_kind}")
+        hb.decode_from_ring()
+        hb.begin("h2d")
         with obs.span("upload", records=frame.n_records) as up:
             cols, static_flags, prepacked = self._prepare_batch(
                 frame, presorted, pad_to=pad_to,
@@ -665,6 +673,8 @@ class MetricGatherer:
             cols, batch_h2d = ingest.upload(cols, site="gatherer.upload")
             self.bytes_h2d += batch_h2d
             up.add(bytes=batch_h2d)
+        hb.end("h2d")
+        hb.add(bytes_h2d=batch_h2d)
         obs.count("batches_uploaded")
         obs.count("h2d_bytes", batch_h2d)
         # occupancy telemetry: how much of the padded dispatch was real
@@ -674,6 +684,7 @@ class MetricGatherer:
         xprof.record_dispatch(
             "metrics.compute_entity_metrics", frame.n_records, num_segments
         )
+        hb.begin("compute")
         with obs.span(
             "compute",
             records=frame.n_records,
@@ -723,16 +734,21 @@ class MetricGatherer:
             # watermark sample while the batch's buffers are live on
             # device (peak attribution = the open `compute` span)
             xprof.sample_memory()
+        hb.end("compute")
+        hb.add(
+            real_rows=frame.n_records, padded_rows=num_segments,
+            entities=n_entities,
+        )
         # keep only what finalize reads: pinning the whole frame or the full
         # result dict would hold ~40 MB of arrays per in-flight batch
         return (
             self._entity_names(frame), block, n_entities,
-            int_names, float_names, frame.n_records,
+            int_names, float_names, frame.n_records, hb,
         )
 
     def _finalize_device_batch(
         self, entity_names, block, n_entities: int, int_names, float_names,
-        n_records: int, out,
+        n_records: int, hb, out,
     ) -> None:
         # ONE blocking pull per batch: entity rows already compacted on
         # device into a fused [k, ints+floats] int32 block (float32 bits
@@ -753,12 +769,19 @@ class MetricGatherer:
             wasted = (
                 (block.shape[1] - n_entities) * block.shape[0] * 4
             )
+            # phase sampled at drain START ("copying"/"staged"), the
+            # informative moment: after collect it is always "idle"
+            hb.add(wb_phase=self._writeback.phase_code())
+            hb.begin("d2h")
             block, batch_d2h = self._writeback.collect(
                 block, site="gatherer.writeback", wasted=wasted,
                 degrade_site=self._GUARD_SITE, name=str(self._bam_file),
             )
+            hb.end("d2h")
             self.bytes_d2h += batch_d2h
             wb.add(bytes=batch_d2h)
+            hb.add(bytes_d2h=batch_d2h)
+            hb.emit()
             xprof.sample_memory()
             obs.count("d2h_bytes", batch_d2h)
             obs.count("entities_written", n_entities)

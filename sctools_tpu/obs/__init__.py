@@ -39,6 +39,12 @@ The scheduler (sctools_tpu.sched) reports through this layer too:
 (attempts, commits, steals, failures, quarantines, lease losses, backoff
 seconds) make a fault-injected run's recovery story readable straight
 from a trace capture (docs/scheduler.md).
+
+All of the above is post-hoc; the LIVE half is :mod:`.pulse`
+(scx-pulse): per-batch heartbeat rings scraped while a run is in
+flight, windowed rates, a localhost Prometheus exporter
+(:mod:`.serve`), and pipeline bubble attribution — read with
+``python -m sctools_tpu.obs pulse <run_dir>``.
 """
 
 from __future__ import annotations

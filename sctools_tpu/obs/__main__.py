@@ -36,6 +36,7 @@ import sys
 from typing import Dict, List, Optional
 
 from . import render_summary, summarize_records
+from . import pulse as pulsemod
 from .fleet import analyze, discover, load_capture, render_timeline
 from .xprof import (
     efficiency_report,
@@ -92,13 +93,20 @@ def _sidecars(paths: List[str]):
             dirs.append(directory)
     counters: Dict[str, Dict[str, float]] = {}
     registries = []
+    rings: Dict[str, dict] = {}
     for directory in dirs:
         for prom in sorted(globmod.glob(os.path.join(directory, "metrics*.prom"))):
             parsed = _parse_prom(prom)
             if parsed:
                 counters[prom] = parsed
         registries.extend(load_registries(directory))
-    return counters, registries
+        # scx-pulse heartbeat rings next to the traces: one summarize
+        # --json covers spans + counters + compile registry + pulse.
+        # First ring per worker wins — a worker's own ring is already
+        # deduped against any flight-embedded copy by the fleet layer.
+        for worker, ring in pulsemod.load_rings(directory).items():
+            rings.setdefault(worker, ring)
+    return counters, registries, rings
 
 
 def _summarize(args, out=None, err=None) -> int:
@@ -135,7 +143,7 @@ def _summarize(args, out=None, err=None) -> int:
     if args.top:
         rows = rows[: args.top]
     if args.as_json:
-        counters, registries = _sidecars(paths)
+        counters, registries, rings = _sidecars(paths)
         payload = {
             "stages": rows,
             "spans": len(records),
@@ -144,6 +152,10 @@ def _summarize(args, out=None, err=None) -> int:
             "compile_registry": (
                 merge_registries(registries)["sites"] if registries else {}
             ),
+            "pulse": {
+                worker: pulsemod.worker_row(ring["records"])
+                for worker, ring in sorted(rings.items())
+            },
         }
         print(json.dumps(payload, separators=(",", ":")), file=out)
     else:
@@ -202,6 +214,141 @@ def _efficiency(args, out=None, err=None) -> int:
     else:
         print(render_efficiency(report), end="", file=out)
     return 0
+
+
+def _render_pulse_view(
+    view: dict, rings: Dict[str, dict], window_s: Optional[float]
+) -> str:
+    """The live-TUI frame: per-worker lanes + rates + bubble verdict."""
+    lines = [
+        f"pulse: {view['run_dir']}"
+        + (f"  (window {window_s:g}s)" if window_s else "  (whole run)")
+    ]
+    workers = view["workers"]
+    name_width = max((len(w) for w in workers), default=6)
+    lines.append(
+        f"{'worker'.ljust(name_width)}  "
+        f"{'lane (#device ~bubble ·idle)'.ljust(48)}  "
+        "beats  cells/s    rows/s   occ%  h2d MB/s  d2h MB/s  bub%  limiting"
+    )
+    for worker in sorted(workers):
+        row = workers[worker]
+        ring = rings[worker]
+        # the lane draws the SAME windowed subset the row's numbers are
+        # computed from — a 20-minute run watched at --window 30 shows
+        # the live 30 seconds, not 20 minutes compressed into 48 chars
+        bar = pulsemod.lane_bar(
+            pulsemod.select_window(
+                ring["records"], window_s,
+                now=pulsemod.ring_now(ring) if window_s else None,
+            )
+        )
+        occupancy = row.get("occupancy")
+        bubble = row.get("bubble_fraction")
+        occ = f"{100 * occupancy:5.1f}" if occupancy is not None else "    -"
+        bub = f"{100 * bubble:4.1f}" if bubble is not None else "   -"
+        lines.append(
+            f"{worker.ljust(name_width)}  {bar}  "
+            f"{row['heartbeats']:5d}  "
+            f"{(row['cells_per_s'] or 0.0):8.1f}  "
+            f"{(row['rows_per_s'] or 0.0):8.0f}  {occ}  "
+            f"{(row['h2d_Bps'] or 0) / 1e6:8.1f}  "
+            f"{(row['d2h_Bps'] or 0) / 1e6:8.1f}  {bub}  "
+            f"{row.get('limiting_stage') or '-'}"
+        )
+    fleet = view["fleet"]
+    bubble = fleet.get("bubble_fraction")
+    lines.append("")
+    lines.append(
+        f"fleet: {fleet['heartbeats']} heartbeat(s), "
+        f"{fleet['cells_per_s'] or 0.0:.1f} cells/s, "
+        f"{fleet['retraces']} retrace(s), bubble "
+        + (f"{100 * bubble:.1f}%" if bubble is not None else "-")
+        + f" limited by {fleet.get('limiting_stage') or '-'}"
+    )
+    torn = sum(r["torn"] for r in rings.values())
+    if torn:
+        lines.append(
+            f"warning: {torn} torn record(s) skipped "
+            "(mid-write scrape or crashed worker; the ring stays readable)"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def _pulse(args, out=None, err=None) -> int:
+    out = out if out is not None else sys.stdout
+    err = err if err is not None else sys.stderr
+    # default window: live surfaces (--watch/--serve) get the trailing
+    # 30 s (reader-anchored, so a stalled worker decays); a one-shot
+    # render summarizes the WHOLE run — a run that finished a minute ago
+    # must not render as 0 heartbeats / all-idle lanes. An explicit
+    # --window applies everywhere (0 = whole run).
+    if args.window is None:
+        window_s = (
+            30.0 if (args.watch or args.serve is not None) else None
+        )
+    else:
+        window_s = args.window if args.window > 0 else None
+
+    def frame():
+        rings = pulsemod.load_rings(args.run_dir)
+        view = pulsemod.fleet_pulse(
+            args.run_dir, window_s=window_s, rings=rings
+        )
+        return rings, view
+
+    rings, view = frame()
+    if not rings:
+        print(
+            f"obs pulse: no pulse.*.ring under {args.run_dir}: run with "
+            f"{pulsemod.ENV_FLAG}=1 (the workers write heartbeat rings "
+            "beside their trace captures)",
+            file=err,
+        )
+        return 2
+    if args.serve is not None:
+        from .serve import PulseExporter
+
+        exporter = PulseExporter(
+            port=args.serve, run_dir=args.run_dir, window_s=window_s
+        )
+        port = exporter.start()
+        print(
+            f"obs pulse: serving /metrics on 127.0.0.1:{port} "
+            "(Ctrl-C to stop)",
+            file=out,
+        )
+        import time as _time
+
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            exporter.stop()
+        return 0
+    if args.as_json:
+        print(json.dumps(view, separators=(",", ":")), file=out)
+        return 0
+    if not args.watch:
+        print(_render_pulse_view(view, rings, window_s), end="", file=out)
+        return 0
+    import time as _time
+
+    frames = 0
+    while True:
+        frames += 1
+        if hasattr(out, "isatty") and out.isatty():
+            out.write("\x1b[2J\x1b[H")
+        print(_render_pulse_view(view, rings, window_s), end="", file=out)
+        if args.frames and frames >= args.frames:
+            return 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        rings, view = frame()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -266,11 +413,49 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="occupancy target for --suggest (default: 0.35, the "
         "bench --check floor)",
     )
+    pulse_cmd = sub.add_parser(
+        "pulse",
+        help="live streaming telemetry: per-worker heartbeat lanes, "
+        "windowed rates, pipeline bubble attribution (scx-pulse)",
+    )
+    pulse_cmd.add_argument(
+        "run_dir",
+        help="run directory holding pulse.<worker>.ring heartbeat rings "
+        f"(written live by every {pulsemod.ENV_FLAG}=1 worker)",
+    )
+    pulse_cmd.add_argument(
+        "--window", type=float, default=None,
+        help="trailing rate window in seconds (default: whole run for a "
+        "one-shot render, 30 for --watch/--serve; 0 = whole run)",
+    )
+    pulse_cmd.add_argument(
+        "--watch", action="store_true",
+        help="refresh the view every --interval seconds (live TUI)",
+    )
+    pulse_cmd.add_argument(
+        "--interval", type=float, default=2.0,
+        help="--watch refresh period in seconds (default 2)",
+    )
+    pulse_cmd.add_argument(
+        "--frames", type=int, default=0,
+        help="stop --watch after N refreshes (0 = until interrupted)",
+    )
+    pulse_cmd.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="the merged per-worker + fleet pulse view as one JSON object",
+    )
+    pulse_cmd.add_argument(
+        "--serve", type=int, default=None, metavar="PORT",
+        help="serve the merged view on 127.0.0.1:PORT/metrics in "
+        "Prometheus exposition format instead of rendering (0 = any port)",
+    )
     args = parser.parse_args(argv)
     if args.command == "summarize":
         return _summarize(args)
     if args.command == "efficiency":
         return _efficiency(args)
+    if args.command == "pulse":
+        return _pulse(args)
     return _timeline(args)
 
 

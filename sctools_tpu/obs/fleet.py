@@ -38,6 +38,8 @@ import statistics
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import pulse as _pulse
+
 __all__ = [
     "CaptureFile",
     "FleetRun",
@@ -162,6 +164,8 @@ class FleetRun:
     events: List[dict] = field(default_factory=list)
     captures: List[CaptureFile] = field(default_factory=list)
     metrics_files: List[str] = field(default_factory=list)
+    # scx-pulse heartbeat rings found under the run dir, keyed by worker
+    pulse_rings: Dict[str, dict] = field(default_factory=dict)
     warnings: List[str] = field(default_factory=list)
 
     def merged_spans(self) -> List[dict]:
@@ -310,6 +314,7 @@ def discover(run_dir: str) -> FleetRun:
                 f"{path}: skipped {capture.bad_lines} malformed line(s)"
             )
         run.captures.append(capture)
+    run.pulse_rings = _pulse.load_rings(run_dir)
     if journal_dir is not None:
         from ..sched import Journal
 
@@ -557,6 +562,42 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
                 if same_lane else None
         chain.reverse()
 
+    # --- scx-pulse heartbeats: per-worker windowed rates + bubble
+    # attribution. A ring FILE is authoritative; a flight record's
+    # embedded pulse section (the last few heartbeats a SIGTERM'd worker
+    # carried out) only fills in for workers with no ring on disk —
+    # the same dedupe discipline as flight-vs-sink spans.
+    pulse_keys = (
+        "heartbeats", "cells_per_s", "occupancy", "retraces",
+        "bubble_fraction", "limiting_stage",
+    )
+    pulse_workers: Dict[str, dict] = {}
+    for worker, ring in sorted(run.pulse_rings.items()):
+        row = _pulse.worker_row(ring["records"])
+        pulse_workers[worker] = {
+            **{key: row[key] for key in pulse_keys}, "source": "ring",
+        }
+    for capture in run.captures:
+        if capture.kind != "flight":
+            continue
+        section = ((capture.flight_meta or {}).get("sections") or {}).get(
+            "pulse"
+        )
+        if not isinstance(section, dict) or capture.worker in pulse_workers:
+            continue
+        recent = [
+            r for r in (section.get("recent") or [])
+            if isinstance(r, dict) and isinstance(r.get("legs"), dict)
+        ]
+        if not recent:
+            continue
+        row = _pulse.worker_row(recent)
+        pulse_workers[capture.worker] = {
+            **{key: row[key] for key in pulse_keys},
+            "heartbeats": int(section.get("seq") or row["heartbeats"]),
+            "source": "flight",
+        }
+
     wall_start = min((l["start"] for l in lanes.values()), default=0.0)
     wall_end = max((l["end"] for l in lanes.values()), default=0.0)
     flights = [
@@ -587,6 +628,7 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
             for row in task_rows.values()
         },
         "occupancy_median": occupancy_median,
+        "pulse": pulse_workers,
         "task_totals": {
             state: states.count(state) for state in sorted(set(states))
         },
@@ -691,6 +733,23 @@ def render_timeline(run: FleetRun, analysis: Dict[str, Any]) -> str:
                 f"{100 * lane['idle_frac']:5.1f}  "
                 f"{lane['tasks']:5d}  {lane['steals']:6d}  "
                 f"{occ}  {moved / 1e6:8.1f}"
+            )
+        lines.append("")
+    pulse_rows = analysis.get("pulse") or {}
+    if pulse_rows:
+        lines.append(
+            "pulse (live heartbeat rings; `obs pulse` for the full view):"
+        )
+        for worker in sorted(pulse_rows):
+            row = pulse_rows[worker]
+            bubble = row.get("bubble_fraction")
+            bub = f"{100 * bubble:.1f}%" if bubble is not None else "-"
+            lines.append(
+                f"  {worker}: {row['heartbeats']} heartbeat(s), "
+                f"{row['cells_per_s'] or 0.0:.1f} cells/s, bubble {bub} "
+                f"limited by {row.get('limiting_stage') or '-'}"
+                + (" (from flight record)" if row["source"] == "flight"
+                   else "")
             )
         lines.append("")
     stats = analysis["task_stats"]

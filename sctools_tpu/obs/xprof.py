@@ -69,6 +69,8 @@ __all__ = [
     "declared_sites",
     "active_site",
     "observe_event",
+    "compile_seq",
+    "retrace_seq",
     "record_dispatch",
     "record_transfer",
     "record_transfer_waste",
@@ -131,6 +133,14 @@ _sites: Dict[str, _Site] = {}
 _declared: Dict[str, int] = {}  # name -> times declared
 _unattributed_compiles = 0
 _unattributed_compile_s = 0.0
+# process-wide backend-compile and steady-state-retrace sequences
+# (bumped under _lock but READ lockless): scx-pulse diffs retrace_seq
+# around each batch to stamp the heartbeat's retrace flag without taking
+# the registry lock per batch — a RETRACE (a compile for an already-seen
+# signature), not any warmup compile, which would read as a phantom
+# retrace storm on every cold start
+_compile_seq = 0
+_retrace_seq = 0
 
 # (direction, site) -> [bytes, seconds, events]
 _ledger: Dict[Tuple[str, str], List[float]] = {}
@@ -385,22 +395,26 @@ def observe_event(event: str, duration: float) -> Optional[str]:
     if "compile" not in event:
         return frame[0] if frame else None
     global _unattributed_compiles, _unattributed_compile_s
+    global _compile_seq, _retrace_seq
     backend = "backend_compile" in event
     if frame is None:
         with _lock:
             _unattributed_compile_s += duration
             if backend:
                 _unattributed_compiles += 1
+                _compile_seq += 1
         return None
     name, sig, seen = frame[0], frame[1], frame[2]
     site = _site(name)
     with _lock:
         site.compile_s += duration
         if backend:
+            _compile_seq += 1
             frame[3] += 1
             site.compiles += 1
             site.signatures[sig] = site.signatures.get(sig, 0) + 1
             if seen:
+                _retrace_seq += 1
                 site.retraces += 1
                 for example in site.retrace_examples:
                     if example["signature"] == sig:
@@ -416,6 +430,26 @@ def observe_event(event: str, duration: float) -> Optional[str]:
         if seen:
             _obs_count("xprof_retraces")
     return name
+
+
+def compile_seq() -> int:
+    """Backend compiles observed so far, attributed or not (lockless)."""
+    return _compile_seq
+
+
+def retrace_seq() -> int:
+    """Steady-state retraces observed so far (lockless int read).
+
+    A retrace is a backend compile for a signature its site had ALREADY
+    seen — the repo-wide definition the efficiency report and the bench
+    gate use. scx-pulse diffs this around each batch to stamp the
+    heartbeat's retrace flag, so a cold start's expected first compiles
+    never read as a phantom retrace storm. Compile events only flow
+    while obs recording is on (the jax.monitoring hook gates on it), so
+    with obs off the flag simply stays 0 — documented in
+    docs/observability.md.
+    """
+    return _retrace_seq
 
 
 # -------------------------------------------------- occupancy telemetry
@@ -912,8 +946,33 @@ def efficiency_report(run_dir: str) -> Dict[str, Any]:
             link[f"{direction}_MBps"] = round(
                 timed_bytes / timed_seconds / 1e6, 1
             )
+    # scx-pulse bubble attribution rides the same report when the run
+    # dir carries heartbeat rings: the device-efficiency story and the
+    # pipeline-overlap story read from one CLI surface
+    from . import pulse as _pulse
+
+    pulse_view = _pulse.fleet_pulse(run_dir)
+    pulse_section = (
+        {
+            "heartbeats": pulse_view["fleet"]["heartbeats"],
+            "cells_per_s": pulse_view["fleet"]["cells_per_s"],
+            "bubble_fraction": pulse_view["fleet"]["bubble_fraction"],
+            "limiting_stage": pulse_view["fleet"]["limiting_stage"],
+            "workers": {
+                worker: {
+                    "heartbeats": row["heartbeats"],
+                    "bubble_fraction": row["bubble_fraction"],
+                    "limiting_stage": row["limiting_stage"],
+                }
+                for worker, row in pulse_view["workers"].items()
+            },
+        }
+        if pulse_view["workers"]
+        else None
+    )
     return {
         "run_dir": os.path.abspath(run_dir),
+        "pulse": pulse_section,
         "workers": sorted(
             {str(r.get("worker", "unknown")) for r in registries}
         ),
@@ -1104,6 +1163,18 @@ def render_efficiency(report: Dict[str, Any]) -> str:
             else ""
         )
     )
+    pulse_section = report.get("pulse")
+    if pulse_section and pulse_section.get("heartbeats"):
+        fraction = pulse_section.get("bubble_fraction")
+        bubble = (
+            f"{100 * fraction:.1f}%" if fraction is not None else "-"
+        )
+        lines.append(
+            f"pulse: {pulse_section['heartbeats']} heartbeat(s), "
+            f"bubble {bubble} limited by "
+            f"{pulse_section.get('limiting_stage') or '-'} "
+            "(`python -m sctools_tpu.obs pulse` for the live view)"
+        )
     lines.append("")
     sites = report["sites"]
     if sites:

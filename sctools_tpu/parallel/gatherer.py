@@ -22,7 +22,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import ingest, obs
-from ..obs import xprof
+from ..obs import pulse, xprof
 from ..io.packed import KEY_HI_SHIFT
 from ..sched import faults
 from ..metrics.gatherer import (
@@ -55,6 +55,12 @@ class _ShardedMixin:
         # dying MID-CHUNK, with earlier batches already in the in-flight
         # CSV — exactly the partial-part window atomic commit must cover
         faults.fire("gatherer.batch", name=str(self._bam_file))
+        # scx-pulse heartbeat, the same per-batch record as the
+        # single-device path (distinct stage id so a mixed fleet's lanes
+        # stay attributable)
+        hb = pulse.heartbeat(f"gatherer.{self.entity_kind}.sharded")
+        hb.decode_from_ring()
+        hb.begin("h2d")
         # the SAME schema decision as the single-device path (shared
         # prologue): byte-identical CSVs require both paths to derive the
         # per-record quality floats the same way. The run-keyed wire is a
@@ -106,6 +112,8 @@ class _ShardedMixin:
             )
             self.bytes_h2d += batch_h2d
             up.add(bytes=batch_h2d, prepacked=int(prepacked))
+        hb.end("h2d")
+        hb.add(bytes_h2d=batch_h2d)
         obs.count("batches_uploaded")
         obs.count("h2d_bytes", batch_h2d)
         shard_size = max(v.shape[1] for v in stacked.values())
@@ -114,6 +122,7 @@ class _ShardedMixin:
             frame.n_records,
             self._n_shards * shard_size,
         )
+        hb.begin("compute")
         with obs.span(
             "compute",
             records=frame.n_records,
@@ -147,14 +156,20 @@ class _ShardedMixin:
             # overlapped writeback: both pulls' D2H starts now, while the
             # next batch partitions/uploads/computes
             blocks, n_entities = self._writeback.stage((blocks, n_entities))
+        hb.end("compute")
+        hb.add(
+            real_rows=frame.n_records,
+            padded_rows=self._n_shards * shard_size,
+            entities=int(unique_codes.size),
+        )
         return (
             self._entity_names(frame), blocks, n_entities,
-            int_names, float_names, frame.n_records,
+            int_names, float_names, frame.n_records, hb,
         )
 
     def _finalize_device_batch(
         self, entity_names, blocks, n_entities, int_names, float_names,
-        n_records, out,
+        n_records, hb, out,
     ) -> None:
         with obs.span("writeback", records=n_records) as wb:
             # the async recovery boundary, same as the single-device path:
@@ -162,13 +177,18 @@ class _ShardedMixin:
             # staged D2H — BOTH pulls ride one guarded attempt through the
             # ingest.pull choke point, so a blip at either lands in the
             # same retry and everything stages before any host use
+            hb.add(wb_phase=self._writeback.phase_code())
+            hb.begin("d2h")
             (blocks, n_entities), batch_d2h = self._writeback.collect(
                 (blocks, n_entities), site="gatherer.writeback",
                 degrade_site=self._GUARD_SITE, name=str(self._bam_file),
             )
+            hb.end("d2h")
             n_entities = np.asarray(n_entities).reshape(-1)
             self.bytes_d2h += batch_d2h
             wb.add(bytes=batch_d2h)
+            hb.add(bytes_d2h=batch_d2h)
+            hb.emit()
             # pad rows pulled beyond the real entity rows: blocks is
             # [n_shards, columns, k] column-major, so each pad row costs
             # one column-slice of 4-byte lanes

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
-from ..obs import xprof
+from ..obs import pulse, xprof
 from ..ops import segments as seg
 from ..platform import shard_map
 from .metrics import P, _check_shard_count, reshard_by_key
@@ -248,6 +248,9 @@ def distributed_sort(
     concrete = not isinstance(
         stacked_cols[key_names[0]], jax.core.Tracer
     )
+    # scx-pulse heartbeat: only a CONCRETE call is a dispatch (the traced
+    # body runs at trace time and must not pollute the live telemetry)
+    hb = pulse.heartbeat("sort") if concrete else pulse.NOOP
     # under tracing the body runs at trace time, not sort time: record that
     # under its own stage name so summarize never ranks the sort stage by
     # compile cost (and never under-counts real executions)
@@ -257,7 +260,7 @@ def distributed_sort(
         shards=n_shards,
     ) as sort_span:
         if concrete:
-            if obs.enabled():
+            if obs.enabled() or pulse.enabled():
                 # actual record count, not padded shard capacity — keeps
                 # this span's rec/s comparable with the other stages'.
                 # Computed only while recording: the scan (and a possible
@@ -276,6 +279,10 @@ def distributed_sort(
                     real_records,
                     n_shards * shard_size,
                 )
+                hb.add(
+                    real_rows=real_records,
+                    padded_rows=n_shards * shard_size,
+                )
             with obs.span("distributed:sort_capacity"):
                 required = required_sort_capacity(
                     stacked_cols, key_names, n_shards
@@ -287,10 +294,13 @@ def distributed_sort(
             # batch onto device 0 and reshard inside the pass)
             from .. import ingest
 
+            hb.begin("h2d")
             stacked_cols, sort_h2d = ingest.upload(
                 stacked_cols, site="sort.upload",
                 sharding=ingest.mesh_sharding(mesh, axis_name),
             )
+            hb.end("h2d")
+            hb.add(bytes_h2d=sort_h2d)
             sort_span.add(bytes=sort_h2d)
             if capacity is None:
                 # bucketed so streaming batches of similar skew reuse one
@@ -310,6 +320,7 @@ def distributed_sort(
         # OOM propagates to the scheduler)
         from .. import guard, ingest
 
+        hb.begin("compute")
         out, dropped = guard.retrying(
             # scx-lint: disable=SCX503 -- capacity is caller-pinned, a bucket_size() output, or the already-bucketed shard_size, so the compiled-program universe stays bounded
             lambda: _build_sample_sort(
@@ -318,8 +329,15 @@ def distributed_sort(
             site="sort.dispatch",
             leg="compute",
         )
+        hb.end("compute")
         if not isinstance(dropped, jax.core.Tracer):
-            dropped_host, _ = ingest.pull(dropped, site="sort.writeback")
+            hb.begin("d2h")
+            dropped_host, sort_d2h = ingest.pull(
+                dropped, site="sort.writeback"
+            )
+            hb.end("d2h")
+            hb.add(bytes_d2h=sort_d2h)
+            hb.emit()
             n_dropped = int(dropped_host.sum())
             if n_dropped:
                 raise RuntimeError(

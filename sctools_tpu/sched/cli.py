@@ -26,7 +26,10 @@
   artifact under the wrong identity.
 
 ``status`` also surfaces scx-guard poison-record sidecars when the
-journal's ``quarantine/`` directory holds any (docs/robustness.md).
+journal's ``quarantine/`` directory holds any (docs/robustness.md), and
+a one-line scx-pulse summary (windowed cells/sec + pipeline bubble
+verdict) when live heartbeat rings sit in the run dir — ``--watch``
+refreshes it per frame (docs/observability.md "scx-pulse").
 """
 
 from __future__ import annotations
@@ -81,6 +84,7 @@ def _status(journal_dir: str, out, journal: Optional[Journal] = None) -> int:
     summary = ", ".join(f"{k}={v}" for k, v in sorted(totals.items()))
     print(f"total={len(tasks)} ({summary})", file=out)
     _print_efficiency_summary(journal_dir, out)
+    _print_pulse_summary(journal_dir, out)
     _print_quarantined_records(journal_dir, out)
     if totals.get(QUARANTINED):
         return 2
@@ -123,6 +127,57 @@ def _print_efficiency_summary(journal_dir: str, out) -> None:
     except Exception:  # noqa: BLE001 - status must never die on telemetry
         # a torn/hand-edited registry is a telemetry problem, never a
         # reason to lose the journal status an operator came for
+        return
+    print(line, file=out)
+
+
+# --watch's pulse window: long enough to smooth batch granularity,
+# short enough that a stalled worker's rate visibly decays within a
+# couple of refresh cycles
+_WATCH_PULSE_WINDOW_S = 30.0
+
+
+def _print_pulse_summary(
+    journal_dir: str, out, window_s: Optional[float] = None
+) -> None:
+    """One scx-pulse line when live heartbeat rings sit in the run dir.
+
+    The live counterpart of the efficiency line: an operator watching an
+    in-flight run sees windowed throughput and the current pipeline
+    bubble verdict without leaving ``sched status`` — the rings are
+    written (and readable) WHILE the workers run, unlike the exit-dump
+    registries the efficiency line reads. One-shot ``status`` prints the
+    whole-run summary (``window_s=None`` — a completed run must not
+    render as decayed-to-zero); ``--watch`` frames pass a trailing
+    window so a hung worker's rate falls instead of freezing.
+    """
+    from ..obs import pulse
+
+    run_dir = os.path.dirname(os.path.abspath(journal_dir)) or "."
+    try:
+        view = pulse.fleet_pulse(run_dir, window_s=window_s)
+        fleet = view["fleet"]
+        if not fleet["heartbeats"]:
+            if window_s and view["workers"]:
+                # rings exist but nothing beat inside the window: the
+                # watch frame must SAY stalled, not drop the line
+                print(
+                    f"pulse: no heartbeats in the last {window_s:g}s "
+                    f"({len(view['workers'])} ring(s) present — workers "
+                    "idle or stalled)",
+                    file=out,
+                )
+            return
+        bubble = fleet.get("bubble_fraction")
+        line = (
+            f"pulse: {fleet['cells_per_s'] or 0.0:.1f} cells/s, bubble "
+            + (f"{100 * bubble:.1f}%" if bubble is not None else "-")
+            + f" limited by {fleet.get('limiting_stage') or '-'} "
+            f"({fleet['heartbeats']} heartbeat(s) from "
+            f"{len(view['workers'])} ring(s); "
+            "`python -m sctools_tpu.obs pulse` for the live lanes)"
+        )
+    except Exception:  # noqa: BLE001 - status must never die on telemetry
         return
     print(line, file=out)
 
@@ -264,6 +319,7 @@ def _render_watch_frame(journal: Journal, out) -> int:
                 f"  {name:<16} {row['worker']:<30} {beat:>8}  {left:>8}",
                 file=out,
             )
+    _print_pulse_summary(journal.root, out, window_s=_WATCH_PULSE_WINDOW_S)
     if not tasks:
         return 1
     if totals.get(QUARANTINED):

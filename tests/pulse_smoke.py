@@ -1,0 +1,260 @@
+"""pulse-smoke: the CI gate for scx-pulse (`make pulse-smoke`).
+
+A traced 2-worker run of the real chunk-metrics pipeline (the
+xprof-smoke scenario) with the live telemetry plane ON
+(``SCTOOLS_TPU_PULSE=1``), then the pulse surfaces are held to their
+contracts:
+
+- every worker that committed work left a parseable ``pulse.*.ring``
+  heartbeat ring beside its trace capture, with zero torn records after
+  a clean exit;
+- every COMMITTED task has >= 1 heartbeat attributed to it (the
+  heartbeat's 16-byte task-id prefix matches the journal's task id) —
+  a dispatch the live plane cannot see is a dispatch the next perf PR
+  cannot steer by;
+- the windowed cells/sec the rings report agrees with the final
+  journal-derived rate (committed CSV rows over the leased->committed
+  wall span) within 2x — live telemetry that disagrees with the ground
+  truth by more than weather is worse than none;
+- bubble attribution names a limiting stage (one of the four legs),
+  per worker and fleet-wide;
+- the HTTP exporter serves valid Prometheus exposition of the merged
+  view (every sample line parses; the fleet gauges are present), and
+  the ``obs pulse`` CLI front door renders it (text and --json);
+- ``obs summarize --json`` and the fleet timeline fold the same rings.
+
+Exit 0 on success; any assertion failure is a gate failure.
+"""
+
+import csv
+import glob
+import gzip
+import io
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "sched_worker.py"
+)
+
+
+def launch(workdir: str, process_id: int):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("SCTOOLS_TPU_FAULTS", None)
+    env["SCTOOLS_TPU_TRACE"] = os.path.join(workdir, "obs")
+    env["SCTOOLS_TPU_TRACE_WORKER"] = f"p{process_id}"
+    env["SCTOOLS_TPU_PULSE"] = "1"
+    return subprocess.Popen(
+        [sys.executable, WORKER, workdir, str(process_id), "2", "5.0",
+         "3", "0.1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def fail(message: str) -> None:
+    print(f"pulse-smoke: FAIL: {message}", file=sys.stderr)
+    sys.exit(1)
+
+
+def count_csv_rows(path: str) -> int:
+    with gzip.open(path, "rt") as f:
+        reader = csv.reader(io.StringIO(f.read()))
+        return max(0, sum(1 for _ in reader) - 1)  # minus header
+
+
+def main() -> int:
+    workdir = os.environ.get(
+        "SCTOOLS_TPU_PULSE_SMOKE_DIR"
+    ) or tempfile.mkdtemp(prefix="sctools_tpu_pulse_smoke.")
+    os.makedirs(workdir, exist_ok=True)
+    bam = os.path.join(workdir, "input.bam")
+
+    from sched_smoke import make_input
+
+    from sctools_tpu.obs import pulse
+    from sctools_tpu.obs.fleet import analyze, discover
+    from sctools_tpu.platform import GenericPlatform
+    from sctools_tpu.sched import COMMITTED, Journal
+
+    make_input(bam)
+    chunk_dir = os.path.join(workdir, "chunks")
+    os.makedirs(chunk_dir, exist_ok=True)
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", os.path.join(chunk_dir, "chunk"), "-s", "0.002",
+         "-t", "CB"]
+    )
+    n_chunks = len(glob.glob(os.path.join(chunk_dir, "*.bam")))
+    assert n_chunks >= 2, f"need >=2 chunks, got {n_chunks}"
+
+    procs = [launch(workdir, 0), launch(workdir, 1)]
+    for proc in procs:
+        out, _ = proc.communicate(timeout=300)
+        if proc.returncode != 0:
+            fail(f"worker exited {proc.returncode}:\n{out[-2000:]}")
+
+    # ---- rings discovered and parseable, no torn records after a clean
+    # exit (live scrapes may see one; a finished ring must not)
+    rings = pulse.load_rings(workdir)
+    if not rings:
+        fail("no pulse.*.ring heartbeat rings written")
+    for worker, ring in rings.items():
+        if not ring["records"]:
+            fail(f"{worker}: ring parsed but holds no heartbeats")
+        if ring["torn"]:
+            fail(f"{worker}: {ring['torn']} torn record(s) after clean exit")
+    total_heartbeats = sum(len(r["records"]) for r in rings.values())
+    print(
+        f"pulse-smoke: {total_heartbeats} heartbeat(s) from "
+        f"{sorted(rings)} ({n_chunks} chunk(s))"
+    )
+
+    # ---- every committed task has >= 1 heartbeat (task-id prefix match)
+    journal_dir = os.path.join(workdir, "sched-journal")
+    journal = Journal(journal_dir, worker_id="pulse-probe")
+    tasks, states = journal.replay()
+    committed = {
+        tid for tid, st in states.items()
+        if st.state == COMMITTED and tid in tasks
+    }
+    if len(committed) != n_chunks:
+        fail(f"{len(committed)} committed of {n_chunks} chunks")
+    seen_prefixes = {
+        record["task_id"]
+        for ring in rings.values()
+        for record in ring["records"]
+        if record["task_id"]
+    }
+    if not seen_prefixes:
+        fail("no heartbeat carries a task id (obs context not adopted)")
+    for tid in committed:
+        if tid[:16] not in seen_prefixes:
+            fail(
+                f"committed task {tasks[tid].name} ({tid[:16]}...) has no "
+                f"heartbeat; seen: {sorted(seen_prefixes)}"
+            )
+
+    # ---- windowed cells/sec vs the journal-derived rate, within 2x.
+    # Journal ground truth: committed CSV rows over the leased->committed
+    # wall span. Pulse: the fleet windowed rate (sum of per-worker rates
+    # over their own heartbeat windows).
+    total_cells = sum(
+        count_csv_rows(path)
+        for path in glob.glob(os.path.join(workdir, "metrics.part*.csv.gz"))
+    )
+    if not total_cells:
+        fail("no committed part rows found for the journal-derived rate")
+    event_ts = [
+        event["ts"]
+        for event in journal.events()
+        if event.get("event") in ("leased", "committed")
+        and isinstance(event.get("ts"), (int, float))
+    ]
+    journal_span = max(event_ts) - min(event_ts)
+    if journal_span <= 0:
+        fail(f"degenerate journal wall span {journal_span}")
+    journal_rate = total_cells / journal_span
+    view = pulse.fleet_pulse(workdir, rings=rings)
+    pulse_rate = view["fleet"]["cells_per_s"]
+    if not pulse_rate:
+        fail(f"fleet pulse reports no cells/sec: {view['fleet']}")
+    ratio = pulse_rate / journal_rate
+    if not (0.5 <= ratio <= 2.0):
+        fail(
+            f"windowed cells/sec {pulse_rate:.1f} vs journal-derived "
+            f"{journal_rate:.1f} (ratio {ratio:.2f}) outside 2x"
+        )
+    print(
+        f"pulse-smoke: windowed {pulse_rate:.1f} cells/s vs journal "
+        f"{journal_rate:.1f} (ratio {ratio:.2f})"
+    )
+
+    # ---- bubble attribution names a stage, per worker and fleet-wide
+    for worker, row in view["workers"].items():
+        if row["limiting_stage"] not in pulse.LEGS:
+            fail(f"{worker}: no limiting stage named: {row}")
+        if row["bubble_fraction"] is None:
+            fail(f"{worker}: no bubble fraction computed")
+    if view["fleet"]["limiting_stage"] not in pulse.LEGS:
+        fail(f"fleet limiting stage not named: {view['fleet']}")
+    print(
+        f"pulse-smoke: bubble {view['fleet']['bubble_fraction']} limited "
+        f"by {view['fleet']['limiting_stage']}"
+    )
+
+    # ---- the HTTP exporter serves valid exposition of the merged view
+    from sctools_tpu.obs.serve import PulseExporter
+
+    exporter = PulseExporter(port=0, run_dir=workdir)
+    port = exporter.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as response:
+            if response.status != 200:
+                fail(f"exporter returned {response.status}")
+            body = response.read().decode("utf-8")
+    finally:
+        exporter.stop()
+    samples = {}
+    for line in body.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        if not name:
+            fail(f"unparseable exposition line: {line!r}")
+        try:
+            samples[name] = float(value)
+        except ValueError:
+            fail(f"non-numeric exposition value: {line!r}")
+    for needed in (
+        "sctools_tpu_pulse_fleet_cells_per_s",
+        "sctools_tpu_pulse_fleet_bubble_fraction",
+        "sctools_tpu_pulse_fleet_heartbeats",
+    ):
+        if needed not in samples:
+            fail(f"exporter exposition missing {needed}: {sorted(samples)}")
+    print(f"pulse-smoke: exporter served {len(samples)} sample(s)")
+
+    # ---- CLI front doors
+    from sctools_tpu.obs.__main__ import main as obs_cli
+
+    if obs_cli(["pulse", workdir]) != 0:
+        fail("obs pulse CLI exited non-zero")
+    if obs_cli(["pulse", workdir, "--json"]) != 0:
+        fail("obs pulse --json exited non-zero")
+    traces = sorted(
+        glob.glob(os.path.join(workdir, "obs", "trace*.jsonl"))
+    )
+    if obs_cli(["summarize", "--json"] + traces) != 0:
+        fail("obs summarize --json exited non-zero")
+
+    # ---- fleet timeline folds the rings
+    analysis = analyze(discover(workdir))
+    if not analysis.get("pulse"):
+        fail("fleet timeline analysis carries no pulse section")
+    for worker, row in analysis["pulse"].items():
+        if row["source"] != "ring":
+            fail(f"{worker}: expected ring-sourced pulse, got {row}")
+
+    print(
+        f"pulse-smoke: OK ({total_heartbeats} heartbeat(s), "
+        f"{len(rings)} ring(s), bubble "
+        f"{view['fleet']['bubble_fraction']} / "
+        f"{view['fleet']['limiting_stage']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

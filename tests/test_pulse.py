@@ -1,0 +1,652 @@
+"""scx-pulse: heartbeat ring, aggregation, bubble attribution, exporters.
+
+Covers the contracts docs/observability.md ("scx-pulse") documents:
+histogram merge algebra (associative + commutative), ring wraparound and
+torn-final-record tolerance, off-mode as a TRUE no-op (the cached
+singleton, pinned like the frame witness), valid Prometheus exposition
+with the PR-4 name-collision discipline, a SIGTERM mid-run leaving a
+parseable ring + flight-record pulse section, and the bench gate
+surfaces (platform-fingerprint trajectory filtering, min-across-repeats
+guard summary, bubble/pulse ceilings).
+"""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import pytest
+
+from sctools_tpu.obs import pulse
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def pulse_dir(tmp_path):
+    """Enable pulse into a temp ring dir for one test, then restore."""
+    was_enabled = pulse._enabled
+    was_dir = pulse._ring_dir
+    pulse.reset()
+    pulse._enabled = True
+    pulse._ring_dir = str(tmp_path)
+    try:
+        yield str(tmp_path)
+    finally:
+        pulse.reset()
+        pulse._enabled = was_enabled
+        pulse._ring_dir = was_dir
+
+
+def emit_one(stage="gatherer.cell", batch=None, t0=0.0, dur=1.0, **fields):
+    hb = pulse.heartbeat(stage)
+    hb.leg("compute", t0, t0 + dur)
+    hb.add(batch=batch, **fields)
+    hb.emit()
+
+
+# ------------------------------------------------------------ histogram
+
+
+def random_hist(rng, n):
+    h = pulse.Pow2Histogram()
+    for _ in range(n):
+        h.add(rng.random() * rng.choice([1e-6, 1e-3, 1.0]))
+    return h
+
+
+def test_histogram_merge_commutative_and_associative():
+    rng = random.Random(11)
+    for _ in range(20):
+        a = random_hist(rng, rng.randrange(0, 50))
+        b = random_hist(rng, rng.randrange(0, 50))
+        c = random_hist(rng, rng.randrange(0, 50))
+        assert a.merge(b).counts == b.merge(a).counts
+        assert a.merge(b.merge(c)).counts == a.merge(b).merge(c).counts
+        # counts conserve through any merge order
+        assert a.merge(b).merge(c).total == a.total + b.total + c.total
+
+
+def test_histogram_buckets_and_quantiles():
+    h = pulse.Pow2Histogram()
+    h.add(0.0)          # bucket 0
+    h.add(1.5e-6)       # ~1.5us -> bucket 1
+    h.add(1.0e-3)       # 1000us -> bucket 10
+    assert h.total == 3
+    assert h.quantile_ms(0.0) is not None
+    assert h.quantile_ms(1.0) == (1 << 10) / 1e3
+    assert pulse.Pow2Histogram().quantile_ms(0.5) is None
+
+
+# ------------------------------------------------------------- off mode
+
+
+def test_off_mode_hands_out_the_noop_singleton():
+    # pinned like the frame witness: with SCTOOLS_TPU_PULSE unset the
+    # handout is the cached singleton — not a subclass, not a fresh
+    # object — and nothing records
+    assert not pulse.enabled()
+    hb = pulse.heartbeat("gatherer.cell")
+    assert hb is pulse.NOOP
+    assert type(hb) is pulse._NoopHeartbeat
+    hb.begin("compute")
+    hb.end("compute")
+    hb.decode_from_ring()
+    assert hb.add(real_rows=5) is hb
+    hb.emit()
+    assert pulse.live_records() == []
+    pulse.note_decode(0.0, 1.0)  # off: dropped, not queued
+    assert not pulse._decode_notes
+
+
+def test_iter_decode_off_passes_through_and_chains_close():
+    closed = []
+
+    class Source:
+        def __iter__(self):
+            return iter([1, 2, 3])
+
+    assert list(pulse.iter_decode(Source())) == [1, 2, 3]
+
+    # on: intervals are noted and close() chains to the source
+    class Gen:
+        def __init__(self):
+            self._it = iter([4, 5])
+
+        def __next__(self):
+            return next(self._it)
+
+        def __iter__(self):
+            return self
+
+        def close(self):
+            closed.append(True)
+
+    was = pulse._enabled
+    pulse._enabled = True
+    try:
+        iterator = pulse.iter_decode(Gen())
+        assert next(iterator) == 4
+        iterator.close()
+        assert closed == [True]
+        assert len(pulse._decode_notes) == 1
+    finally:
+        pulse._enabled = was
+        pulse.reset()
+
+
+# ----------------------------------------------------- ring file format
+
+
+def test_ring_roundtrip_and_wraparound(pulse_dir):
+    for index in range(10):
+        emit_one(batch=index, t0=float(index), real_rows=7, padded_rows=8,
+                 entities=2, bytes_h2d=100, bytes_d2h=50)
+    path = pulse.ring_path()
+    assert os.path.exists(path)
+    ring = pulse.load_ring(path)
+    assert ring["torn"] == 0
+    assert [r["batch"] for r in ring["records"]] == list(range(10))
+    record = ring["records"][3]
+    assert record["stage"] == "gatherer.cell"
+    assert record["real_rows"] == 7 and record["padded_rows"] == 8
+    assert record["entities"] == 2
+    assert record["bytes_h2d"] == 100 and record["bytes_d2h"] == 50
+    assert record["legs"]["compute"] == (3.0, 4.0)
+
+    # wraparound: writes beyond capacity keep the NEWEST capacity records
+    capacity = pulse._writer.capacity
+    total = capacity + 25
+    for index in range(10, total):
+        emit_one(batch=index, t0=float(index))
+    ring = pulse.load_ring(path)
+    assert len(ring["records"]) == capacity
+    assert ring["records"][0]["seq"] == total - capacity + 1
+    assert ring["records"][-1]["seq"] == total
+
+
+def test_ring_capacity_env(pulse_dir, monkeypatch):
+    monkeypatch.setenv(pulse.ENV_CAPACITY, "64")
+    assert pulse.capacity() == 64
+    monkeypatch.setenv(pulse.ENV_CAPACITY, "garbage")
+    assert pulse.capacity() == pulse.DEFAULT_CAPACITY
+    monkeypatch.setenv(pulse.ENV_CAPACITY, "1")  # below floor
+    assert pulse.capacity() == pulse.DEFAULT_CAPACITY
+
+
+def test_torn_final_record_is_skipped_not_fatal(pulse_dir):
+    for index in range(5):
+        emit_one(batch=index, t0=float(index))
+    path = pulse.ring_path()
+    pulse.reset()  # close the writer so the file is stable
+    # tear the LAST record mid-write: corrupt its trailing seq_echo, the
+    # exact state a reader racing the writer (or a crash mid-pwrite)
+    # observes
+    offset = (
+        pulse.HEADER_SIZE + 4 * pulse.RECORD_SIZE + pulse.RECORD_SIZE - 8
+    )
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        f.write(b"\xde\xad\xbe\xef\xde\xad\xbe\xef")
+    ring = pulse.load_ring(path)
+    assert ring["torn"] == 1
+    assert [r["batch"] for r in ring["records"]] == [0, 1, 2, 3]
+
+
+def test_not_a_ring_rejected(tmp_path):
+    bogus = tmp_path / "pulse.x.ring"
+    bogus.write_bytes(b"not a ring at all")
+    assert pulse.load_ring(str(bogus)) is None
+    with pytest.raises(ValueError):
+        pulse.parse_ring_bytes(b"\0" * (pulse.HEADER_SIZE + 10))
+
+
+# ------------------------------------------------------- memory session
+
+
+def test_memory_session_records_and_restores():
+    assert not pulse.enabled()
+    with pulse.memory_session() as records:
+        assert pulse.enabled()
+        emit_one(batch=0, real_rows=3, padded_rows=4, entities=1)
+        assert len(records) == 1
+        assert records[0]["real_rows"] == 3
+    assert not pulse.enabled()
+    assert pulse.memory_records() == []
+
+
+# ------------------------------------------------- fold + bubble algebra
+
+
+def synthetic_record(stage, legs, ts=None, **fields):
+    record = {
+        "seq": 1, "ts": ts if ts is not None else max(
+            (e for _, e in legs.values()), default=0.0
+        ),
+        "batch": 0, "stage": stage, "ring_slot": 255, "wb_phase": "idle",
+        "retrace": False, "real_rows": 0, "padded_rows": 0, "entities": 0,
+        "bytes_h2d": 0, "bytes_d2h": 0, "task_id": "",
+        "legs": {name: legs.get(name, (0.0, 0.0)) for name in pulse.LEGS},
+    }
+    record.update(fields)
+    return record
+
+
+def test_fold_windowed_rates():
+    records = [
+        synthetic_record(
+            "count", {"compute": (float(i), i + 0.5)}, ts=float(i + 1),
+            real_rows=100, padded_rows=128, entities=10,
+            bytes_h2d=1000, bytes_d2h=500,
+        )
+        for i in range(10)
+    ]
+    fold = pulse.fold_records(records)
+    assert fold["heartbeats"] == 10
+    assert fold["occupancy"] == pytest.approx(100 / 128, abs=1e-3)
+    assert fold["cells_per_s"] == pytest.approx(100 / fold["window_s"], rel=0.01)
+    # trailing window selects only the newest heartbeats (boundary
+    # inclusive: ts 7..10 for a 3s window ending at 10)
+    windowed = pulse.fold_records(records, window_s=3.0)
+    assert windowed["heartbeats"] == 4
+    # a window longer than the data must not dilute the rate (span is
+    # clamped to what the data covers)
+    wide = pulse.fold_records(records, window_s=500.0)
+    assert wide["cells_per_s"] == pytest.approx(
+        fold["cells_per_s"], rel=0.05
+    )
+    assert pulse.fold_records([])["heartbeats"] == 0
+
+
+def test_windowed_fold_decays_for_a_stalled_worker():
+    # the live-view contract: with reader time (`now`, translated onto
+    # the worker clock) anchoring the window, a hung worker's heartbeats
+    # age out and the rate falls to zero — it must NOT freeze at the
+    # last healthy value
+    records = [
+        synthetic_record(
+            "count", {"compute": (float(i), i + 0.5)}, ts=float(i + 1),
+            entities=10,
+        )
+        for i in range(10)
+    ]
+    healthy = pulse.fold_records(records, window_s=5.0, now=10.0)
+    assert healthy["heartbeats"] > 0
+    # reader scrapes 100s after the last heartbeat: everything aged out
+    stalled = pulse.fold_records(records, window_s=5.0, now=110.0)
+    assert stalled["heartbeats"] == 0
+    assert stalled["cells_per_s"] is None
+
+
+def test_worker_row_windows_the_bubble_with_the_rates():
+    # an hour of healthy overlap must not dilute a LIVE bubble: the
+    # windowed row computes its bubble over the same trailing records
+    # as its rates
+    healthy = [
+        synthetic_record(
+            "gatherer.cell",
+            {"decode": (i + 0.1, i + 0.4), "compute": (float(i), i + 1.0)},
+            ts=float(i + 1),
+        )
+        for i in range(50)
+    ]
+    serialized = [
+        synthetic_record(
+            "gatherer.cell",
+            {
+                "decode": (100.0 + 2 * i, 100.0 + 2 * i + 1.4),
+                "compute": (100.0 + 2 * i + 1.4, 100.0 + 2 * i + 2.0),
+            },
+            ts=100.0 + 2 * i + 2.0,
+        )
+        for i in range(5)
+    ]
+    records = healthy + serialized
+    whole = pulse.worker_row(records)
+    live = pulse.worker_row(records, window_s=15.0)
+    assert live["bubble_fraction"] > 0.5  # the regression, undiluted
+    assert whole["bubble_fraction"] < live["bubble_fraction"]
+    assert live["limiting_stage"] == "decode"
+
+
+def test_bubble_attribution_overlapped_vs_serialized():
+    # perfectly overlapped: decode/h2d run UNDER the device leg -> no
+    # bubble, the device leg is limiting
+    overlapped = [
+        synthetic_record(
+            "gatherer.cell",
+            {
+                "decode": (i + 0.1, i + 0.4),
+                "h2d": (i + 0.1, i + 0.2),
+                "compute": (float(i), i + 0.9),
+                "d2h": (i + 0.9, i + 1.0),
+            },
+        )
+        for i in range(5)
+    ]
+    verdict = pulse.attribute_bubbles(overlapped)
+    assert verdict["bubble_fraction"] < 0.05
+    assert verdict["limiting_stage"] == "compute"
+
+    # serialized: decode runs ALONE before each compute -> the bubble is
+    # the decode wall, and decode is the limiting stage
+    serialized = [
+        synthetic_record(
+            "gatherer.cell",
+            {
+                "decode": (2.0 * i, 2.0 * i + 1.4),
+                "compute": (2.0 * i + 1.4, 2.0 * i + 2.0),
+            },
+        )
+        for i in range(5)
+    ]
+    verdict = pulse.attribute_bubbles(serialized)
+    assert verdict["bubble_fraction"] == pytest.approx(0.7, abs=0.05)
+    assert verdict["limiting_stage"] == "decode"
+
+    empty = pulse.attribute_bubbles([])
+    assert empty["bubble_fraction"] is None
+    assert empty["limiting_stage"] is None
+
+
+def test_interval_helpers():
+    assert pulse._union([(0, 1), (0.5, 2), (3, 4)]) == [(0, 2), (3, 4)]
+    assert pulse._subtract([(0, 10)], [(2, 3), (5, 7)]) == [
+        (0, 2), (3, 5), (7, 10)
+    ]
+    assert pulse._subtract([(0, 1)], [(0, 1)]) == []
+
+
+def test_lane_bar_marks_device_and_bubble():
+    records = [
+        synthetic_record(
+            "gatherer.cell",
+            {"decode": (0.0, 0.5), "compute": (0.5, 1.0)},
+        )
+    ]
+    bar = pulse.lane_bar(records, width=10)
+    assert len(bar) == 10
+    assert "~" in bar and "#" in bar
+    assert pulse.lane_bar([], width=10) == "·" * 10
+
+
+# ------------------------------------------------------------ exporters
+
+
+def test_render_pulse_metrics_parses_and_detects_collisions(pulse_dir):
+    emit_one(batch=0, real_rows=10, padded_rows=16, entities=5)
+    view = pulse.fleet_pulse(pulse_dir)
+    text = pulse.render_pulse_metrics(view)
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, _, value = line.rpartition(" ")
+        assert name
+        float(value)  # every sample value must parse
+    # the PR-4 collision discipline: two workers whose labels sanitize
+    # to the same string would silently merge into one series -> raise
+    fold = {"heartbeats": 1, "cells_per_s": 1.0, "rows_per_s": 1.0,
+            "occupancy": 1.0, "h2d_Bps": 0.0, "d2h_Bps": 0.0,
+            "bubble_fraction": 0.0}
+    colliding = {
+        "workers": {"p 0": dict(fold), "p_0": dict(fold)},
+        "fleet": {"heartbeats": 2},
+    }
+    with pytest.raises(ValueError, match="collision"):
+        pulse.render_pulse_metrics(colliding)
+
+
+def test_http_exporter_serves_valid_exposition(pulse_dir):
+    emit_one(batch=0, real_rows=10, padded_rows=16, entities=5)
+    from sctools_tpu.obs.serve import PulseExporter
+
+    exporter = PulseExporter(port=0, run_dir=pulse_dir)
+    port = exporter.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as response:
+            assert response.status == 200
+            assert "text/plain" in response.headers["Content-Type"]
+            body = response.read().decode()
+        assert "sctools_tpu_pulse_fleet_heartbeats" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10
+            )
+    finally:
+        exporter.stop()
+
+
+def test_textfile_export_atomic(pulse_dir):
+    emit_one(batch=0, real_rows=10, padded_rows=16, entities=5)
+    target = pulse.export_textfile()
+    assert target and os.path.exists(target)
+    with open(target) as f:
+        assert "sctools_tpu_pulse_" in f.read()
+    assert not [
+        name for name in os.listdir(pulse_dir) if ".tmp." in name
+    ]
+
+
+# ---------------------------------------------------- SIGTERM mid-run
+
+_SIGTERM_CHILD = r"""
+import os, sys, time
+import sctools_tpu.obs as obs
+from sctools_tpu.obs import pulse
+
+assert pulse.enabled()
+assert obs.install_flight_recorder()
+hb = pulse.heartbeat("count")
+hb.leg("compute", 0.0, 1.0)
+hb.add(real_rows=10, padded_rows=16, entities=3)
+hb.emit()
+print("READY", flush=True)
+time.sleep(60)
+"""
+
+
+def test_sigterm_leaves_parseable_ring_and_flight_section(tmp_path):
+    trace_dir = tmp_path / "obs"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SCTOOLS_TPU_TRACE"] = str(trace_dir)
+    env["SCTOOLS_TPU_TRACE_WORKER"] = "pulsar"
+    env["SCTOOLS_TPU_PULSE"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SIGTERM_CHILD],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "READY" in line, line
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # the ring on disk parses (torn final record tolerated by contract)
+    rings = pulse.load_rings(str(trace_dir))
+    assert "pulsar" in rings, os.listdir(trace_dir)
+    records = rings["pulsar"]["records"]
+    assert len(records) == 1
+    assert records[0]["stage"] == "count"
+    # the flight record carries the pulse section naming the ring
+    flight_path = trace_dir / "flight.pulsar.jsonl"
+    assert flight_path.exists()
+    with open(flight_path) as f:
+        meta = json.loads(f.readline())
+    section = (meta.get("sections") or {}).get("pulse")
+    assert section, meta.get("sections")
+    assert section["seq"] == 1
+    assert section["path"].endswith("pulse.pulsar.ring")
+    assert section["recent"] and section["recent"][0]["stage"] == "count"
+
+
+def test_retrace_flag_claimed_by_one_heartbeat(pulse_dir):
+    # with pipelined batches several heartbeats are open at once; ONE
+    # real retrace must flag exactly one of them, or the pulse view
+    # over-counts vs xprof's authoritative retraces_steady_state
+    from sctools_tpu.obs import xprof
+
+    before = xprof._retrace_seq
+    hb1 = pulse.heartbeat("gatherer.cell")
+    hb2 = pulse.heartbeat("gatherer.cell")
+    try:
+        xprof._retrace_seq = before + 1  # one retrace lands mid-flight
+        hb1.leg("compute", 0.0, 1.0)
+        hb1.emit()
+        hb2.leg("compute", 0.5, 1.5)
+        hb2.emit()
+    finally:
+        xprof._retrace_seq = before
+    flags = [r["retrace"] for r in pulse.live_records()]
+    assert flags.count(True) == 1, flags
+    # a warmup COMPILE (no retrace) must not flag anything
+    hb3 = pulse.heartbeat("gatherer.cell")
+    hb3.leg("compute", 2.0, 3.0)
+    hb3.emit()
+    assert pulse.live_records()[-1]["retrace"] is False
+
+
+# --------------------------------------------------- bench gate surfaces
+
+
+def _bench():
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    return bench
+
+
+def test_summarize_overhead_ratios_takes_min():
+    bench = _bench()
+    # contention rejection: one clean round bounds the true overhead
+    assert bench._summarize_overhead_ratios([1.05, 1.01, 1.08]) == 1.01
+    assert bench._summarize_overhead_ratios([1.02]) == 1.02
+
+
+def write_bench_point(repo_dir, n, value, platform):
+    with open(os.path.join(repo_dir, f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(
+            {
+                "n": n,
+                "parsed": {
+                    "metric": "calculate_cell_metrics_end_to_end",
+                    "value": value,
+                    "unit": "cells/sec",
+                    "platform": platform,
+                },
+            },
+            f,
+        )
+
+
+def test_check_result_platform_filtering(tmp_path):
+    bench = _bench()
+    fast = {"backend": "axon", "device_kind": "axon", "device_count": 8}
+    slow = {"backend": "cpu", "device_kind": "cpu", "device_count": 1}
+    repo = str(tmp_path)
+    write_bench_point(repo, 1, 10000.0, fast)
+    write_bench_point(repo, 2, 12000.0, fast)
+    write_bench_point(repo, 3, 1000.0, slow)
+    metric = "calculate_cell_metrics_end_to_end"
+    # a slow-platform value healthy against its OWN trajectory passes...
+    ok = bench.check_result(
+        {"metric": metric, "value": 900.0, "platform": slow}, repo
+    )
+    assert ok["ok"], ok
+    trajectory = next(
+        c for c in ok["checks"] if c["name"] == "trajectory"
+    )
+    assert trajectory["points"] == 1 and trajectory["reference"] == 1000.0
+    # ...the SAME value unfingerprinted fails against the mixed median
+    assert not bench.check_result({"metric": metric, "value": 900.0}, repo)[
+        "ok"
+    ]
+    # a fast-platform value is never dragged down by the slow point
+    verdict = bench.check_result(
+        {"metric": metric, "value": 9000.0, "platform": fast}, repo
+    )
+    assert verdict["ok"]
+    assert next(
+        c for c in verdict["checks"] if c["name"] == "trajectory"
+    )["points"] == 2
+    # first point of a NEW platform: vacuous pass, with the exclusion
+    # named in the detail
+    fresh = bench.check_result(
+        {
+            "metric": metric, "value": 1.0,
+            "platform": {"backend": "q", "device_kind": "q",
+                         "device_count": 2},
+        },
+        repo,
+    )
+    assert fresh["ok"]
+    assert "other-platform" in next(
+        c for c in fresh["checks"] if c["name"] == "trajectory"
+    )["detail"]
+
+
+def test_check_result_bubble_and_pulse_gates(tmp_path):
+    bench = _bench()
+    repo = str(tmp_path)
+    write_bench_point(
+        repo, 1, 1000.0,
+        {"backend": "cpu", "device_kind": "cpu", "device_count": 1},
+    )
+    metric = "calculate_cell_metrics_end_to_end"
+    base = {"metric": metric, "value": 1000.0}
+    assert not bench.check_result(
+        {**base, "bubble_fraction": 0.5, "limiting_stage": "decode"}, repo
+    )["ok"]
+    good = bench.check_result(
+        {**base, "bubble_fraction": 0.1, "limiting_stage": "compute"}, repo
+    )
+    assert good["ok"]
+    gate = next(
+        c for c in good["checks"] if c["name"] == "bubble_fraction"
+    )
+    assert gate["limiting_stage"] == "compute"
+    assert not bench.check_result(
+        {**base, "pulse": {"overhead": 1.1, "pulse_on": False}}, repo
+    )["ok"]
+    assert bench.check_result(
+        {**base, "pulse": {"overhead": 1.1, "pulse_on": True}}, repo
+    )["ok"]
+    # guard min-across-repeats: ratios override the summary value
+    assert bench.check_result(
+        {**base, "guard": {"overhead": 1.04, "ratios": [1.04, 1.01]}}, repo
+    )["ok"]
+    assert not bench.check_result(
+        {**base, "guard": {"overhead": 1.01, "ratios": [1.04, 1.03]}}, repo
+    )["ok"]
+
+
+def test_bench_pulse_overhead_asserts_off_mode():
+    bench = _bench()
+    assert not pulse.enabled()
+    result = bench.bench_pulse_overhead(rounds=1, calls=4)
+    assert result["pulse_on"] is False
+    assert result["overhead"] == min(result["ratios"])
+
+
+# --------------------------------------------------------- wire phases
+
+
+def test_writeback_ring_phase_code():
+    from sctools_tpu.ingest.wire import WritebackRing
+
+    ring = WritebackRing(name="t", slots=2)
+    try:
+        assert ring.phase_code() == pulse.WB_PHASES["idle"]
+    finally:
+        ring.close()
