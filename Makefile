@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke perf-gate docs clean
 
-ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke perf-gate
+ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -17,16 +17,16 @@ native:
 # + tsan.supp audit, sctools_tpu/analysis). Both must pass for `make ci`.
 # tests/ is style-checked but excluded from scx-lint: it hosts the
 # deliberately-bad fixture corpus and test-local jax.config setup.
-# --no-race --no-shard --no-life --no-cost: `make modelcheck` owns the
-# four whole-package passes (SCX4xx + SCX5xx + SCX6xx + SCX7xx, same
-# path set), so ci builds the package model exactly once.
+# --no-race --no-shard --no-life --no-cost --no-mesh: `make modelcheck`
+# owns the five whole-package passes (SCX4xx + SCX5xx + SCX6xx + SCX7xx
+# + SCX8xx, same path set), so ci builds the package model exactly once.
 lint:
 	@if $(PY) -c "import ruff" 2>/dev/null; then \
 		$(PY) -m ruff check sctools_tpu tests bench.py __graft_entry__.py; \
 	else \
 		$(PY) -m compileall -q sctools_tpu tests bench.py __graft_entry__.py; \
 	fi
-	$(PY) -m sctools_tpu.analysis --no-race --no-shard --no-life --no-cost sctools_tpu bench.py __graft_entry__.py
+	$(PY) -m sctools_tpu.analysis --no-race --no-shard --no-life --no-cost --no-mesh sctools_tpu bench.py __graft_entry__.py
 
 # concurrency gate: the scx-race pass (SCX401-404) on its own — lock
 # inventory, acquisition-order cycles, death-path safety, cross-thread
@@ -73,13 +73,25 @@ lifecheck:
 costcheck:
 	$(PY) -m sctools_tpu.analysis --cost-only sctools_tpu bench.py __graft_entry__.py
 
-# the ci shape of racecheck+shardcheck+lifecheck+costcheck: all four
-# whole-package passes in ONE process (the *-only flags compose), so the
-# package parses once (analysis/astcache — and at most once across
-# processes too: the parse cache persists content-hash-keyed under
-# .scx_cache/) for all four gates
+# collective-safety gate: the scx-mesh pass (SCX801-805) on its own —
+# collectives under data-/rank-dependent branches, mismatched collective
+# order across paths of one mapped body, host syncs between collectives,
+# hardcoded device counts in mesh context, unreduced shard-partials
+# escaping replicated. The runtime half of the contract (the
+# SCTOOLS_TPU_MESH_DEBUG=1 collective-schedule witness against the
+# --emit-collective-schedule contract) runs inside mesh-smoke, which
+# asserts every worker's observed schedule is identical and a subset of
+# the static universe (docs/static_analysis.md).
+meshcheck:
+	$(PY) -m sctools_tpu.analysis --mesh-only sctools_tpu bench.py __graft_entry__.py
+
+# the ci shape of racecheck+shardcheck+lifecheck+costcheck+meshcheck:
+# all five whole-package passes in ONE process (the *-only flags
+# compose), so the package parses once (analysis/astcache — and at most
+# once across processes too: the parse cache persists content-hash-keyed
+# under .scx_cache/) for all five gates
 modelcheck:
-	$(PY) -m sctools_tpu.analysis --race-only --shard-only --life-only --cost-only sctools_tpu bench.py __graft_entry__.py
+	$(PY) -m sctools_tpu.analysis --race-only --shard-only --life-only --cost-only --mesh-only sctools_tpu bench.py __graft_entry__.py
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -180,6 +192,19 @@ pulse-smoke:
 	rm -rf /tmp/sctools_tpu_pulse_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_PULSE_SMOKE_DIR=/tmp/sctools_tpu_pulse_smoke \
 	$(PY) tests/pulse_smoke.py
+
+# collective-schedule gate: a 2-worker mesh-sharded run under
+# SCTOOLS_TPU_MESH_DEBUG=1 against the static collective schedule — both
+# workers must record NON-EMPTY, IDENTICAL per-region collective
+# schedules that sit inside the --emit-collective-schedule universe with
+# zero witness violations, every worker must announce the same mesh
+# fingerprint to the sched journal, and the on-device collective merge
+# must produce a CSV byte-identical to the legacy file-level concat path
+# (tests/mesh_smoke.py; docs/static_analysis.md "scx-mesh").
+mesh-smoke:
+	rm -rf /tmp/sctools_tpu_mesh_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_MESH_SMOKE_DIR=/tmp/sctools_tpu_mesh_smoke \
+	$(PY) tests/mesh_smoke.py
 
 # perf-regression gate self-test: bench.py --check must fail a
 # synthetically-degraded result and pass a trajectory-consistent one

@@ -1069,7 +1069,7 @@ def bench_pulse_overhead(rounds: int = 3, calls: int = 80) -> dict:
     }
 
 
-def _platform_fingerprint() -> dict:
+def _platform_fingerprint(mesh=None) -> dict:
     """The machine-enforced comparability key every result carries.
 
     (jax backend, device kind, device count): the BENCH_r06 lesson — a
@@ -1077,30 +1077,49 @@ def _platform_fingerprint() -> dict:
     device points with only a prose note separating them. The gate now
     compares a result's trajectory/median ONLY against same-fingerprint
     points, so cross-platform numbers can never gate each other.
+
+    ``mesh`` (a ``jax.sharding.Mesh``) stamps the MESH SHAPE (axis names
+    + sizes) into the fingerprint — the MULTICHIP_r* lesson:
+    ``dryrun_multichip`` forces the host platform, so every multichip
+    point reads cpu×8 and backend/device-kind alone cannot separate an
+    8-way mesh run from a 4-way one. Platform comparison is dict
+    equality, so a mesh-stamped point gates only against points recorded
+    on an identical topology.
     """
     import jax
 
     devices = jax.devices()
-    return {
+    fingerprint = {
         "backend": str(jax.default_backend()),
         "device_kind": str(devices[0].device_kind) if devices else "unknown",
         "device_count": len(devices),
     }
+    if mesh is not None:
+        fingerprint["mesh"] = {
+            "axes": [str(a) for a in mesh.axis_names],
+            "sizes": [int(mesh.shape[a]) for a in mesh.axis_names],
+        }
+    return fingerprint
 
 
 REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def load_trajectory(repo_dir: str, metric: str) -> list:
-    """The BENCH_r*.json history points matching ``metric``.
+def load_trajectory(
+    repo_dir: str, metric: str, pattern: str = "BENCH_r*.json"
+) -> list:
+    """The trajectory history points matching ``metric``.
 
     Each round's driver appends one BENCH_rNN.json with the parsed result;
     together they are the repo's own performance trajectory — the gate's
     reference. Unreadable or metric-mismatched files are skipped (the
-    headline metric changed once already, r01 -> r02).
+    headline metric changed once already, r01 -> r02). ``pattern``
+    selects the point family: ``"MULTICHIP_r*.json"`` loads the
+    multichip points (mesh-aware fingerprints: each carries the mesh
+    shape, so same-platform filtering separates topologies).
     """
     entries = []
-    for path in sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json"))):
+    for path in sorted(glob.glob(os.path.join(repo_dir, pattern))):
         try:
             with open(path) as f:
                 data = json.load(f)

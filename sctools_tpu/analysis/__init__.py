@@ -37,15 +37,25 @@ stdlib — nothing here imports jax, numpy, or the code under analysis:
   the acting half — :mod:`.retune`, the offline bucket autotuner behind
   ``--retune``. Same shared parse, which is also PERSISTENT now
   (:mod:`.astcache` pickles trees content-hash-keyed under
-  ``.scx_cache/``).
+  ``.scx_cache/``);
+- :mod:`.meshcheck` — whole-package collective-safety & SPMD-divergence
+  model (shard_map region inventory, mapped-reach call graph,
+  collective issue sites against the mesh axis universe), rules
+  SCX801-SCX805, paired with the runtime collective-schedule witness
+  (:mod:`.meshwitness`, ``SCTOOLS_TPU_MESH_DEBUG=1``) that ``make
+  mesh-smoke`` validates live: per-worker observed schedules must be
+  identical across the fleet and inside the static schedule
+  (``--emit-collective-schedule``) — the gate the on-device collective
+  merge (ROADMAP item 1) lands behind. Same shared parse.
 
 Findings carry stable rule ids and honor inline
 ``# scx-lint: disable=SCXNNN`` escape hatches (:mod:`.findings`).
 ``make lint`` runs the CLI after ruff/compileall, making a clean scx-lint
 run part of ``make ci`` mergeability; ``make racecheck`` / ``make
-shardcheck`` / ``make lifecheck`` / ``make costcheck`` run the
-whole-package passes on their own, and ``make modelcheck`` (the ci leg)
-runs all four in one process over one shared parse.
+shardcheck`` / ``make lifecheck`` / ``make costcheck`` / ``make
+meshcheck`` run the whole-package passes on their own, and ``make
+modelcheck`` (the ci leg) runs all five in one process over one shared
+parse.
 """
 
 # Re-exports resolve lazily (PEP 562): every library module imports
@@ -66,6 +76,9 @@ _EXPORTS = {
     "lint_file": "jaxlint",
     "LIFE_RULES": "lifecheck",
     "check_life": "lifecheck",
+    "MESH_RULES": "meshcheck",
+    "check_mesh": "meshcheck",
+    "build_collective_schedule": "meshcheck",
     "RACE_RULES": "racecheck",
     "check_races": "racecheck",
     "lock_graph": "racecheck",
@@ -82,8 +95,8 @@ _EXPORTS = {
 
 _SUBMODULES = frozenset(
     {"abicheck", "astcache", "cli", "costcheck", "findings", "jaxlint",
-     "lifecheck", "racecheck", "retune", "shardcheck", "suppaudit",
-     "witness"}
+     "lifecheck", "meshcheck", "meshwitness", "racecheck", "retune",
+     "shardcheck", "suppaudit", "witness"}
 )
 
 
@@ -110,15 +123,18 @@ __all__ = [
     "Finding",
     "JAX_RULES",
     "LIFE_RULES",
+    "MESH_RULES",
     "RACE_RULES",
     "SHARD_RULES",
     "SUPP_RULES",
     "Suppressions",
     "audit_suppressions",
+    "build_collective_schedule",
     "build_shape_contract",
     "check_abi",
     "check_cost",
     "check_life",
+    "check_mesh",
     "check_races",
     "check_shards",
     "check_signatures",

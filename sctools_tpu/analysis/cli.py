@@ -1,6 +1,6 @@
 """scx-lint CLI: ``python -m sctools_tpu.analysis [paths...]``.
 
-Runs seven passes and exits non-zero when any finding survives
+Runs eight passes and exits non-zero when any finding survives
 suppressions:
 
 1. JAX lint (SCX1xx) over every ``.py`` file under the given paths;
@@ -29,18 +29,24 @@ suppressions:
    ``--retune <run_dir>`` is the acting half: the offline autotuner
    that rewrites the pinned bucket floors in ``ops/segments.py`` from
    recorded registries, double-gated by shardcheck + shape-contract
-   coverage).
+   coverage);
+8. collective-safety & SPMD-divergence check (SCX8xx) over the same
+   model build (``--mesh-only`` runs just this pass — ``make
+   meshcheck`` — and ``--emit-collective-schedule FILE`` writes the
+   statically predicted collective universe the mesh smoke validates
+   the per-worker runtime schedules against,
+   ``SCTOOLS_TPU_MESH_DEBUG=1``).
 
 ``--json`` replaces the human-readable output with one machine-readable
 findings array covering every pass that ran (rule, path, line, message).
 
 The module imports nothing heavyweight (no jax, no numpy), so the gate
-adds milliseconds to ``make lint``. Passes 4-7 share one parse per file
+adds milliseconds to ``make lint``. Passes 4-8 share one parse per file
 through :mod:`.astcache` — in-process AND across invocations (the
 content-hash-keyed ``.scx_cache/`` store; the summary line reports
 parse-cache effectiveness) — so ``--race-only --shard-only --life-only
---cost-only`` style CI splits (``make modelcheck``) do not pay the
-package parse four times.
+--cost-only --mesh-only`` style CI splits (``make modelcheck``) do not
+pay the package parse five times.
 """
 
 from __future__ import annotations
@@ -62,6 +68,11 @@ from .costcheck import (
 from .findings import Finding
 from .jaxlint import JAX_RULES, lint_file
 from .lifecheck import LIFE_RULES, check_life
+from .meshcheck import (
+    MESH_RULES,
+    build_collective_schedule,
+    check_mesh,
+)
 from .racecheck import RACE_RULES, check_races, lock_graph
 from .shardcheck import SHARD_RULES, build_shape_contract, check_shards
 from .suppaudit import SUPP_RULES, audit_suppressions
@@ -124,6 +135,7 @@ def _print_rules() -> None:
         ("shape / sharding flow", SHARD_RULES),
         ("frame lifetime / aliasing", LIFE_RULES),
         ("device cost / transfer discipline", COST_RULES),
+        ("collective safety / SPMD divergence", MESH_RULES),
     ):
         print(f"  {title}:")
         for rule_id, slug in sorted(rules.items()):
@@ -189,6 +201,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="run ONLY the SCX7xx device-cost pass (make costcheck)",
     )
     parser.add_argument(
+        "--no-mesh", action="store_true",
+        help="skip the SCX8xx collective-safety pass",
+    )
+    parser.add_argument(
+        "--mesh-only", action="store_true",
+        help="run ONLY the SCX8xx collective-safety pass (make meshcheck)",
+    )
+    parser.add_argument(
         "--emit-lock-graph", metavar="FILE", default=None,
         help="write the static lock inventory + acquisition-order graph "
         "as JSON (the SCTOOLS_TPU_LOCK_GRAPH contract file for the "
@@ -205,6 +225,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="write the statically-enumerated transfer-site inventory as "
         "JSON (the set the xprof smoke asserts the observed ledger "
         "sites against) and exit",
+    )
+    parser.add_argument(
+        "--emit-collective-schedule", metavar="FILE", default=None,
+        help="write the statically predicted collective universe as JSON "
+        "(the SCTOOLS_TPU_MESH_SCHEDULE contract file the runtime "
+        "collective-schedule witness and the mesh smoke validate "
+        "per-worker observed schedules against) and exit",
     )
     parser.add_argument(
         "--retune", metavar="RUN_DIR", default=None,
@@ -296,6 +323,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         return 0
 
+    if args.emit_collective_schedule is not None:
+        schedule = build_collective_schedule(args.paths)
+        _dump_json(schedule, args.emit_collective_schedule)
+        if not args.quiet:
+            print(
+                f"scx-mesh: wrote {len(schedule['collectives'])} "
+                f"collective pair(s) across "
+                f"{len(schedule['computations'])} computation(s), "
+                f"{len(schedule['regions'])} mapped region(s) to "
+                f"{args.emit_collective_schedule}"
+            )
+        return 0
+
     if args.retune is not None:
         from .retune import retune
 
@@ -310,18 +350,19 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     only_flags = (
         args.race_only or args.shard_only or args.life_only
-        or args.cost_only
+        or args.cost_only or args.mesh_only
     )
     if only_flags:
         # the *-only flags compose: `--race-only --shard-only
-        # --life-only --cost-only` runs all four whole-package passes
-        # over ONE astcache model build (the `make modelcheck` shape —
-        # one process, one parse per file)
+        # --life-only --cost-only --mesh-only` runs all five
+        # whole-package passes over ONE astcache model build (the `make
+        # modelcheck` shape — one process, one parse per file)
         args.no_jax_lint = args.no_abi = args.no_supp = True
         args.no_race = not args.race_only
         args.no_shard = not args.shard_only
         args.no_life = not args.life_only
         args.no_cost = not args.cost_only
+        args.no_mesh = not args.mesh_only
 
     findings: List[Finding] = []
     checked_files = 0
@@ -358,6 +399,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.extend(check_life(args.paths))
     if not args.no_cost:
         findings.extend(check_cost(args.paths))
+    if not args.no_mesh:
+        findings.extend(check_mesh(args.paths))
     if only_flags and not checked_files:
         from .racecheck import _collect_py_files as _race_files
 
@@ -397,6 +440,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 ("shard", args.no_shard),
                 ("life", args.no_life),
                 ("cost", args.no_cost),
+                ("mesh", args.no_mesh),
             )
             if not skipped
         ]

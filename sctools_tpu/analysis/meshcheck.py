@@ -1,0 +1,1078 @@
+"""scx-mesh: static collective-safety & SPMD-divergence analysis (SCX801-805).
+
+ROADMAP item 1 turns MergeCellMetrics/MergeGeneMetrics into on-device
+collective reductions over the mesh — and the one bug class no existing
+pass models is the multi-chip killer: devices disagreeing on collective
+issue order. An SPMD program is correct only if every device linearizes
+the SAME sequence of collectives; a psum one device issues and another
+skips deadlocks the mesh with no error, no traceback, and no timeout
+shorter than the watchdog. scx-race made lock-order inversion a CI
+failure before it could deadlock a host; this pass does the same for
+collective-order divergence before the first on-device merge lands.
+
+Whole-package and interprocedural over the shared :mod:`.astcache`
+parse, like racecheck/shardcheck/lifecheck/costcheck. The model holds:
+
+1. every ``platform.shard_map`` region (the mapped function, its
+   in/out specs, the axes they partition) and the set of functions
+   reachable from mapped bodies along the name-resolved call graph
+   ("mapped reach" — collectives live in helpers like
+   ``reshard_by_key``, not in the mapped body's own text);
+2. every collective issue site: the ``jax.lax`` family AND the
+   :mod:`sctools_tpu.parallel.collective` choke-point wrappers, with
+   the axis argument resolved against the package axis universe
+   (``*_AXIS`` constants, axis-name parameter defaults, literal mesh
+   constructions — the scx-shard vocabulary);
+3. mesh-context functions (a ``mesh`` parameter, ``self._mesh``, or a
+   local ``make_mesh``/``Mesh`` binding) for the portability rule.
+
+Rules:
+
+- **SCX801 divergent-collective-path** — a collective reachable under a
+  data- or rank-dependent branch: inside a callable handed to
+  ``lax.cond``/``lax.switch``/``lax.while_loop``/``lax.scan``, or inside
+  a Python branch whose condition derives from ``axis_index``. Devices
+  can disagree about whether (or how many times) the collective issues,
+  so peers block forever on a collective that never comes.
+- **SCX802 mismatched-collective-order** — two paths through one mapped
+  body issue different collective sequences or axis sets (an
+  ``if``/``else`` whose branches disagree). Even when the condition is
+  uniform today, the two paths are two different SPMD programs, and any
+  future per-worker divergence of the condition is a deadlock; the rule
+  is heuristic and suppression-friendly (like SCX403).
+- **SCX803 host-sync-in-collective-region** — ``ingest.pull``, host
+  callbacks (``io_callback``/``pure_callback``/``jax.debug.callback``),
+  ``.block_until_ready()`` or ``.item()`` lexically between two
+  collective issues of one mapped computation. A host sync in the
+  middle of a collective schedule stalls every peer at the next
+  collective for as long as the host dawdles — the mesh-wide version of
+  the SCX703 overlap-window rule.
+- **SCX804 mesh-portability** — shapes or static args derived from a
+  hardcoded device count instead of the mesh axis size: an
+  ``n_shards``/``n_devices``/``n_slices``-style name assigned an
+  integer literal (or passed literally) inside a mesh-context function.
+  The code works on the 8-device bench mesh and silently corrupts or
+  deadlocks on any other topology; ``mesh.shape[axis]`` is always
+  available and always right.
+- **SCX805 unreduced-partial-escape** — a ``shard_map`` output marked
+  replicated (``P()`` / ``None`` out_spec) from a body that issues no
+  reducing collective: each device returns ITS partial as if it were
+  the total — the device analog of concatenating per-chunk CSVs without
+  merging, the exact bug class the on-device collective merge exists to
+  kill.
+
+The runtime half mirrors the lock witness: ``--emit-collective-schedule
+FILE`` writes the statically predicted collective universe
+(:func:`build_collective_schedule`: the global (name, axis) set plus the
+per-computation collective sets), ``SCTOOLS_TPU_MESH_DEBUG=1`` makes
+every issued collective record into :mod:`.meshwitness`, and ``make
+mesh-smoke`` asserts each worker's observed schedule is non-empty,
+identical across the fleet, violation-free, and inside the static
+schedule — a live 2-worker validation of the model every CI run.
+
+Model limits (deliberate, documented): name-based call resolution;
+branch analysis is lexical (a condition's uniformity across devices is
+undecidable statically — SCX802 errs toward reporting, with inline
+suppression as the escape hatch); an axis forwarded through a parameter
+is symbolic, so the schedule admits it against every declared axis
+(``"*"`` in the emitted pair set). ``analysis/`` is exempt as the
+mechanism; so is :mod:`sctools_tpu.parallel.collective` itself (its
+bodies hold the raw ``jax.lax`` calls every wrapper forwards to) and
+the ``platform`` shim.
+
+Pure stdlib; imports nothing heavyweight; honors ``# scx-lint:
+disable=SCX8xx`` escapes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .astcache import collect_py_files, parse_cached
+from .findings import Finding, Suppressions
+
+MESH_RULES = {
+    "SCX801": "divergent-collective-path",
+    "SCX802": "mismatched-collective-order",
+    "SCX803": "host-sync-in-collective-region",
+    "SCX804": "mesh-portability",
+    "SCX805": "unreduced-partial-escape",
+}
+
+# the analyzer + witness machinery is the mechanism, not the subject
+MESH_EXEMPT_DIRS = ("analysis",)
+
+# the jax.lax collective family and the choke-point wrapper names (one
+# vocabulary — parallel.collective mirrors lax signatures)
+COLLECTIVE_NAMES = frozenset(
+    (
+        "psum", "pmean", "pmax", "pmin", "psum_scatter",
+        "all_gather", "all_to_all", "ppermute", "pshuffle", "axis_index",
+    )
+)
+# collectives that REDUCE/COMBINE across the axis (SCX805: a replicated
+# out_spec is only sound when one of these produced the value)
+REDUCING_COLLECTIVES = frozenset(
+    ("psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather")
+)
+# positional index of the axis-name argument (mirrors shardcheck)
+_COLLECTIVE_AXIS_ARG = {name: 1 for name in COLLECTIVE_NAMES}
+_COLLECTIVE_AXIS_ARG["axis_index"] = 0
+
+# structured-control-flow builders whose branch callables trace
+# divergently (SCX801)
+_BRANCHY_BUILDERS = frozenset(("cond", "switch", "while_loop", "scan"))
+
+# host-sync spellings (SCX803)
+_SYNC_ATTRS = frozenset(("block_until_ready", "item"))
+_CALLBACK_NAMES = frozenset(("io_callback", "pure_callback", "callback"))
+
+# SCX804: names that carry a device/shard count
+_COUNT_NAME = re.compile(r"^(n|num)_(shards?|devices?|slices?)$")
+
+_AXIS_PARAM_NAMES = frozenset(("axis_name", "axis", "ici_axis", "dcn_axis"))
+
+
+# ------------------------------------------------------------- records
+
+
+@dataclass
+class SmSite:
+    """One ``platform.shard_map`` construction."""
+
+    module: str
+    path: str
+    line: int
+    fn_qual: Optional[str]
+    # one entry per out spec: True when the spec is replicated (P() with
+    # no axes / None); None when the spec expression was unresolvable
+    out_replicated: Tuple[Optional[bool], ...] = ()
+
+
+@dataclass
+class CollectiveCall:
+    name: str
+    axis: str  # resolved axis, or "*" for a symbolic/unresolved axis
+    module: str
+    path: str
+    line: int
+    func_qual: Optional[str]  # enclosing function
+
+
+@dataclass
+class FuncInfo:
+    qual: str
+    module: str
+    path: str
+    name: str
+    line: int
+    cls: Optional[str] = None
+    params: Tuple[str, ...] = ()
+    mesh_context: bool = False
+    calls: List[Tuple[Tuple[str, ...], Optional[str]]] = field(
+        default_factory=list
+    )
+
+
+@dataclass
+class ModInfo:
+    name: str
+    path: str
+    is_pkg: bool
+    tree: ast.Module
+    # the choke-point wrapper module and the shard_map shim hold the raw
+    # jax.lax calls / shard_map plumbing every caller forwards to: they
+    # are the MECHANISM and never the subject of the SCX8xx rules
+    mechanism: bool = False
+    mod_aliases: Dict[str, str] = field(default_factory=dict)
+    from_funcs: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    jax_aliases: Set[str] = field(default_factory=set)
+    lax_aliases: Set[str] = field(default_factory=set)
+    shard_map_names: Set[str] = field(default_factory=set)
+    collective_mods: Set[str] = field(default_factory=set)
+    collective_funcs: Set[str] = field(default_factory=set)
+    ingest_mods: Set[str] = field(default_factory=set)
+    pull_names: Set[str] = field(default_factory=set)
+    pspec_names: Set[str] = field(default_factory=set)
+    mesh_ctor_names: Set[str] = field(default_factory=set)
+    str_constants: Dict[str, str] = field(default_factory=dict)
+    def_index: Dict[str, List[str]] = field(default_factory=dict)
+    functions: List[FuncInfo] = field(default_factory=list)
+
+
+class MeshModel:
+    """The whole-package collective-safety model."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.sm_sites: List[SmSite] = []
+        self.mapped_quals: Set[str] = set()
+        self.mapped_reach: Set[str] = set()
+        self.axis_universe: Set[str] = set()
+        # per-function collective calls, in lexical order
+        self.collectives: Dict[str, List[CollectiveCall]] = {}
+        self.findings: List[Finding] = []
+
+
+# --------------------------------------------------------- small helpers
+
+
+def _root_chain(node: ast.AST) -> Tuple[Optional[str], List[str]]:
+    chain: List[str] = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, list(reversed(chain))
+    return None, []
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _end(node: ast.AST) -> int:
+    return getattr(node, "end_lineno", node.lineno) or node.lineno
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+# ------------------------------------------------------------ the build
+
+
+class _Analyzer:
+    def __init__(self) -> None:
+        self.model = MeshModel()
+        # (path, lineno) of rank-dependent If/While nodes: SCX801 owns
+        # those; SCX802 must not double-report the same branch
+        self._rank_branches: Set[Tuple[str, int]] = set()
+
+    # ------------------------------------------------------- phase A
+
+    def load(self, files: Sequence[Tuple[str, str, bool]]) -> None:
+        for path, name, is_pkg in files:
+            parsed = parse_cached(path)
+            if parsed is None:
+                continue
+            _, tree = parsed
+            parts = name.split(".")
+            base = parts[-1]
+            parent = parts[-2] if len(parts) > 1 else ""
+            # the shim and the parallel/ choke-point wrapper module are
+            # the mechanism; a module merely NAMED collective elsewhere
+            # (metrics/collective.py, the on-device merge) is a subject
+            self.model.modules[name] = ModInfo(
+                name=name, path=path, is_pkg=is_pkg, tree=tree,
+                mechanism=base == "platform"
+                or (base == "collective" and parent in ("", "parallel")),
+            )
+        for mod in self.model.modules.values():
+            self._collect_imports(mod)
+            self._collect_constants(mod)
+            self._index_functions(mod)
+        self._collect_axes()
+
+    def _collect_imports(self, mod: ModInfo) -> None:
+        known = self.model.modules
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if alias.name == "jax":
+                        mod.jax_aliases.add(bound)
+                    elif alias.name in known:
+                        mod.mod_aliases[alias.asname or alias.name] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                source = node.module or ""
+                target = self._resolve_from(mod, node)
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    orig = alias.name
+                    if orig == "shard_map":
+                        mod.shard_map_names.add(bound)
+                    elif orig == "lax" and source.split(".")[0] == "jax":
+                        mod.lax_aliases.add(bound)
+                    elif orig == "collective":
+                        mod.collective_mods.add(bound)
+                    elif orig in COLLECTIVE_NAMES and source.rpartition(".")[
+                        2
+                    ] == "collective":
+                        mod.collective_funcs.add(bound)
+                    elif orig == "ingest":
+                        mod.ingest_mods.add(bound)
+                    elif orig == "pull" and "ingest" in source.split("."):
+                        mod.pull_names.add(bound)
+                    elif orig == "PartitionSpec":
+                        mod.pspec_names.add(bound)
+                    elif orig in ("make_mesh", "make_hybrid_mesh", "Mesh"):
+                        mod.mesh_ctor_names.add(bound)
+                    if target is not None:
+                        candidate = f"{target}.{orig}" if target else orig
+                        if candidate in known:
+                            mod.mod_aliases[bound] = candidate
+                        else:
+                            mod.from_funcs[bound] = (target, orig)
+
+    def _resolve_from(
+        self, mod: ModInfo, node: ast.ImportFrom
+    ) -> Optional[str]:
+        if node.level == 0:
+            return node.module or None
+        base = mod.name if mod.is_pkg else mod.name.rpartition(".")[0]
+        parts = base.split(".") if base else []
+        if node.level > 1:
+            cut = node.level - 1
+            if cut >= len(parts):
+                return None
+            parts = parts[: len(parts) - cut]
+        if node.module:
+            parts = parts + node.module.split(".")
+        return ".".join(parts) or None
+
+    def _collect_constants(self, mod: ModInfo) -> None:
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for target in stmt.targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                text = _const_str(stmt.value)
+                if text is not None:
+                    mod.str_constants[target.id] = text
+                    if "AXIS" in target.id.upper():
+                        self.model.axis_universe.add(text)
+                root, chain = _root_chain(stmt.value)
+                if (
+                    root in mod.jax_aliases
+                    and chain
+                    and chain[-1] == "PartitionSpec"
+                ):
+                    mod.pspec_names.add(target.id)
+                if root in mod.jax_aliases and chain and chain[-1] == "lax":
+                    mod.lax_aliases.add(target.id)
+
+    def _index_functions(self, mod: ModInfo) -> None:
+        def index(node, prefix, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}.{child.name}"
+                    args = child.args
+                    params = tuple(
+                        a.arg
+                        for a in list(args.posonlyargs) + list(args.args)
+                    )
+                    info = FuncInfo(
+                        qual=qual, module=mod.name, path=mod.path,
+                        name=child.name, line=child.lineno, cls=cls,
+                        params=params, mesh_context="mesh" in params,
+                    )
+                    info._node = child  # type: ignore[attr-defined]
+                    mod.functions.append(info)
+                    mod.def_index.setdefault(child.name, []).append(qual)
+                    self.model.functions[qual] = info
+                    index(child, qual, cls)
+                elif isinstance(child, ast.ClassDef):
+                    index(child, f"{prefix}.{child.name}", child.name)
+                else:
+                    index(child, prefix, cls)
+
+        index(mod.tree, mod.name, None)
+
+    # ------------------------------------------------- axis resolution
+
+    def _collect_axes(self) -> None:
+        universe = self.model.axis_universe
+        for mod in self.model.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    args = node.args
+                    named = list(args.posonlyargs) + list(args.args)
+                    defaults = list(args.defaults)
+                    for param, default in zip(named[-len(defaults):], defaults):
+                        if self._is_axis_param(param.arg):
+                            resolved = self._axis_value(mod, default)
+                            if resolved is not None:
+                                universe.add(resolved)
+                    for param, default in zip(args.kwonlyargs, args.kw_defaults):
+                        if default is not None and self._is_axis_param(
+                            param.arg
+                        ):
+                            resolved = self._axis_value(mod, default)
+                            if resolved is not None:
+                                universe.add(resolved)
+                elif isinstance(node, ast.Call):
+                    terminal = _terminal_name(node.func)
+                    if terminal == "Mesh" and len(node.args) >= 2:
+                        names = node.args[1]
+                        elts = (
+                            names.elts
+                            if isinstance(names, (ast.Tuple, ast.List))
+                            else [names]
+                        )
+                        for elt in elts:
+                            resolved = self._axis_value(mod, elt)
+                            if resolved is not None:
+                                universe.add(resolved)
+                    for kw in node.keywords:
+                        if kw.arg is not None and self._is_axis_param(kw.arg):
+                            resolved = self._axis_value(mod, kw.value)
+                            if resolved is not None:
+                                universe.add(resolved)
+
+    @staticmethod
+    def _is_axis_param(name: str) -> bool:
+        return name in _AXIS_PARAM_NAMES or name.endswith("_axis")
+
+    def _axis_value(self, mod: ModInfo, expr: ast.AST) -> Optional[str]:
+        text = _const_str(expr)
+        if text is not None:
+            return text
+        if isinstance(expr, ast.Name):
+            if expr.id in mod.str_constants:
+                return mod.str_constants[expr.id]
+            bound = mod.from_funcs.get(expr.id)
+            if bound is not None:
+                other = self.model.modules.get(bound[0])
+                if other is not None:
+                    return other.str_constants.get(bound[1])
+        if isinstance(expr, ast.Attribute):
+            root, chain = _root_chain(expr)
+            if root in mod.mod_aliases and len(chain) == 1:
+                other = self.model.modules.get(mod.mod_aliases[root])
+                if other is not None:
+                    return other.str_constants.get(chain[0])
+        return None
+
+    # --------------------------------------------------- site inventory
+
+    def collect_sites(self) -> None:
+        for mod in self.model.modules.values():
+            if mod.name.rpartition(".")[2] == "platform":
+                continue  # the shim is the mechanism, not a site
+            for info in mod.functions:
+                node = getattr(info, "_node", None)
+                if node is None:
+                    continue
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    terminal = _terminal_name(dec.func)
+                    if terminal == "partial" and dec.args:
+                        inner = dec.args[0]
+                        if self._is_shard_map_expr(mod, inner):
+                            self._add_sm_site(mod, dec, info.qual)
+                    elif self._is_shard_map_expr(mod, dec.func):
+                        self._add_sm_site(mod, dec, info.qual)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and self._is_shard_map_expr(
+                    mod, node.func
+                ):
+                    already = any(
+                        sm.path == mod.path and sm.line == node.lineno
+                        for sm in self.model.sm_sites
+                    )
+                    if not already:
+                        self._add_sm_site(mod, node, None)
+        for sm in self.model.sm_sites:
+            if sm.fn_qual:
+                self.model.mapped_quals.add(sm.fn_qual)
+
+    def _is_shard_map_expr(self, mod: ModInfo, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in mod.shard_map_names
+        return False
+
+    def _add_sm_site(
+        self, mod: ModInfo, call: ast.Call, fn_qual: Optional[str]
+    ) -> SmSite:
+        if fn_qual is None and call.args:
+            first = call.args[0]
+            if isinstance(first, ast.Name):
+                quals = mod.def_index.get(first.id)
+                if quals:
+                    fn_qual = self._nearest_qual(quals, call.lineno)
+        out_specs = _kw(call, "out_specs")
+        replicated: List[Optional[bool]] = []
+        if out_specs is not None:
+            specs = (
+                list(out_specs.elts)
+                if isinstance(out_specs, (ast.Tuple, ast.List))
+                else [out_specs]
+            )
+            for spec in specs:
+                replicated.append(self._spec_replicated(mod, spec))
+        site = SmSite(
+            module=mod.name, path=mod.path, line=call.lineno,
+            fn_qual=fn_qual, out_replicated=tuple(replicated),
+        )
+        self.model.sm_sites.append(site)
+        return site
+
+    def _nearest_qual(self, quals: List[str], line: int) -> str:
+        best = quals[0]
+        best_line = -1
+        for qual in quals:
+            info = self.model.functions.get(qual)
+            if info is not None and best_line < info.line <= line + 2:
+                best, best_line = qual, info.line
+        return best
+
+    def _spec_replicated(self, mod: ModInfo, spec: ast.AST) -> Optional[bool]:
+        """True = replicated out_spec (P() / None), False = partitioned,
+        None = unresolvable (a spec bound elsewhere)."""
+        if isinstance(spec, ast.Constant) and spec.value is None:
+            return True
+        if isinstance(spec, ast.Call):
+            terminal = _terminal_name(spec.func)
+            if terminal in mod.pspec_names or terminal == "PartitionSpec":
+                real_args = [
+                    a for a in spec.args
+                    if not (isinstance(a, ast.Constant) and a.value is None)
+                ]
+                return not real_args and not spec.keywords
+        return None
+
+    # --------------------------------------------------- body analysis
+
+    def analyze(self) -> None:
+        for mod in self.model.modules.values():
+            for info in mod.functions:
+                node = getattr(info, "_node", None)
+                if node is None:
+                    continue
+                self._scan_function(mod, info, node, mod.mechanism)
+        self._compute_reach()
+        self._check_divergent_paths()
+        self._check_branch_order()
+        self._check_sync_regions()
+        self._check_portability()
+        self._check_partial_escape()
+
+    @staticmethod
+    def _own_nodes(node: ast.AST):
+        """Walk ``node`` WITHOUT descending into nested function defs.
+
+        A nested def's body belongs to the nested function's own scan —
+        attributing its collectives to the enclosing builder would give
+        every ``_build_*`` closure factory a phantom collective schedule.
+        """
+        stack = [node]
+        while stack:
+            current = stack.pop()
+            yield current
+            for child in ast.iter_child_nodes(current):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                stack.append(child)
+
+    def _scan_function(
+        self, mod: ModInfo, info: FuncInfo, node, mechanism: bool
+    ) -> None:
+        for sub in self._own_nodes(node):
+            if sub is not node and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if isinstance(sub, ast.Attribute):
+                root, chain = _root_chain(sub)
+                if root == "self" and chain and chain[-1] in ("_mesh", "mesh"):
+                    info.mesh_context = True
+            if isinstance(sub, ast.Assign) and isinstance(
+                sub.value, ast.Call
+            ):
+                # a local mesh construction makes this a mesh-context fn
+                ctor = _terminal_name(sub.value.func)
+                if ctor in mod.mesh_ctor_names or ctor in (
+                    "make_mesh", "make_hybrid_mesh",
+                ):
+                    info.mesh_context = True
+            if not isinstance(sub, ast.Call):
+                continue
+            targets = self._resolve_call(mod, sub.func, info.cls)
+            terminal = _terminal_name(sub.func)
+            if targets or terminal:
+                info.calls.append((targets, terminal))
+            if not mechanism:
+                collective = self._collective_call(mod, sub)
+                if collective is not None:
+                    name, axis = collective
+                    self.model.collectives.setdefault(info.qual, []).append(
+                        CollectiveCall(
+                            name=name, axis=axis, module=mod.name,
+                            path=mod.path, line=sub.lineno,
+                            func_qual=info.qual,
+                        )
+                    )
+
+    def _collective_call(
+        self, mod: ModInfo, call: ast.Call
+    ) -> Optional[Tuple[str, str]]:
+        """(name, axis) when ``call`` issues a collective, else None."""
+        terminal = _terminal_name(call.func)
+        if terminal not in COLLECTIVE_NAMES:
+            return None
+        func = call.func
+        recognized = False
+        if isinstance(func, ast.Attribute):
+            root, chain = _root_chain(func)
+            recognized = (
+                (root in mod.jax_aliases and chain[:1] == ["lax"])
+                or (root in mod.lax_aliases and len(chain) == 1)
+                or (root in mod.collective_mods and len(chain) == 1)
+            )
+        elif isinstance(func, ast.Name):
+            recognized = func.id in mod.collective_funcs
+        if not recognized:
+            return None
+        index = _COLLECTIVE_AXIS_ARG[terminal]
+        axis_expr = _kw(call, "axis_name")
+        if axis_expr is None and len(call.args) > index:
+            axis_expr = call.args[index]
+        axis = "*"
+        if axis_expr is not None:
+            resolved = self._axis_value(mod, axis_expr)
+            if resolved is not None:
+                axis = resolved
+        return terminal, axis
+
+    def _resolve_call(
+        self, mod: ModInfo, func: ast.AST, cls: Optional[str]
+    ) -> Tuple[str, ...]:
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mod.def_index:
+                return tuple(mod.def_index[name])
+            bound = mod.from_funcs.get(name)
+            if bound is not None:
+                qual = f"{bound[0]}.{bound[1]}"
+                if qual in self.model.functions:
+                    return (qual,)
+            return ()
+        if isinstance(func, ast.Attribute):
+            root, chain = _root_chain(func)
+            if root is None or not chain:
+                return ()
+            if root == "self" and cls is not None and len(chain) == 1:
+                qual = f"{mod.name}.{cls}.{chain[0]}"
+                if qual in self.model.functions:
+                    return (qual,)
+                return ()
+            if root in mod.mod_aliases:
+                qual = ".".join([mod.mod_aliases[root]] + chain)
+                if qual in self.model.functions:
+                    return (qual,)
+        return ()
+
+    def _compute_reach(self) -> None:
+        """Mapped reach: closure over the call graph from mapped bodies."""
+        model = self.model
+        reach: Set[str] = set(model.mapped_quals)
+        frontier = list(reach)
+        while frontier:
+            qual = frontier.pop()
+            info = model.functions.get(qual)
+            if info is None:
+                continue
+            for targets, _ in info.calls:
+                for target in targets:
+                    if target not in reach:
+                        reach.add(target)
+                        frontier.append(target)
+        model.mapped_reach = reach
+
+    # ----------------------------------------------------- rule checks
+
+    def _function_collectives(self, qual: str) -> List[CollectiveCall]:
+        return self.model.collectives.get(qual, [])
+
+    def _reach_has_reducer(self, qual: str) -> bool:
+        """Whether ``qual`` or anything it reaches issues a reducing
+        collective."""
+        seen: Set[str] = set()
+        frontier = [qual]
+        while frontier:
+            current = frontier.pop()
+            if current in seen:
+                continue
+            seen.add(current)
+            for call in self._function_collectives(current):
+                if call.name in REDUCING_COLLECTIVES:
+                    return True
+            info = self.model.functions.get(current)
+            if info is None:
+                continue
+            for targets, _ in info.calls:
+                frontier.extend(targets)
+        return False
+
+    def _collectives_in(self, mod: ModInfo, node: ast.AST) -> List[
+        Tuple[str, str, int]
+    ]:
+        """(name, axis, line) for every collective lexically inside
+        ``node``."""
+        out: List[Tuple[str, str, int]] = []
+        if mod.mechanism:
+            return out
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                hit = self._collective_call(mod, sub)
+                if hit is not None:
+                    out.append((hit[0], hit[1], sub.lineno))
+        return out
+
+    def _check_divergent_paths(self) -> None:
+        """SCX801: collectives under lax control flow or rank branches."""
+        model = self.model
+        for qual in sorted(model.mapped_reach):
+            info = model.functions.get(qual)
+            node = getattr(info, "_node", None) if info else None
+            if node is None:
+                continue
+            mod = model.modules.get(info.module)
+            if mod is None:
+                continue
+            # (a) collectives inside callables handed to lax.cond/...
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                terminal = _terminal_name(sub.func)
+                if terminal not in _BRANCHY_BUILDERS:
+                    continue
+                root, chain = _root_chain(sub.func)
+                lax_call = (
+                    (root in mod.jax_aliases and chain[:1] == ["lax"])
+                    or (root in mod.lax_aliases and len(chain) == 1)
+                )
+                if not lax_call:
+                    continue
+                for arg in sub.args:
+                    bodies: List[ast.AST] = []
+                    if isinstance(arg, ast.Lambda):
+                        bodies.append(arg.body)
+                    elif isinstance(arg, ast.Name):
+                        quals = mod.def_index.get(arg.id, ())
+                        for branch_qual in quals:
+                            branch = model.functions.get(branch_qual)
+                            bnode = getattr(branch, "_node", None)
+                            if bnode is not None:
+                                bodies.append(bnode)
+                    for body in bodies:
+                        for name, _axis, line in self._collectives_in(
+                            mod, body
+                        ):
+                            model.findings.append(
+                                Finding(
+                                    "SCX801", mod.path, line,
+                                    f"collective `{name}` traces inside a "
+                                    f"`lax.{terminal}` branch: devices can "
+                                    "disagree on whether (or how many "
+                                    "times) it issues, and peers block "
+                                    "forever on a collective that never "
+                                    "comes",
+                                )
+                            )
+            # (b) Python branches on rank identity (axis_index-derived)
+            rank_names: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    value = sub.value
+                    carries_rank = any(
+                        isinstance(inner, ast.Call)
+                        and _terminal_name(inner.func) == "axis_index"
+                        for inner in ast.walk(value)
+                    ) or any(
+                        isinstance(inner, ast.Name)
+                        and inner.id in rank_names
+                        for inner in ast.walk(value)
+                    )
+                    if carries_rank:
+                        for target in sub.targets:
+                            if isinstance(target, ast.Name):
+                                rank_names.add(target.id)
+            for sub in ast.walk(node):
+                if not isinstance(sub, (ast.If, ast.While)):
+                    continue
+                test_rank = any(
+                    (
+                        isinstance(inner, ast.Name)
+                        and inner.id in rank_names
+                    )
+                    or (
+                        isinstance(inner, ast.Call)
+                        and _terminal_name(inner.func) == "axis_index"
+                    )
+                    for inner in ast.walk(sub.test)
+                )
+                if not test_rank:
+                    continue
+                self._rank_branches.add((mod.path, sub.lineno))
+                branch_nodes = list(sub.body) + list(sub.orelse)
+                for branch_stmt in branch_nodes:
+                    for name, _axis, line in self._collectives_in(
+                        mod, branch_stmt
+                    ):
+                        model.findings.append(
+                            Finding(
+                                "SCX801", mod.path, line,
+                                f"collective `{name}` issues under a "
+                                "rank-dependent branch (condition derives "
+                                "from `axis_index`): each device traces a "
+                                "different program and the mesh deadlocks "
+                                "at the first disagreement",
+                            )
+                        )
+
+    def _check_branch_order(self) -> None:
+        """SCX802: if/else branches with differing collective sequences."""
+        model = self.model
+        for qual in sorted(model.mapped_reach):
+            info = model.functions.get(qual)
+            node = getattr(info, "_node", None) if info else None
+            if node is None:
+                continue
+            mod = model.modules.get(info.module)
+            if mod is None:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.If):
+                    continue
+                if (mod.path, sub.lineno) in self._rank_branches:
+                    continue  # SCX801 already owns rank-dependent branches
+                body_seq = [
+                    (n, a)
+                    for stmt in sub.body
+                    for n, a, _ in self._collectives_in(mod, stmt)
+                ]
+                else_seq = [
+                    (n, a)
+                    for stmt in sub.orelse
+                    for n, a, _ in self._collectives_in(mod, stmt)
+                ]
+                if body_seq == else_seq or not (body_seq or else_seq):
+                    continue
+                def render(seq):
+                    return (
+                        ", ".join(f"{n}@{a}" for n, a in seq) or "(none)"
+                    )
+                model.findings.append(
+                    Finding(
+                        "SCX802", mod.path, sub.lineno,
+                        "two paths through mapped computation "
+                        f"`{info.name}` issue different collective "
+                        f"sequences ({render(body_seq)} vs "
+                        f"{render(else_seq)}): any per-worker divergence "
+                        "of this condition deadlocks the mesh",
+                    )
+                )
+
+    def _check_sync_regions(self) -> None:
+        """SCX803: host syncs lexically between collectives."""
+        model = self.model
+        for qual in sorted(model.mapped_reach):
+            info = model.functions.get(qual)
+            node = getattr(info, "_node", None) if info else None
+            if node is None:
+                continue
+            mod = model.modules.get(info.module)
+            if mod is None:
+                continue
+            lines = [c.line for c in self._function_collectives(qual)]
+            if len(lines) < 2:
+                continue
+            first, last = min(lines), max(lines)
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if not first < sub.lineno < last:
+                    continue
+                label = self._sync_label(mod, sub)
+                if label is None:
+                    continue
+                model.findings.append(
+                    Finding(
+                        "SCX803", mod.path, sub.lineno,
+                        f"{label} between collectives of one mapped "
+                        f"computation (`{info.name}` issues collectives "
+                        f"at lines {first} and {last}): the host sync "
+                        "stalls every peer at its next collective for "
+                        "as long as the host dawdles",
+                        _end(sub),
+                    )
+                )
+
+    def _sync_label(self, mod: ModInfo, call: ast.Call) -> Optional[str]:
+        func = call.func
+        terminal = _terminal_name(func)
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
+            return f"`.{func.attr}()`"
+        if terminal in _CALLBACK_NAMES:
+            return f"host callback `{terminal}`"
+        if isinstance(func, ast.Name) and func.id in mod.pull_names:
+            return "`ingest.pull`"
+        if isinstance(func, ast.Attribute):
+            root, chain = _root_chain(func)
+            if root in mod.ingest_mods and chain == ["pull"]:
+                return "`ingest.pull`"
+        return None
+
+    def _check_portability(self) -> None:
+        """SCX804: hardcoded device counts in mesh-context functions."""
+        model = self.model
+        for info in model.functions.values():
+            in_scope = info.mesh_context or info.qual in model.mapped_reach
+            if not in_scope:
+                continue
+            node = getattr(info, "_node", None)
+            if node is None:
+                continue
+            mod = model.modules.get(info.module)
+            if mod is None:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    if not (
+                        isinstance(sub.value, ast.Constant)
+                        and isinstance(sub.value.value, int)
+                        and not isinstance(sub.value.value, bool)
+                    ):
+                        continue
+                    for target in sub.targets:
+                        if isinstance(target, ast.Name) and _COUNT_NAME.match(
+                            target.id
+                        ):
+                            model.findings.append(
+                                Finding(
+                                    "SCX804", mod.path, sub.lineno,
+                                    f"`{target.id} = {sub.value.value}` "
+                                    "hardcodes a device count in a "
+                                    "mesh-context function: shapes derived "
+                                    "from it break on any other topology — "
+                                    "derive it from `mesh.shape[axis]`",
+                                )
+                            )
+                elif isinstance(sub, ast.Call):
+                    for kw in sub.keywords:
+                        if (
+                            kw.arg is not None
+                            and _COUNT_NAME.match(kw.arg)
+                            and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, int)
+                            and not isinstance(kw.value.value, bool)
+                        ):
+                            model.findings.append(
+                                Finding(
+                                    "SCX804", mod.path, kw.value.lineno,
+                                    f"`{kw.arg}={kw.value.value}` hardcodes "
+                                    "a device count in a mesh-context "
+                                    "function: derive it from the mesh "
+                                    "axis size instead",
+                                )
+                            )
+
+    def _check_partial_escape(self) -> None:
+        """SCX805: replicated out_specs over a reduction-free body."""
+        model = self.model
+        for sm in model.sm_sites:
+            if sm.fn_qual is None or not sm.out_replicated:
+                continue
+            if not any(rep is True for rep in sm.out_replicated):
+                continue
+            if self._reach_has_reducer(sm.fn_qual):
+                continue
+            info = model.functions.get(sm.fn_qual)
+            name = info.name if info else sm.fn_qual
+            model.findings.append(
+                Finding(
+                    "SCX805", sm.path, sm.line,
+                    f"shard_map over `{name}` marks an output replicated "
+                    "(P()/None out_spec) but the body issues no reducing "
+                    "collective: each device returns ITS shard-partial as "
+                    "if it were the total — the on-device analog of "
+                    "concatenating per-chunk CSVs without a merge",
+                )
+            )
+
+
+# ------------------------------------------------------------- public API
+
+
+def build_model(paths: Sequence[str]) -> MeshModel:
+    """Parse + analyze every ``.py`` under ``paths`` into one MeshModel."""
+    analyzer = _Analyzer()
+    analyzer.load(collect_py_files(paths, MESH_EXEMPT_DIRS))
+    analyzer.collect_sites()
+    analyzer.analyze()
+    return analyzer.model
+
+
+def check_mesh(paths: Sequence[str]) -> List[Finding]:
+    """Run the SCX8xx pass; returns suppression-filtered findings."""
+    model = build_model(paths)
+    by_path: Dict[str, List[Finding]] = {}
+    for finding in model.findings:
+        by_path.setdefault(finding.path, []).append(finding)
+    out: List[Finding] = []
+    for path, findings in by_path.items():
+        parsed = parse_cached(path)
+        if parsed is None:
+            out.extend(findings)
+            continue
+        out.extend(Suppressions.from_text(parsed[0], "#").apply(findings))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def build_collective_schedule(paths: Sequence[str]) -> Dict[str, Any]:
+    """The statically predicted collective universe (the witness contract).
+
+    ``collectives`` is the global allowed set of ``[name, axis]`` pairs
+    (axis ``"*"`` marks a parameter-forwarded axis, admitted against any
+    declared axis — an over-approximation, sound for the runtime subset
+    check). ``computations`` maps each function that issues collectives
+    — mapped bodies and the helpers they reach — to its per-function
+    collective set, the region vocabulary the runtime witness dumps use.
+    Exact cross-worker SEQUENCE identity is the runtime witness's half
+    of the contract; the static side pins the universe.
+    """
+    model = build_model(paths)
+    pairs: Set[Tuple[str, str]] = set()
+    computations: Dict[str, List[List[str]]] = {}
+    for qual, calls in sorted(model.collectives.items()):
+        if qual not in model.mapped_reach:
+            continue
+        rows: List[List[str]] = []
+        for call in calls:
+            pair = [call.name, call.axis]
+            pairs.add((call.name, call.axis))
+            if pair not in rows:
+                rows.append(pair)
+        computations[qual] = rows
+    return {
+        "collectives": sorted([list(p) for p in pairs]),
+        "computations": computations,
+        "axis_universe": sorted(model.axis_universe),
+        "regions": sorted(
+            sm.fn_qual for sm in model.sm_sites if sm.fn_qual
+        ),
+    }

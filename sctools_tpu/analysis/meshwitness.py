@@ -1,0 +1,445 @@
+"""Runtime collective-schedule witness: the dynamic half of scx-mesh.
+
+The static pass (:mod:`.meshcheck`) proves properties about a MODEL of
+the package's ``shard_map`` regions and the collectives they can issue;
+this module validates the model against live runs. Every collective in
+the library is issued through :mod:`sctools_tpu.parallel.collective`
+(the one sanctioned spelling), and each wrapper notifies this witness at
+TRACE time — the moment jax linearizes the mapped body into the exact
+program every device of the mesh will execute. SPMD safety is precisely
+the property that this linearization is identical on every worker: two
+workers that trace different collective sequences for the same mapped
+computation will deadlock the mesh at dispatch, devices waiting on
+collectives their peers never issue.
+
+Off by default, and off means OFF: with ``SCTOOLS_TPU_MESH_DEBUG`` unset
+(or anything but ``1``) the collective wrappers call straight through to
+``jax.lax`` and never touch this module's state (pinned by test). With
+``SCTOOLS_TPU_MESH_DEBUG=1`` each wrapper records, per issue:
+
+- the **collective entry** ``(name, axis, shape, dtype, nbytes)`` into
+  the region of the mapped computation being traced (the
+  ``platform.shard_map`` shim tags regions by the wrapped function's
+  qualname);
+- a **static-schedule check**: when ``SCTOOLS_TPU_MESH_SCHEDULE`` points
+  at a schedule emitted by ``python -m sctools_tpu.analysis
+  --emit-collective-schedule``, any observed ``(name, axis)`` pair
+  missing from the static universe is a violation — the model lied, and
+  the smoke gate comparing the two must fail;
+- an **outside-region check**: a collective recorded with no open
+  region means a mapped computation escaped the ``platform.shard_map``
+  shim (or a collective ran outside any mapped body) — recorded as a
+  violation so the choke-point invariant stays observable.
+
+At interpreter exit (when a trace dir is configured) the witness writes
+``mesh.<worker>.json`` next to the worker's trace capture:
+``{"schedules": {...}, "sequence": [...], "counts": {...}, "bytes":
+{...}, "violations": [...]}`` — the files ``make mesh-smoke`` reads to
+assert every worker's per-region collective schedule is NON-EMPTY,
+IDENTICAL across the fleet, violation-free, and a subset of the static
+schedule. ``obs efficiency`` and the fleet timeline surface the per-
+worker counts/bytes so collective-merge cost sits next to the transfer
+ledger.
+
+Like the rest of the analysis package this module is pure stdlib; obs is
+imported lazily and only on the cold paths (violations, the exit dump).
+"""
+
+from __future__ import annotations
+
+import atexit
+import glob
+import json
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+ENV_FLAG = "SCTOOLS_TPU_MESH_DEBUG"
+ENV_SCHEDULE = "SCTOOLS_TPU_MESH_SCHEDULE"
+
+__all__ = [
+    "enabled",
+    "record_collective",
+    "region",
+    "tag_region",
+    "snapshot",
+    "dump",
+    "load_dumps",
+    "collective_totals",
+    "violations",
+    "reset",
+]
+
+
+def enabled() -> bool:
+    """Whether collective witnessing is on (``SCTOOLS_TPU_MESH_DEBUG=1``)."""
+    return os.environ.get(ENV_FLAG, "") == "1"
+
+
+# witness bookkeeping. _meta is a RAW bounded-acquire lock (the same
+# death-path discipline as the lock witness): recording happens at trace
+# time on ordinary threads, but a flight dump fired from a signal
+# handler reads the snapshot — a bounded acquire with a lockless
+# fallback means the death path can never hang on witness bookkeeping.
+_meta = threading.Lock()
+_META_TIMEOUT_S = 1.0
+# region label -> list of distinct observed sequences, each
+# {"entries": [entry...], "count": traces}
+_schedules: Dict[str, List[Dict[str, Any]]] = {}
+_sequence: List[Dict[str, Any]] = []  # global issue order, this process
+_counts: Dict[str, int] = {}
+_bytes: Dict[str, int] = {}
+_violations: List[Dict[str, Any]] = []
+_static_pairs: Optional[Set[Tuple[str, str]]] = None
+_static_path: Optional[str] = None
+_static_loaded = False
+_dump_registered = False
+_tls = threading.local()
+
+
+def _axis_key(axis) -> str:
+    """One canonical string per axis spec (``'shard'``, ``'dcn+shard'``)."""
+    if isinstance(axis, (tuple, list)):
+        return "+".join(str(a) for a in axis)
+    return str(axis)
+
+
+def _region_stack() -> List[Tuple[str, List[Dict[str, Any]]]]:
+    stack = getattr(_tls, "regions", None)
+    if stack is None:
+        stack = _tls.regions = []
+    return stack
+
+
+def _load_static() -> Optional[Set[Tuple[str, str]]]:
+    global _static_pairs, _static_loaded, _static_path
+    if _static_loaded:
+        return _static_pairs
+    if not _meta.acquire(timeout=_META_TIMEOUT_S):
+        return _static_pairs
+    try:
+        if _static_loaded:
+            return _static_pairs
+        path = os.environ.get(ENV_SCHEDULE, "").strip()
+        pairs: Optional[Set[Tuple[str, str]]] = None
+        if path:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    data = json.load(f)
+                pairs = {
+                    (str(name), str(axis))
+                    for name, axis in data.get("collectives", ())
+                }
+                _static_path = path
+            except (OSError, ValueError, KeyError, TypeError):
+                # an unreadable schedule must not crash the instrumented
+                # process; the smoke comparing dumps will catch it
+                pairs = None
+        _static_pairs = pairs
+        _static_loaded = True
+    finally:
+        _meta.release()
+    return _static_pairs
+
+
+def _record_violation(kind: str, detail: Dict[str, Any]) -> None:
+    entry = dict(detail)
+    entry["kind"] = kind
+    if _meta.acquire(timeout=_META_TIMEOUT_S):
+        try:
+            _violations.append(entry)
+        finally:
+            _meta.release()
+    try:
+        sys.stderr.write(
+            f"sctools-tpu mesh-witness: {kind}: "
+            f"{json.dumps(entry, sort_keys=True, default=str)}\n"
+        )
+        sys.stderr.flush()
+    except OSError:
+        pass
+    # an unscheduled collective is a static-model lie about the very
+    # property that deadlocks meshes: persist the postmortem now
+    if getattr(_tls, "announcing", False):
+        return
+    _tls.announcing = True
+    try:
+        from .. import obs
+
+        obs.flight_dump(reason=f"mesh-witness:{kind}")
+    except Exception:  # noqa: BLE001 - diagnosis must never be fatal
+        pass
+    finally:
+        _tls.announcing = False
+
+
+def record_collective(
+    name: str,
+    axis,
+    shape: Sequence[int],
+    dtype: str,
+    nbytes: int,
+) -> None:
+    """One collective issued at trace time (called by the wrappers)."""
+    if not enabled():
+        return
+    _ensure_dump_registered()
+    entry = {
+        "name": str(name),
+        "axis": _axis_key(axis),
+        "shape": [int(d) for d in shape],
+        "dtype": str(dtype),
+        "nbytes": int(nbytes),
+    }
+    stack = _region_stack()
+    if stack:
+        entry["region"] = stack[-1][0]
+        stack[-1][1].append(entry)
+    else:
+        entry["region"] = None
+        _record_violation(
+            "outside-region",
+            {
+                "collective": entry["name"],
+                "axis": entry["axis"],
+                "note": "collective issued outside any platform.shard_map "
+                "region — the choke-point invariant is broken",
+            },
+        )
+    static = _load_static()
+    # the static emitter writes axis "*" for parameter-forwarded axes
+    # (the axis string is only known at trace time); an exact pair OR
+    # the wildcard admits the observation
+    if static is not None and (
+        (entry["name"], entry["axis"]) not in static
+        and (entry["name"], "*") not in static
+    ):
+        _record_violation(
+            "unscheduled-collective",
+            {
+                "collective": entry["name"],
+                "axis": entry["axis"],
+                "region": entry["region"],
+                "schedule": _static_path,
+                "note": "observed collective missing from the static "
+                "collective schedule",
+            },
+        )
+    if _meta.acquire(timeout=_META_TIMEOUT_S):
+        try:
+            _sequence.append(entry)
+            _counts[entry["name"]] = _counts.get(entry["name"], 0) + 1
+            _bytes[entry["name"]] = _bytes.get(entry["name"], 0) + entry[
+                "nbytes"
+            ]
+        finally:
+            _meta.release()
+
+
+class _Region:
+    """Context manager that scopes recorded collectives to one mapped body."""
+
+    __slots__ = ("label", "_entries")
+
+    def __init__(self, label: str):
+        self.label = label
+        self._entries: List[Dict[str, Any]] = []
+
+    def __enter__(self) -> "_Region":
+        _region_stack().append((self.label, self._entries))
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        stack = _region_stack()
+        for index in range(len(stack) - 1, -1, -1):
+            if stack[index][1] is self._entries:
+                del stack[index]
+                break
+        if exc_type is not None:
+            return
+        # fold this trace's sequence into the region's schedule set:
+        # repeat traces of an identical sequence dedupe (count++), a
+        # DIFFERENT sequence for the same region is kept separately so
+        # the fleet check can see it (and fail on cross-worker drift)
+        key = [
+            {k: e[k] for k in ("name", "axis", "shape", "dtype", "nbytes")}
+            for e in self._entries
+        ]
+        if not _meta.acquire(timeout=_META_TIMEOUT_S):
+            return
+        try:
+            rows = _schedules.setdefault(self.label, [])
+            for row in rows:
+                if row["entries"] == key:
+                    row["count"] += 1
+                    return
+            rows.append({"entries": key, "count": 1})
+        finally:
+            _meta.release()
+
+
+def region(label: str) -> _Region:
+    """Open a collective-recording region named ``label``."""
+    return _Region(label)
+
+
+def region_label(fn) -> str:
+    """The canonical region name for a mapped function."""
+    qual = getattr(fn, "__qualname__", getattr(fn, "__name__", "mapped"))
+    module = getattr(fn, "__module__", "") or ""
+    label = f"{module}.{qual}" if module else str(qual)
+    return label.replace(".<locals>", "")
+
+
+def tag_region(fn):
+    """Wrap a mapped function so its trace records into a named region.
+
+    Applied by the ``platform.shard_map`` shim when the witness is armed;
+    the wrapper body runs at trace time, exactly when the collectives
+    inside issue.
+    """
+    import functools
+
+    label = region_label(fn)
+
+    @functools.wraps(fn)
+    def traced(*args, **kwargs):
+        with region(label):
+            return fn(*args, **kwargs)
+
+    return traced
+
+
+def _ensure_dump_registered() -> None:
+    global _dump_registered
+    if _dump_registered:
+        return
+    _dump_registered = True
+    atexit.register(_dump_at_exit)
+
+
+# ------------------------------------------------------------- read side
+
+
+def violations() -> List[Dict[str, Any]]:
+    """Snapshot of recorded violations."""
+    with _meta:
+        return [dict(v) for v in _violations]
+
+
+def collective_totals() -> Dict[str, Dict[str, int]]:
+    """Per-collective issue counts and operand bytes (this process)."""
+    with _meta:
+        return {
+            name: {"count": _counts[name], "nbytes": _bytes.get(name, 0)}
+            for name in sorted(_counts)
+        }
+
+
+def snapshot(lock_timeout: Optional[float] = None) -> Dict[str, Any]:
+    """The whole witness state as one JSON-safe dict (the dump payload).
+
+    ``lock_timeout`` bounds the death path (flight-record section): on
+    contention the snapshot degrades to the enabled flag alone rather
+    than hanging a signal handler.
+    """
+    timeout = _META_TIMEOUT_S if lock_timeout is None else lock_timeout
+    if not _meta.acquire(timeout=timeout):
+        return {"enabled": enabled(), "degraded": "lock-timeout"}
+    try:
+        return {
+            "enabled": enabled(),
+            "schedules": {
+                label: [
+                    {"entries": list(row["entries"]), "count": row["count"]}
+                    for row in rows
+                ]
+                for label, rows in sorted(_schedules.items())
+            },
+            "sequence": [dict(e) for e in _sequence],
+            "counts": dict(_counts),
+            "bytes": dict(_bytes),
+            "violations": [dict(v) for v in _violations],
+            "static_schedule": _static_path,
+        }
+    finally:
+        _meta.release()
+
+
+def dump(path: Optional[str] = None) -> Optional[str]:
+    """Write the witness snapshot to ``path`` (default: the trace dir)."""
+    target = path
+    if target is None:
+        from .. import obs
+
+        base = obs.configured_trace_dir()
+        if base is None:
+            return None
+        target = os.path.join(
+            base, f"mesh.{obs.configured_worker_name()}.json"
+        )
+    tmp = f"{target}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snapshot(), f, sort_keys=True, indent=1)
+            f.write("\n")
+        os.replace(tmp, target)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return None
+    return target
+
+
+def _dump_at_exit() -> None:
+    try:
+        dump()
+    except Exception:  # noqa: BLE001 - exit hook must never raise
+        pass
+
+
+def load_dumps(run_dir: str) -> Dict[str, Dict[str, Any]]:
+    """``mesh.<worker>.json`` dumps under ``run_dir``, keyed by worker.
+
+    Searches the run dir and one level of subdirectories (the smokes
+    keep captures under ``<run>/obs/``). Unreadable dumps are skipped —
+    the surfaces riding this (``obs efficiency``, the fleet timeline)
+    degrade to absence, never crash a report.
+    """
+    out: Dict[str, Dict[str, Any]] = {}
+    patterns = [
+        os.path.join(run_dir, "mesh.*.json"),
+        os.path.join(run_dir, "*", "mesh.*.json"),
+    ]
+    for pattern in patterns:
+        for path in sorted(glob.glob(pattern)):
+            base = os.path.basename(path)
+            worker = base[len("mesh."):-len(".json")] or "worker"
+            if worker in out:
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if isinstance(data, dict):
+                out[worker] = data
+    return out
+
+
+def reset() -> None:
+    """Clear observed schedules, totals, violations, and the schedule
+    cache (tests)."""
+    global _static_pairs, _static_loaded, _static_path
+    with _meta:
+        _schedules.clear()
+        _sequence.clear()
+        _counts.clear()
+        _bytes.clear()
+        _violations.clear()
+        _static_pairs = None
+        _static_loaded = False
+        _static_path = None

@@ -1,0 +1,638 @@
+"""On-device collective merge: MergeCellMetrics/MergeGeneMetrics as mesh
+reductions (ROADMAP item 1's acting half, landed behind scx-mesh).
+
+The reference merges per-chunk metric CSVs on one host: cell metrics
+concatenate (cells are disjoint across chunks by the SplitBam
+invariant), gene metrics recombine (counts sum, quality moments
+re-average). This module moves the merge's data plane onto the device
+mesh:
+
+- every part's numeric payload uploads shard-per-device
+  (``NamedSharding`` via :func:`ingest.mesh_sharding`, parts
+  round-robined over the mesh axis) as raw int32 LANES — int64 and
+  float64 columns travel as bit-pattern pairs, so the collective is pure
+  data movement and bit-exact by construction;
+- one ``shard_map`` pass gathers every shard's rows to every device
+  (``all_gather`` over the mesh axis — the ICI replacement for the
+  host-side file concat) and, for gene metrics, ``psum``\\ s a dense
+  per-gene integer count accumulator (int32 addition is exact, and
+  addition is associative, so the device sum equals the legacy pandas
+  fold bit for bit);
+- ONE :func:`ingest.pull` materializes the merged block; the host
+  decodes the lanes back (bit-exact), restores the legacy row order,
+  and renders through the same formatting the legacy path uses.
+
+Byte-identity contracts (each pinned by test and by ``make mesh-smoke``):
+
+- :func:`collective_merge_parts` == ``parallel.launch
+  merge_sorted_csv_parts`` on gatherer part files (the canonical
+  ``str(int64)``/``str(float64)`` wire format — a non-canonical value is
+  detected at parse time and refused loudly);
+- :class:`CollectiveMergeCellMetrics` == ``MergeCellMetrics`` (pandas
+  concat semantics, including the mixed-dtype column upcast);
+- :class:`CollectiveMergeGeneMetrics` == ``MergeGeneMetrics``: the
+  integer count columns come from the device ``psum``; the float64
+  read-weighted moments and ratio recomputation replay the legacy
+  incremental fold ON HOST over the device-gathered rows — float64 is a
+  host dtype here (no x64 on device), so the device carries those
+  columns as opaque bit lanes and reduces the integer plane. The fold's
+  count columns are asserted equal to the device sums before the device
+  values land in the output.
+
+Why this is safe to land now: scx-mesh (SCX801-805) statically rejects
+divergent collective schedules, and the runtime witness
+(``SCTOOLS_TPU_MESH_DEBUG=1``) proves live that every worker of the mesh
+linearizes the identical collective sequence inside the static schedule
+— the deadlock class that makes naive on-device merges dangerous is a CI
+failure before this module's first dispatch.
+"""
+
+from __future__ import annotations
+
+import functools
+import glob as _glob
+import gzip
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import ingest, obs
+from ..obs import xprof
+from ..ops import segments as seg
+from ..parallel import collective
+from ..parallel.mesh import make_mesh
+from ..platform import shard_map
+from .merge import MergeGeneMetrics, MergeMetrics
+
+P = None  # assigned lazily (jax import cost stays off the CLI cold path)
+
+_INT_TEXT = re.compile(r"^-?\d+$")
+_I32_MIN, _I32_MAX = -(2**31), 2**31 - 1
+
+
+def _pspec():
+    global P
+    if P is None:
+        import jax
+
+        P = jax.sharding.PartitionSpec
+    return P
+
+
+# --------------------------------------------------------- lane encoding
+
+
+def _encode_lanes(columns: Sequence[np.ndarray]) -> np.ndarray:
+    """[rows, 2 * n_columns] int32 bit-lane matrix for 8-byte columns.
+
+    int64 and float64 columns each contribute two int32 lanes (their raw
+    bit pattern). The device never interprets the lanes — the collective
+    is data movement — so the decode side reconstructs every value
+    bit-exactly, NaN payloads included.
+    """
+    rows = len(columns[0]) if columns else 0
+    lanes = np.empty((rows, 2 * len(columns)), dtype=np.int32)
+    for index, column in enumerate(columns):
+        if column.dtype == np.float64:
+            raw = column.view(np.int32)
+        elif column.dtype == np.int64:
+            raw = column.view(np.int32)
+        else:
+            raise ValueError(
+                f"collective merge carries int64/float64 columns only, "
+                f"got {column.dtype}"
+            )
+        lanes[:, 2 * index: 2 * index + 2] = raw.reshape(rows, 2)
+    return lanes
+
+
+def _decode_lanes(
+    lanes: np.ndarray, dtypes: Sequence[np.dtype]
+) -> List[np.ndarray]:
+    """Inverse of :func:`_encode_lanes` (bit-exact)."""
+    out: List[np.ndarray] = []
+    for index, dtype in enumerate(dtypes):
+        raw = np.ascontiguousarray(lanes[:, 2 * index: 2 * index + 2])
+        out.append(raw.view(np.int64).reshape(-1).view(dtype).copy())
+    return out
+
+
+# ----------------------------------------------------- the device passes
+
+
+@functools.lru_cache(maxsize=32)
+def _build_gather(mesh, axis_name, rows_bucket: int, n_lanes: int):
+    """Compiled all_gather merge pass, cached per (mesh, shape)."""
+    spec = _pspec()(axis_name)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=_pspec()(),
+        check_vma=False,
+    )
+    def gather_rows(stacked):
+        # [1, R, L] local block -> [S, R, L] replicated: the row concat
+        # of the legacy merge, moved onto the mesh interconnect
+        return collective.all_gather(stacked[0], axis_name)
+
+    return xprof.instrument_jit(gather_rows, name="metrics.collective_merge")
+
+
+@functools.lru_cache(maxsize=32)
+def _build_gather_psum(
+    mesh, axis_name, rows_bucket: int, n_lanes: int,
+    vocab_bucket: int, n_counts: int,
+):
+    """Gather pass + dense integer count reduction (the gene merge)."""
+    spec = _pspec()(axis_name)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=(_pspec()(), _pspec()()),
+        check_vma=False,
+    )
+    def gather_and_reduce(stacked, counts):
+        gathered = collective.all_gather(stacked[0], axis_name)
+        # dense [vocab, n_counts] int32 accumulators: int addition is
+        # exact and associative, so this psum IS the legacy pandas sum
+        summed = collective.psum(counts[0], axis_name)
+        return gathered, summed
+
+    return xprof.instrument_jit(
+        gather_and_reduce, name="metrics.collective_merge_gene"
+    )
+
+
+def _stack_shards(
+    mesh,
+    part_lanes: Sequence[np.ndarray],
+) -> Tuple[np.ndarray, List[List[int]], int]:
+    """Round-robin parts over the mesh axis into one [S, R, L] block.
+
+    Returns ``(stacked, assignment, rows_bucket)`` where ``assignment``
+    lists the part indices each shard carries, in concatenation order —
+    the host-side key for restoring legacy row order after the gather.
+    """
+    n_shards = mesh.size
+    assignment: List[List[int]] = [[] for _ in range(n_shards)]
+    for part_index in range(len(part_lanes)):
+        assignment[part_index % n_shards].append(part_index)
+    shard_rows = [
+        sum(part_lanes[p].shape[0] for p in parts) for parts in assignment
+    ]
+    # pow2 row bucket: repeat merges of similar part sets reuse one
+    # executable (the scx-shard/scx-cost shape discipline)
+    rows_bucket = seg.bucket_size(max(max(shard_rows), 1), minimum=8)
+    n_lanes = part_lanes[0].shape[1] if part_lanes else 0
+    stacked = np.zeros((n_shards, rows_bucket, n_lanes), dtype=np.int32)
+    for shard, parts in enumerate(assignment):
+        cursor = 0
+        for p in parts:
+            block = part_lanes[p]
+            stacked[shard, cursor: cursor + block.shape[0]] = block
+            cursor += block.shape[0]
+    return stacked, assignment, rows_bucket
+
+
+def _gathered_part_rows(
+    gathered: np.ndarray,
+    assignment: List[List[int]],
+    part_rows: Sequence[int],
+) -> List[np.ndarray]:
+    """Slice the pulled [S, R, L] block back into per-part row blocks."""
+    out: List[Optional[np.ndarray]] = [None] * len(part_rows)
+    for shard, parts in enumerate(assignment):
+        cursor = 0
+        for p in parts:
+            rows = part_rows[p]
+            out[p] = np.asarray(gathered[shard, cursor: cursor + rows])
+            cursor += rows
+    return [block for block in out if block is not None]
+
+
+def _merge_mesh(mesh):
+    """The merge mesh: the caller's, or one over every local device."""
+    if mesh is not None:
+        return mesh
+    return make_mesh()
+
+
+def _device_gather_parts(
+    mesh,
+    part_columns: List[List[np.ndarray]],
+    site: str,
+    counts: Optional[np.ndarray] = None,
+) -> Tuple[List[List[np.ndarray]], Optional[np.ndarray]]:
+    """Ship every part's 8-byte columns through the mesh gather.
+
+    Returns ``(per_part_columns, summed)``: column lists decoded
+    bit-exactly from the pulled block, and — when ``counts`` (a sharded
+    ``[n_shards, vocab, n_counts]`` int32 accumulator) rides along — the
+    ``psum``-reduced ``[vocab, n_counts]`` totals (else ``None``). The
+    dtype layout must be identical across parts (callers unify dtypes
+    first — the same upcast pandas concat applies).
+    """
+    dtypes = [c.dtype for c in part_columns[0]]
+    part_lanes = [_encode_lanes(cols) for cols in part_columns]
+    part_rows = [lanes.shape[0] for lanes in part_lanes]
+    stacked, assignment, rows_bucket = _stack_shards(mesh, part_lanes)
+    axis = (
+        mesh.axis_names[0]
+        if len(mesh.axis_names) == 1
+        else tuple(mesh.axis_names)
+    )
+    summed = None
+    with obs.span(
+        "merge:collective", parts=len(part_lanes), shards=mesh.size,
+        rows=int(sum(part_rows)), reduced=int(counts is not None),
+    ) as span:
+        payload = stacked if counts is None else (stacked, counts)
+        staged, nbytes = ingest.upload(
+            payload, site=site, sharding=ingest.mesh_sharding(mesh)
+        )
+        span.add(bytes=nbytes)
+        n_lanes = stacked.shape[2]
+        xprof.record_dispatch(
+            site, int(sum(part_rows)), int(mesh.size * rows_bucket)
+        )
+        if counts is None:
+            # scx-lint: disable=SCX503 -- n_lanes is twice the schema's column count (a closed per-schema set) and rows_bucket is a bucket_size() output
+            gathered = _build_gather(mesh, axis, rows_bucket, n_lanes)(staged)
+            gathered, _ = ingest.pull(gathered, site=site)
+        else:
+            # scx-lint: disable=SCX503 -- lane/count widths are the schema's column counts (closed per-schema sets); row and vocab sizes are bucket_size() outputs
+            gathered, summed = _build_gather_psum(
+                mesh, axis, rows_bucket, n_lanes,
+                counts.shape[1], counts.shape[2],
+            )(*staged)
+            (gathered, summed), _ = ingest.pull(
+                (gathered, summed), site=site
+            )
+            summed = np.asarray(summed)
+    return [
+        _decode_lanes(block, dtypes)
+        for block in _gathered_part_rows(
+            np.asarray(gathered), assignment, part_rows
+        )
+    ], summed
+
+
+# --------------------------------------------- the part-file merge (fleet)
+
+
+def _parse_canonical_part(path: str) -> Tuple[str, List[str], List[str]]:
+    """(header_line, index_texts, row_tails) of one gatherer part file."""
+    with gzip.open(path, "rt") as f:
+        header = f.readline()
+        names: List[str] = []
+        tails: List[str] = []
+        for line in f:
+            if not line.strip():
+                continue
+            name, _, tail = line.rstrip("\n").partition(",")
+            names.append(name)
+            tails.append(tail)
+    return header, names, tails
+
+
+def _columns_from_tails(
+    path: str, tails: List[str], n_columns: int
+) -> List[np.ndarray]:
+    """Parse row tails into canonical int64/float64 columns.
+
+    Every value must round-trip through ``str()`` byte-for-byte — the
+    property the gatherer's CSV writer guarantees — or the collective
+    merge refuses the input rather than silently rewriting it.
+    """
+    cells = [tail.split(",") for tail in tails]
+    for row in cells:
+        if len(row) != n_columns:
+            raise ValueError(
+                f"collective merge: ragged row in {path} "
+                f"({len(row)} fields, header has {n_columns})"
+            )
+    columns: List[np.ndarray] = []
+    for col in range(n_columns):
+        texts = [row[col] for row in cells]
+        if all(_INT_TEXT.match(t) for t in texts):
+            values = np.array([int(t) for t in texts], dtype=np.int64)
+            rendered = [str(v) for v in values.tolist()]
+        else:
+            values = np.array([float(t) for t in texts], dtype=np.float64)
+            rendered = [str(v) for v in values.tolist()]
+        if rendered != texts:
+            drift = next(
+                (t, r) for t, r in zip(texts, rendered) if t != r
+            )
+            raise ValueError(
+                f"collective merge: non-canonical value {drift[0]!r} in "
+                f"{path} (round-trips as {drift[1]!r}); merge these parts "
+                "with parallel.merge_sorted_csv_parts instead"
+            )
+        columns.append(values)
+    return columns
+
+
+def collective_merge_parts(
+    part_pattern: str,
+    output_path: str,
+    mesh=None,
+    compress: bool = True,
+    journal_dir: Optional[str] = None,
+    expected_parts: Optional[int] = None,
+) -> int:
+    """Join per-worker CSV parts via the mesh collective (rank-0 step).
+
+    The on-device drop-in for ``parallel.merge_sorted_csv_parts``: same
+    validation (gap/duplicate/journal checks), same output bytes — the
+    parts' numeric payload rides the mesh interconnect as int32 lanes,
+    one ``all_gather`` replaces the host-side stream concat, and the
+    host re-renders the pulled values through the writer's own
+    ``str()`` contract (byte-identical because the part format
+    round-trips by construction; verified per value at parse time).
+    Returns the number of entity rows written.
+
+    The merge is OFF the fleet-timeline critical path by construction:
+    it runs after the last chunk commit, its wall is one bucket-padded
+    gather over rows that already live on device-adjacent memory, and
+    its span (``merge:collective``) is attributable in ``obs timeline``
+    next to the chunk lanes.
+    """
+    from ..parallel.launch import _check_journal_parts, _check_part_sequence
+    from ..sched import atomic_output
+
+    paths = sorted(_glob.glob(part_pattern))
+    if not paths:
+        raise FileNotFoundError(f"no parts match {part_pattern}")
+    _check_part_sequence(paths, part_pattern, expected_parts)
+    if journal_dir is not None:
+        _check_journal_parts(paths, journal_dir)
+
+    header: Optional[str] = None
+    part_names: List[List[str]] = []
+    part_columns: List[List[np.ndarray]] = []
+    for path in paths:
+        part_header, names, tails = _parse_canonical_part(path)
+        if header is None:
+            header = part_header
+        elif part_header != header:
+            raise ValueError(f"part {path} header differs")
+        n_columns = len(part_header.rstrip("\n").split(",")) - 1
+        part_names.append(names)
+        part_columns.append(_columns_from_tails(path, tails, n_columns))
+
+    # dtype layout must match across parts (same schema writer); a
+    # mixed int/float column unifies to float64 exactly like the text
+    # path would have rendered it -- refuse instead of guessing
+    layouts = {tuple(c.dtype.str for c in cols) for cols in part_columns}
+    if len(layouts) > 1:
+        raise ValueError(
+            f"collective merge: parts under {part_pattern!r} disagree on "
+            f"column dtypes ({sorted(layouts)}); merge with "
+            "parallel.merge_sorted_csv_parts instead"
+        )
+
+    mesh = _merge_mesh(mesh)
+    gathered, _ = _device_gather_parts(mesh, part_columns, "merge.collect")
+
+    # legacy row order: heapq.merge keyed on the index text, parts
+    # pre-sorted, ties broken by part order -- (name, part, row) exactly
+    order: List[Tuple[str, int, int]] = []
+    for part_index, names in enumerate(part_names):
+        for row_index, name in enumerate(names):
+            order.append((name, part_index, row_index))
+    order.sort()
+
+    rendered_parts: List[List[str]] = []
+    for part_index, columns in enumerate(gathered):
+        texts = [
+            [str(v) for v in column.tolist()] for column in columns
+        ]
+        rendered_parts.append(
+            [
+                ",".join(row_texts)
+                for row_texts in zip(*texts)
+            ]
+            if texts
+            else []
+        )
+
+    n_rows = 0
+    merge_span = obs.span(
+        "distributed:merge_parts", parts=len(paths), collective=1
+    )
+    with merge_span, atomic_output(output_path) as tmp_path:
+        opener = gzip.open if compress else open
+        with opener(tmp_path, "wt") as out:
+            out.write(header or "")
+            for name, part_index, row_index in order:
+                out.write(
+                    f"{name},{rendered_parts[part_index][row_index]}\n"
+                )
+                n_rows += 1
+        merge_span.add(records=n_rows)
+    return n_rows
+
+
+# ------------------------------------------------- the class-level merges
+
+
+def _unified_frames(metric_files: Sequence[str]):
+    """read_csv every input and unify per-column dtypes.
+
+    ``pd.concat`` upcasts a column that is int in one input and float in
+    another to float64; applying the same cast BEFORE the lane encoding
+    keeps the device-gathered values bit-identical to what the legacy
+    concat would have held.
+    """
+    import pandas as pd
+
+    frames = [pd.read_csv(f, index_col=0) for f in metric_files]
+    columns = list(frames[0].columns)
+    for frame in frames[1:]:
+        if list(frame.columns) != columns:
+            raise ValueError(
+                "collective merge: input files disagree on columns"
+            )
+    targets: Dict[str, np.dtype] = {}
+    for column in columns:
+        kinds = {frame[column].dtype.kind for frame in frames}
+        if not kinds <= {"i", "u", "f"}:
+            # bool renders True/False under pandas concat and 1/0 after
+            # an int cast; strings have no lane encoding at all — either
+            # would silently break the byte-identity contract, so refuse
+            # toward the file-level merger instead of guessing
+            raise ValueError(
+                f"collective merge: column {column!r} is non-numeric "
+                f"(dtype kinds {sorted(kinds)}); merge these files with "
+                "the file-level MergeCellMetrics/MergeGeneMetrics instead"
+            )
+        targets[column] = (
+            np.dtype(np.float64) if "f" in kinds else np.dtype(np.int64)
+        )
+    unified = []
+    for frame in frames:
+        cast = {
+            column: target
+            for column, target in targets.items()
+            if frame[column].dtype != target
+        }
+        unified.append(frame.astype(cast) if cast else frame)
+    return unified, columns
+
+
+class CollectiveMergeCellMetrics(MergeMetrics):
+    """``MergeCellMetrics`` with the concat's data plane on the mesh.
+
+    Cells are disjoint across inputs, so the merge IS the gather: every
+    part's rows ride one ``all_gather`` as bit lanes and the output
+    frame reassembles from the pulled block in input order — the values
+    pandas would have concatenated, moved over ICI instead of host RAM.
+    Output bytes equal ``MergeCellMetrics`` exactly (same parse, same
+    values, same ``to_csv``).
+    """
+
+    def __init__(self, metric_files, output_file: str, mesh=None):
+        super().__init__(metric_files, output_file)
+        self._mesh = mesh
+
+    def execute(self) -> None:
+        import pandas as pd
+
+        frames, columns = _unified_frames(self._metric_files)
+        mesh = _merge_mesh(self._mesh)
+        part_columns = [
+            [frame[column].to_numpy() for column in columns]
+            for frame in frames
+        ]
+        gathered, _ = _device_gather_parts(
+            mesh, part_columns, "merge.collect"
+        )
+        pieces = []
+        for frame, cols in zip(frames, gathered):
+            pieces.append(
+                pd.DataFrame(
+                    dict(zip(columns, cols)),
+                    index=frame.index,
+                    columns=columns,
+                )
+            )
+        merged = pd.concat(pieces, axis=0)
+        merged.to_csv(self._output_file, compression="gzip")
+
+
+class CollectiveMergeGeneMetrics(MergeMetrics):
+    """``MergeGeneMetrics`` with the count reduction on the mesh.
+
+    Gene rows collide across inputs, so this is a REAL reduction: each
+    shard scatters its parts' integer count columns into a dense
+    [gene_vocab, n_counts] accumulator and one ``psum`` produces the
+    global sums (int32 addition — exact, associative, bit-equal to the
+    pandas fold). The float64 read-weighted moments and ratios replay
+    the legacy incremental fold on host over the device-gathered rows
+    (float64 is a host dtype here; the device carries those columns as
+    opaque bit lanes), and the fold's own count sums are asserted equal
+    to the device's before the device values land in the output.
+    """
+
+    def __init__(self, metric_files, output_file: str, mesh=None):
+        super().__init__(metric_files, output_file)
+        self._mesh = mesh
+
+    def execute(self) -> None:
+        import pandas as pd
+
+        frames, columns = _unified_frames(self._metric_files)
+        mesh = _merge_mesh(self._mesh)
+        legacy = MergeGeneMetrics(self._metric_files, self._output_file)
+        count_columns = [
+            c
+            for c in legacy.COUNT_COLUMNS_TO_SUM
+            if c in columns
+            and all(f[c].dtype.kind == "i" for f in frames)
+        ]
+        vocab = sorted(
+            {name for frame in frames for name in frame.index}
+        )
+        slot = {name: index for index, name in enumerate(vocab)}
+        vocab_bucket = seg.bucket_size(max(len(vocab), 1), minimum=8)
+        n_shards = mesh.size
+        accumulators = np.zeros(
+            (n_shards, vocab_bucket, max(len(count_columns), 1)),
+            dtype=np.int64,
+        )
+        for part_index, frame in enumerate(frames):
+            shard = part_index % n_shards
+            rows = np.array([slot[name] for name in frame.index])
+            for c_index, column in enumerate(count_columns):
+                np.add.at(
+                    accumulators[shard, :, c_index],
+                    rows,
+                    frame[column].to_numpy(),
+                )
+        # range-check the CROSS-SHARD totals AND the per-shard partials:
+        # each shard's accumulator can fit int32 while their psum wraps
+        # (the totals check), and with mixed-sign inputs a partial can
+        # overflow even when the total fits (the staging astype would
+        # wrap silently) — the int64 host sums here are exactly the
+        # values the device must be able to represent
+        totals = accumulators.sum(axis=0)
+        for staged_values in (totals, accumulators):
+            if staged_values.max(initial=0) > _I32_MAX or staged_values.min(
+                initial=0
+            ) < _I32_MIN:
+                raise ValueError(
+                    "collective merge: summed count column exceeds int32 "
+                    "on-device range; merge with MergeGeneMetrics instead"
+                )
+
+        part_columns = [
+            [frame[column].to_numpy() for column in columns]
+            for frame in frames
+        ]
+        gathered, summed = _device_gather_parts(
+            mesh, part_columns, "merge.collect",
+            counts=accumulators.astype(np.int32),
+        )
+
+        # host plane: the legacy incremental fold over device-gathered
+        # rows (bit-exact reconstruction), then the device sums replace
+        # the fold's count columns after an equality assert
+        rebuilt = []
+        for frame, cols in zip(frames, gathered):
+            rebuilt.append(
+                pd.DataFrame(
+                    dict(zip(columns, cols)),
+                    index=frame.index,
+                    columns=columns,
+                )
+            )
+        nucleus = rebuilt[0]
+        for leaf in rebuilt[1:]:
+            nucleus = legacy._merge_pair(nucleus, leaf)
+        if count_columns:
+            device_sums = pd.DataFrame(
+                {
+                    column: summed[
+                        [slot[name] for name in nucleus.index], c_index
+                    ].astype(np.int64)
+                    for c_index, column in enumerate(count_columns)
+                },
+                index=nucleus.index,
+            )
+            host_sums = nucleus[count_columns].astype(np.int64)
+            if not host_sums.equals(device_sums[count_columns]):
+                raise AssertionError(
+                    "collective gene merge: device psum disagrees with "
+                    "the host fold — refusing to publish"
+                )
+            for column in count_columns:
+                nucleus[column] = device_sums[column].astype(
+                    nucleus[column].dtype
+                )
+        nucleus.to_csv(self._output_file, compression="gzip")

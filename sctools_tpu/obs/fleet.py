@@ -166,6 +166,10 @@ class FleetRun:
     metrics_files: List[str] = field(default_factory=list)
     # scx-pulse heartbeat rings found under the run dir, keyed by worker
     pulse_rings: Dict[str, dict] = field(default_factory=dict)
+    # scx-mesh collective-schedule witness dumps (mesh.<worker>.json)
+    mesh_dumps: Dict[str, dict] = field(default_factory=dict)
+    # per-worker mesh fingerprints announced to the sched journal
+    worker_meshes: Dict[str, dict] = field(default_factory=dict)
     warnings: List[str] = field(default_factory=list)
 
     def merged_spans(self) -> List[dict]:
@@ -315,12 +319,26 @@ def discover(run_dir: str) -> FleetRun:
             )
         run.captures.append(capture)
     run.pulse_rings = _pulse.load_rings(run_dir)
+    from ..analysis import meshwitness
+
+    run.mesh_dumps = meshwitness.load_dumps(run_dir)
     if journal_dir is not None:
         from ..sched import Journal
 
         journal = Journal(journal_dir, worker_id="fleet-read")
         run.tasks, run.states = journal.replay()
         run.events = journal.events()
+        # worker META events (mesh announcements) ride the same event
+        # list — fold them out of the copy already in hand rather than
+        # re-reading every events-*.jsonl through worker_meta()
+        for event in run.events:
+            if event.get("event") != "worker":
+                continue
+            worker = event.get("worker")
+            if isinstance(worker, str) and isinstance(
+                event.get("mesh"), dict
+            ):
+                run.worker_meshes[worker] = event["mesh"]
     _journal_offsets(run.captures, run.events)
     any_anchored = any(c.offset is not None for c in run.captures)
     for capture in run.captures:
@@ -598,6 +616,26 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
             "source": "flight",
         }
 
+    # --- scx-mesh collective witness: per-worker collective counts and
+    # operand bytes (mesh.<worker>.json dumps), so merge cost is visible
+    # next to the transfer columns; absent dumps -> absent section
+    collective_workers: Dict[str, dict] = {}
+    for worker, dumped in sorted(run.mesh_dumps.items()):
+        counts = {
+            str(k): int(v) for k, v in (dumped.get("counts") or {}).items()
+        }
+        nbytes = {
+            str(k): int(v) for k, v in (dumped.get("bytes") or {}).items()
+        }
+        collective_workers[worker] = {
+            "counts": counts,
+            "bytes": nbytes,
+            "issued": sum(counts.values()),
+            "operand_bytes": sum(nbytes.values()),
+            "violations": len(dumped.get("violations") or ()),
+            "mesh": run.worker_meshes.get(worker),
+        }
+
     wall_start = min((l["start"] for l in lanes.values()), default=0.0)
     wall_end = max((l["end"] for l in lanes.values()), default=0.0)
     flights = [
@@ -629,6 +667,8 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
         },
         "occupancy_median": occupancy_median,
         "pulse": pulse_workers,
+        "collectives": collective_workers,
+        "worker_meshes": dict(run.worker_meshes),
         "task_totals": {
             state: states.count(state) for state in sorted(set(states))
         },
@@ -750,6 +790,31 @@ def render_timeline(run: FleetRun, analysis: Dict[str, Any]) -> str:
                 f"limited by {row.get('limiting_stage') or '-'}"
                 + (" (from flight record)" if row["source"] == "flight"
                    else "")
+            )
+        lines.append("")
+    collective_rows = analysis.get("collectives") or {}
+    if collective_rows:
+        lines.append(
+            "collectives (mesh witness dumps; `obs efficiency` for the "
+            "fleet totals):"
+        )
+        for worker in sorted(collective_rows):
+            row = collective_rows[worker]
+            mesh = row.get("mesh") or {}
+            shape = ",".join(
+                f"{axis}={size}"
+                for axis, size in zip(
+                    mesh.get("axes") or [], mesh.get("sizes") or []
+                )
+            ) or "?"
+            per_kind = ", ".join(
+                f"{name} x{count}"
+                for name, count in sorted(row["counts"].items())
+            ) or "none"
+            lines.append(
+                f"  {worker} (mesh {shape}): {per_kind}, "
+                f"{row['operand_bytes'] / 1e6:.2f} MB operand, "
+                f"{row['violations']} violation(s)"
             )
         lines.append("")
     stats = analysis["task_stats"]

@@ -970,9 +970,49 @@ def efficiency_report(run_dir: str) -> Dict[str, Any]:
         if pulse_view["workers"]
         else None
     )
+    # scx-mesh collective-schedule witness dumps (mesh.<worker>.json):
+    # per-worker collective counts/bytes so on-device merge cost reads
+    # next to the transfer ledger; graceful absence when the run was not
+    # armed (SCTOOLS_TPU_MESH_DEBUG=1)
+    from ..analysis import meshwitness
+
+    mesh_dumps = meshwitness.load_dumps(run_dir)
+    collectives_section = None
+    if mesh_dumps:
+        fleet_counts: Dict[str, int] = {}
+        fleet_bytes: Dict[str, int] = {}
+        worker_rows: Dict[str, Any] = {}
+        total_violations = 0
+        for worker, dumped in sorted(mesh_dumps.items()):
+            counts = {
+                str(k): int(v)
+                for k, v in (dumped.get("counts") or {}).items()
+            }
+            nbytes = {
+                str(k): int(v)
+                for k, v in (dumped.get("bytes") or {}).items()
+            }
+            violations = list(dumped.get("violations") or ())
+            total_violations += len(violations)
+            worker_rows[worker] = {
+                "counts": counts,
+                "bytes": nbytes,
+                "violations": len(violations),
+            }
+            for name, count in counts.items():
+                fleet_counts[name] = fleet_counts.get(name, 0) + count
+            for name, value in nbytes.items():
+                fleet_bytes[name] = fleet_bytes.get(name, 0) + value
+        collectives_section = {
+            "counts": fleet_counts,
+            "bytes": fleet_bytes,
+            "violations": total_violations,
+            "workers": worker_rows,
+        }
     return {
         "run_dir": os.path.abspath(run_dir),
         "pulse": pulse_section,
+        "collectives": collectives_section,
         "workers": sorted(
             {str(r.get("worker", "unknown")) for r in registries}
         ),
@@ -1266,6 +1306,27 @@ def render_efficiency(report: Dict[str, Any]) -> str:
                         else ""
                     )
                 )
+        lines.append("")
+    collectives = report.get("collectives")
+    if collectives:
+        per_kind = ", ".join(
+            f"{name} x{count} "
+            f"({_fmt_bytes(collectives['bytes'].get(name, 0))} MB)"
+            for name, count in sorted(collectives["counts"].items())
+        ) or "none"
+        lines.append(
+            f"collectives (mesh witness, {len(collectives['workers'])} "
+            f"worker dump(s), {collectives['violations']} violation(s)): "
+            f"{per_kind}"
+        )
+        for worker in sorted(collectives["workers"]):
+            row = collectives["workers"][worker]
+            issued = sum(row["counts"].values())
+            moved = sum(row["bytes"].values())
+            lines.append(
+                f"    {worker}: {issued} collective(s), "
+                f"{_fmt_bytes(moved)} MB operand"
+            )
         lines.append("")
     if totals["padded_rows"]:
         lines.append(

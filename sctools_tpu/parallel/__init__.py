@@ -23,7 +23,13 @@ from .launch import (
     run_process_cell_metrics,
     sync_processes,
 )
-from .mesh import make_hybrid_mesh, make_mesh
+from . import collective
+from .mesh import (
+    collective_preflight,
+    make_hybrid_mesh,
+    make_mesh,
+    mesh_fingerprint,
+)
 from .shard import partition_columns, shard_assignment
 from .count import sharded_count_molecules
 from .sort import distributed_sort, required_sort_capacity
@@ -50,8 +56,11 @@ __all__ = [
     "run_process_cell_metrics",
     "merge_sorted_csv_parts",
     "sync_processes",
+    "collective",
+    "collective_preflight",
     "make_mesh",
     "make_hybrid_mesh",
+    "mesh_fingerprint",
     "hybrid_metrics_step",
     "partition_columns",
     "shard_assignment",

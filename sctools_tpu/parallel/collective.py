@@ -1,0 +1,108 @@
+"""The ONE sanctioned spelling of mesh collectives (scx-mesh choke point).
+
+Every collective the library issues inside a mapped computation goes
+through these wrappers instead of bare ``jax.lax.*``. Two reasons:
+
+1. the runtime collective-schedule witness
+   (:mod:`sctools_tpu.analysis.meshwitness`,
+   ``SCTOOLS_TPU_MESH_DEBUG=1``): each wrapper records the issued
+   collective (name, axis, abstract shape, dtype, operand bytes) into
+   the enclosing ``platform.shard_map`` region at TRACE time — the
+   linearization every device of the mesh will execute. The fleet merge
+   asserts all workers recorded identical schedules that sit inside the
+   static schedule ``--emit-collective-schedule`` emits; devices that
+   disagree on collective issue order deadlock the mesh, which is why
+   scx-mesh makes the disagreement a CI failure first.
+2. the static model: scx-mesh (SCX801-805) and scx-shard (SCX504)
+   resolve these names exactly like the ``jax.lax`` family, so routing
+   through the choke point costs no analyzer coverage.
+
+Off means OFF: with the witness disarmed each wrapper is a direct
+``jax.lax`` call behind one module-global bool check, and the check runs
+at trace time only — dispatches of a cached executable never enter this
+module.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+
+from ..analysis import meshwitness
+
+
+def _note(name: str, axis, value) -> None:
+    """Record one issued collective against the operand's abstract value."""
+    if not meshwitness.enabled():
+        return
+    leaves = jax.tree_util.tree_leaves(value)
+    shape: tuple = ()
+    dtype = "?"
+    nbytes = 0
+    for leaf in leaves:
+        aval_shape = tuple(getattr(leaf, "shape", ()) or ())
+        aval_dtype = getattr(leaf, "dtype", None)
+        itemsize = getattr(aval_dtype, "itemsize", 0) or 0
+        nbytes += int(math.prod(aval_shape)) * int(itemsize)
+        if not shape:
+            shape = aval_shape
+            dtype = str(aval_dtype) if aval_dtype is not None else "?"
+    meshwitness.record_collective(name, axis, shape, dtype, nbytes)
+
+
+def psum(x, axis_name):
+    """``jax.lax.psum`` through the witness choke point."""
+    _note("psum", axis_name, x)
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    """``jax.lax.pmean`` through the witness choke point."""
+    _note("pmean", axis_name, x)
+    return jax.lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name):
+    """``jax.lax.pmax`` through the witness choke point."""
+    _note("pmax", axis_name, x)
+    return jax.lax.pmax(x, axis_name)
+
+
+def pmin(x, axis_name):
+    """``jax.lax.pmin`` through the witness choke point."""
+    _note("pmin", axis_name, x)
+    return jax.lax.pmin(x, axis_name)
+
+
+def all_gather(x, axis_name, **kwargs):
+    """``jax.lax.all_gather`` through the witness choke point."""
+    _note("all_gather", axis_name, x)
+    return jax.lax.all_gather(x, axis_name, **kwargs)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, **kwargs):
+    """``jax.lax.all_to_all`` through the witness choke point."""
+    _note("all_to_all", axis_name, x)
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis, concat_axis, **kwargs
+    )
+
+
+def ppermute(x, axis_name, perm):
+    """``jax.lax.ppermute`` through the witness choke point."""
+    _note("ppermute", axis_name, x)
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    """``jax.lax.axis_index`` through the witness choke point.
+
+    Not a communication primitive, but part of the issue schedule: a
+    branch on its value is exactly the rank-divergence SCX801 exists to
+    reject, so the witness records where rank identity enters a mapped
+    body.
+    """
+    if meshwitness.enabled():
+        meshwitness.record_collective("axis_index", axis_name, (), "int32", 0)
+    return jax.lax.axis_index(axis_name)

@@ -266,6 +266,7 @@ def run_process_cell_metrics(
     """
     from ..guard import quarantine
     from ..sched import QuarantinedTasksError, WorkQueue
+    from .mesh import mesh_fingerprint
 
     mesh = mesh if mesh is not None else local_mesh()
     tasks = make_cell_metric_tasks(
@@ -280,6 +281,10 @@ def run_process_cell_metrics(
         lease_ttl=lease_ttl,
         max_attempts=max_attempts,
         backoff_base=backoff_base,
+        # the per-MESH worker notion (scx-mesh): the journal knows which
+        # mesh each worker serves, so per-mesh steps (the collective
+        # merge) schedule once per mesh and `sched status` groups lanes
+        mesh=mesh_fingerprint(mesh),
     )
     # guard's poison-record sidecars land next to the journal, where
     # `sched status` (and the merge-time operator) will look for them

@@ -9,6 +9,7 @@ same collectives over DCN without code changes.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -34,6 +35,99 @@ def make_mesh(
             )
         devices = devices[:n_devices]
     return jax.sharding.Mesh(np.asarray(devices), (axis_name,))
+
+
+def collective_preflight(mesh: jax.sharding.Mesh, axis_name: str = DEFAULT_AXIS) -> dict:
+    """Prove this worker's collective schedule before it serves batches.
+
+    One tiny mapped computation issues the canonical collective sequence
+    — ``psum``, ``all_gather``, ``all_to_all`` — through the
+    :mod:`.collective` choke point and validates conservation of a known
+    payload. Two jobs:
+
+    1. with the scx-mesh witness armed (``SCTOOLS_TPU_MESH_DEBUG=1``)
+       the trace records this worker's schedule into
+       ``mesh.<worker>.json``, so the fleet check can assert every
+       worker of the mesh linearizes the IDENTICAL sequence inside the
+       static schedule BEFORE real data is at stake — SPMD divergence
+       surfaces as a preflight failure, not a mid-run deadlock;
+    2. unconditionally, a wrong topology (a mesh whose collectives
+       drop or duplicate elements) fails loudly here, at one bucket of
+       synthetic bytes, instead of corrupting a merge.
+
+    Returns ``{"devices", "total"}`` for callers that want to log it.
+    """
+    import jax.numpy as jnp
+
+    from .. import ingest
+    from ..obs import xprof
+    from ..platform import shard_map
+    from . import collective
+
+    # scx-lint: disable=SCX503 -- the mesh axis size is a closed per-topology set (one value per mesh this process ever constructs), not a data-dependent scalar
+    n = int(mesh.shape[axis_name])
+    block = np.arange(n * 4, dtype=np.int32).reshape(n, 4)
+    staged, _ = ingest.upload(
+        block, site="mesh.preflight",
+        sharding=ingest.mesh_sharding(mesh, axis_name),
+    )
+
+    spec = jax.sharding.PartitionSpec(axis_name)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(jax.sharding.PartitionSpec(), spec),
+        check_vma=False,
+    )
+    def preflight(local):
+        rows = local[0]
+        total = collective.psum(rows.sum(), axis_name)
+        gathered = collective.all_gather(rows, axis_name)
+        fanout = jnp.repeat(rows.sum(), n)
+        exchanged = collective.all_to_all(fanout, axis_name, 0, 0)
+        return total + 0 * gathered.sum(), exchanged[None]
+
+    run = xprof.instrument_jit(preflight, name="parallel.mesh_preflight")
+    total, exchanged = run(staged)
+    (total, exchanged), _ = ingest.pull(
+        (total, exchanged), site="mesh.preflight"
+    )
+    expected = int(block.sum())
+    total = int(np.asarray(total))
+    rows = np.asarray(exchanged).reshape(n, n)
+    if total != expected or not np.all(rows.sum(axis=1) == expected):
+        raise RuntimeError(
+            f"collective preflight failed on mesh {mesh!r}: psum total "
+            f"{total} (expected {expected}), all_to_all row sums "
+            f"{rows.sum(axis=1).tolist()} — the mesh's collectives drop "
+            "or duplicate elements; do not serve batches on it"
+        )
+    return {"devices": n, "total": total}
+
+
+def mesh_fingerprint(mesh: jax.sharding.Mesh) -> dict:
+    """The comparability key of a mesh: axis names + sizes + device kind.
+
+    scx-sched's per-mesh worker notion and the MULTICHIP bench points
+    both stamp this: two workers serve "the same mesh" exactly when
+    their fingerprints match (the precondition for a per-mesh collective
+    merge — merging parts produced under different topologies is the
+    legacy file-level path's job), and a bench point gates only against
+    points recorded on an identical mesh shape. ``dryrun_multichip``
+    forces the host platform, so backend/device-kind alone reads cpu×8
+    for EVERY multichip round — the mesh shape is the part of the
+    fingerprint that actually varies.
+    """
+    devices = list(mesh.devices.flat)
+    kind = str(devices[0].device_kind) if devices else "unknown"
+    return {
+        "axes": [str(a) for a in mesh.axis_names],
+        "sizes": [int(mesh.shape[a]) for a in mesh.axis_names],
+        "devices": int(mesh.size),
+        "device_kind": kind,
+    }
 
 
 def make_hybrid_mesh(

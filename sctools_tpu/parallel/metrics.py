@@ -30,6 +30,7 @@ from ..metrics.device import compute_entity_metrics
 from ..obs import xprof
 from ..ops import segments as seg
 from ..platform import shard_map
+from . import collective
 from .mesh import DEFAULT_AXIS
 
 _I32_MAX = np.iinfo(np.int32).max
@@ -115,7 +116,7 @@ def reshard_by_key(
         by_dtype.setdefault(buffers[name].dtype, []).append(name)
     for dtype, group in by_dtype.items():
         stacked = jnp.stack([buffers[n] for n in group])  # [C, n_shards, cap]
-        received = jax.lax.all_to_all(
+        received = collective.all_to_all(
             stacked, axis_name, split_axis=1, concat_axis=1, tiled=True
         )
         for i, name in enumerate(group):

@@ -41,6 +41,7 @@ from .. import obs
 from ..obs import pulse, xprof
 from ..ops import segments as seg
 from ..platform import shard_map
+from . import collective
 from .metrics import P, _check_shard_count, reshard_by_key
 
 _I32_MAX = np.iinfo(np.int32).max
@@ -186,7 +187,7 @@ def _build_sample_sort(
         # ANY key skew (module docstring) — a dominant equal-key run splits
         # across shards instead of landing on one.
         tie = (
-            jax.lax.axis_index(axis_name).astype(jnp.int32) * local_size
+            collective.axis_index(axis_name).astype(jnp.int32) * local_size
             + jnp.arange(local_size, dtype=jnp.int32)
         )
         route_keys = keys + [tie]
@@ -195,7 +196,7 @@ def _build_sample_sort(
         sample_at = jnp.asarray(_sample_positions(local_size, n_shards))
         samples = [k[sample_at] for k in route_keys]
         pools = [
-            jax.lax.all_gather(s, axis_name).reshape(-1) for s in samples
+            collective.all_gather(s, axis_name).reshape(-1) for s in samples
         ]
         pools = jax.lax.sort(pools, num_keys=len(pools))
         pivot_at = jnp.asarray(_pivot_positions(pools[0].shape[0], n_shards))

@@ -83,12 +83,45 @@ def _status(journal_dir: str, out, journal: Optional[Journal] = None) -> int:
             print("  ".join("-" * w for w in widths), file=out)
     summary = ", ".join(f"{k}={v}" for k, v in sorted(totals.items()))
     print(f"total={len(tasks)} ({summary})", file=out)
+    _print_mesh_summary(journal, out)
     _print_efficiency_summary(journal_dir, out)
     _print_pulse_summary(journal_dir, out)
     _print_quarantined_records(journal_dir, out)
     if totals.get(QUARANTINED):
         return 2
     return 0 if totals.get(COMMITTED, 0) == len(tasks) else 1
+
+
+def _print_mesh_summary(journal: Journal, out) -> None:
+    """One line per announced device mesh (the scx-mesh worker notion).
+
+    Workers that passed a ``mesh=`` fingerprint to their WorkQueue group
+    here by topology — the operator sees at a glance whether every
+    worker of a run serves the SAME mesh (the precondition for the
+    on-device collective merge) or the fleet is split across shapes.
+    """
+    try:
+        meta = journal.worker_meta()
+    except Exception:  # noqa: BLE001 - status must never die on telemetry
+        return
+    by_mesh = {}
+    for worker, info in sorted(meta.items()):
+        mesh = info.get("mesh")
+        if not isinstance(mesh, dict):
+            continue
+        axes = mesh.get("axes") or []
+        sizes = mesh.get("sizes") or []
+        shape = ",".join(
+            f"{axis}={size}" for axis, size in zip(axes, sizes)
+        ) or "?"
+        key = f"{shape} ({mesh.get('device_kind', '?')})"
+        by_mesh.setdefault(key, []).append(worker)
+    for shape, workers in sorted(by_mesh.items()):
+        print(
+            f"mesh {shape}: {len(workers)} worker(s) — "
+            f"{', '.join(workers)}",
+            file=out,
+        )
 
 
 def _print_efficiency_summary(journal_dir: str, out) -> None:

@@ -242,7 +242,45 @@ class Journal:
         record.update(extra)
         self._append("events", record)
 
+    def announce_worker(self, meta: Dict[str, Any]) -> None:
+        """Describe this worker in its event log (scx-mesh: the mesh it
+        serves).
+
+        Worker announcements are META events (``"event": "worker"``, no
+        task id): :meth:`replay` ignores them by construction (it folds
+        only string task ids), while :meth:`worker_meta` and the fleet
+        surfaces read them to group workers per MESH rather than per
+        process — the notion the on-device collective merge schedules
+        by (one merge per mesh, not one per worker).
+        """
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        record = {
+            "id": None, "event": "worker", "ts": round(wall_clock(), 6),
+            "seq": seq, "worker": self.worker_id,
+        }
+        record.update(meta)
+        self._append("events", record)
+
     # ------------------------------------------------------------- reads
+
+    def worker_meta(self) -> Dict[str, Dict[str, Any]]:
+        """Per-worker announcement metadata, last announcement wins."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for event in self.events():
+            if event.get("event") != "worker":
+                continue
+            worker = event.get("worker")
+            if not isinstance(worker, str):
+                continue
+            meta = {
+                k: v
+                for k, v in event.items()
+                if k not in ("id", "event", "ts", "seq", "worker")
+            }
+            out[worker] = meta
+        return out
 
     def _read_jsonl(self, pattern: str) -> List[Dict[str, Any]]:
         out: List[Dict[str, Any]] = []

@@ -98,10 +98,19 @@ class WorkQueue:
         backoff_base: float = 0.25,
         backoff_cap: float = 30.0,
         poll_interval: float = 0.5,
+        mesh: Optional[Dict] = None,
     ):
+        """``mesh`` (a ``parallel.mesh.mesh_fingerprint`` dict) announces
+        which device mesh this worker serves — the scx-mesh per-MESH
+        worker notion: `sched status` groups workers by fingerprint, and
+        the collective merge is scheduled once per mesh, not once per
+        process."""
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         self.journal = Journal(journal_dir, worker_id)
+        self.mesh = dict(mesh) if mesh else None
+        if self.mesh is not None:
+            self.journal.announce_worker({"mesh": self.mesh})
         self.broker = LeaseBroker(
             self.journal.leases_dir, self.journal.worker_id, ttl=lease_ttl
         )

@@ -1,0 +1,34 @@
+"""SCX801 bad fixture: collectives reachable under data- and
+rank-dependent branches — devices can disagree on the issue schedule and
+deadlock at the first collective a peer never reaches."""
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.platform import shard_map
+
+AXIS = "shard"
+
+
+def build_divergent_merge(mesh):
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+    )
+    def step(block):
+        def reduce_branch(x):
+            return jax.lax.psum(x, AXIS)  # <- SCX801
+
+        def skip_branch(x):
+            return x
+
+        picked = jax.lax.cond(
+            block.sum() > 0, reduce_branch, skip_branch, block
+        )
+        rank = jax.lax.axis_index(AXIS)
+        if rank == 0:
+            picked = jax.lax.all_gather(picked, AXIS).sum(axis=0)  # <- SCX801
+        return picked
+
+    return step

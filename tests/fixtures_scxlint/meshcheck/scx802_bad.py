@@ -1,0 +1,26 @@
+"""SCX802 bad fixture: two paths through one mapped body issue different
+collective sequences — the branches are two different SPMD programs, and
+any per-worker divergence of the condition deadlocks the mesh."""
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.platform import shard_map
+
+AXIS = "shard"
+
+
+def build_merge(mesh, combine):
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+    )
+    def step(block):
+        if combine == "sum":  # <- SCX802
+            out = jax.lax.psum(block, AXIS)
+        else:
+            out = jax.lax.all_gather(block, AXIS).sum(axis=0)
+        return out
+
+    return step

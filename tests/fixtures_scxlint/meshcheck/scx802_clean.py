@@ -1,0 +1,26 @@
+"""SCX802 clean twin: one collective sequence on every path — the config
+branch only varies element math AFTER the schedule is fixed."""
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.platform import shard_map
+
+AXIS = "shard"
+
+
+def build_merge(mesh, combine):
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+    )
+    def step(block):
+        out = jax.lax.psum(block, AXIS)
+        if combine == "scaled":
+            out = out * 2
+        else:
+            out = out + 1
+        return out
+
+    return step

@@ -1,0 +1,27 @@
+"""SCX803 bad fixture: host syncs between two collectives of one mapped
+computation — every peer stalls at its next collective for as long as
+the host dawdles over the pull."""
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.ingest import pull
+from sctools_tpu.platform import shard_map
+
+AXIS = "shard"
+
+
+def build_probed_merge(mesh):
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+    )
+    def step(block):
+        partial_sum = jax.lax.psum(block, AXIS)
+        probe, _ = pull(partial_sum, site="fix.probe")  # <- SCX803
+        jax.block_until_ready(partial_sum)  # <- SCX803
+        gathered = jax.lax.all_gather(block, AXIS)
+        return gathered.sum(axis=0) + partial_sum + probe.shape[0]
+
+    return step

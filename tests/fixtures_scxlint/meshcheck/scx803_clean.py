@@ -1,0 +1,31 @@
+"""SCX803 clean twin: the collective schedule runs sync-free; host reads
+land after the LAST collective of the mapped computation."""
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.ingest import pull
+from sctools_tpu.platform import shard_map
+
+AXIS = "shard"
+
+
+def build_probed_merge(mesh):
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+    )
+    def step(block):
+        partial_sum = jax.lax.psum(block, AXIS)
+        gathered = jax.lax.all_gather(block, AXIS)
+        return gathered.sum(axis=0) + partial_sum
+
+    return step
+
+
+def drive(mesh, block):
+    merged = build_probed_merge(mesh)(block)
+    host, _ = pull(merged, site="fix.probe")
+    jax.block_until_ready(merged)
+    return host

@@ -1,0 +1,15 @@
+"""SCX804 bad fixture: hardcoded device counts in mesh-context
+functions — shapes derived from them work on the 8-device bench mesh and
+silently corrupt (or deadlock) on any other topology."""
+
+
+def shard_for_mesh(cols, mesh):
+    n_shards = 8  # <- SCX804
+    return {name: col.reshape(n_shards, -1) for name, col in cols.items()}
+
+
+def route_records(cols, mesh, rekey):
+    return rekey(
+        cols,
+        n_devices=8,  # <- SCX804
+    )

@@ -1,0 +1,13 @@
+"""SCX804 clean twin: every shard count derives from the mesh itself —
+the same code is correct on 1, 8, or 256 devices."""
+
+AXIS = "shard"
+
+
+def shard_for_mesh(cols, mesh):
+    n_shards = mesh.shape[AXIS]
+    return {name: col.reshape(n_shards, -1) for name, col in cols.items()}
+
+
+def route_records(cols, mesh, rekey):
+    return rekey(cols, n_devices=len(mesh.devices))

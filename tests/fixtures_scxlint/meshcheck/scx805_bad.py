@@ -1,0 +1,20 @@
+"""SCX805 bad fixture: a shard-partial accumulator escapes the mesh
+region through a replicated out_spec with no reduction — each device
+returns ITS partial as if it were the total, the on-device analog of
+concatenating per-chunk CSVs without a merge."""
+
+import functools
+
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.platform import shard_map
+
+AXIS = "shard"
+
+
+def build_totals(mesh):
+    @functools.partial(shard_map, mesh=mesh, in_specs=(P(AXIS),), out_specs=P())  # <- SCX805
+    def local_totals(block):
+        return block.sum(axis=0)
+
+    return local_totals

@@ -1,0 +1,30 @@
+"""SCX805 clean twin: the replicated output is the RESULT of a reducing
+collective — every device really does hold the same total — and the
+partitioned variant needs no reduction at all."""
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from sctools_tpu.platform import shard_map
+
+AXIS = "shard"
+
+
+def build_totals(mesh):
+    @functools.partial(shard_map, mesh=mesh, in_specs=(P(AXIS),), out_specs=P())
+    def mesh_totals(block):
+        return jax.lax.psum(block.sum(axis=0), AXIS)
+
+    return mesh_totals
+
+
+def build_local_rows(mesh):
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+    )
+    def local_rows(block):
+        return block * 2
+
+    return local_rows

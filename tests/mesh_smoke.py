@@ -1,0 +1,250 @@
+"""mesh-smoke: the CI gate for scx-mesh (`make mesh-smoke`).
+
+A 2-worker run where each worker serves a REAL 4-device (virtual CPU)
+mesh under the armed collective-schedule witness
+(``SCTOOLS_TPU_MESH_DEBUG=1``) against the static schedule
+(``--emit-collective-schedule``):
+
+- each worker runs the collective preflight (canonical
+  psum/all_gather/all_to_all through the choke point) and then the
+  mesh-sharded chunk pipeline, announcing its mesh fingerprint to the
+  sched journal (the per-MESH worker notion);
+- the gate asserts both workers dumped NON-EMPTY, IDENTICAL per-region
+  collective schedules with ZERO violations, every observed pair inside
+  the static schedule — the SPMD-divergence contract, validated live;
+- the journal shows both workers announced the SAME mesh fingerprint
+  and `sched status` renders the mesh line;
+- the committed parts then merge twice: the legacy file-level concat
+  (merge_sorted_csv_parts) and the ON-DEVICE collective merge
+  (collective_merge_parts, all_gather over an 8-device driver mesh,
+  witnessed in-process) — and the two outputs must be BYTE-IDENTICAL;
+- `obs efficiency` and the fleet timeline surface per-worker collective
+  counts/bytes from the witness dumps, and the merge stays off the
+  fleet critical path (it runs after the last chunk commit; its wall is
+  recorded in the summary the MULTICHIP trajectory points cite).
+
+Exit 0 on success; any assertion failure is a gate failure.
+"""
+
+import glob
+import gzip
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "mesh_worker.py"
+)
+
+LEASE_TTL = "2.0"
+WORKER_DEVICES = 4
+DRIVER_DEVICES = 8
+
+# the driver's own merge runs collectives on an 8-device virtual mesh:
+# the flag must be set before jax initializes a backend
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={DRIVER_DEVICES}"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def launch(workdir: str, process_id: int):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={WORKER_DEVICES}"
+    )
+    env["SCTOOLS_TPU_TRACE"] = os.path.join(workdir, "obs")
+    env["SCTOOLS_TPU_TRACE_WORKER"] = f"p{process_id}"
+    env.pop("SCTOOLS_TPU_FAULTS", None)
+    return subprocess.Popen(
+        [sys.executable, WORKER, workdir, str(process_id), "2", LEASE_TTL],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def _gz_bytes(path: str) -> bytes:
+    with gzip.open(path, "rb") as f:
+        return f.read()
+
+
+def main() -> int:
+    workdir = os.environ.get(
+        "SCTOOLS_TPU_MESH_SMOKE_DIR"
+    ) or tempfile.mkdtemp(prefix="sctools_tpu_mesh_smoke.")
+    os.makedirs(workdir, exist_ok=True)
+    bam = os.path.join(workdir, "input.bam")
+
+    from sched_smoke import make_input
+    from witness_smoke import arm_mesh_witness, check_mesh_dumps
+
+    from sctools_tpu.platform import GenericPlatform
+    from sctools_tpu.sched import COMMITTED, Journal
+
+    # arm the collective-schedule witness for both workers AND the
+    # driver's own merge (launch() + this process inherit os.environ)
+    schedule = arm_mesh_witness(REPO_ROOT, workdir)
+    assert schedule["collectives"], "static schedule is empty"
+
+    make_input(bam)
+    chunk_dir = os.path.join(workdir, "chunks")
+    os.makedirs(chunk_dir, exist_ok=True)
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", os.path.join(chunk_dir, "chunk"), "-s", "0.002",
+         "-t", "CB"]
+    )
+    n_chunks = len(glob.glob(os.path.join(chunk_dir, "*.bam")))
+    assert n_chunks >= 2, f"need >=2 chunks, got {n_chunks}"
+
+    # two mesh workers, no faults: both must converge and both must
+    # leave witness dumps (the atexit hook needs a clean exit)
+    proc_a = launch(workdir, 0)
+    proc_b = launch(workdir, 1)
+    out_a, _ = proc_a.communicate(timeout=600)
+    out_b, _ = proc_b.communicate(timeout=600)
+    assert proc_a.returncode == 0, f"A failed:\n{out_a[-3000:]}"
+    assert proc_b.returncode == 0, f"B failed:\n{out_b[-3000:]}"
+    assert "preflight ok" in out_a and "preflight ok" in out_b
+
+    journal_dir = os.path.join(workdir, "sched-journal")
+    journal = Journal(journal_dir, worker_id="mesh-smoke-probe")
+    tasks, states = journal.replay()
+    assert len(tasks) == n_chunks and all(
+        st.state == COMMITTED for st in states.values()
+    ), {tasks[t].name: states[t].state for t in tasks}
+
+    # ---- the per-MESH worker notion: both workers announced the SAME
+    # mesh fingerprint to the journal
+    meta = journal.worker_meta()
+    meshes = {
+        worker: info.get("mesh")
+        for worker, info in meta.items()
+        if isinstance(info.get("mesh"), dict)
+    }
+    assert len(meshes) == 2, f"expected 2 mesh announcements: {meta}"
+    fingerprints = list(meshes.values())
+    assert fingerprints[0] == fingerprints[1], (
+        f"workers announced DIFFERENT meshes: {meshes}"
+    )
+    assert fingerprints[0]["sizes"] == [WORKER_DEVICES], fingerprints[0]
+    import io
+
+    from sctools_tpu.sched.cli import main as sched_cli
+
+    status_out = io.StringIO()
+    sched_cli(["status", journal_dir], out=status_out)
+    assert f"mesh shard={WORKER_DEVICES}" in status_out.getvalue(), (
+        status_out.getvalue()
+    )
+
+    # ---- the witness contract: identical, violation-free, in-schedule
+    obs_dir = os.path.join(workdir, "obs")
+    per_worker = check_mesh_dumps(obs_dir, schedule, expect_dumps=2)
+    preflight_region = "sctools_tpu.parallel.mesh.collective_preflight.preflight"
+    for worker, schedules in per_worker.items():
+        assert preflight_region in schedules, (worker, list(schedules))
+        names = [
+            entry["name"]
+            for row in schedules[preflight_region]
+            for entry in row["entries"]
+        ]
+        assert names == ["psum", "all_gather", "all_to_all"], names
+
+    # ---- the acting half: collective merge byte-identical to the
+    # legacy file-level concat, with the driver's collectives witnessed
+    from sctools_tpu.analysis import meshwitness
+    from sctools_tpu.metrics.collective import collective_merge_parts
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+
+    pattern = os.path.join(workdir, "metrics.part*.csv.gz")
+    legacy_out = os.path.join(workdir, "merged_legacy.csv.gz")
+    coll_out = os.path.join(workdir, "merged_collective.csv.gz")
+    t0 = time.perf_counter()
+    n_legacy = merge_sorted_csv_parts(
+        pattern, legacy_out, journal_dir=journal_dir,
+        expected_parts=n_chunks,
+    )
+    legacy_wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    n_coll = collective_merge_parts(
+        pattern, coll_out, journal_dir=journal_dir,
+        expected_parts=n_chunks,
+    )
+    collective_wall = time.perf_counter() - t0
+    assert n_legacy == n_coll > 0, (n_legacy, n_coll)
+    assert _gz_bytes(legacy_out) == _gz_bytes(coll_out), (
+        "collective merge output differs from the legacy concat path"
+    )
+    snap = meshwitness.snapshot()
+    assert snap["violations"] == [], snap["violations"]
+    assert snap["counts"].get("all_gather", 0) >= 1, snap["counts"]
+
+    # ---- observability: collective counts/bytes surface in the
+    # efficiency report and the fleet timeline; the merge is off the
+    # task critical path (it ran after the last chunk commit)
+    from sctools_tpu.obs.fleet import analyze, discover, render_timeline
+    from sctools_tpu.obs.xprof import efficiency_report
+
+    # witness dumps are keyed by the JOURNAL worker id (the obs context
+    # the scheduler stamps), so they join the same vocabulary as the
+    # fleet lanes and the mesh announcements
+    mesh_workers = set(meshes)
+    report = efficiency_report(workdir)
+    section = report["collectives"]
+    assert section is not None and set(section["workers"]) >= mesh_workers, (
+        section,
+    )
+    assert section["violations"] == 0
+    assert sum(section["counts"].values()) >= 2, section["counts"]
+
+    run = discover(workdir)
+    analysis = analyze(run)
+    rows = analysis["collectives"]
+    assert mesh_workers <= set(rows), rows
+    for worker in sorted(mesh_workers):
+        assert rows[worker]["issued"] >= 3, rows[worker]
+        assert rows[worker]["violations"] == 0
+    assert analysis["worker_meshes"], analysis["worker_meshes"]
+    rendered = render_timeline(run, analysis)
+    assert "collectives (mesh witness" in rendered
+    chain = analysis["critical_path"]
+    assert chain and all(
+        link["task"].startswith("chunk") for link in chain
+    ), chain
+
+    # the summary the MULTICHIP trajectory point for the collective
+    # merge cites (mesh-aware fingerprint; merge walls for both paths)
+    summary = {
+        "n_chunks": n_chunks,
+        "rows_merged": n_coll,
+        "merge_wall_s": {
+            "legacy_concat": round(legacy_wall, 4),
+            "collective": round(collective_wall, 4),
+        },
+        "worker_mesh": fingerprints[0],
+        "collectives": section["counts"],
+    }
+    with open(os.path.join(workdir, "mesh_smoke_summary.json"), "w") as f:
+        json.dump(summary, f, indent=1, sort_keys=True)
+
+    print(
+        f"mesh-smoke OK: {n_chunks} chunk(s), 2 identical worker "
+        f"schedules ({sum(section['counts'].values())} collective(s) "
+        f"witnessed, 0 violations), merge byte-identical "
+        f"(legacy {legacy_wall:.3f}s vs collective {collective_wall:.3f}s, "
+        f"{n_coll} row(s))"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+"""Worker process for the scx-mesh collective-schedule smoke gate.
+
+Each worker serves a REAL multi-device (virtual CPU) mesh: it runs the
+collective preflight — the canonical psum/all_gather/all_to_all sequence
+through the choke point, recorded by the armed witness — then works the
+shared chunk queue with the mesh-sharded gatherer, announcing its mesh
+fingerprint to the sched journal (the per-MESH worker notion). The
+caller asserts both workers' recorded collective schedules are
+identical, violation-free, and inside the static schedule.
+
+Invoked as: python mesh_worker.py <workdir> <process_id> <num_processes>
+  [lease_ttl]
+"""
+
+import glob
+import os
+import sys
+
+
+def main() -> int:
+    workdir = sys.argv[1]
+    process_id = int(sys.argv[2])
+    num_processes = int(sys.argv[3])
+    lease_ttl = float(sys.argv[4]) if len(sys.argv) > 4 else 2.0
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from sctools_tpu.parallel.launch import local_mesh, run_process_cell_metrics
+    from sctools_tpu.parallel.mesh import collective_preflight
+
+    mesh = local_mesh()
+    report = collective_preflight(mesh)
+    print(f"[p{process_id}] preflight ok: {report}", flush=True)
+
+    chunks = sorted(glob.glob(os.path.join(workdir, "chunks", "*.bam")))
+    assert chunks, "no chunk files prepared"
+    parts = run_process_cell_metrics(
+        chunks,
+        os.path.join(workdir, f"proc{process_id}"),
+        num_processes,
+        process_id,
+        mesh=mesh,
+        lease_ttl=lease_ttl,
+    )
+    print(f"[p{process_id}] committed {len(parts)} part(s)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

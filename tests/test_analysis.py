@@ -39,6 +39,7 @@ from sctools_tpu.analysis import (
     check_abi,
     check_cost,
     check_life,
+    check_mesh,
     check_races,
     check_shards,
     check_signatures,
@@ -1871,3 +1872,265 @@ def test_retune_without_registries_fails_loudly(tmp_path, retune_tree):
         str(empty), _tree_paths(retune_tree), out=lambda s: None
     )
     assert code == 2
+
+
+# ----------------------------------------------------- meshcheck (SCX8xx)
+
+MESH = os.path.join(FIXTURES, "meshcheck")
+MESH_RULE_IDS = ["SCX801", "SCX802", "SCX803", "SCX804", "SCX805"]
+
+
+@pytest.mark.parametrize("rule", MESH_RULE_IDS)
+def test_mesh_rule_fires_exactly_on_marked_lines(rule):
+    path = os.path.join(MESH, f"{rule.lower()}_bad.py")
+    findings = check_mesh([path])
+    assert findings, f"{rule} bad fixture produced no findings"
+    assert {f.rule for f in findings} == {rule}
+    expected = _marked_lines(path, rule)
+    assert expected, f"fixture {path} has no # <- {rule} markers"
+    assert sorted(f.line for f in findings) == expected, [
+        f.render() for f in findings
+    ]
+
+
+@pytest.mark.parametrize("rule", MESH_RULE_IDS)
+def test_mesh_rule_silent_on_clean_fixture(rule):
+    findings = check_mesh(
+        [os.path.join(MESH, f"{rule.lower()}_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_mesh_real_tree_is_clean():
+    # the audit contract: every SCX801-805 finding on the real tree is
+    # fixed or carries a justified inline suppression (the graft dry
+    # run's deliberately pinned 2-slice hybrid leg), and this pin keeps
+    # it that way — the precondition for the on-device collective merge
+    findings = check_mesh(TREE)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_mesh_inline_suppression(tmp_path):
+    src = (
+        "def shard_for_mesh(cols, mesh):\n"
+        "    n_shards = 8  "
+        "# scx-lint: disable=SCX804 -- fixture rig pins the bench topology\n"
+        "    return n_shards\n"
+    )
+    path = tmp_path / "suppressed_mesh.py"
+    path.write_text(src)
+    assert check_mesh([str(path)]) == []
+
+
+def test_mesh_collective_module_is_mechanism_exempt(tmp_path):
+    # the choke-point wrappers hold the raw jax.lax calls every caller
+    # forwards to; their bodies must not inventory as collective issues
+    # (a builder-shaped caller would otherwise inherit phantom findings)
+    src = (
+        "import functools\n\n"
+        "import jax\n"
+        "from jax.sharding import PartitionSpec as P\n\n"
+        "from sctools_tpu.platform import shard_map\n\n"
+        "AXIS = 'shard'\n\n\n"
+        "def build(mesh, combine):\n"
+        "    @functools.partial(\n"
+        "        shard_map, mesh=mesh, in_specs=(P(AXIS),),"
+        " out_specs=P(AXIS),\n"
+        "    )\n"
+        "    def step(block):\n"
+        "        if combine == 'sum':\n"
+        "            out = jax.lax.psum(block, AXIS)\n"
+        "        else:\n"
+        "            out = jax.lax.all_gather(block, AXIS).sum(axis=0)\n"
+        "        return out\n\n"
+        "    return step\n"
+    )
+    plain = tmp_path / "caller.py"
+    plain.write_text(src)
+    assert {f.rule for f in check_mesh([str(plain)])} == {"SCX802"}
+    # the same text in a module NAMED collective.py is the mechanism
+    mech = tmp_path / "collective.py"
+    mech.write_text(src)
+    assert check_mesh([str(mech)]) == []
+
+
+def test_mesh_collective_wrappers_are_recognized(tmp_path):
+    # collectives issued through the parallel.collective choke point are
+    # the same vocabulary as bare jax.lax for every SCX8xx rule
+    src = (
+        "import functools\n\n"
+        "from jax.sharding import PartitionSpec as P\n\n"
+        "from sctools_tpu.parallel.collective import all_gather, psum\n"
+        "from sctools_tpu.platform import shard_map\n\n"
+        "AXIS = 'shard'\n\n\n"
+        "def build(mesh, combine):\n"
+        "    @functools.partial(\n"
+        "        shard_map, mesh=mesh, in_specs=(P(AXIS),),"
+        " out_specs=P(AXIS),\n"
+        "    )\n"
+        "    def step(block):\n"
+        "        if combine == 'sum':\n"
+        "            out = psum(block, AXIS)\n"
+        "        else:\n"
+        "            out = all_gather(block, AXIS).sum(axis=0)\n"
+        "        return out\n\n"
+        "    return step\n"
+    )
+    path = tmp_path / "wrapped.py"
+    path.write_text(src)
+    findings = check_mesh([str(path)])
+    assert {f.rule for f in findings} == {"SCX802"}, [
+        f.render() for f in findings
+    ]
+
+
+def test_collective_schedule_names_real_regions():
+    from sctools_tpu.analysis import build_collective_schedule
+
+    schedule = build_collective_schedule(TREE)
+    pairs = {tuple(p) for p in schedule["collectives"]}
+    assert ("all_to_all", "*") in pairs
+    assert ("all_gather", "*") in pairs
+    regions = set(schedule["regions"])
+    assert "sctools_tpu.parallel.metrics._build_distributed_step.step" in (
+        regions
+    )
+    assert "sctools_tpu.parallel.sort._build_sample_sort.run" in regions
+    assert (
+        "sctools_tpu.parallel.metrics.reshard_by_key"
+        in schedule["computations"]
+    )
+    assert set(schedule["axis_universe"]) >= {"shard", "dcn"}
+
+
+def test_cli_mesh_only(capsys):
+    rc = cli_main(["--mesh-only"] + TREE)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "passes: mesh" in out
+
+
+def test_cli_mesh_only_fails_on_bad_corpus(capsys):
+    rc = cli_main(["-q", "--mesh-only", MESH])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for rule in MESH_RULE_IDS:
+        assert rule in out, (rule, out)
+
+
+def test_cli_five_model_passes_compose(capsys):
+    # the `make modelcheck` shape: all five whole-package passes in one
+    # process over one shared parse
+    rc = cli_main(
+        ["--race-only", "--shard-only", "--life-only", "--cost-only",
+         "--mesh-only", RACE, SHARD, LIFE, COST, MESH]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "SCX401" in out and "SCX501" in out
+    assert "SCX601" in out and "SCX701" in out and "SCX801" in out
+    assert "passes: race, shard, life, cost, mesh" in out
+
+
+def test_cli_json_covers_mesh_pass(capsys):
+    rc = cli_main(["--json", "--mesh-only", MESH])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    rules = {f["rule"] for f in payload["findings"]}
+    assert set(MESH_RULE_IDS) <= rules, rules
+
+
+def test_cli_emit_collective_schedule(tmp_path, capsys):
+    dest = tmp_path / "schedule.json"
+    rc = cli_main(["--emit-collective-schedule", str(dest)] + TREE)
+    capsys.readouterr()
+    assert rc == 0
+    with open(dest, encoding="utf-8") as f:
+        schedule = json.load(f)
+    assert schedule["collectives"] and schedule["regions"]
+
+
+# ------------------------------------------- runtime collective witness
+
+
+def test_mesh_witness_off_is_noop(monkeypatch):
+    from sctools_tpu.analysis import meshwitness
+
+    monkeypatch.delenv(meshwitness.ENV_FLAG, raising=False)
+    meshwitness.reset()
+    meshwitness.record_collective("psum", "shard", (4,), "int32", 16)
+    snap = meshwitness.snapshot()
+    assert snap["sequence"] == [] and snap["counts"] == {}
+
+
+def test_mesh_witness_records_regions_and_dedupes(monkeypatch):
+    from sctools_tpu.analysis import meshwitness
+
+    monkeypatch.setenv(meshwitness.ENV_FLAG, "1")
+    monkeypatch.delenv(meshwitness.ENV_SCHEDULE, raising=False)
+    meshwitness.reset()
+    for _ in range(2):
+        with meshwitness.region("fix.step"):
+            meshwitness.record_collective("psum", "shard", (4,), "int32", 16)
+            meshwitness.record_collective(
+                "all_gather", ("dcn", "shard"), (4, 2), "int32", 32
+            )
+    snap = meshwitness.snapshot()
+    assert snap["violations"] == []
+    rows = snap["schedules"]["fix.step"]
+    assert len(rows) == 1 and rows[0]["count"] == 2
+    assert [e["name"] for e in rows[0]["entries"]] == ["psum", "all_gather"]
+    assert rows[0]["entries"][1]["axis"] == "dcn+shard"
+    assert snap["counts"] == {"psum": 2, "all_gather": 2}
+    assert snap["bytes"] == {"psum": 32, "all_gather": 64}
+    # a DIFFERENT sequence for the same region is kept separately
+    with meshwitness.region("fix.step"):
+        meshwitness.record_collective("psum", "shard", (4,), "int32", 16)
+    assert len(meshwitness.snapshot()["schedules"]["fix.step"]) == 2
+    meshwitness.reset()
+
+
+def test_mesh_witness_flags_unscheduled_collective(tmp_path, monkeypatch):
+    from sctools_tpu.analysis import meshwitness
+
+    schedule = tmp_path / "schedule.json"
+    schedule.write_text(json.dumps({"collectives": [["psum", "shard"]]}))
+    monkeypatch.setenv(meshwitness.ENV_FLAG, "1")
+    monkeypatch.setenv(meshwitness.ENV_SCHEDULE, str(schedule))
+    meshwitness.reset()
+    with meshwitness.region("fix.step"):
+        meshwitness.record_collective("psum", "shard", (4,), "int32", 16)
+        meshwitness.record_collective("ppermute", "shard", (4,), "int32", 16)
+    kinds = [v["kind"] for v in meshwitness.violations()]
+    assert kinds == ["unscheduled-collective"]
+    meshwitness.reset()
+
+
+def test_mesh_witness_flags_outside_region(monkeypatch):
+    from sctools_tpu.analysis import meshwitness
+
+    monkeypatch.setenv(meshwitness.ENV_FLAG, "1")
+    monkeypatch.delenv(meshwitness.ENV_SCHEDULE, raising=False)
+    meshwitness.reset()
+    meshwitness.record_collective("psum", "shard", (4,), "int32", 16)
+    kinds = [v["kind"] for v in meshwitness.violations()]
+    assert kinds == ["outside-region"]
+    meshwitness.reset()
+
+
+def test_mesh_witness_dump_roundtrip(tmp_path, monkeypatch):
+    from sctools_tpu.analysis import meshwitness
+
+    monkeypatch.setenv(meshwitness.ENV_FLAG, "1")
+    monkeypatch.delenv(meshwitness.ENV_SCHEDULE, raising=False)
+    meshwitness.reset()
+    with meshwitness.region("fix.step"):
+        meshwitness.record_collective("psum", "shard", (8,), "float32", 32)
+    dest = tmp_path / "mesh.p0.json"
+    assert meshwitness.dump(str(dest)) == str(dest)
+    loaded = meshwitness.load_dumps(str(tmp_path))
+    assert set(loaded) == {"p0"}
+    assert loaded["p0"]["counts"] == {"psum": 1}
+    assert loaded["p0"]["violations"] == []
+    meshwitness.reset()

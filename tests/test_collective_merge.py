@@ -1,0 +1,298 @@
+"""scx-mesh acting half: the on-device collective merge.
+
+The byte-identity contracts of metrics/collective.py — the collective
+paths must reproduce their file-level twins exactly (decompressed
+bytes), because the merge is pure data movement (cells), an exact
+integer reduction plus a host-replayed float64 fold (genes), or a
+canonical-text round-trip (gatherer parts). Plus the refusal paths: the
+collective mergers must refuse loudly rather than silently rewrite
+non-canonical input, and the runtime collective-schedule witness must
+see the merge's psum/all_gather inside its shard_map regions.
+"""
+
+import glob
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sctools_tpu.metrics.collective import (
+    CollectiveMergeCellMetrics,
+    CollectiveMergeGeneMetrics,
+    collective_merge_parts,
+)
+from sctools_tpu.metrics.merge import MergeCellMetrics, MergeGeneMetrics
+from sctools_tpu.metrics.writer import MetricCSVWriter
+from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _gz_bytes(path: str) -> bytes:
+    with gzip.open(path, "rb") as f:
+        return f.read()
+
+
+def _cell_csv(path, names, seed):
+    rng = np.random.default_rng(seed)
+    frame = pd.DataFrame(
+        {
+            "n_reads": rng.integers(0, 100, len(names)),
+            "quality_mean": rng.random(len(names)) * 40,
+        },
+        index=pd.Index(list(names)),
+    )
+    frame.to_csv(path, compression="gzip")
+
+
+def _gene_csv(path, names, seed):
+    rng = np.random.default_rng(seed)
+    cols = {
+        c: rng.integers(1, 50, len(names))
+        for c in MergeGeneMetrics.COUNT_COLUMNS_TO_SUM
+    }
+    for c in MergeGeneMetrics.READ_WEIGHTED_COLUMNS:
+        cols[c] = rng.random(len(names))
+    pd.DataFrame(cols, index=pd.Index(list(names))).to_csv(
+        path, compression="gzip"
+    )
+
+
+def test_cell_merge_byte_identical_to_legacy(tmp_path):
+    f1, f2 = str(tmp_path / "a.csv.gz"), str(tmp_path / "b.csv.gz")
+    _cell_csv(f1, ["AAA", "CCC"], 1)
+    _cell_csv(f2, ["GGG", "TTT"], 2)
+    legacy, coll = str(tmp_path / "legacy"), str(tmp_path / "coll")
+    MergeCellMetrics([f1, f2], legacy).execute()
+    CollectiveMergeCellMetrics([f1, f2], coll).execute()
+    assert _gz_bytes(legacy + ".csv.gz") == _gz_bytes(coll + ".csv.gz")
+
+
+def test_cell_merge_matches_mixed_dtype_upcast(tmp_path):
+    # one part's column parses int, the other float: pd.concat upcasts;
+    # the collective path must apply the identical cast before encoding
+    f1, f2 = str(tmp_path / "a.csv.gz"), str(tmp_path / "b.csv.gz")
+    pd.DataFrame(
+        {"n_reads": [3, 4]}, index=pd.Index(["AAA", "CCC"])
+    ).to_csv(f1, compression="gzip")
+    pd.DataFrame(
+        {"n_reads": [1.5, np.nan]}, index=pd.Index(["GGG", "TTT"])
+    ).to_csv(f2, compression="gzip")
+    legacy, coll = str(tmp_path / "legacy"), str(tmp_path / "coll")
+    MergeCellMetrics([f1, f2], legacy).execute()
+    CollectiveMergeCellMetrics([f1, f2], coll).execute()
+    assert _gz_bytes(legacy + ".csv.gz") == _gz_bytes(coll + ".csv.gz")
+
+
+def test_gene_merge_byte_identical_with_collisions(tmp_path):
+    # overlapping genes across three inputs: the real reduction case —
+    # device psum owns the count columns, the host fold the moments
+    files = []
+    for index, (names, seed) in enumerate(
+        [(["ACT", "TUB", "GAP"], 3), (["TUB", "MYC"], 4),
+         (["ACT", "MYC", "ZZZ"], 5)]
+    ):
+        path = str(tmp_path / f"g{index}.csv.gz")
+        _gene_csv(path, names, seed)
+        files.append(path)
+    legacy, coll = str(tmp_path / "legacy"), str(tmp_path / "coll")
+    MergeGeneMetrics(files, legacy).execute()
+    CollectiveMergeGeneMetrics(files, coll).execute()
+    assert _gz_bytes(legacy + ".csv.gz") == _gz_bytes(coll + ".csv.gz")
+
+
+def test_gene_merge_refuses_int32_overflow(tmp_path):
+    path = str(tmp_path / "big.csv.gz")
+    cols = {c: [1] for c in MergeGeneMetrics.COUNT_COLUMNS_TO_SUM}
+    cols["n_reads"] = [2**33]
+    for c in MergeGeneMetrics.READ_WEIGHTED_COLUMNS:
+        cols[c] = [0.5]
+    pd.DataFrame(cols, index=pd.Index(["ACT"])).to_csv(
+        path, compression="gzip"
+    )
+    with pytest.raises(ValueError, match="int32"):
+        CollectiveMergeGeneMetrics(
+            [path, path], str(tmp_path / "out")
+        ).execute()
+
+
+def _make_part(tmp_path, index, names, seed):
+    writer = MetricCSVWriter(str(tmp_path / f"metrics.part{index:04d}"))
+    rng = np.random.default_rng(seed)
+    writer.write_header({"n_reads": 0, "quality_mean": 0.0})
+    writer.write_block(
+        sorted(names),
+        [
+            rng.integers(0, 1000, len(names)).astype(np.int64),
+            (rng.random(len(names)) * 37).astype(np.float64),
+        ],
+    )
+    writer.close()
+    return writer.filename
+
+
+def test_parts_merge_byte_identical_to_text_merge(tmp_path):
+    _make_part(tmp_path, 0, ["AAA", "CCC", "GGG"], 1)
+    _make_part(tmp_path, 1, ["ACG", "TTT"], 2)
+    _make_part(tmp_path, 2, ["CCA", "GTT", "TAC"], 3)
+    pattern = str(tmp_path / "metrics.part*.csv.gz")
+    legacy = str(tmp_path / "legacy.csv.gz")
+    coll = str(tmp_path / "coll.csv.gz")
+    n_legacy = merge_sorted_csv_parts(pattern, legacy)
+    n_coll = collective_merge_parts(pattern, coll)
+    assert n_legacy == n_coll == 8
+    assert _gz_bytes(legacy) == _gz_bytes(coll)
+
+
+def test_parts_merge_validates_sequence(tmp_path):
+    # the same gap check as the text merge: part 1 of {0, 2} missing
+    _make_part(tmp_path, 0, ["AAA"], 1)
+    _make_part(tmp_path, 2, ["CCC"], 2)
+    with pytest.raises(ValueError, match="gaps"):
+        collective_merge_parts(
+            str(tmp_path / "metrics.part*.csv.gz"),
+            str(tmp_path / "out.csv.gz"),
+        )
+
+
+def test_parts_merge_refuses_non_canonical_values(tmp_path):
+    # "007" parses to 7 and would re-render as "7": silent rewrite —
+    # the collective path must refuse and point at the text merger
+    path = tmp_path / "metrics.part0000.csv.gz"
+    with gzip.open(path, "wt") as f:
+        f.write(",n_reads\nAAA,007\n")
+    with pytest.raises(ValueError, match="non-canonical"):
+        collective_merge_parts(
+            str(tmp_path / "metrics.part*.csv.gz"),
+            str(tmp_path / "out.csv.gz"),
+        )
+
+
+def test_parts_merge_refuses_ragged_rows(tmp_path):
+    path = tmp_path / "metrics.part0000.csv.gz"
+    with gzip.open(path, "wt") as f:
+        f.write(",n_reads,quality_mean\nAAA,7\n")
+    with pytest.raises(ValueError, match="ragged"):
+        collective_merge_parts(
+            str(tmp_path / "metrics.part*.csv.gz"),
+            str(tmp_path / "out.csv.gz"),
+        )
+
+
+def test_merge_cli_devices_flag(tmp_path):
+    from sctools_tpu.platform import GenericPlatform
+
+    f1, f2 = str(tmp_path / "a.csv.gz"), str(tmp_path / "b.csv.gz")
+    _cell_csv(f1, ["AAA", "CCC"], 6)
+    _cell_csv(f2, ["GGG", "TTT"], 7)
+    single = str(tmp_path / "single")
+    sharded = str(tmp_path / "sharded")
+    assert GenericPlatform.merge_cell_metrics([f1, f2, "-o", single]) == 0
+    assert GenericPlatform.merge_cell_metrics(
+        [f1, f2, "-o", sharded, "--devices", "8"]
+    ) == 0
+    assert _gz_bytes(single + ".csv.gz") == _gz_bytes(sharded + ".csv.gz")
+
+
+def test_merge_records_collective_schedule(tmp_path):
+    # the merge's collectives must land in the runtime witness inside
+    # named shard_map regions and inside the static schedule — the live
+    # proof the mesh-smoke runs fleet-wide, exercised here in-process
+    # via a subprocess (the witness arms at import/trace time)
+    script = tmp_path / "drive.py"
+    script.write_text(
+        "import os, sys, json\n"
+        "import numpy as np\n"
+        "import pandas as pd\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from sctools_tpu.metrics.collective import (\n"
+        "    CollectiveMergeGeneMetrics,\n"
+        ")\n"
+        "from sctools_tpu.analysis import meshwitness\n"
+        "from sctools_tpu.metrics.merge import MergeGeneMetrics\n"
+        "tmp = sys.argv[1]\n"
+        "names = ['ACT', 'TUB']\n"
+        "cols = {}\n"
+        "for c in MergeGeneMetrics.COUNT_COLUMNS_TO_SUM:\n"
+        "    cols[c] = [2, 3]\n"
+        "for c in MergeGeneMetrics.READ_WEIGHTED_COLUMNS:\n"
+        "    cols[c] = [0.25, 0.5]\n"
+        "frame = pd.DataFrame(cols, index=pd.Index(names))\n"
+        "f1 = os.path.join(tmp, 'a.csv.gz')\n"
+        "frame.to_csv(f1, compression='gzip')\n"
+        "CollectiveMergeGeneMetrics(\n"
+        "    [f1, f1], os.path.join(tmp, 'out')\n"
+        ").execute()\n"
+        "snap = meshwitness.snapshot()\n"
+        "print(json.dumps({'counts': snap['counts'],\n"
+        "                  'violations': snap['violations'],\n"
+        "                  'regions': sorted(snap['schedules'])}))\n"
+    )
+    schedule = tmp_path / "schedule.json"
+    from sctools_tpu.analysis import build_collective_schedule
+
+    with open(schedule, "w") as f:
+        json.dump(
+            build_collective_schedule(
+                [os.path.join(REPO, "sctools_tpu")]
+            ),
+            f,
+        )
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        SCTOOLS_TPU_MESH_DEBUG="1",
+        SCTOOLS_TPU_MESH_SCHEDULE=str(schedule),
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    out = subprocess.run(
+        [sys.executable, str(script), str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["violations"] == []
+    assert payload["counts"].get("psum", 0) >= 1
+    assert payload["counts"].get("all_gather", 0) >= 1
+    assert any(
+        region.endswith("gather_and_reduce") for region in payload["regions"]
+    ), payload["regions"]
+
+
+def test_gene_merge_refuses_cross_shard_overflow(tmp_path):
+    # each per-shard partial fits int32; only their SUM overflows — the
+    # guard must check the cross-shard totals, not the shard partials
+    # (a wrapped psum would otherwise surface as a confusing
+    # device-vs-host assertion instead of the intended refusal)
+    cols = {c: [1] for c in MergeGeneMetrics.COUNT_COLUMNS_TO_SUM}
+    cols["n_reads"] = [1_500_000_000]  # < 2^31, but 8 copies sum past it
+    for c in MergeGeneMetrics.READ_WEIGHTED_COLUMNS:
+        cols[c] = [0.5]
+    path = str(tmp_path / "part.csv.gz")
+    pd.DataFrame(cols, index=pd.Index(["ACT"])).to_csv(
+        path, compression="gzip"
+    )
+    with pytest.raises(ValueError, match="int32"):
+        CollectiveMergeGeneMetrics(
+            [path] * 8, str(tmp_path / "out")
+        ).execute()
+
+
+def test_cell_merge_refuses_non_numeric_columns(tmp_path):
+    # bool renders True/False under pandas concat and 1/0 after an int
+    # cast — a silent byte-identity break; the collective path must
+    # refuse toward the file-level merger instead
+    f1 = str(tmp_path / "a.csv.gz")
+    pd.DataFrame(
+        {"n_reads": [3], "passed_qc": [True]}, index=pd.Index(["AAA"])
+    ).to_csv(f1, compression="gzip")
+    with pytest.raises(ValueError, match="non-numeric"):
+        CollectiveMergeCellMetrics(
+            [f1, f1], str(tmp_path / "out")
+        ).execute()

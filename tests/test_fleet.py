@@ -283,3 +283,33 @@ def test_summarize_cli_warns_on_torn_file(run_dir, capsys):
 def test_summarize_cli_missing_file_still_exits_2(tmp_path, capsys):
     assert obs_cli(["summarize", str(tmp_path / "absent.jsonl")]) == 2
     capsys.readouterr()
+
+
+def test_timeline_surfaces_collective_dumps(tmp_path):
+    # mesh.<worker>.json dumps under a run dir surface as per-worker
+    # collective rows in the fleet analysis + rendered timeline, and
+    # absence degrades to an empty section (no crash, no rows)
+    import json as _json
+
+    from sctools_tpu.obs.fleet import analyze, discover, render_timeline
+
+    run = discover(str(tmp_path))
+    empty = analyze(run)
+    assert empty["collectives"] == {}
+    with open(tmp_path / "mesh.p0.json", "w") as f:
+        _json.dump(
+            {
+                "enabled": True,
+                "counts": {"all_to_all": 4},
+                "bytes": {"all_to_all": 4992},
+                "violations": [],
+            },
+            f,
+        )
+    run = discover(str(tmp_path))
+    analysis = analyze(run)
+    row = analysis["collectives"]["p0"]
+    assert row["issued"] == 4 and row["operand_bytes"] == 4992
+    rendered = render_timeline(run, analysis)
+    assert "collectives (mesh witness" in rendered
+    assert "all_to_all x4" in rendered

@@ -650,3 +650,49 @@ def test_writeback_ring_phase_code():
         assert ring.phase_code() == pulse.WB_PHASES["idle"]
     finally:
         ring.close()
+
+
+def test_mesh_aware_platform_fingerprint_and_multichip_trajectory(tmp_path):
+    # scx-mesh: the mesh shape (axis names + sizes) joins the
+    # comparability fingerprint — dryrun_multichip forces the host
+    # platform, so backend/device-kind alone cannot separate an 8-way
+    # mesh point from a 4-way one; dict-equality filtering then keeps
+    # topologies in separate trajectories
+    import json as _json
+
+    import jax
+
+    import bench
+
+    mesh = jax.sharding.Mesh(
+        __import__("numpy").asarray(jax.devices()[:4]), ("shard",)
+    )
+    fingerprint = bench._platform_fingerprint(mesh=mesh)
+    assert fingerprint["mesh"] == {"axes": ["shard"], "sizes": [4]}
+    assert "mesh" not in bench._platform_fingerprint()
+    # the MULTICHIP_r* family loads through the same trajectory reader
+    # via the pattern parameter, without polluting the BENCH_r* family
+    repo = str(tmp_path)
+    point = {
+        "parsed": {
+            "metric": "collective_merge_rows_per_sec",
+            "value": 1000.0,
+            "unit": "rows/s",
+            "platform": fingerprint,
+        }
+    }
+    with open(tmp_path / "MULTICHIP_r99.json", "w") as f:
+        _json.dump(point, f)
+    loaded = bench.load_trajectory(
+        repo, "collective_merge_rows_per_sec", pattern="MULTICHIP_r*.json"
+    )
+    assert len(loaded) == 1 and loaded[0]["platform"]["mesh"]["sizes"] == [4]
+    assert bench.load_trajectory(repo, "collective_merge_rows_per_sec") == []
+    # the committed r07 point carries the mesh-aware fingerprint
+    committed = bench.load_trajectory(
+        bench.REPO_DIR, "collective_merge_rows_per_sec",
+        pattern="MULTICHIP_r*.json",
+    )
+    assert committed and committed[0]["platform"]["mesh"] == {
+        "axes": ["shard"], "sizes": [8],
+    }

@@ -932,3 +932,41 @@ def test_sigterm_during_guarded_stall_keeps_lease_semantics(tmp_path):
         expected = f.read()
     with gzip.open(merged, "rb") as f:
         assert f.read() == expected
+
+
+def test_worker_mesh_announcement(tmp_path):
+    # the scx-mesh per-MESH worker notion: a WorkQueue given a mesh
+    # fingerprint announces it, replay ignores the meta event, and
+    # `sched status` renders one line per topology
+    import io
+
+    from sctools_tpu.sched import WorkQueue, make_task
+    from sctools_tpu.sched.cli import main as sched_cli
+
+    journal_dir = str(tmp_path / "journal")
+    fp = {
+        "axes": ["shard"], "sizes": [8], "devices": 8,
+        "device_kind": "cpu",
+    }
+    queue = WorkQueue(journal_dir, worker_id="meshed-0", mesh=fp)
+    queue.register([make_task("noop", "t0", {})])
+    queue.run(lambda task: None)
+    queue.close()
+    meta = queue.journal.worker_meta()
+    assert meta == {"meshed-0": {"mesh": fp}}
+    # replay folds ONLY task events: the announcement must not create a
+    # phantom task state
+    tasks, states = queue.journal.replay()
+    assert set(tasks) == set(states) and len(tasks) == 1
+    out = io.StringIO()
+    rc = sched_cli(["status", journal_dir], out=out)
+    text = out.getvalue()
+    assert rc == 0, text
+    assert "mesh shard=8 (cpu): 1 worker(s)" in text, text
+
+
+def test_worker_meta_empty_without_announcements(tmp_path):
+    from sctools_tpu.sched import Journal
+
+    journal = Journal(str(tmp_path / "journal"), worker_id="plain")
+    assert journal.worker_meta() == {}

@@ -659,3 +659,37 @@ def test_gatherer_compact_site_feeds_suggest(recording, tmp_path):
     padded_beyond_real = site["padded_rows"] > site["real_rows"]
     if padded_beyond_real:
         assert wasted > 0
+
+
+def test_efficiency_report_surfaces_collective_dumps(tmp_path):
+    # scx-mesh witness dumps ride the efficiency report: per-worker
+    # collective counts/bytes next to the transfer ledger, absent
+    # section when the run was not armed
+    import json as _json
+
+    from sctools_tpu.obs.xprof import efficiency_report, render_efficiency
+
+    report = efficiency_report(str(tmp_path))
+    assert report["collectives"] is None
+    for worker, count in (("p0", 3), ("p1", 3)):
+        with open(tmp_path / f"mesh.{worker}.json", "w") as f:
+            _json.dump(
+                {
+                    "enabled": True,
+                    "counts": {"psum": count, "all_gather": 1},
+                    "bytes": {"psum": 1024 * count, "all_gather": 2048},
+                    "violations": [],
+                    "schedules": {},
+                    "sequence": [],
+                },
+                f,
+            )
+    report = efficiency_report(str(tmp_path))
+    section = report["collectives"]
+    assert section["counts"] == {"psum": 6, "all_gather": 2}
+    assert section["bytes"]["psum"] == 6144
+    assert section["violations"] == 0
+    assert set(section["workers"]) == {"p0", "p1"}
+    rendered = render_efficiency(report)
+    assert "collectives (mesh witness" in rendered
+    assert "psum x6" in rendered

@@ -113,3 +113,82 @@ def check_frame_dumps(dump_dir, expect_dumps=None):
         "never engaged, so the run validated nothing"
     )
     return stamped
+
+
+def arm_mesh_witness(repo_root, workdir):
+    """Emit the static collective schedule and arm the scx-mesh witness.
+
+    Writes ``mesh_schedule.json`` under ``workdir`` and sets
+    ``SCTOOLS_TPU_MESH_DEBUG=1`` / ``SCTOOLS_TPU_MESH_SCHEDULE`` in
+    ``os.environ`` (worker ``launch()`` inherits it; the driver's own
+    in-process collectives are witnessed too). Returns the schedule dict
+    for the post-run subset check.
+    """
+    from sctools_tpu.analysis import build_collective_schedule
+
+    schedule = build_collective_schedule(
+        [os.path.join(repo_root, "sctools_tpu")]
+    )
+    schedule_path = os.path.join(workdir, "mesh_schedule.json")
+    with open(schedule_path, "w", encoding="utf-8") as f:
+        json.dump(schedule, f)
+    os.environ["SCTOOLS_TPU_MESH_DEBUG"] = "1"
+    os.environ["SCTOOLS_TPU_MESH_SCHEDULE"] = schedule_path
+    return schedule
+
+
+def check_mesh_dumps(dump_dir, schedule, expect_dumps=None):
+    """Validate every ``mesh.*.json`` dump under ``dump_dir``.
+
+    The witness must have engaged on EVERY worker (non-empty recorded
+    schedules), recorded zero violations, every observed (name, axis)
+    pair must sit inside the static schedule (axis "*" in the schedule
+    admits any axis — the parameter-forwarded case), every observed
+    region must be statically known, and — the SPMD-identity core of
+    the contract — every worker's per-region schedule map must be
+    IDENTICAL across the fleet: two workers disagreeing on a collective
+    sequence is exactly the divergence that deadlocks a real mesh.
+    Returns {worker: schedules} for further assertions.
+    """
+    mesh_dumps = sorted(glob.glob(os.path.join(dump_dir, "mesh.*.json")))
+    if expect_dumps is not None:
+        assert len(mesh_dumps) == expect_dumps, (
+            f"mesh witness dumps missing: {mesh_dumps}"
+        )
+    else:
+        assert mesh_dumps, f"no mesh-witness dump under {dump_dir}"
+    allowed_pairs = {tuple(p) for p in schedule["collectives"]}
+    known_regions = set(schedule["regions"]) | set(
+        schedule["computations"]
+    )
+    per_worker = {}
+    for dump_path in mesh_dumps:
+        with open(dump_path, encoding="utf-8") as f:
+            dump = json.load(f)
+        assert dump["enabled"], dump_path
+        assert dump["violations"] == [], (dump_path, dump["violations"])
+        assert dump["schedules"], (
+            f"{dump_path}: worker recorded no collective schedule — the "
+            "run validated nothing"
+        )
+        for region, rows in dump["schedules"].items():
+            assert region in known_regions, (dump_path, region)
+            for row in rows:
+                for entry in row["entries"]:
+                    pair = (entry["name"], entry["axis"])
+                    wild = (entry["name"], "*")
+                    assert pair in allowed_pairs or wild in allowed_pairs, (
+                        dump_path, pair,
+                    )
+        worker = os.path.basename(dump_path)[len("mesh."):-len(".json")]
+        per_worker[worker] = dump["schedules"]
+    reference = None
+    for worker, schedules in sorted(per_worker.items()):
+        if reference is None:
+            reference = (worker, schedules)
+            continue
+        assert schedules == reference[1], (
+            "cross-worker collective schedules DIVERGE — this is the "
+            f"mesh-deadlock bug class: {reference[0]} vs {worker}"
+        )
+    return per_worker
