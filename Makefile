@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck aotcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke perf-gate docs clean
 
-ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke perf-gate
+ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -17,16 +17,17 @@ native:
 # + tsan.supp audit, sctools_tpu/analysis). Both must pass for `make ci`.
 # tests/ is style-checked but excluded from scx-lint: it hosts the
 # deliberately-bad fixture corpus and test-local jax.config setup.
-# --no-race --no-shard --no-life --no-cost --no-mesh: `make modelcheck`
-# owns the five whole-package passes (SCX4xx + SCX5xx + SCX6xx + SCX7xx
-# + SCX8xx, same path set), so ci builds the package model exactly once.
+# --no-race --no-shard --no-life --no-cost --no-mesh --no-aot: `make
+# modelcheck` owns the six whole-package passes (SCX4xx + SCX5xx +
+# SCX6xx + SCX7xx + SCX8xx + SCX9xx, same path set), so ci builds the
+# package model exactly once.
 lint:
 	@if $(PY) -c "import ruff" 2>/dev/null; then \
 		$(PY) -m ruff check sctools_tpu tests bench.py __graft_entry__.py; \
 	else \
 		$(PY) -m compileall -q sctools_tpu tests bench.py __graft_entry__.py; \
 	fi
-	$(PY) -m sctools_tpu.analysis --no-race --no-shard --no-life --no-cost --no-mesh sctools_tpu bench.py __graft_entry__.py
+	$(PY) -m sctools_tpu.analysis --no-race --no-shard --no-life --no-cost --no-mesh --no-aot sctools_tpu bench.py __graft_entry__.py
 
 # concurrency gate: the scx-race pass (SCX401-404) on its own — lock
 # inventory, acquisition-order cycles, death-path safety, cross-thread
@@ -85,13 +86,25 @@ costcheck:
 meshcheck:
 	$(PY) -m sctools_tpu.analysis --mesh-only sctools_tpu bench.py __graft_entry__.py
 
-# the ci shape of racecheck+shardcheck+lifecheck+costcheck+meshcheck:
-# all five whole-package passes in ONE process (the *-only flags
-# compose), so the package parses once (analysis/astcache — and at most
-# once across processes too: the parse cache persists content-hash-keyed
-# under .scx_cache/) for all five gates
+# AOT dispatch-closure gate: the scx-aot pass (SCX901-905) on its own —
+# every jit dispatch reachable from a @serve_entry closed under the
+# shape contract, no request-path compiles / host state / lazy work /
+# unbounded admission — PLUS the manifest staleness guard: the committed
+# sctools_tpu/serve/aot_manifest.json must hash to the freshly derived
+# shape contract, or the precompiled executable set no longer matches
+# the code being served (regenerate with --emit-aot-manifest;
+# docs/serving.md).
+aotcheck:
+	$(PY) -m sctools_tpu.analysis --aot-only --aot-manifest sctools_tpu/serve/aot_manifest.json sctools_tpu bench.py __graft_entry__.py
+
+# the ci shape of racecheck+shardcheck+lifecheck+costcheck+meshcheck+
+# aotcheck: all six whole-package passes in ONE process (the *-only
+# flags compose), so the package parses once (analysis/astcache — and at
+# most once across processes too: the parse cache persists content-hash-
+# keyed under .scx_cache/) for all six gates; the --aot-manifest
+# staleness guard rides the same process
 modelcheck:
-	$(PY) -m sctools_tpu.analysis --race-only --shard-only --life-only --cost-only --mesh-only sctools_tpu bench.py __graft_entry__.py
+	$(PY) -m sctools_tpu.analysis --race-only --shard-only --life-only --cost-only --mesh-only --aot-only --aot-manifest sctools_tpu/serve/aot_manifest.json sctools_tpu bench.py __graft_entry__.py
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -205,6 +218,19 @@ mesh-smoke:
 	rm -rf /tmp/sctools_tpu_mesh_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_MESH_SMOKE_DIR=/tmp/sctools_tpu_mesh_smoke \
 	$(PY) tests/mesh_smoke.py
+
+# resident-serving gate: two serve workers (warmed from the committed
+# AOT manifest, persistent executable cache) drain a multi-tenant
+# journal under continuous cross-tenant packing; one worker is
+# SIGTERM'd mid-job and a replacement spawned — zero lost jobs, every
+# per-tenant CSV byte-identical to a solo reference run, 0 retraces in
+# the merged xprof registries, and every observed signature inside the
+# committed AOT manifest's contract (tests/serve_smoke.py;
+# docs/serving.md).
+serve-smoke:
+	rm -rf /tmp/sctools_tpu_serve_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_SERVE_SMOKE_DIR=/tmp/sctools_tpu_serve_smoke \
+	$(PY) tests/serve_smoke.py
 
 # perf-regression gate self-test: bench.py --check must fail a
 # synthetically-degraded result and pass a trajectory-consistent one

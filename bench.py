@@ -57,6 +57,16 @@ Prints ONE JSON line. Flags:
               measured pipeline bubble fraction (scx-pulse attribution
               over the timed runs' heartbeats) to <= 0.35
               (bubble_fraction gate, with the limiting stage named).
+  --serve     include the resident-serving scenario (docs/serving.md):
+              a cold replica (fresh AOT executable cache) and a warm one
+              (same cache, pre-populated by the cold run) each drain a
+              multi-tenant job set through `python -m sctools_tpu.serve
+              worker`; the JSON reports cold/warm time-to-first-result,
+              per-job service latency p50/p95, aggregate cells/sec over
+              the warm window, pack counts, lost jobs, and fleet-merged
+              retraces. --check then holds ttfr_speedup >= 5 (the AOT
+              cache must turn first-request compiles into disk loads),
+              lost_jobs == 0, and retraces == 0.
   --check-selftest  verify the gate's own semantics against synthetic
               degraded/healthy results and exit (cheap; `make ci` leg)
 """
@@ -68,7 +78,9 @@ import glob
 import json
 import os
 import statistics
+import subprocess
 import sys
+import tempfile
 
 from sctools_tpu import obs
 from sctools_tpu.obs import pulse, xprof
@@ -124,6 +136,21 @@ PULSE_OVERHEAD_CEILING = 1.02
 # gains real headroom the moment compute moves to actual device
 # hardware.
 BUBBLE_CEILING = 0.35
+# scx-aot serving floor: a warm replica (manifest-keyed persistent
+# executable cache populated) must reach its first committed result at
+# least 5x faster than a cold one (fresh cache, first request pays the
+# compiles) — below that, the AOT precompile plane isn't actually
+# carrying the serve path and residents are compiling on request
+SERVE_TTFR_SPEEDUP_FLOOR = 5.0
+
+# serving scenario workload: small per-tenant jobs so two fit one padded
+# record bucket (packing visible) and decode never dominates the
+# time-to-first-result the cold/warm comparison measures
+SERVE_TENANTS = 4
+SERVE_CELLS_PER_TENANT = 256
+SERVE_MOLECULES_PER_CELL = 4
+SERVE_READS_PER_MOLECULE = 2
+SERVE_BATCH_RECORDS = 4096  # the RECORD_BUCKET_MIN floor
 
 # device workload size
 N_CELLS = 1 << 16  # 65k cells
@@ -1069,6 +1096,155 @@ def bench_pulse_overhead(rounds: int = 3, calls: int = 80) -> dict:
     }
 
 
+def _percentile(values, q: float):
+    """Nearest-rank percentile of a small sample; None when empty."""
+    ordered = sorted(values)
+    if not ordered:
+        return None
+    index = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return ordered[index]
+
+
+def _serve_latencies(journal_dir: str):
+    """(per-job leased->committed latencies, serving window) from a journal."""
+    from sctools_tpu.sched import Journal
+
+    journal = Journal(journal_dir, worker_id="bench-probe")
+    try:
+        events = journal.events()
+    finally:
+        journal.close()
+    leased, committed = {}, {}
+    for event in events:
+        tid = event.get("id")
+        if not isinstance(tid, str) or "ts" not in event:
+            continue
+        if event.get("event") == "leased":
+            leased.setdefault(tid, float(event["ts"]))
+        elif event.get("event") == "committed":
+            committed.setdefault(tid, float(event["ts"]))
+    latencies = [
+        committed[tid] - leased[tid] for tid in committed if tid in leased
+    ]
+    window = (
+        max(committed.values()) - min(leased.values())
+        if committed and leased
+        else 0.0
+    )
+    return latencies, window
+
+
+def bench_serve() -> dict:
+    """The resident-serving scenario: cold vs warm replica over real workers.
+
+    Two `python -m sctools_tpu.serve worker` subprocesses drain identical
+    multi-tenant job sets. The cold replica starts with a FRESH AOT
+    executable cache, so its first committed result pays every compile;
+    the warm replica shares the now-populated cache, so the same
+    executables load from disk. Their reported time-to-first-result
+    (worker construction -> first commit, warmup included) is the
+    cold/warm comparison --check gates at >= 5x. Latency percentiles and
+    the aggregate cells/sec come from the warm journal's own event
+    timestamps; retraces come from the merged xprof registries of both
+    workers (must be 0: a resident that retraces compiles per request).
+    """
+    from sctools_tpu import native
+    from sctools_tpu.serve.api import ServeJob
+    from sctools_tpu.serve.cli import submit_jobs
+    from sctools_tpu.serve.manifest import DEFAULT_MANIFEST_PATH
+
+    workdir = tempfile.mkdtemp(prefix="sctools_tpu_bench_serve.")
+    os.makedirs(os.path.join(workdir, "obs"), exist_ok=True)
+    bams = []
+    for i in range(SERVE_TENANTS):
+        path = os.path.join(workdir, f"tenant{i:02d}.bam")
+        native.synth_bam_native(
+            path,
+            n_cells=SERVE_CELLS_PER_TENANT,
+            molecules_per_cell=SERVE_MOLECULES_PER_CELL,
+            reads_per_molecule=SERVE_READS_PER_MOLECULE,
+            n_genes=256,
+            seed=SYNTH_SEED + 100 + i,
+            compress_level=1,
+        )
+        bams.append(path)
+
+    def submit(phase: str) -> str:
+        out_dir = os.path.join(workdir, f"out_{phase}")
+        os.makedirs(out_dir, exist_ok=True)
+        journal_dir = os.path.join(workdir, f"journal-{phase}")
+        submit_jobs(
+            journal_dir,
+            [
+                ServeJob(
+                    f"tenant{i:02d}", bam,
+                    os.path.join(out_dir, f"tenant{i:02d}"),
+                )
+                for i, bam in enumerate(bams)
+            ],
+        )
+        return journal_dir
+
+    def run_worker(phase: str, journal_dir: str) -> dict:
+        env = dict(os.environ)
+        env["SCTOOLS_TPU_AOT_CACHE"] = os.path.join(workdir, "aot_cache")
+        env["SCTOOLS_TPU_TRACE"] = os.path.join(workdir, "obs")
+        env["SCTOOLS_TPU_TRACE_WORKER"] = phase
+        env.pop("SCTOOLS_TPU_FAULTS", None)
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "sctools_tpu.serve", "worker",
+                journal_dir, "--worker-id", phase, "--drain",
+                "--manifest", DEFAULT_MANIFEST_PATH,
+                "--idle-timeout", "120", "--poll-interval", "0.05",
+                "--batch-records", str(SERVE_BATCH_RECORDS),
+            ],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"bench --serve: {phase} worker failed "
+                f"(rc {proc.returncode}):\n{proc.stdout[-2000:]}"
+                f"\n{proc.stderr[-2000:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    cold = run_worker("cold", submit("cold"))
+    warm = run_worker("warm", submit("warm"))
+
+    latencies, window = _serve_latencies(
+        os.path.join(workdir, "journal-warm")
+    )
+    merged = xprof.merge_registries(xprof.load_registries(workdir))
+    retraces = sum(
+        int(site.get("retraces") or 0) for site in merged["sites"].values()
+    )
+    ttfr_cold = float(cold["first_result_s"])
+    ttfr_warm = float(warm["first_result_s"])
+    n_cells = SERVE_TENANTS * SERVE_CELLS_PER_TENANT
+    return {
+        "tenants": SERVE_TENANTS,
+        "jobs": 2 * SERVE_TENANTS,
+        "lost_jobs": (
+            2 * SERVE_TENANTS
+            - cold["jobs_committed"] - warm["jobs_committed"]
+        ),
+        "ttfr_cold_s": round(ttfr_cold, 3),
+        "ttfr_warm_s": round(ttfr_warm, 3),
+        "ttfr_speedup": round(ttfr_cold / max(ttfr_warm, 1e-9), 2),
+        "latency_p50_s": round(_percentile(latencies, 0.50) or 0.0, 3),
+        "latency_p95_s": round(_percentile(latencies, 0.95) or 0.0, 3),
+        "cells_per_sec": (
+            round(n_cells / window, 2) if window > 0 else None
+        ),
+        "packs_run": cold["packs_run"] + warm["packs_run"],
+        "packs_degraded": (
+            cold["packs_degraded"] + warm["packs_degraded"]
+        ),
+        "retraces": retraces,
+    }
+
+
 def _platform_fingerprint(mesh=None) -> dict:
     """The machine-enforced comparability key every result carries.
 
@@ -1349,6 +1525,34 @@ def check_result(
             ceiling=BUBBLE_CEILING,
             limiting_stage=result.get("limiting_stage"),
         )
+    # scx-aot serving gates, held whenever the result carries the serve
+    # scenario: the AOT executable cache must make a warm replica's
+    # first result at least 5x faster than a cold one's (otherwise the
+    # manifest precompile is not actually being served from), every
+    # submitted job must commit, and a resident that retraces is
+    # compiling per request — the exact failure mode scx-aot certifies
+    # against.
+    serve = result.get("serve")
+    if isinstance(serve, dict):
+        speedup = serve.get("ttfr_speedup")
+        if isinstance(speedup, (int, float)):
+            add(
+                "serve_ttfr_speedup",
+                speedup >= SERVE_TTFR_SPEEDUP_FLOOR,
+                value=speedup,
+                floor=SERVE_TTFR_SPEEDUP_FLOOR,
+                ttfr_cold_s=serve.get("ttfr_cold_s"),
+                ttfr_warm_s=serve.get("ttfr_warm_s"),
+            )
+        lost = serve.get("lost_jobs")
+        if isinstance(lost, int):
+            add("serve_lost_jobs", lost == 0, value=lost, floor=0)
+        serve_retraces = serve.get("retraces")
+        if isinstance(serve_retraces, int):
+            add(
+                "serve_retraces", serve_retraces == 0,
+                value=serve_retraces, floor=0,
+            )
     return verdict
 
 
@@ -1473,6 +1677,25 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         "metric": metric, "value": reference, "vs_baseline": 5.0,
         "bubble_fraction": 0.06, "limiting_stage": "compute",
     }
+    # scx-aot serving gates: a warm replica that barely beats cold means
+    # the AOT cache is not being served from; lost jobs and retracing
+    # residents are each independently fatal; the healthy shape passes
+    serve_cold_cache = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "serve": {"ttfr_speedup": 1.2, "lost_jobs": 0, "retraces": 0},
+    }
+    serve_lossy = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "serve": {"ttfr_speedup": 8.0, "lost_jobs": 1, "retraces": 0},
+    }
+    serve_retracing = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "serve": {"ttfr_speedup": 8.0, "lost_jobs": 0, "retraces": 3},
+    }
+    serve_healthy = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "serve": {"ttfr_speedup": 8.0, "lost_jobs": 0, "retraces": 0},
+    }
     # platform comparability: the fingerprints literally committed in
     # the trajectory files (BENCH_r02-r05 are axon points, r06 the
     # CPU-only container point)
@@ -1563,6 +1786,16 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         failures.append("bubble-bound pipeline (0.8) passed the gate")
     if not check_result(streaming, repo_dir)["ok"]:
         failures.append("well-overlapped pipeline (0.06) failed the gate")
+    if check_result(serve_cold_cache, repo_dir)["ok"]:
+        failures.append(
+            "serve result with a cold-cache-grade TTFR speedup (1.2) passed"
+        )
+    if check_result(serve_lossy, repo_dir)["ok"]:
+        failures.append("serve result that lost a job passed the gate")
+    if check_result(serve_retracing, repo_dir)["ok"]:
+        failures.append("retracing serve result passed the gate")
+    if not check_result(serve_healthy, repo_dir)["ok"]:
+        failures.append("healthy serve result failed the gate")
     if not check_result(cpu_result, repo_dir)["ok"]:
         failures.append(
             "same-platform-healthy CPU result failed the gate "
@@ -1596,6 +1829,7 @@ def main(argv=None):
     parser.add_argument("--sched", action="store_true")
     parser.add_argument("--ingest", action="store_true")
     parser.add_argument("--wire", action="store_true")
+    parser.add_argument("--serve", action="store_true")
     parser.add_argument("--check", action="store_true")
     parser.add_argument(
         "--result", metavar="FILE",
@@ -1693,6 +1927,8 @@ def main(argv=None):
         result["ingest"] = bench_ingest(bam_path)
     if args.wire:
         result["wire"] = bench_wire()
+    if args.serve:
+        result["serve"] = bench_serve()
     # always measured (cheap): the guard ladder's no-fault cost, the
     # frame witness's off-mode handout cost, and the pulse plane's
     # off-mode heartbeat cost ride the trajectory so --check can hold

@@ -49,6 +49,11 @@ MODULE_CLIS = (
         "sctools_tpu.sched.cli",
         ("status", "resume", "retry-quarantined"),
     ),
+    (
+        "python -m sctools_tpu.serve",
+        "sctools_tpu.serve.cli",
+        ("worker", "submit"),
+    ),
     ("python -m sctools_tpu.analysis", "sctools_tpu.analysis.cli", ()),
 )
 

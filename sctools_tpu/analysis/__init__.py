@@ -6,7 +6,7 @@ silent retraces, host-device syncs inside traced code, tracer leaks into
 Python control flow, and drift between the hand-written ctypes tables in
 ``native/__init__.py`` and the ``extern "C"`` sources they bind.
 
-Seven passes, one CLI (``python -m sctools_tpu.analysis``), all pure
+Nine passes, one CLI (``python -m sctools_tpu.analysis``), all pure
 stdlib — nothing here imports jax, numpy, or the code under analysis:
 
 - :mod:`.jaxlint`  — AST rules SCX101-SCX108 over traced functions;
@@ -46,16 +46,24 @@ stdlib — nothing here imports jax, numpy, or the code under analysis:
   mesh-smoke`` validates live: per-worker observed schedules must be
   identical across the fleet and inside the static schedule
   (``--emit-collective-schedule``) — the gate the on-device collective
-  merge (ROADMAP item 1) lands behind. Same shared parse.
+  merge (ROADMAP item 1) lands behind. Same shared parse;
+- :mod:`.aotcheck` — whole-package AOT dispatch-closure model (serve
+  entry roots, serve-reach call graph, jit-dispatch closure against the
+  shape contract, request-path compile/host-state/lazy-work/admission
+  discipline), rules SCX901-SCX905, paired with the AOT manifest
+  (``--emit-aot-manifest`` — the content-hashed certified dispatch
+  universe the build step precompiles and the resident serve workers
+  (:mod:`sctools_tpu.serve`) warm before admission; ``--aot-manifest``
+  is the staleness guard ``make aotcheck`` runs). Same shared parse.
 
 Findings carry stable rule ids and honor inline
 ``# scx-lint: disable=SCXNNN`` escape hatches (:mod:`.findings`).
 ``make lint`` runs the CLI after ruff/compileall, making a clean scx-lint
 run part of ``make ci`` mergeability; ``make racecheck`` / ``make
 shardcheck`` / ``make lifecheck`` / ``make costcheck`` / ``make
-meshcheck`` run the whole-package passes on their own, and ``make
-modelcheck`` (the ci leg) runs all five in one process over one shared
-parse.
+meshcheck`` / ``make aotcheck`` run the whole-package passes on their
+own, and ``make modelcheck`` (the ci leg) runs all six in one process
+over one shared parse.
 """
 
 # Re-exports resolve lazily (PEP 562): every library module imports
@@ -66,6 +74,11 @@ parse.
 _EXPORTS = {
     "ABI_RULES": "abicheck",
     "check_abi": "abicheck",
+    "AOT_RULES": "aotcheck",
+    "check_aot": "aotcheck",
+    "build_aot_manifest": "aotcheck",
+    "validate_manifest": "aotcheck",
+    "contract_hash": "aotcheck",
     "COST_RULES": "costcheck",
     "check_cost": "costcheck",
     "check_transfer_sites": "costcheck",
@@ -94,7 +107,7 @@ _EXPORTS = {
 }
 
 _SUBMODULES = frozenset(
-    {"abicheck", "astcache", "cli", "costcheck", "findings", "jaxlint",
+    {"abicheck", "aotcheck", "astcache", "cli", "costcheck", "findings", "jaxlint",
      "lifecheck", "meshcheck", "meshwitness", "racecheck", "retune",
      "shardcheck", "suppaudit", "witness"}
 )
@@ -119,6 +132,7 @@ def __getattr__(name):
 
 __all__ = [
     "ABI_RULES",
+    "AOT_RULES",
     "COST_RULES",
     "Finding",
     "JAX_RULES",
@@ -129,9 +143,11 @@ __all__ = [
     "SUPP_RULES",
     "Suppressions",
     "audit_suppressions",
+    "build_aot_manifest",
     "build_collective_schedule",
     "build_shape_contract",
     "check_abi",
+    "check_aot",
     "check_cost",
     "check_life",
     "check_mesh",
@@ -139,10 +155,12 @@ __all__ = [
     "check_shards",
     "check_signatures",
     "check_transfer_sites",
+    "contract_hash",
     "dim_admissible",
     "lint_file",
     "lock_graph",
     "make_lock",
     "make_rlock",
     "transfer_inventory",
+    "validate_manifest",
 ]

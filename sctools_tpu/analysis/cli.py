@@ -1,6 +1,6 @@
 """scx-lint CLI: ``python -m sctools_tpu.analysis [paths...]``.
 
-Runs eight passes and exits non-zero when any finding survives
+Runs nine passes and exits non-zero when any finding survives
 suppressions:
 
 1. JAX lint (SCX1xx) over every ``.py`` file under the given paths;
@@ -35,18 +35,25 @@ suppressions:
    meshcheck`` — and ``--emit-collective-schedule FILE`` writes the
    statically predicted collective universe the mesh smoke validates
    the per-worker runtime schedules against,
-   ``SCTOOLS_TPU_MESH_DEBUG=1``).
+   ``SCTOOLS_TPU_MESH_DEBUG=1``);
+9. AOT dispatch-closure check (SCX9xx) over the same model build
+   (``--aot-only`` runs just this pass — ``make aotcheck``;
+   ``--emit-aot-manifest FILE`` writes the certified (site, signature,
+   sharding) universe reachable from the ``@serve_entry`` roots, and
+   ``--aot-manifest FILE`` validates a committed manifest for
+   staleness against the freshly derived shape contract — the build
+   gate the resident serve workers trust, docs/serving.md).
 
 ``--json`` replaces the human-readable output with one machine-readable
 findings array covering every pass that ran (rule, path, line, message).
 
 The module imports nothing heavyweight (no jax, no numpy), so the gate
-adds milliseconds to ``make lint``. Passes 4-8 share one parse per file
+adds milliseconds to ``make lint``. Passes 4-9 share one parse per file
 through :mod:`.astcache` — in-process AND across invocations (the
 content-hash-keyed ``.scx_cache/`` store; the summary line reports
 parse-cache effectiveness) — so ``--race-only --shard-only --life-only
---cost-only --mesh-only`` style CI splits (``make modelcheck``) do not
-pay the package parse five times.
+--cost-only --mesh-only --aot-only`` style CI splits (``make
+modelcheck``) do not pay the package parse six times.
 """
 
 from __future__ import annotations
@@ -58,6 +65,12 @@ import sys
 from typing import List, Optional
 
 from .abicheck import ABI_RULES, check_abi
+from .aotcheck import (
+    AOT_RULES,
+    build_aot_manifest,
+    check_aot,
+    validate_manifest,
+)
 from .astcache import SKIP_DIRS as _SKIP_DIRS
 from .astcache import stats as _parse_stats
 from .costcheck import (
@@ -136,6 +149,7 @@ def _print_rules() -> None:
         ("frame lifetime / aliasing", LIFE_RULES),
         ("device cost / transfer discipline", COST_RULES),
         ("collective safety / SPMD divergence", MESH_RULES),
+        ("AOT dispatch closure / serving", AOT_RULES),
     ):
         print(f"  {title}:")
         for rule_id, slug in sorted(rules.items()):
@@ -209,6 +223,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="run ONLY the SCX8xx collective-safety pass (make meshcheck)",
     )
     parser.add_argument(
+        "--no-aot", action="store_true",
+        help="skip the SCX9xx AOT dispatch-closure pass",
+    )
+    parser.add_argument(
+        "--aot-only", action="store_true",
+        help="run ONLY the SCX9xx AOT dispatch-closure pass "
+        "(make aotcheck)",
+    )
+    parser.add_argument(
         "--emit-lock-graph", metavar="FILE", default=None,
         help="write the static lock inventory + acquisition-order graph "
         "as JSON (the SCTOOLS_TPU_LOCK_GRAPH contract file for the "
@@ -232,6 +255,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "(the SCTOOLS_TPU_MESH_SCHEDULE contract file the runtime "
         "collective-schedule witness and the mesh smoke validate "
         "per-worker observed schedules against) and exit",
+    )
+    parser.add_argument(
+        "--emit-aot-manifest", metavar="FILE", default=None,
+        help="write the certified AOT manifest as JSON (the content-"
+        "hashed (site, signature, sharding) universe reachable from "
+        "the @serve_entry roots; the build step precompiles it and "
+        "the resident serve workers load it) and exit",
+    )
+    parser.add_argument(
+        "--aot-manifest", metavar="FILE", default=None,
+        help="validate a committed AOT manifest: fail (exit 1) when its "
+        "embedded contract was hand-edited or its content hash drifted "
+        "from the freshly derived shape contract (the staleness guard "
+        "make aotcheck runs)",
     )
     parser.add_argument(
         "--retune", metavar="RUN_DIR", default=None,
@@ -336,6 +373,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         return 0
 
+    if args.emit_aot_manifest is not None:
+        manifest = build_aot_manifest(args.paths)
+        _dump_json(manifest, args.emit_aot_manifest)
+        if not args.quiet:
+            precompiled = sum(
+                1
+                for entry in manifest["sites"].values()
+                if entry["precompile"]
+            )
+            print(
+                f"scx-aot: wrote {len(manifest['sites'])} site(s) "
+                f"({precompiled} precompile, "
+                f"{len(manifest['serve_entries'])} serve entr(ies)), "
+                f"contract {manifest['contract_hash'][:12]}… to "
+                f"{args.emit_aot_manifest}"
+            )
+        return 0
+
     if args.retune is not None:
         from .retune import retune
 
@@ -350,11 +405,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     only_flags = (
         args.race_only or args.shard_only or args.life_only
-        or args.cost_only or args.mesh_only
+        or args.cost_only or args.mesh_only or args.aot_only
     )
     if only_flags:
         # the *-only flags compose: `--race-only --shard-only
-        # --life-only --cost-only --mesh-only` runs all five
+        # --life-only --cost-only --mesh-only --aot-only` runs all six
         # whole-package passes over ONE astcache model build (the `make
         # modelcheck` shape — one process, one parse per file)
         args.no_jax_lint = args.no_abi = args.no_supp = True
@@ -363,6 +418,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.no_life = not args.life_only
         args.no_cost = not args.cost_only
         args.no_mesh = not args.mesh_only
+        args.no_aot = not args.aot_only
 
     findings: List[Finding] = []
     checked_files = 0
@@ -401,6 +457,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings.extend(check_cost(args.paths))
     if not args.no_mesh:
         findings.extend(check_mesh(args.paths))
+    if not args.no_aot:
+        findings.extend(check_aot(args.paths))
+    manifest_stale = False
+    if args.aot_manifest is not None:
+        # the staleness guard (make aotcheck): a committed manifest whose
+        # contract drifted from the live tree would serve executables
+        # certified for code that no longer exists
+        try:
+            with open(args.aot_manifest, "r", encoding="utf-8") as f:
+                committed = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(
+                f"scx-aot: cannot read manifest {args.aot_manifest}: {exc}",
+                file=sys.stderr,
+            )
+            manifest_stale = True
+        else:
+            problems = validate_manifest(committed, args.paths)
+            for problem in problems:
+                print(f"scx-aot: {problem}", file=sys.stderr)
+            manifest_stale = bool(problems)
+            if not problems and not args.quiet:
+                print(
+                    f"scx-aot: manifest {args.aot_manifest} matches the "
+                    f"fresh shape contract "
+                    f"({str(committed.get('contract_hash', ''))[:12]}…)"
+                )
     if only_flags and not checked_files:
         from .racecheck import _collect_py_files as _race_files
 
@@ -426,7 +509,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             sort_keys=True,
         )
         print()
-        return 1 if findings else 0
+        return 1 if (findings or manifest_stale) else 0
     for finding in findings:
         print(finding.render())
     if not args.quiet:
@@ -441,6 +524,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 ("life", args.no_life),
                 ("cost", args.no_cost),
                 ("mesh", args.no_mesh),
+                ("aot", args.no_aot),
             )
             if not skipped
         ]
@@ -456,4 +540,4 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"python file(s); passes: {', '.join(passes) or 'none'}"
             + cache_note
         )
-    return 1 if findings else 0
+    return 1 if (findings or manifest_stale) else 0

@@ -359,6 +359,14 @@ class MetricGatherer:
 
     # ---- device backend --------------------------------------------------
 
+    def _make_writer(self) -> MetricCSVWriter:
+        """Build the device pass's output writer.
+
+        Overridable seam: the serve packer substitutes a router that splits
+        each result block back out to per-job CSVs by entity membership.
+        """
+        return MetricCSVWriter(self._output_stem, self._compress)
+
     def _extract_device(self, mode: str) -> None:
         """Streaming device pass: bounded host memory for any file size.
 
@@ -399,7 +407,7 @@ class MetricGatherer:
                 self._batch_records,
                 mode if mode != "rb" else None,
             )
-        out = MetricCSVWriter(self._output_stem, self._compress)
+        out = self._make_writer()
         # the writeback ring (scx-wire): each dispatched batch's compacted
         # result block starts its D2H at dispatch time and drains in FIFO
         # order in finalize; slot states ride flight records so a SIGTERM

@@ -51,6 +51,7 @@ deferred into the recording functions that need them.
 from __future__ import annotations
 
 import glob as _glob
+import hashlib
 import json
 import os
 import threading
@@ -213,6 +214,94 @@ def _leaf_sharding_tag(leaf) -> str:
     return "@(" + "+".join(axes) + ")"
 
 
+# ------------------------------------------------------- executable store
+# The serve plane's AOT executable store (docs/serving.md): serialized
+# exported modules living beside the persistent compilation cache. The
+# persistent cache alone cannot make a warm replica fast — its key is
+# derived from the lowered module, so every process still pays Python
+# tracing + MLIR lowering per signature before it can even ASK the cache.
+# The store indexes by (site, abstract signature) instead: the first
+# worker to compile a signature exports its StableHLO (``jax.export``)
+# into the store and precompiles the exported wrapper so the persistent
+# cache holds its executable too (the ISSUE's build step); every replica
+# after it deserializes the module and dispatches through it — no Python
+# tracing of the original function, and the wrapper's one backend
+# compile is a cache retrieval. Store entries are only ever produced by
+# this library's own build/serve steps in a trusted cache directory.
+_exec_store_dir: Optional[str] = None
+_exec_loaded: Dict[Tuple[str, str], Any] = {}  # (site, sig) -> jit wrapper
+_exec_failed: set = set()  # (site, sig) that failed load/call: use jit
+_exec_local: set = set()  # (site, sig) persisted here: keep jit dispatch
+_exec_stats = {"hits": 0, "loads": 0, "persists": 0, "fallbacks": 0}
+_AOT_MISS = object()
+
+
+def _export_module():
+    """The jax export module across the versions we ride on, or None."""
+    try:
+        from jax import export as module  # noqa: PLC0415
+
+        if hasattr(module, "export"):
+            return module
+    except Exception:  # noqa: BLE001 - probe the next location
+        pass
+    try:
+        from jax.experimental import export as module  # noqa: PLC0415
+
+        if hasattr(module, "export"):
+            return module
+    except Exception:  # noqa: BLE001 - probe the next location
+        pass
+    try:
+        from jax._src.export import _export as module  # noqa: PLC0415
+
+        return module
+    except Exception:  # noqa: BLE001 - no export support: store disabled
+        return None
+
+
+def enable_executable_store(path: str) -> None:
+    """Serve AOT dispatch: load/persist executables under ``path``.
+
+    Once enabled, every :func:`instrument_jit` site first consults the
+    store for its (site, signature) key — a hit dispatches the stored
+    executable with no tracing; a miss falls through to normal jit
+    dispatch and then serializes whatever that call compiled, so the
+    store converges to the live signature universe. Enabled by the serve
+    worker's warmup (before admission, per SCX904); batch paths never
+    turn it on.
+    """
+    global _exec_store_dir
+    os.makedirs(path, exist_ok=True)
+    with _lock:
+        _exec_store_dir = path
+
+
+def disable_executable_store() -> None:
+    """Drop back to plain jit dispatch (tests / non-serve embedders)."""
+    global _exec_store_dir
+    with _lock:
+        _exec_store_dir = None
+        _exec_loaded.clear()
+        _exec_failed.clear()
+        _exec_local.clear()
+
+
+def executable_store_dir() -> Optional[str]:
+    return _exec_store_dir
+
+
+def executable_store_stats() -> Dict[str, int]:
+    """Copy of the store counters (hits/loads/persists/fallbacks)."""
+    with _lock:
+        return dict(_exec_stats)
+
+
+def _exec_entry_path(store: str, site: str, sig: str) -> str:
+    digest = hashlib.sha256(f"{site}\x00{sig}".encode()).hexdigest()[:32]
+    return os.path.join(store, f"{digest}.jaxexec")
+
+
 class _InstrumentedJit:
     """A ``jax.jit`` callable with per-call-site registry accounting.
 
@@ -311,12 +400,194 @@ class _InstrumentedJit:
         except Exception:  # noqa: BLE001 - telemetry must never break the op
             return
 
+    def _aot_ready(self) -> bool:
+        """Store enabled and we are not inside someone else's trace."""
+        if _exec_store_dir is None:
+            return False
+        try:
+            import jax
+
+            return jax.core.trace_state_clean()
+        except Exception:  # noqa: BLE001 - store is opportunistic
+            return False
+
+    def _aot_load(self, sig: str):
+        """The store's jitted wrapper for this (site, sig), or None.
+
+        Deserializing parses the exported StableHLO — no Python tracing
+        of the original function — and the returned ``jit(exported.call)``
+        wrapper's single backend compile resolves through the persistent
+        cache (the persist step compiled the same module).
+        """
+        key = (self.site_name, sig)
+        wrapper = _exec_loaded.get(key)
+        if wrapper is not None:
+            return wrapper
+        if key in _exec_failed or key in _exec_local:
+            return None
+        store = _exec_store_dir
+        path = _exec_entry_path(store, self.site_name, sig)
+        if not os.path.exists(path):
+            return None
+        export_mod = _export_module()
+        if export_mod is None:
+            return None
+        try:
+            import jax
+
+            with open(path, "rb") as f:
+                blob = f.read()
+            exported = export_mod.deserialize(blob)
+            wrapper = jax.jit(exported.call)
+        except Exception:  # noqa: BLE001 - a bad entry must not break serve
+            with _lock:
+                _exec_failed.add(key)
+            return None
+        with _lock:
+            _exec_loaded[key] = wrapper
+            _exec_stats["loads"] += 1
+        return wrapper
+
+    def _aot_call(self, wrapper, sig: str, args, kwargs, enabled: bool):
+        """Dispatch a stored module; ``_AOT_MISS`` falls back to jit.
+
+        The module was exported from a live call with this same abstract
+        signature, so the call convention matches; anything that still
+        goes wrong (tree mismatch, backend refusal) marks the key failed
+        and re-dispatches through jit — correctness never depends on the
+        store. The wrapper's one-per-process backend compile (a
+        persistent-cache retrieval) attributes to this site through a
+        normal frame, pinned ``seen=False`` so materializing a stored
+        executable can never read as a retrace.
+        """
+        dynamic = {
+            k: v for k, v in kwargs.items() if k not in self._static_names
+        }
+        if enabled:
+            site = _site(self.site_name)
+            reg_sig = sig
+            with _lock:
+                site.calls += 1
+                if (
+                    reg_sig not in site.signatures
+                    and len(site.signatures) >= _MAX_SIGNATURES
+                ):
+                    reg_sig = SIGNATURE_OVERFLOW
+                site.signatures.setdefault(reg_sig, 0)
+                site.sig_calls[reg_sig] = site.sig_calls.get(reg_sig, 0) + 1
+                _exec_stats["hits"] += 1
+            frame = [self.site_name, reg_sig, False, 0]
+            frames = _active_frames()
+            frames.append(frame)
+            try:
+                out = wrapper(*args, **dynamic)
+            except Exception:  # noqa: BLE001 - fall back to the jit path
+                return self._aot_fail(sig)
+            finally:
+                frames.pop()
+            return out
+        with _lock:
+            _exec_stats["hits"] += 1
+        try:
+            return wrapper(*args, **dynamic)
+        except Exception:  # noqa: BLE001 - fall back to the jit path
+            return self._aot_fail(sig)
+
+    def _aot_fail(self, sig: str):
+        key = (self.site_name, sig)
+        with _lock:
+            _exec_failed.add(key)
+            _exec_loaded.pop(key, None)
+            _exec_stats["fallbacks"] += 1
+        return _AOT_MISS
+
+    def _aot_persist(self, sig: str, args, kwargs) -> None:
+        """Export this signature's module into the store and precompile.
+
+        Two legs, both on the build/cold path so later replicas never
+        pay them: (1) ``export`` re-traces the function once and the
+        serialized StableHLO lands in the store; (2) the deserialized
+        wrapper is lowered and compiled, which writes the wrapper's
+        executable into the persistent compilation cache — the entry a
+        warm replica's one wrapper compile retrieves. Best-effort: any
+        backend/export refusal degrades to plain jit dispatch.
+        """
+        key = (self.site_name, sig)
+        if key in _exec_loaded or key in _exec_failed or key in _exec_local:
+            return
+        store = _exec_store_dir
+        if store is None:
+            return
+        path = _exec_entry_path(store, self.site_name, sig)
+        if os.path.exists(path):
+            return
+        export_mod = _export_module()
+        if export_mod is None:
+            return
+        try:
+            import jax
+
+            dynamic = {
+                k: v
+                for k, v in kwargs.items()
+                if k not in self._static_names
+            }
+            # the probe's own trace/lower/compile emits monitoring
+            # events; without the gate they would read as phantom
+            # compiles in the registry this store exists to keep clean
+            _tls.ignore_events = True
+            try:
+                blob = export_mod.export(self._jit)(*args, **kwargs
+                                                    ).serialize()
+                wrapper = jax.jit(export_mod.deserialize(blob).call)
+                wrapper.lower(*args, **dynamic).compile()
+            finally:
+                _tls.ignore_events = False
+        except Exception:  # noqa: BLE001 - store stays best-effort
+            with _lock:
+                _exec_failed.add(key)
+            return
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return
+        with _lock:
+            # the origin process keeps its hot in-process jit cache;
+            # only OTHER replicas dispatch this signature via the store
+            _exec_local.add(key)
+            _exec_stats["persists"] += 1
+
     def __call__(self, *args, **kwargs):
-        if not _obs_enabled():
+        enabled = _obs_enabled()
+        aot = self._aot_ready()
+        if not enabled and not aot:
             return self._jit(*args, **kwargs)
-        _obs_install_jax_hooks()  # compile events route through observe_event
+        if enabled:
+            # compile events route through observe_event
+            _obs_install_jax_hooks()
         sig = self._signature(args, kwargs)
+        if aot:
+            compiled = self._aot_load(sig)
+            if compiled is not None:
+                out = self._aot_call(compiled, sig, args, kwargs, enabled)
+                if out is not _AOT_MISS:
+                    return out
+        if not enabled:
+            # store enabled, no stored executable, registry off: plain
+            # dispatch, then serialize whatever it compiled (the
+            # exists/failed guards make repeat calls a stat + a dict hit)
+            out = self._jit(*args, **kwargs)
+            self._aot_persist(sig, args, kwargs)
+            return out
         site = _site(self.site_name)
+        aot_sig = sig  # store key: never the overflow bucket
         with _lock:
             site.calls += 1
             if sig in site.signatures:
@@ -339,6 +610,8 @@ class _InstrumentedJit:
             frames.pop()
         if frame[3] and not seen:
             self._record_cost(site, sig, args, kwargs)
+        if aot and frame[3]:
+            self._aot_persist(aot_sig, args, kwargs)
         return out
 
     # AOT/introspection passthroughs so the wrapper stays drop-in

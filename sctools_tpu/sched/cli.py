@@ -84,6 +84,7 @@ def _status(journal_dir: str, out, journal: Optional[Journal] = None) -> int:
     summary = ", ".join(f"{k}={v}" for k, v in sorted(totals.items()))
     print(f"total={len(tasks)} ({summary})", file=out)
     _print_mesh_summary(journal, out)
+    _print_serve_summary(journal, tasks, states, out)
     _print_efficiency_summary(journal_dir, out)
     _print_pulse_summary(journal_dir, out)
     _print_quarantined_records(journal_dir, out)
@@ -120,6 +121,70 @@ def _print_mesh_summary(journal: Journal, out) -> None:
         print(
             f"mesh {shape}: {len(workers)} worker(s) — "
             f"{', '.join(workers)}",
+            file=out,
+        )
+
+
+def _print_serve_summary(journal: Journal, tasks, states, out) -> None:
+    """Per-tenant serve-plane view when the journal carries serve jobs.
+
+    One line per tenant (queued/running/committed/quarantined) plus one
+    admission line per resident worker that announced its
+    AdmissionController snapshot — the operator's answer to "who is
+    waiting, who is being starved, and how deep is each replica" without
+    leaving ``sched status``.
+    """
+    from ..serve.api import SERVE_TASK_KIND
+
+    per_tenant = {}
+    for tid in sorted(tasks, key=lambda t: tasks[t].name):
+        task = tasks[tid]
+        if task.kind != SERVE_TASK_KIND:
+            continue
+        tenant = str(task.payload.get("tenant", "?"))
+        st = states.get(tid)
+        state = st.state if st else "pending"
+        if state == COMMITTED:
+            bucket = "committed"
+        elif state == QUARANTINED:
+            bucket = "quarantined"
+        elif state == LEASED:
+            bucket = "running"
+        else:
+            bucket = "queued"
+        counts = per_tenant.setdefault(
+            tenant,
+            {"queued": 0, "running": 0, "committed": 0, "quarantined": 0},
+        )
+        counts[bucket] += 1
+    if not per_tenant:
+        return
+    for tenant, counts in sorted(per_tenant.items()):
+        line = (
+            f"serve tenant {tenant}: queued={counts['queued']} "
+            f"running={counts['running']} committed={counts['committed']}"
+        )
+        if counts["quarantined"]:
+            line += f" quarantined={counts['quarantined']}"
+        print(line, file=out)
+    try:
+        meta = journal.worker_meta()
+    except Exception:  # noqa: BLE001 - status must never die on telemetry
+        return
+    for worker, info in sorted(meta.items()):
+        serve = info.get("serve")
+        if not isinstance(serve, dict):
+            continue
+        in_flight = serve.get("in_flight") or {}
+        depth = sum(in_flight.values()) if in_flight else 0
+        detail = (
+            ", ".join(f"{t}={n}" for t, n in sorted(in_flight.items()))
+            or "idle"
+        )
+        warm = "warm" if info.get("warm") else "warming"
+        print(
+            f"serve admission {worker}: depth={depth} "
+            f"(max {serve.get('max_depth', '?')}/tenant) {detail} [{warm}]",
             file=out,
         )
 

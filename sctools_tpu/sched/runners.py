@@ -21,6 +21,10 @@ from .journal import Task
 
 RUNNERS: Dict[str, str] = {
     "cell_metrics": "sctools_tpu.parallel.launch:run_cell_metrics_task",
+    # serve jobs are normally drained by the resident engine
+    # (sctools_tpu.serve); this solo runner lets `sched resume` finish a
+    # serve journal on any host after the fleet is gone
+    "serve_cell_metrics": "sctools_tpu.serve.engine:run_serve_task",
 }
 
 

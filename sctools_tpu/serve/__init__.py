@@ -1,0 +1,61 @@
+"""sctools_tpu.serve: the AOT-precompiled resident serving plane.
+
+A long-lived, multi-tenant metrics service over the existing machinery:
+
+- **Queue/API** — jobs ride the scx-sched journal
+  (:data:`~sctools_tpu.serve.api.SERVE_TASK_KIND`); lease/steal/
+  quarantine give tenant isolation and crash recovery.
+- **AOT manifest** — scx-aot (``make aotcheck``) certifies the jit
+  dispatch universe reachable from the ``@serve_entry`` roots is closed
+  under the shape contract and writes it, content-hashed, to
+  ``sctools_tpu/serve/aot_manifest.json``; the build step precompiles
+  it against the persistent compilation cache.
+- **Warmup** — :class:`~sctools_tpu.serve.engine.ServeWorker` loads the
+  manifest, validates its hash, and warms every certified executable
+  (``@warmup_step``) before admitting work, so a fresh replica answers
+  its first request hot.
+- **Packing** — chunks from different tenants pack into the existing
+  padded record buckets (:mod:`~sctools_tpu.serve.packer`), occupancy
+  as the objective, per-tenant round-robin fairness + admission depth
+  on top (:class:`~sctools_tpu.serve.api.AdmissionController`).
+- **Dashboard** — scx-pulse's ``--serve PORT`` Prometheus endpoint.
+
+Lazy attribute exports keep ``import sctools_tpu.serve`` light (the
+engine pulls in jax; the api/manifest halves are stdlib-only).
+"""
+
+from typing import Any
+
+_EXPORTS = {
+    "AdmissionController": "api",
+    "DEFAULT_ADMISSION_DEPTH": "api",
+    "SERVE_TASK_KIND": "api",
+    "ServeJob": "api",
+    "group_open_jobs": "api",
+    "serve_entry": "api",
+    "warmup_step": "api",
+    "DEFAULT_MANIFEST_PATH": "manifest",
+    "aot_cache_dir": "manifest",
+    "load_manifest": "manifest",
+    "validate_loaded_manifest": "manifest",
+    "ServeWorker": "engine",
+    "run_serve_task": "engine",
+    "PackPlan": "packer",
+    "plan_packs": "packer",
+    "run_packed": "packer",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str) -> Any:
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module 'sctools_tpu.serve' has no {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{module}", __name__), name)
+
+
+def __dir__() -> list:
+    return sorted(set(globals()) | set(__all__))

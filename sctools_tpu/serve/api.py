@@ -1,0 +1,163 @@
+"""Serving-plane vocabulary: entry markers, jobs, admission control.
+
+This module is deliberately stdlib-only — it defines the *contract*
+between the resident serve worker (:mod:`.engine`) and the scx-aot
+static pass (:mod:`sctools_tpu.analysis.aotcheck`), not any device
+behaviour:
+
+- :func:`serve_entry` marks a function as a request-path root.  scx-aot
+  walks the call graph from every ``@serve_entry`` and enforces the
+  SCX901-905 closure rules over everything it reaches: every jit
+  dispatch bucketed under the shape contract, no compile-capable calls,
+  no per-request host state, no first-request lazy work, no unbounded
+  admission.
+- :func:`warmup_step` marks a function as replica warmup: it runs
+  before the worker accepts work, so compile-capable and
+  one-time-setup calls are *expected* there (SCX902/SCX904 exempt it).
+- :class:`AdmissionController` is the fairness/depth mechanism SCX905
+  checks for: per-tenant round-robin selection with a bounded
+  in-flight depth, so one tenant's backlog cannot starve the rest or
+  grow the packing loop without bound.
+
+The markers are honest runtime attributes (not comments), so tests and
+the engine can introspect them; the static pass recognizes the
+decorator *names* without importing this module.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, TypeVar
+
+F = TypeVar("F", bound=Callable[..., Any])
+
+#: journal task kind for one serve job (a per-tenant metrics request);
+#: registered in sched.runners so `sched resume` can drain a serve
+#: journal without the resident engine
+SERVE_TASK_KIND = "serve_cell_metrics"
+
+#: default per-tenant admission depth (jobs admitted into the packing
+#: loop at once); the SCX905-checked bound
+DEFAULT_ADMISSION_DEPTH = 4
+
+
+def serve_entry(fn: F) -> F:
+    """Mark ``fn`` as a serve request-path root (scx-aot entry point)."""
+    fn.__scx_serve_entry__ = True  # type: ignore[attr-defined]
+    return fn
+
+
+def warmup_step(fn: F) -> F:
+    """Mark ``fn`` as replica warmup (pre-admission; SCX902/904 exempt)."""
+    fn.__scx_warmup_step__ = True  # type: ignore[attr-defined]
+    return fn
+
+
+@dataclass(frozen=True)
+class ServeJob:
+    """One tenant request: a chunk of records in, one metrics part out.
+
+    Jobs ride the scx-sched journal (kind :data:`SERVE_TASK_KIND`) so
+    lease/steal/quarantine give tenant isolation and crash recovery for
+    free; the payload is exactly this record.
+    """
+
+    tenant: str
+    bam: str
+    out: str
+
+    def payload(self) -> Dict[str, Any]:
+        return {"tenant": self.tenant, "bam": self.bam, "out": self.out}
+
+    @staticmethod
+    def from_payload(payload: Dict[str, Any]) -> "ServeJob":
+        return ServeJob(
+            tenant=str(payload["tenant"]),
+            bam=str(payload["bam"]),
+            out=str(payload["out"]),
+        )
+
+
+@dataclass
+class AdmissionController:
+    """Per-tenant round-robin admission with a bounded depth.
+
+    ``admit(tenant)`` says whether one more job from ``tenant`` may
+    enter the packing loop; ``release(tenant)`` returns its slot.
+    ``select(queued_by_tenant)`` picks the next tenant round-robin among
+    those with queued work AND a free slot — a tenant with a deep
+    backlog gets exactly one turn per cycle, so admission stays fair
+    and the in-flight set stays bounded (the SCX905 property).
+    """
+
+    max_depth: int = DEFAULT_ADMISSION_DEPTH
+    _in_flight: Dict[str, int] = field(default_factory=dict)
+    _cursor: int = 0
+
+    def depth(self, tenant: str) -> int:
+        return self._in_flight.get(tenant, 0)
+
+    def admit(self, tenant: str) -> bool:
+        if self.depth(tenant) >= self.max_depth:
+            return False
+        self._in_flight[tenant] = self.depth(tenant) + 1
+        return True
+
+    def release(self, tenant: str) -> None:
+        current = self.depth(tenant)
+        if current <= 1:
+            self._in_flight.pop(tenant, None)
+        else:
+            self._in_flight[tenant] = current - 1
+
+    def select(
+        self, queued_by_tenant: Dict[str, Sequence[str]]
+    ) -> Optional[str]:
+        """Next admissible tenant, round-robin; None when all blocked."""
+        tenants = sorted(t for t, q in queued_by_tenant.items() if q)
+        if not tenants:
+            return None
+        start = self._cursor % len(tenants)
+        for offset in itertools.islice(range(len(tenants)), len(tenants)):
+            tenant = tenants[(start + offset) % len(tenants)]
+            if self.depth(tenant) < self.max_depth:
+                self._cursor = (start + offset + 1) % len(tenants)
+                return tenant
+        return None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Announced to the journal; `sched status` renders it."""
+        return {
+            "max_depth": self.max_depth,
+            "in_flight": dict(sorted(self._in_flight.items())),
+        }
+
+
+def group_open_jobs(
+    tasks: Dict[str, Any], states: Dict[str, Any], now: float
+) -> Dict[str, List[str]]:
+    """Claimable serve-task ids grouped by tenant, stable order per tenant.
+
+    A task is claimable when it is a serve job that is not terminal and
+    past any backoff deadline.  A journal state of ``leased`` does NOT
+    exclude it: the journal cannot see lease-file TTLs, so a dead
+    worker's jobs would never be stolen — whether a lease is actually
+    live is the broker's call (``acquire`` fails on live leases and
+    steals expired ones).  Duck-typed against sched's folded
+    ``TaskState`` (a missing state means never-touched, i.e. claimable)
+    so this module stays stdlib-only.
+    """
+    queued: Dict[str, List[str]] = {}
+    for tid in sorted(tasks, key=lambda t: tasks[t].name):
+        task = tasks[tid]
+        if task.kind != SERVE_TASK_KIND:
+            continue
+        state = states.get(tid)
+        if state is not None and (
+            state.terminal or state.not_before > now
+        ):
+            continue
+        tenant = str(task.payload.get("tenant", "?"))
+        queued.setdefault(tenant, []).append(tid)
+    return queued

@@ -1,0 +1,167 @@
+"""Serve-plane CLI: run resident workers and submit tenant jobs.
+
+``python -m sctools_tpu.serve <command>``:
+
+- ``worker <journal_dir>`` — run one resident replica: load + verify the
+  AOT manifest, warm the certified executable set (optionally tracing a
+  calibration BAM through the real gatherer so every executable is
+  resident), then serve until drained / idle / a job quota.  Exits with
+  a one-line JSON summary on stdout (jobs committed, time-to-first-
+  result, pack counts) that ``bench.py --serve`` and the serve smoke
+  parse.
+- ``submit <journal_dir> --job TENANT BAM OUT ...`` — register tenant
+  jobs in the journal (content-hashed ids: resubmitting the same job is
+  a no-op).  Submission is journal-only; any worker (or ``python -m
+  sctools_tpu.sched resume``) may pick the jobs up.
+
+The worker takes every knob as a flag — a resident process must not
+consult per-request host state (the SCX903 rule it is itself subject
+to), so configuration happens exactly once, here, at spawn.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .api import DEFAULT_ADMISSION_DEPTH, SERVE_TASK_KIND, ServeJob
+
+
+def submit_jobs(journal_dir: str, jobs: List[ServeJob]) -> int:
+    """Register jobs (idempotently) in the journal; returns the new count."""
+    from ..sched.journal import Journal, make_task
+
+    journal = Journal(journal_dir, worker_id="serve-submit")
+    try:
+        tasks = [
+            make_task(
+                SERVE_TASK_KIND,
+                f"{job.tenant}/{os.path.basename(job.out)}",
+                job.payload(),
+            )
+            for job in jobs
+        ]
+        return len(journal.register(tasks))
+    finally:
+        journal.close()
+
+
+def _cmd_worker(args, out) -> int:
+    from ..metrics.gatherer import DEFAULT_BATCH_RECORDS
+    from .engine import ServeWorker
+
+    with ServeWorker(
+        args.journal_dir,
+        worker_id=args.worker_id,
+        manifest_path=args.manifest,
+        max_depth=args.max_depth,
+        batch_records=args.batch_records or DEFAULT_BATCH_RECORDS,
+        compress=not args.no_compress,
+        lease_ttl=args.lease_ttl,
+        poll_interval=args.poll_interval,
+    ) as worker:
+        worker.warmup(calibration_bam=args.calibration_bam)
+        committed = worker.serve_forever(
+            max_jobs=args.max_jobs,
+            idle_timeout_s=args.idle_timeout,
+            drain=args.drain,
+        )
+        print(
+            json.dumps(
+                {
+                    "worker": worker.worker_id,
+                    "jobs_committed": committed,
+                    "first_result_s": worker.first_result_s,
+                    "packs_run": worker.packs_run,
+                    "packs_degraded": worker.packs_degraded,
+                }
+            ),
+            file=out,
+        )
+    return 0
+
+
+def _cmd_submit(args, out) -> int:
+    jobs = [
+        ServeJob(tenant=tenant, bam=bam, out=stem)
+        for tenant, bam, stem in args.job
+    ]
+    if not jobs:
+        print("submit: no --job TENANT BAM OUT given", file=sys.stderr)
+        return 2
+    fresh = submit_jobs(args.journal_dir, jobs)
+    print(
+        f"registered {fresh} new job(s) ({len(jobs) - fresh} already known)",
+        file=out,
+    )
+    return 0
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    parser = argparse.ArgumentParser(
+        prog="python -m sctools_tpu.serve",
+        description="AOT-precompiled resident serving plane",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    worker = sub.add_parser(
+        "worker", help="run one resident replica over a journal"
+    )
+    worker.add_argument("journal_dir")
+    worker.add_argument("--worker-id", default=None)
+    worker.add_argument(
+        "--manifest",
+        default=None,
+        help="AOT manifest path (default: the committed package manifest)",
+    )
+    worker.add_argument(
+        "--calibration-bam",
+        default=None,
+        help="warmup traces this BAM through the real gatherer so every "
+        "certified executable is resident before admission",
+    )
+    worker.add_argument("--max-jobs", type=int, default=None)
+    worker.add_argument(
+        "--idle-timeout",
+        type=float,
+        default=None,
+        help="exit after this many seconds with nothing claimable",
+    )
+    worker.add_argument(
+        "--drain",
+        action="store_true",
+        help="exit as soon as no open serve task remains",
+    )
+    worker.add_argument(
+        "--max-depth", type=int, default=DEFAULT_ADMISSION_DEPTH
+    )
+    worker.add_argument(
+        "--batch-records",
+        type=int,
+        default=None,
+        help="streaming batch size (bucket capacity for packing)",
+    )
+    worker.add_argument("--no-compress", action="store_true")
+    worker.add_argument("--lease-ttl", type=float, default=30.0)
+    worker.add_argument("--poll-interval", type=float, default=0.25)
+    worker.set_defaults(fn=_cmd_worker)
+
+    submit = sub.add_parser(
+        "submit", help="register tenant jobs in a serve journal"
+    )
+    submit.add_argument("journal_dir")
+    submit.add_argument(
+        "--job",
+        nargs=3,
+        metavar=("TENANT", "BAM", "OUT"),
+        action="append",
+        default=[],
+    )
+    submit.set_defaults(fn=_cmd_submit)
+
+    args = parser.parse_args(argv)
+    return args.fn(args, out)

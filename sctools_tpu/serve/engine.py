@@ -1,0 +1,395 @@
+"""The resident serve worker: warm once, then pack and commit forever.
+
+A :class:`ServeWorker` is a long-lived process over a scx-sched journal.
+Its life has exactly two phases, and the scx-aot pass (SCX901-905) holds
+the boundary:
+
+1. **Warmup** (``@warmup_step``, pre-admission): load the committed AOT
+   manifest, verify its content hash, point JAX at the manifest-keyed
+   persistent executable cache (:func:`~sctools_tpu.utils.cache.
+   enable_aot_cache`), and drive one calibration job through the real
+   gatherer so every certified executable is resident before the first
+   request — on a warm cache that is a disk read, not a compile.
+2. **Serving** (``@serve_entry``): replay the journal, admit claimable
+   jobs through the per-tenant round-robin
+   :class:`~sctools_tpu.serve.api.AdmissionController`, pack admitted
+   jobs across tenants into shared padded buckets
+   (:mod:`~sctools_tpu.serve.packer`), and run each pack under the same
+   lease/heartbeat/commit discipline as
+   :class:`~sctools_tpu.sched.scheduler.WorkQueue` — so SIGTERM'd
+   workers lose nothing (peers steal the expired leases and recompute),
+   and every artifact publishes atomically with a journaled sha256.
+
+The group runner mirrors WorkQueue's journal vocabulary event for event
+(``leased``/``committed``/``failed``/``quarantined``, full-jitter
+backoff, steal accounting) rather than wrapping ``WorkQueue.run``,
+because packing needs to hold N leases at once while WorkQueue drains
+strictly one task at a time.
+
+``run_serve_task`` is the solo escape hatch registered in
+:mod:`sctools_tpu.sched.runners`: ``python -m sctools_tpu.sched resume``
+can drain a serve journal one job at a time on a host with no resident
+engine at all.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import obs
+from ..obs import xprof
+from ..metrics.gatherer import DEFAULT_BATCH_RECORDS, GatherCellMetrics
+from ..sched import faults
+from ..sched.commit import sha256_file
+from ..sched.journal import Task, TaskState, wall_clock
+from ..sched.lease import LeaseLost
+from ..sched.scheduler import WorkQueue, backoff_delay
+from ..utils.cache import enable_aot_cache
+from .api import (
+    DEFAULT_ADMISSION_DEPTH,
+    SERVE_TASK_KIND,
+    AdmissionController,
+    ServeJob,
+    group_open_jobs,
+    serve_entry,
+    warmup_step,
+)
+from .manifest import (
+    DEFAULT_MANIFEST_PATH,
+    aot_cache_dir,
+    load_manifest,
+)
+from .packer import plan_packs, run_packed
+
+
+class ServeWorker:
+    """One resident replica: a warm executable set over a shared journal."""
+
+    def __init__(
+        self,
+        journal_dir: str,
+        worker_id: Optional[str] = None,
+        manifest_path: Optional[str] = None,
+        max_depth: int = DEFAULT_ADMISSION_DEPTH,
+        batch_records: int = DEFAULT_BATCH_RECORDS,
+        compress: bool = True,
+        lease_ttl: float = 30.0,
+        poll_interval: float = 0.25,
+    ):
+        self._queue = WorkQueue(
+            journal_dir,
+            worker_id,
+            lease_ttl=lease_ttl,
+            poll_interval=poll_interval,
+        )
+        self._admission = AdmissionController(max_depth=max_depth)
+        self._manifest_path = manifest_path or DEFAULT_MANIFEST_PATH
+        self._manifest: Optional[Dict] = None
+        self._batch_records = batch_records
+        self._compress = compress
+        self._warm = False
+        self._started = time.perf_counter()
+        #: seconds from worker construction to the first committed result
+        #: (the cold-replica time-to-first-result bench.py --serve reads)
+        self.first_result_s: Optional[float] = None
+        self.jobs_committed = 0
+        self.packs_run = 0
+        self.packs_degraded = 0
+
+    @property
+    def worker_id(self) -> str:
+        return self._queue.worker_id
+
+    @property
+    def manifest(self) -> Optional[Dict]:
+        return self._manifest
+
+    # ------------------------------------------------------------ warmup
+
+    @warmup_step
+    def warmup(self, calibration_bam: Optional[str] = None) -> None:
+        """Load + verify the manifest, wire the AOT cache, warm the set.
+
+        Runs BEFORE admission (the SCX902/904 boundary): everything
+        compile-capable or lazily-initialized happens here.  The
+        calibration job goes through the real gatherer with the real
+        batch_records, so it traces the exact bucketed signatures the
+        manifest certifies — on a warm persistent cache every one loads
+        from disk instead of compiling.
+        """
+        manifest = load_manifest(self._manifest_path)
+        self._manifest = manifest
+        cache_dir = aot_cache_dir(manifest, self._manifest_path)
+        enable_aot_cache(cache_dir)
+        # the executable store (docs/serving.md): dispatch serialized
+        # executables directly, skipping per-process tracing — the first
+        # replica to compile a signature persists it for the fleet
+        xprof.enable_executable_store(os.path.join(cache_dir, "exec"))
+        if calibration_bam:
+            with tempfile.TemporaryDirectory(prefix="serve-warm-") as tmp:
+                stem = os.path.join(tmp, "calibration")
+                gatherer = GatherCellMetrics(
+                    calibration_bam,
+                    stem,
+                    compress=self._compress,
+                    batch_records=self._batch_records,
+                )
+                gatherer.extract_metrics()
+        self._warm = True
+        self._queue.journal.announce_worker(
+            {"serve": self._admission.snapshot(), "warm": True}
+        )
+
+    # ----------------------------------------------------------- serving
+
+    @serve_entry
+    def serve_forever(
+        self,
+        max_jobs: Optional[int] = None,
+        idle_timeout_s: Optional[float] = None,
+        drain: bool = False,
+    ) -> int:
+        """Admit, pack, run, commit — until told (or drained) to stop.
+
+        ``max_jobs`` stops after N committed jobs; ``idle_timeout_s``
+        stops after that long with nothing claimable; ``drain`` stops as
+        soon as the journal holds no open serve task.  Returns the
+        number of jobs this worker committed.
+        """
+        if not self._warm:
+            raise RuntimeError(
+                "serve_forever before warmup(): replicas must warm the "
+                "certified executable set before admitting work"
+            )
+        journal = self._queue.journal
+        idle_since = time.perf_counter()
+        while True:
+            tasks, states = journal.replay()
+            queued = group_open_jobs(tasks, states, wall_clock())
+            group = self._admit_group(queued, tasks, states)
+            # `worked` counts tasks actually held under a lease — an
+            # admitted group whose leases are all live with a peer is
+            # idle time, not progress, and must hit the sleep below.
+            worked = self._run_group(group) if group else 0
+            if worked:
+                idle_since = time.perf_counter()
+                journal.announce_worker(
+                    {"serve": self._admission.snapshot(), "warm": True}
+                )
+            if max_jobs is not None and self.jobs_committed >= max_jobs:
+                break
+            if drain and not self._any_open(tasks, states):
+                break
+            if not worked:
+                if (
+                    idle_timeout_s is not None
+                    and time.perf_counter() - idle_since > idle_timeout_s
+                ):
+                    break
+                with obs.span("serve:wait"):
+                    time.sleep(self._queue.poll_interval)
+        return self.jobs_committed
+
+    def _any_open(self, tasks: Dict[str, Task], states) -> bool:
+        for tid, task in tasks.items():
+            if task.kind != SERVE_TASK_KIND:
+                continue
+            state = states.get(tid) or TaskState()
+            if not state.terminal:
+                return True
+        return False
+
+    def _admit_group(
+        self, queued: Dict[str, List[str]], tasks: Dict[str, Task], states
+    ) -> List[Tuple[str, ServeJob]]:
+        """Build one cross-tenant group under the admission bound.
+
+        Round-robin over tenants with claimable work: each `select` call
+        yields the next fair tenant with a free depth slot, and `admit`
+        takes the slot — so a tenant with a deep backlog contributes at
+        most ``max_depth`` jobs per group, however empty the others are.
+        """
+        queues = {tenant: list(ids) for tenant, ids in queued.items()}
+        group: List[Tuple[str, ServeJob]] = []
+        while True:
+            tenant = self._admission.select(queues)
+            if tenant is None or not self._admission.admit(tenant):
+                break
+            tid = queues[tenant].pop(0)
+            group.append((tid, ServeJob.from_payload(tasks[tid].payload)))
+        return group
+
+    # -------------------------------------------------------- group runs
+
+    def _heartbeat_all(self, leases, stop: threading.Event) -> None:
+        interval = max(self._queue.broker.ttl / 3.0, 0.05)
+        while not stop.wait(interval):
+            for tid, lease in list(leases.items()):
+                faults.fire("lease.renew", name=tid)
+                try:
+                    lease.renew()
+                except LeaseLost:
+                    obs.count("sched_lease_lost")
+                    leases.pop(tid, None)
+                except OSError:
+                    continue  # transient fs hiccup; the TTL absorbs a few
+
+    def _run_group(self, group: Sequence[Tuple[str, ServeJob]]) -> int:
+        """Lease, pack, run, and commit one admitted group.
+
+        Mirrors WorkQueue's discipline with N concurrent leases: acquire
+        each task's lease, re-replay under the leases (never recompute a
+        committed task, never bypass a racing peer's fresh backoff),
+        journal ``leased``, heartbeat every held lease, then run each
+        pack and journal per-task ``committed``/``failed``/
+        ``quarantined``.  A pack failure fails only its members.
+        Returns the number of tasks this call held a lease on (commits
+        AND journaled failures both count as forward progress).
+        """
+        journal = self._queue.journal
+        broker = self._queue.broker
+        leases: Dict[str, object] = {}
+        held: List[Tuple[str, ServeJob]] = []
+        for tid, job in group:
+            lease = broker.acquire(tid)
+            if lease is None:
+                self._admission.release(job.tenant)
+                continue
+            leases[tid] = lease
+            held.append((tid, job))
+        if not held:
+            return 0
+        _, fresh = journal.replay()
+        attempts: Dict[str, int] = {}
+        ready: List[Tuple[str, ServeJob]] = []
+        now = wall_clock()
+        for tid, job in held:
+            state = fresh.get(tid) or TaskState()
+            if state.terminal or state.not_before > now:
+                leases.pop(tid).release()
+                self._admission.release(job.tenant)
+                continue
+            attempts[tid] = state.attempts + 1
+            journal.record(
+                tid,
+                "leased",
+                attempt=attempts[tid],
+                stolen=int(leases[tid].stolen),
+            )
+            obs.count("sched_attempts")
+            ready.append((tid, job))
+        stop = threading.Event()
+        beat = threading.Thread(
+            target=self._heartbeat_all,
+            args=(leases, stop),
+            name="serve-heartbeat",
+            daemon=True,
+        )
+        beat.start()
+        try:
+            jobs = [job for _, job in ready]
+            tid_of = {id(job): tid for (tid, job) in ready}
+            for plan in plan_packs(jobs, self._batch_records):
+                members = [(tid_of[id(job)], job) for job in plan.jobs]
+                self._run_pack(journal, members, attempts)
+        finally:
+            stop.set()
+            beat.join(timeout=5.0)
+            for lease in leases.values():
+                lease.release()
+            for _, job in held:
+                self._admission.release(job.tenant)
+        return len(ready)
+
+    def _run_pack(
+        self,
+        journal,
+        members: Sequence[Tuple[str, ServeJob]],
+        attempts: Dict[str, int],
+    ) -> int:
+        for tid, _ in members:
+            faults.fire("task.claimed", name=tid)
+        try:
+            with obs.span(
+                "serve:pack",
+                jobs=len(members),
+                tenants=len({job.tenant for _, job in members}),
+            ):
+                artifacts, packed = run_packed(
+                    [job for _, job in members],
+                    compress=self._compress,
+                    batch_records=self._batch_records,
+                )
+        except Exception as error:  # noqa: BLE001 - every failure journals
+            self._fail_pack(journal, members, attempts, error)
+            return 0
+        self.packs_run += 1
+        if len(members) > 1 and not packed:
+            self.packs_degraded += 1
+        for (tid, _), artifact in zip(members, artifacts):
+            faults.fire("task.commit", name=tid)
+            journal.record(
+                tid,
+                "committed",
+                attempt=attempts[tid],
+                part=artifact,
+                sha256=sha256_file(artifact),
+            )
+            obs.count("sched_commits")
+            self.jobs_committed += 1
+            if self.first_result_s is None:
+                self.first_result_s = time.perf_counter() - self._started
+        return len(members)
+
+    def _fail_pack(
+        self,
+        journal,
+        members: Sequence[Tuple[str, ServeJob]],
+        attempts: Dict[str, int],
+        error: Exception,
+    ) -> None:
+        message = f"{type(error).__name__}: {error}"
+        _, states = journal.replay()
+        for tid, _ in members:
+            obs.count("sched_failures")
+            failures = (states.get(tid) or TaskState()).failures + 1
+            if failures >= self._queue.max_attempts:
+                journal.record(
+                    tid, "failed", attempt=attempts[tid], error=message
+                )
+                journal.record(tid, "quarantined", error=message)
+                obs.count("sched_quarantined")
+                continue
+            delay = backoff_delay(
+                failures,
+                self._queue.backoff_base,
+                self._queue.backoff_cap,
+                self._queue._rng,
+            )
+            journal.record(
+                tid,
+                "failed",
+                attempt=attempts[tid],
+                error=message,
+                not_before=round(wall_clock() + delay, 6),
+            )
+
+    def close(self) -> None:
+        self._queue.close()
+
+    def __enter__(self) -> "ServeWorker":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def run_serve_task(task: Task) -> Optional[str]:
+    """Solo runner for ``sched resume``: one serve job, no resident engine."""
+    job = ServeJob.from_payload(task.payload)
+    artifacts, _ = run_packed([job])
+    return artifacts[0]

@@ -1,0 +1,125 @@
+"""Runtime loader for the scx-aot manifest.
+
+The static half (:mod:`sctools_tpu.analysis.aotcheck`) certifies the jit
+dispatch universe reachable from the ``@serve_entry`` roots and writes it
+— content-hashed — via ``--emit-aot-manifest``.  This module is the thin
+runtime counterpart: a resident worker loads the committed manifest,
+checks its integrity (the embedded contract must hash to the recorded
+``contract_hash``; a hand-edited manifest is refused), and derives the
+AOT executable cache directory from that hash so a rebuilt contract can
+never serve a stale cache.
+
+Staleness against the *live tree* (fresh contract vs committed hash) is
+the build gate's job (``make aotcheck``), not the worker's: re-deriving
+the contract means parsing the whole package, which a serving process
+must not pay per boot.  The worker trusts what CI certified.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+DEFAULT_MANIFEST_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "aot_manifest.json"
+)
+
+MANIFEST_VERSION = 1  # mirrors analysis.aotcheck.MANIFEST_VERSION
+
+
+class ManifestError(RuntimeError):
+    """A manifest failed to load or failed its integrity check."""
+
+
+def _contract_hash(contract: Dict[str, Any]) -> str:
+    # same canonicalization as analysis.aotcheck.contract_hash; duplicated
+    # (3 lines) so the serve runtime never imports the analyzer package
+    canonical = json.dumps(contract, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canonical.encode("utf-8")).hexdigest()
+
+
+def load_manifest(path: Optional[str] = None) -> Dict[str, Any]:
+    """Load and integrity-check the committed AOT manifest.
+
+    Raises :class:`ManifestError` on a missing/unreadable file or any
+    integrity problem (see :func:`validate_loaded_manifest`).
+    """
+    path = path or DEFAULT_MANIFEST_PATH
+    try:
+        with open(path, "r", encoding="utf-8") as handle:
+            manifest = json.load(handle)
+    except (OSError, ValueError) as exc:
+        raise ManifestError(
+            f"cannot load AOT manifest {path!r}: {exc}; build one with "
+            f"python -m sctools_tpu.analysis --emit-aot-manifest"
+        ) from exc
+    problems = validate_loaded_manifest(manifest)
+    if problems:
+        raise ManifestError(
+            f"AOT manifest {path!r} failed integrity: " + "; ".join(problems)
+        )
+    return manifest
+
+
+def validate_loaded_manifest(manifest: Dict[str, Any]) -> List[str]:
+    """Integrity problems with an in-memory manifest (no tree parse).
+
+    Checks version, presence of the embedded contract + hash, and that
+    the embedded contract actually hashes to the recorded value.
+    """
+    problems: List[str] = []
+    version = manifest.get("version")
+    if version != MANIFEST_VERSION:
+        problems.append(f"manifest version {version!r} != {MANIFEST_VERSION}")
+    embedded = manifest.get("contract")
+    recorded = manifest.get("contract_hash")
+    if not isinstance(embedded, dict) or not recorded:
+        problems.append("manifest missing embedded contract or hash")
+        return problems
+    actual = _contract_hash(embedded)
+    if actual != recorded:
+        problems.append(
+            f"embedded contract hash mismatch (recorded {recorded[:12]}…, "
+            f"actual {actual[:12]}…)"
+        )
+    if not isinstance(manifest.get("sites"), dict):
+        problems.append("manifest missing sites table")
+    return problems
+
+
+def aot_cache_dir(
+    manifest: Dict[str, Any], manifest_path: Optional[str] = None
+) -> str:
+    """The AOT executable cache directory for a manifest.
+
+    ``SCTOOLS_TPU_AOT_CACHE`` overrides; default is a sibling of the
+    manifest file keyed by the contract hash, so replicas built from the
+    same certified contract share executables and a contract change
+    rolls the cache over instead of mixing generations.
+    """
+    env = os.environ.get("SCTOOLS_TPU_AOT_CACHE", "")
+    if env:
+        return env
+    manifest_path = manifest_path or DEFAULT_MANIFEST_PATH
+    digest = str(manifest.get("contract_hash", ""))[:12] or "unkeyed"
+    return os.path.join(
+        os.path.dirname(os.path.abspath(manifest_path)),
+        f".aot_cache-{digest}",
+    )
+
+
+def precompile_sites(manifest: Dict[str, Any]) -> List[str]:
+    """Names of sites the build step precompiles / the worker warms.
+
+    The ``precompile`` flag marks every site whose signature universe the
+    shape contract closes (dims bucketed) — the certified executable set.
+    ``serve_reachable`` is a narrowing annotation (statically provable
+    reach from a ``@serve_entry``), informational here: dynamic dispatch
+    through the gatherer reaches sites the static walk cannot resolve.
+    """
+    sites = manifest.get("sites", {})
+    return sorted(
+        name for name, entry in sites.items() if entry.get("precompile")
+    )

@@ -1,0 +1,301 @@
+"""Cross-tenant packing into the existing padded record buckets.
+
+The occupancy half of the serving plane.  Solo, every small job pads its
+records up to its own bucket (the RECORD_BUCKET_MIN floor), so a worker
+fed 1k-record requests runs the device at a few percent occupancy.  The
+packer concatenates chunks from *different tenants* into super-frames
+before they reach the gatherer's streaming loop, so two 1.5k-record jobs
+share one 4096 bucket instead of padding two — same executables, same
+shape contract, better fill.
+
+Three pieces:
+
+- :func:`plan_packs` — greedy first-fit-decreasing bin packing over
+  file-size record estimates; the objective is total padded records
+  (Σ ``bucket_size(pack)``), bounded by one dispatch per pack.
+- :class:`PackedCellMetrics` — a :class:`GatherCellMetrics` whose frame
+  source reads every member job's BAM in sequence and accumulates frames
+  into bucket-capacity super-frames, claiming each job's entity names
+  into a membership map as it goes.
+- ``_RouterWriter`` — the writer seam (``MetricGatherer._make_writer``):
+  result rows route back to per-job CSVs by entity membership, so a
+  packed run publishes byte-identical artifacts to solo runs (per-entity
+  metrics are independent of batch neighbours; jax segment reductions
+  don't mix entities).
+
+Packing is safe only when member jobs cannot share an entity: a barcode
+appearing in two jobs would silently merge into one row.  The frame
+source checks membership as it claims names and raises
+:class:`PackEntityCollision`; :func:`run_packed` then falls back to solo
+runs — slower, never wrong.  Same for header skew: member BAMs must
+agree on reference names (the wire ref column is header-coded).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from .. import ingest
+from ..io.packed import concat_frames, copy_frame
+from ..io.sam import AlignmentReader
+from ..metrics.gatherer import DEFAULT_BATCH_RECORDS, GatherCellMetrics
+from ..metrics.writer import MetricCSVWriter
+from ..ops.segments import bucket_size
+from .api import ServeJob
+
+#: rough compressed bytes per alignment record, for the planner's record
+#: estimate; only the packing heuristic depends on it, never correctness
+EST_RECORD_BYTES = 48
+
+
+class PackEntityCollision(RuntimeError):
+    """Two jobs in one pack claim the same entity (or skewed headers)."""
+
+
+def artifact_path(output_stem: str, compress: bool = True) -> str:
+    """The CSV path a job's writer will publish (no writer constructed)."""
+    suffix = ".csv.gz" if compress else ".csv"
+    if output_stem.endswith(suffix):
+        return output_stem
+    return output_stem + suffix
+
+
+@dataclass(frozen=True)
+class PackPlan:
+    """One packed dispatch group: jobs that share padded buckets."""
+
+    jobs: Tuple[ServeJob, ...]
+    estimated_records: int
+
+
+def estimate_records(bam: str) -> int:
+    """File-size record estimate (planning only; streaming never trusts it)."""
+    try:
+        size = os.path.getsize(bam)
+    except OSError:
+        size = 0
+    return max(1, size // EST_RECORD_BYTES)
+
+
+def plan_packs(
+    jobs: Sequence[ServeJob], batch_records: int = DEFAULT_BATCH_RECORDS
+) -> List[PackPlan]:
+    """Greedy occupancy packing: first-fit-decreasing into one-dispatch bins.
+
+    Capacity is ``bucket_size(batch_records)`` — a pack must fit one
+    streaming dispatch, so its records land in one padded bucket run.
+    Jobs inside a pack keep a deterministic (tenant, bam) order so the
+    packed record stream is reproducible run to run.
+    """
+    capacity = bucket_size(batch_records)
+    estimates = {id(job): estimate_records(job.bam) for job in jobs}
+    order = sorted(jobs, key=lambda j: (-estimates[id(j)], j.tenant, j.bam))
+    bins: List[List[ServeJob]] = []
+    totals: List[int] = []
+    for job in order:
+        est = min(estimates[id(job)], capacity)
+        for i, total in enumerate(totals):
+            if total + est <= capacity:
+                bins[i].append(job)
+                totals[i] += est
+                break
+        else:
+            bins.append([job])
+            totals.append(est)
+    plans = []
+    for members, total in zip(bins, totals):
+        members = sorted(members, key=lambda j: (j.tenant, j.bam))
+        plans.append(PackPlan(jobs=tuple(members), estimated_records=total))
+    plans.sort(key=lambda p: (p.jobs[0].tenant, p.jobs[0].bam))
+    return plans
+
+
+class _RouterWriter:
+    """Writer seam: split result blocks back out to per-job CSVs.
+
+    Duck-types the slice of :class:`MetricCSVWriter` the gatherer's
+    device path uses (``write_header`` / ``write_block`` / ``close`` /
+    ``discard``), fanning each call out by entity membership.  Every
+    per-job writer keeps the atomic inflight-then-publish commit, so a
+    pack killed mid-run publishes nothing for any member.
+    """
+
+    def __init__(
+        self,
+        jobs: Sequence[ServeJob],
+        membership: Dict[str, int],
+        compress: bool,
+    ):
+        self._writers = [MetricCSVWriter(job.out, compress) for job in jobs]
+        self._membership = membership
+
+    @property
+    def filenames(self) -> List[str]:
+        return [writer.filename for writer in self._writers]
+
+    def write_header(self, record) -> None:
+        for writer in self._writers:
+            writer.write_header(record)
+
+    def write_block(self, index, columns) -> None:
+        names = [str(name) for name in index]
+        owners = np.empty(len(names), dtype=np.int64)
+        for i, name in enumerate(names):
+            owner = self._membership.get(name)
+            if owner is None:
+                raise PackEntityCollision(
+                    f"result entity {name!r} claimed by no pack member"
+                )
+            owners[i] = owner
+        arrays = [np.asarray(column) for column in columns]
+        names_arr = np.asarray(names, dtype=object)
+        for j in range(len(self._writers)):
+            mask = owners == j
+            if mask.any():
+                self._writers[j].write_block(
+                    names_arr[mask], [column[mask] for column in arrays]
+                )
+
+    def close(self) -> None:
+        for writer in self._writers:
+            writer.close()
+
+    def discard(self) -> None:
+        for writer in self._writers:
+            writer.discard()
+
+
+class PackedCellMetrics(GatherCellMetrics):
+    """Cell metrics over a pack: many jobs, one streaming device run.
+
+    The frame source reads each member BAM through the ingest ring in
+    (tenant, bam) order, copies every frame off the recycled arena slot,
+    claims its entity names for the owning job, and accumulates frames
+    into bucket-capacity super-frames — that accumulation is what turns
+    N underfull buckets into one full one.  Output routes back to
+    per-job CSVs through ``_RouterWriter``.
+    """
+
+    def __init__(
+        self,
+        jobs: Sequence[ServeJob],
+        compress: bool = True,
+        batch_records: int = DEFAULT_BATCH_RECORDS,
+    ):
+        if not jobs:
+            raise ValueError("a pack needs at least one job")
+        self._jobs = list(jobs)
+        self._membership: Dict[str, int] = {}
+        self._router: _RouterWriter = None  # built in _make_writer
+        # largest member donates the header for wire-schema probing; the
+        # frame source separately refuses packs with skewed headers
+        primary = max(self._jobs, key=lambda j: estimate_records(j.bam))
+        super().__init__(
+            primary.bam,
+            primary.out,
+            compress=compress,
+            batch_records=batch_records,
+            frame_source=self._pack_frames,
+        )
+
+    @property
+    def artifacts(self) -> List[str]:
+        """Per-job published CSV paths, aligned with the job list."""
+        return [artifact_path(job.out, self._compress) for job in self._jobs]
+
+    def _make_writer(self) -> _RouterWriter:
+        self._router = _RouterWriter(
+            self._jobs, self._membership, self._compress
+        )
+        return self._router
+
+    def _check_headers(self) -> None:
+        references = None
+        for job in self._jobs:
+            with AlignmentReader(job.bam, None) as probe:
+                names = tuple(probe.header.references)
+            if references is None:
+                references = names
+            elif names != references:
+                raise PackEntityCollision(
+                    f"pack member {job.bam!r} has a different reference "
+                    f"set than its peers; refusing to mix header codings"
+                )
+
+    def _claim(self, owner: int, names: Sequence[str]) -> None:
+        membership = self._membership
+        for name in names:
+            rendered = "None" if name == "" else str(name)
+            prior = membership.get(rendered)
+            if prior is None:
+                membership[rendered] = owner
+            elif prior != owner:
+                raise PackEntityCollision(
+                    f"entity {rendered!r} appears in jobs for both "
+                    f"{self._jobs[prior].tenant!r} and "
+                    f"{self._jobs[owner].tenant!r}; packing would merge "
+                    f"their rows"
+                )
+
+    def _pack_frames(self):
+        if len(self._jobs) > 1:
+            self._check_headers()
+        capacity = bucket_size(self._batch_records)
+        acc = None
+        for owner, job in enumerate(self._jobs):
+            for frame in ingest.ring_frames(job.bam, self._batch_records):
+                # ring frames alias recycled arena slots; accumulation
+                # retains them past the ring window, so copy first
+                frame = copy_frame(frame)
+                self._claim(owner, frame.cell_names)
+                acc = frame if acc is None else concat_frames(acc, frame)
+                if acc.n_records >= capacity:
+                    yield acc
+                    acc = None
+        if acc is not None and acc.n_records:
+            yield acc
+
+
+def run_packed(
+    jobs: Sequence[ServeJob],
+    compress: bool = True,
+    batch_records: int = DEFAULT_BATCH_RECORDS,
+) -> Tuple[List[str], bool]:
+    """Run one pack; returns (per-job artifact paths, actually_packed).
+
+    On :class:`PackEntityCollision` (shared entities or skewed headers)
+    the pack degrades to per-job solo runs — the same artifacts, without
+    the shared buckets.  Collisions surface while streaming, before any
+    member publishes (atomic commit), so the fallback starts clean.
+    """
+    jobs = list(jobs)
+    # tenants submit output stems from another host; the directory is
+    # the worker's to materialize (a missing parent must not quarantine)
+    for job in jobs:
+        parent = os.path.dirname(artifact_path(job.out, compress))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+    if len(jobs) > 1:
+        gatherer = PackedCellMetrics(
+            jobs, compress=compress, batch_records=batch_records
+        )
+        try:
+            gatherer.extract_metrics()
+            return gatherer.artifacts, True
+        except PackEntityCollision:
+            pass  # degrade below; nothing was published
+    artifacts = []
+    for job in jobs:
+        solo = GatherCellMetrics(
+            job.bam,
+            job.out,
+            compress=compress,
+            batch_records=batch_records,
+        )
+        solo.extract_metrics()
+        artifacts.append(artifact_path(job.out, compress))
+    return artifacts, False

@@ -42,3 +42,26 @@ def enable_compilation_cache(path: str = "") -> None:
     jax.config.update(  # scx-lint: disable=SCX106 -- same policy as above
         "jax_persistent_cache_min_compile_time_secs", 0.5
     )
+
+
+def enable_aot_cache(path: str) -> None:
+    """Point JAX at the serve plane's AOT executable cache, unconditionally.
+
+    Unlike :func:`enable_compilation_cache` this overrides any prior cache
+    dir and drops the time/size floors: the AOT manifest's executables are
+    precompiled at build time and every one of them — however small — must
+    hit the cache so a fresh replica's warmup is a read, not a compile.
+    """
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    # scx-lint: disable=SCX106 -- serve AOT cache policy lives here, the
+    # sanctioned central cache module; serve entry points route through
+    # this helper instead of touching jax.config themselves
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update(  # scx-lint: disable=SCX106 -- same policy as above
+        "jax_persistent_cache_min_compile_time_secs", 0.0
+    )
+    jax.config.update(  # scx-lint: disable=SCX106 -- same policy as above
+        "jax_persistent_cache_min_entry_size_bytes", -1
+    )

@@ -1,0 +1,20 @@
+"""SCX901 bad fixture: a jit site dispatched on a serve path whose
+shape-contract entry is not bucketed — no caller passes its dims
+through a bucket/pad helper, so the signature universe is open and some
+request will compile at dispatch time.
+"""
+
+import functools
+
+from sctools_tpu.obs.xprof import instrument_jit
+from sctools_tpu.serve.api import serve_entry
+
+
+@functools.partial(instrument_jit, name="fixture.serve_kernel")
+def serve_kernel(cols):
+    return cols
+
+
+@serve_entry
+def handle(frame):
+    return serve_kernel(frame)  # <- SCX901

@@ -1,0 +1,22 @@
+"""SCX901 clean fixture: every serve-reachable jit dispatch is
+bucketed — the entry's dims pass through ``bucket_size``, so the shape
+contract closes over the site and the AOT manifest can precompile its
+whole signature universe.
+"""
+
+import functools
+
+from sctools_tpu.obs.xprof import instrument_jit
+from sctools_tpu.ops.segments import bucket_size
+from sctools_tpu.serve.api import serve_entry
+
+
+@functools.partial(instrument_jit, name="fixture.serve_kernel_closed")
+def serve_kernel_closed(cols):
+    return cols
+
+
+@serve_entry
+def handle(frame):
+    n = bucket_size(len(frame))
+    return serve_kernel_closed(frame[:n])

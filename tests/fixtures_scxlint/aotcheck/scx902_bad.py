@@ -1,0 +1,47 @@
+"""SCX902 bad fixture: compile-capable calls on the request path — an
+``instrument_jit`` construction, a raw ``jax.jit``, and an explicit
+``site.lower().compile()`` inside serve-reachable functions that are
+not warmup steps.  Every one is a dispatch-time compile a warmed
+replica must never pay.
+"""
+
+import functools
+
+import jax
+
+from sctools_tpu.obs.xprof import instrument_jit
+from sctools_tpu.ops.segments import bucket_size
+from sctools_tpu.serve.api import serve_entry
+
+
+@functools.partial(instrument_jit, name="fixture.kernel")
+def kernel(cols):
+    return cols
+
+
+def _step(cols):
+    return cols
+
+
+def _bucketed_caller(frame):
+    # keeps the kernel's contract entry bucketed; SCX902 is the subject
+    n = bucket_size(len(frame))
+    return kernel(frame[:n])
+
+
+@serve_entry
+def handle(frame):
+    n = bucket_size(len(frame))
+    instrument_jit(_step, name="fixture.step")  # <- SCX902
+    return kernel(frame[:n])
+
+
+@serve_entry
+def handle_raw(frame):
+    return jax.jit(_step)(frame)  # <- SCX902
+
+
+@serve_entry
+def handle_lower(frame):
+    n = bucket_size(len(frame))
+    return kernel.lower(frame[:n]).compile()  # <- SCX902

@@ -1,0 +1,32 @@
+"""SCX902 clean fixture: executable construction lives in a
+``@warmup_step`` (run before the replica admits work); the request path
+only dispatches the already-compiled, bucketed site.
+"""
+
+import functools
+
+from sctools_tpu.obs.xprof import instrument_jit
+from sctools_tpu.ops.segments import bucket_size
+from sctools_tpu.serve.api import serve_entry, warmup_step
+
+
+@functools.partial(instrument_jit, name="fixture.kernel")
+def kernel(cols):
+    return cols
+
+
+def _step(cols):
+    return cols
+
+
+@warmup_step
+def warm(frame):
+    step = instrument_jit(_step, name="fixture.step")
+    n = bucket_size(len(frame))
+    return step(frame[:n])
+
+
+@serve_entry
+def handle(frame):
+    n = bucket_size(len(frame))
+    return kernel(frame[:n])

@@ -1,0 +1,27 @@
+"""SCX903 bad fixture: per-request host state on a serve path — an
+``os.environ`` read, a ``jax.config`` mutation, and a wall-clock read
+feeding request handling.  Each can fork executables between replicas
+or requests (different flags, different static values), so a warmed
+fleet stops being one fleet.
+"""
+
+import datetime
+import os
+
+import jax
+
+from sctools_tpu.serve.api import serve_entry
+
+
+@serve_entry
+def handle(frame):
+    flags = os.environ.get("FIXTURE_FLAGS", "")  # <- SCX903
+    jax.config.update("jax_enable_x64", bool(flags))  # <- SCX903
+    stamp = datetime.datetime.now().isoformat()  # <- SCX903
+    return frame, stamp
+
+
+@serve_entry
+def handle_getenv(frame):
+    mode = os.getenv("FIXTURE_MODE", "fast")  # <- SCX903
+    return frame, mode

@@ -1,0 +1,16 @@
+"""SCX903 clean fixture: host state is resolved ONCE at module import
+(replica startup) and passed into the request path as plain values —
+every replica serves the same executables for the process lifetime.
+"""
+
+import os
+
+from sctools_tpu.serve.api import serve_entry
+
+_FLAGS = os.environ.get("FIXTURE_FLAGS", "")
+_MODE = os.getenv("FIXTURE_MODE", "fast")
+
+
+@serve_entry
+def handle(frame):
+    return frame, _FLAGS, _MODE

@@ -1,0 +1,27 @@
+"""SCX904 bad fixture: first-request lazy work — a function-body
+import, a native-extension load, and a device table upload inside the
+request path.  The first request pays seconds of one-time setup that
+belongs in replica warmup.
+"""
+
+from sctools_tpu.serve.api import serve_entry
+
+
+@serve_entry
+def handle(frame):
+    import numpy as np  # <- SCX904
+
+    from sctools_tpu.ingest import upload  # <- SCX904
+
+    cols = upload(np.asarray(frame))  # <- SCX904
+    return cols
+
+
+@serve_entry
+def handle_native(frame):
+    lib = ensure_native("metrics")  # <- SCX904
+    return lib, frame
+
+
+def ensure_native(name):
+    return name

@@ -1,0 +1,19 @@
+"""SCX905 bad fixture: an unbounded intake loop reachable from a serve
+entry — ``while True`` around journal intake with no admission depth or
+fairness mechanism anywhere in the function.  One tenant's backlog can
+monopolize the packing loop and starve every other tenant.
+"""
+
+from sctools_tpu.serve.api import serve_entry
+
+
+@serve_entry
+def run_forever(journal):
+    while True:  # <- SCX905
+        tasks, states = journal.replay()
+        for tid in sorted(tasks):
+            _process(tasks[tid])
+
+
+def _process(task):
+    return task

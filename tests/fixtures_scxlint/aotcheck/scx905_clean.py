@@ -1,0 +1,25 @@
+"""SCX905 clean fixture: the resident intake loop gates every take
+through an ``AdmissionController`` — per-tenant round-robin selection
+with a bounded in-flight depth — so admission is fair and bounded.
+"""
+
+from sctools_tpu.serve.api import AdmissionController, serve_entry
+
+
+@serve_entry
+def run_forever(journal, admission: AdmissionController):
+    while True:
+        tasks, states = journal.replay()
+        tenant = admission.select(_queued_by_tenant(tasks, states))
+        if tenant is None:
+            break
+        _process(tenant)
+        admission.release(tenant)
+
+
+def _queued_by_tenant(tasks, states):
+    return {}
+
+
+def _process(tenant):
+    return tenant

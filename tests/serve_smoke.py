@@ -1,0 +1,275 @@
+"""serve-smoke: the CI gate for the serving plane (`make serve-smoke`).
+
+Two resident workers over one serve journal, six jobs across three
+tenants, with the victim worker SIGTERM'd mid-job (held inside a pack by
+an injected ``delay@task.claimed`` fault) and a replacement spawned:
+
+- zero lost jobs: every submitted job ends ``committed`` (the survivors
+  steal the dead worker's expired leases and recompute), none
+  quarantined;
+- every tenant artifact is byte-identical to a solo single-job reference
+  run — cross-tenant packing must be invisible in the output;
+- the merged xprof registries show **zero retraces** (warmup plus the
+  AOT persistent cache make every serve-path dispatch a cache hit);
+- every observed runtime signature sits inside the committed AOT
+  manifest's shape contract (the scx-aot certification is honest);
+- ``sched status`` renders the serve view (per-tenant counts and the
+  admission line) and exits 0.
+
+Exit 0 on success; any assertion failure is a gate failure.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+MANIFEST = os.path.join(
+    REPO_ROOT, "sctools_tpu", "serve", "aot_manifest.json"
+)
+LEASE_TTL = "2.0"
+BATCH_RECORDS = 4096
+
+# (tenant, job, barcode prefix): prefixes are disjoint AND ordered to
+# match the packer's (tenant, bam) member sort, so the packed stream
+# stays ascending (presorted) exactly like each solo input
+JOBS = [
+    ("t0", "job0", "AA"),
+    ("t0", "job1", "AC"),
+    ("t1", "job0", "CA"),
+    ("t1", "job1", "CC"),
+    ("t2", "job0", "TA"),
+    ("t2", "job1", "TC"),
+]
+
+
+def make_input(path: str, prefix: str, seed: int, n_cells: int = 32) -> None:
+    import random
+
+    from helpers import make_record, write_bam
+
+    rng = random.Random(seed)
+    records = []
+    for cb in sorted(
+        prefix + "".join(rng.choice("ACGT") for _ in range(10))
+        for _ in range(n_cells)
+    ):
+        for ub in sorted(
+            "".join(rng.choice("ACGT") for _ in range(6)) for _ in range(3)
+        ):
+            ge = rng.choice(["G1", "G2"])
+            for i in range(2):
+                records.append(
+                    make_record(
+                        name=f"{cb}{ub}{i}", cb=cb, cr=cb, cy="IIII",
+                        ub=ub, ur=ub, uy="IIII", ge=ge, xf="CODING",
+                        nh=1, pos=rng.randrange(1000),
+                    )
+                )
+    write_bam(path, records)
+
+
+def launch_worker(workdir: str, worker_id: str, fault_spec: str, extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["SCTOOLS_TPU_TRACE"] = os.path.join(workdir, "obs")
+    env["SCTOOLS_TPU_TRACE_WORKER"] = worker_id
+    env["SCTOOLS_TPU_AOT_CACHE"] = os.path.join(workdir, "aot_cache")
+    if fault_spec:
+        env["SCTOOLS_TPU_FAULTS"] = fault_spec
+    else:
+        env.pop("SCTOOLS_TPU_FAULTS", None)
+    cmd = [
+        sys.executable, "-m", "sctools_tpu.serve", "worker",
+        os.path.join(workdir, "journal"),
+        "--worker-id", worker_id,
+        "--manifest", MANIFEST,
+        "--calibration-bam", os.path.join(workdir, "calibration.bam"),
+        "--batch-records", str(BATCH_RECORDS),
+        "--no-compress",
+        "--lease-ttl", LEASE_TTL,
+        "--poll-interval", "0.1",
+    ] + list(extra)
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env,
+    )
+
+
+def wait_for_lease(journal_dir: str, proc, timeout_s: float = 180.0):
+    """Block until some task is journaled ``leased`` (victim mid-job)."""
+    from sctools_tpu.sched import Journal
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise AssertionError(
+                f"victim exited before leasing:\n{out[-2000:]}"
+            )
+        journal = Journal(journal_dir, worker_id="smoke-probe")
+        try:
+            _, states = journal.replay()
+        finally:
+            journal.close()
+        if any(st.state == "leased" for st in states.values()):
+            return
+        time.sleep(0.25)
+    raise AssertionError("victim never leased a job")
+
+
+def main() -> int:
+    workdir = os.environ.get(
+        "SCTOOLS_TPU_SERVE_SMOKE_DIR"
+    ) or tempfile.mkdtemp(prefix="sctools_tpu_serve_smoke.")
+    os.makedirs(workdir, exist_ok=True)
+    os.makedirs(os.path.join(workdir, "obs"), exist_ok=True)
+    out_dir = os.path.join(workdir, "out")
+    os.makedirs(out_dir, exist_ok=True)
+    journal_dir = os.path.join(workdir, "journal")
+
+    make_input(os.path.join(workdir, "calibration.bam"), "GG", seed=99)
+    jobs = []
+    for tenant, job, prefix in JOBS:
+        bam = os.path.join(workdir, f"{tenant}.{job}.bam")
+        make_input(bam, prefix, seed=hash((tenant, job)) % 10_000)
+        jobs.append((tenant, bam, os.path.join(out_dir, f"{tenant}.{job}")))
+
+    from sctools_tpu.sched import COMMITTED, Journal
+    from sctools_tpu.serve.cli import submit_jobs
+    from sctools_tpu.serve.api import ServeJob
+
+    fresh = submit_jobs(
+        journal_dir, [ServeJob(t, b, o) for t, b, o in jobs]
+    )
+    assert fresh == len(JOBS), f"registered {fresh}, want {len(JOBS)}"
+
+    # victim A: admission depth 1 (leases one job per tenant, leaving the
+    # rest for B), held mid-pack for 30s by the injected delay — the
+    # window this smoke SIGTERMs it in.  Its heartbeat keeps the leases
+    # live until it dies; then the TTL expires and peers steal.
+    proc_a = launch_worker(
+        workdir, "wA", "delay@task.claimed:secs=30,times=1",
+        ["--max-depth", "1", "--idle-timeout", "90", "--drain"],
+    )
+    wait_for_lease(journal_dir, proc_a)
+
+    # worker B: clean, serving alongside the stalled victim
+    proc_b = launch_worker(
+        workdir, "wB", "", ["--idle-timeout", "90", "--drain"]
+    )
+
+    proc_a.send_signal(signal.SIGTERM)
+    proc_a.wait(timeout=60)
+    assert proc_a.returncode != 0, "SIGTERM'd victim reported success"
+
+    # replacement C takes the dead worker's place in the fleet
+    proc_c = launch_worker(
+        workdir, "wC", "", ["--idle-timeout", "90", "--drain"]
+    )
+    out_b, _ = proc_b.communicate(timeout=300)
+    out_c, _ = proc_c.communicate(timeout=300)
+    assert proc_b.returncode == 0, f"B failed:\n{out_b[-2000:]}"
+    assert proc_c.returncode == 0, f"C failed:\n{out_c[-2000:]}"
+    summary_b = json.loads(out_b.strip().splitlines()[-1])
+    summary_c = json.loads(out_c.strip().splitlines()[-1])
+    survivors_committed = (
+        summary_b["jobs_committed"] + summary_c["jobs_committed"]
+    )
+    packs_run = summary_b["packs_run"] + summary_c["packs_run"]
+    degraded = summary_b["packs_degraded"] + summary_c["packs_degraded"]
+
+    # zero lost jobs: every task committed, nothing quarantined, and the
+    # survivors stole the dead worker's leases
+    journal = Journal(journal_dir, worker_id="smoke-probe")
+    try:
+        tasks, states = journal.replay()
+    finally:
+        journal.close()
+    assert len(tasks) == len(JOBS), (len(tasks), len(JOBS))
+    assert all(st.state == COMMITTED for st in states.values()), {
+        tasks[t].name: states[t].state for t in tasks
+    }
+    steals = sum(st.steals for st in states.values())
+    assert steals >= 1, "no lease was stolen from the SIGTERM'd victim"
+    assert survivors_committed == len(JOBS), (
+        summary_b, summary_c,
+    )
+    assert packs_run >= 1 and degraded == 0, (packs_run, degraded)
+
+    # cross-tenant packing must be invisible: every artifact byte-equal
+    # to a solo reference run of the same job
+    from sctools_tpu.metrics.gatherer import GatherCellMetrics
+
+    ref_dir = os.path.join(workdir, "ref")
+    os.makedirs(ref_dir, exist_ok=True)
+    for tenant, bam, stem in jobs:
+        ref_stem = os.path.join(ref_dir, os.path.basename(stem))
+        GatherCellMetrics(
+            bam, ref_stem, compress=False, batch_records=BATCH_RECORDS
+        ).extract_metrics()
+        with open(stem + ".csv", "rb") as f:
+            served = f.read()
+        with open(ref_stem + ".csv", "rb") as f:
+            expected = f.read()
+        assert served == expected, (
+            f"{tenant}: packed artifact differs from solo run ({stem})"
+        )
+
+    # zero retraces across the fleet, and every observed signature must
+    # sit inside the committed manifest's shape contract
+    from sctools_tpu.analysis.shardcheck import check_signatures
+    from sctools_tpu.obs import xprof
+
+    registries = xprof.load_registries(workdir)
+    assert registries, "no xprof registries captured"
+    merged = xprof.merge_registries(registries)
+    retraces = sum(
+        int(site.get("retraces") or 0) for site in merged["sites"].values()
+    )
+    assert retraces == 0, {
+        name: site["retrace_signatures"]
+        for name, site in merged["sites"].items()
+        if site.get("retraces")
+    }
+    with open(MANIFEST, encoding="utf-8") as f:
+        manifest = json.load(f)
+    violations = check_signatures(manifest["contract"], merged["sites"])
+    assert not violations, violations
+
+    # the serve view of sched status renders and exits 0
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("SCTOOLS_TPU_FAULTS", None)
+    status = subprocess.run(
+        [sys.executable, "-m", "sctools_tpu.sched", "status", journal_dir],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert status.returncode == 0, status.stderr[-2000:]
+    assert "serve tenant" in status.stdout, status.stdout[-2000:]
+    assert "serve admission" in status.stdout, status.stdout[-2000:]
+
+    n_parts = len(glob.glob(os.path.join(out_dir, "*.csv")))
+    print(
+        f"serve-smoke OK: {len(JOBS)} job(s) committed across "
+        f"{len({t for t, _, _ in JOBS})} tenant(s), victim SIGTERM'd "
+        f"mid-job, {steals} steal(s), {packs_run} pack(s) ({degraded} "
+        f"degraded), {n_parts} artifact(s) byte-identical to solo runs, "
+        f"0 retraces, signatures within the AOT manifest"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,8 +35,10 @@ import pytest
 
 from sctools_tpu.analysis import (
     audit_suppressions,
+    build_aot_manifest,
     build_shape_contract,
     check_abi,
+    check_aot,
     check_cost,
     check_life,
     check_mesh,
@@ -44,10 +46,12 @@ from sctools_tpu.analysis import (
     check_shards,
     check_signatures,
     check_transfer_sites,
+    contract_hash,
     dim_admissible,
     lint_file,
     lock_graph,
     transfer_inventory,
+    validate_manifest,
 )
 from sctools_tpu.analysis import witness
 from sctools_tpu.analysis.cli import main as cli_main
@@ -2018,18 +2022,20 @@ def test_cli_mesh_only_fails_on_bad_corpus(capsys):
         assert rule in out, (rule, out)
 
 
-def test_cli_five_model_passes_compose(capsys):
-    # the `make modelcheck` shape: all five whole-package passes in one
+def test_cli_six_model_passes_compose(capsys):
+    # the `make modelcheck` shape: all six whole-package passes in one
     # process over one shared parse
+    aot = os.path.join(FIXTURES, "aotcheck")
     rc = cli_main(
         ["--race-only", "--shard-only", "--life-only", "--cost-only",
-         "--mesh-only", RACE, SHARD, LIFE, COST, MESH]
+         "--mesh-only", "--aot-only", RACE, SHARD, LIFE, COST, MESH, aot]
     )
     out = capsys.readouterr().out
     assert rc == 1
     assert "SCX401" in out and "SCX501" in out
     assert "SCX601" in out and "SCX701" in out and "SCX801" in out
-    assert "passes: race, shard, life, cost, mesh" in out
+    assert "SCX901" in out
+    assert "passes: race, shard, life, cost, mesh, aot" in out
 
 
 def test_cli_json_covers_mesh_pass(capsys):
@@ -2134,3 +2140,165 @@ def test_mesh_witness_dump_roundtrip(tmp_path, monkeypatch):
     assert loaded["p0"]["counts"] == {"psum": 1}
     assert loaded["p0"]["violations"] == []
     meshwitness.reset()
+
+# ------------------------------------------------------ aotcheck (SCX9xx)
+
+AOT = os.path.join(FIXTURES, "aotcheck")
+AOT_RULE_IDS = ["SCX901", "SCX902", "SCX903", "SCX904", "SCX905"]
+COMMITTED_MANIFEST = os.path.join(
+    REPO, "sctools_tpu", "serve", "aot_manifest.json"
+)
+
+
+@pytest.mark.parametrize("rule", AOT_RULE_IDS)
+def test_aot_rule_fires_exactly_on_marked_lines(rule):
+    path = os.path.join(AOT, f"{rule.lower()}_bad.py")
+    findings = check_aot([path])
+    assert findings, f"{rule} bad fixture produced no findings"
+    assert {f.rule for f in findings} == {rule}
+    expected = _marked_lines(path, rule)
+    assert expected, f"fixture {path} has no # <- {rule} markers"
+    assert sorted(f.line for f in findings) == expected, [
+        f.render() for f in findings
+    ]
+
+
+@pytest.mark.parametrize("rule", AOT_RULE_IDS)
+def test_aot_rule_silent_on_clean_fixture(rule):
+    findings = check_aot(
+        [os.path.join(AOT, f"{rule.lower()}_clean.py")]
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_aot_real_tree_is_clean():
+    # the audit contract: every SCX901-905 finding on the real tree is
+    # fixed or carries a justified inline suppression — the precondition
+    # for the resident serving plane admitting traffic at all
+    findings = check_aot(TREE)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_aot_inline_suppression(tmp_path):
+    src = (
+        "import os\n\n"
+        "from sctools_tpu.serve.api import serve_entry\n\n\n"
+        "@serve_entry\n"
+        "def handle(request):\n"
+        "    mode = os.environ.get('MODE')  "
+        "# scx-lint: disable=SCX903 -- pinned at spawn, never varies\n"
+        "    return mode\n"
+    )
+    path = tmp_path / "suppressed_serve.py"
+    path.write_text(src)
+    assert check_aot([str(path)]) == []
+
+
+def test_aot_manifest_build_names_real_universe():
+    manifest = build_aot_manifest(TREE)
+    assert manifest["version"] == 1
+    assert (
+        "sctools_tpu.serve.engine.ServeWorker.serve_forever"
+        in manifest["serve_entries"]
+    )
+    assert manifest["contract_hash"] == contract_hash(manifest["contract"])
+    sites = manifest["sites"]
+    assert sites, "empty site universe"
+    assert any(entry["precompile"] for entry in sites.values())
+    for entry in sites.values():
+        assert set(entry) >= {
+            "dims", "module", "axes", "sharded", "static_argnames",
+            "serve_reachable", "precompile",
+        }
+
+
+def test_aot_manifest_validates_fresh_and_rejects_tamper():
+    manifest = build_aot_manifest(TREE)
+    assert validate_manifest(manifest, TREE) == []
+    tampered = dict(manifest)
+    tampered["contract_hash"] = "0" * 64
+    problems = validate_manifest(tampered, TREE)
+    assert problems and any("hash" in p for p in problems), problems
+
+
+def test_aot_manifest_staleness_detected():
+    # a manifest certified for one tree must not validate against a tree
+    # with a different shape contract
+    manifest = build_aot_manifest(TREE)
+    problems = validate_manifest(manifest, [AOT])
+    assert problems and any(
+        "--emit-aot-manifest" in p for p in problems
+    ), problems
+
+
+def test_committed_manifest_is_fresh():
+    # the staleness gate `make aotcheck` runs, pinned as a test: the
+    # manifest committed beside the serve package must match the live
+    # tree's shape contract (regenerate with --emit-aot-manifest)
+    with open(COMMITTED_MANIFEST, encoding="utf-8") as f:
+        manifest = json.load(f)
+    assert validate_manifest(manifest, TREE) == []
+
+
+def test_cli_aot_only(capsys):
+    rc = cli_main(["--aot-only"] + TREE)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "passes: aot" in out
+
+
+def test_cli_aot_only_fails_on_bad_corpus(capsys):
+    rc = cli_main(["-q", "--aot-only", AOT])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for rule in AOT_RULE_IDS:
+        assert rule in out, (rule, out)
+
+
+def test_cli_json_covers_aot_pass(capsys):
+    rc = cli_main(["--json", "--aot-only", AOT])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    rules = {f["rule"] for f in payload["findings"]}
+    assert set(AOT_RULE_IDS) <= rules, rules
+
+
+def test_cli_emit_aot_manifest(tmp_path, capsys):
+    dest = tmp_path / "manifest.json"
+    rc = cli_main(["--emit-aot-manifest", str(dest)] + TREE)
+    capsys.readouterr()
+    assert rc == 0
+    with open(dest, encoding="utf-8") as f:
+        manifest = json.load(f)
+    assert manifest["sites"] and manifest["serve_entries"]
+    assert validate_manifest(manifest, TREE) == []
+
+
+def test_cli_aot_manifest_gate(tmp_path, capsys):
+    dest = tmp_path / "manifest.json"
+    assert cli_main(["--emit-aot-manifest", str(dest)] + TREE) == 0
+    capsys.readouterr()
+    # fresh manifest passes the gate
+    rc = cli_main(["--aot-only", "--aot-manifest", str(dest)] + TREE)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "manifest" in out
+    # a tampered manifest fails it with an scx-aot message
+    with open(dest, encoding="utf-8") as f:
+        manifest = json.load(f)
+    manifest["contract_hash"] = "0" * 64
+    with open(dest, "w", encoding="utf-8") as f:
+        json.dump(manifest, f)
+    rc = cli_main(["--aot-only", "--aot-manifest", str(dest)] + TREE)
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "scx-aot" in captured.err
+    # an unreadable manifest path also gates
+    rc = cli_main(
+        ["--aot-only", "--aot-manifest", str(tmp_path / "missing.json")]
+        + TREE
+    )
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "cannot read manifest" in captured.err

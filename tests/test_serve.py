@@ -1,0 +1,472 @@
+"""Serving-plane units: admission, grouping, manifest, packer, engine, CLI.
+
+The end-to-end crash/steal/byte-identity scenario lives in
+tests/serve_smoke.py (`make serve-smoke`); these tests pin the pieces:
+per-tenant round-robin admission with a bounded depth, journal-state
+claimability (including steal-ability of a dead worker's leased tasks),
+manifest integrity/staleness/cache-keying, first-fit-decreasing pack
+planning, the entity-collision degrade path, and the resident worker's
+warm-before-admit contract.
+"""
+
+import json
+import os
+
+import pytest
+
+from helpers import make_record, write_bam
+from sctools_tpu.sched import COMMITTED, Journal
+from sctools_tpu.sched import cli as sched_cli
+from sctools_tpu.sched.journal import TaskState, make_task
+from sctools_tpu.serve.api import (
+    DEFAULT_ADMISSION_DEPTH,
+    SERVE_TASK_KIND,
+    AdmissionController,
+    ServeJob,
+    group_open_jobs,
+    serve_entry,
+    warmup_step,
+)
+from sctools_tpu.serve.cli import main as serve_cli_main
+from sctools_tpu.serve.cli import submit_jobs
+from sctools_tpu.serve.engine import ServeWorker, run_serve_task
+from sctools_tpu.serve.manifest import (
+    DEFAULT_MANIFEST_PATH,
+    ManifestError,
+    aot_cache_dir,
+    load_manifest,
+    precompile_sites,
+    validate_loaded_manifest,
+)
+from sctools_tpu.serve.packer import (
+    PackEntityCollision,
+    artifact_path,
+    estimate_records,
+    plan_packs,
+    run_packed,
+)
+
+
+def _tenant_bam(path, prefix, n_cells=4):
+    records = []
+    for i in range(n_cells):
+        cb = f"{prefix}{i:02d}" + "A" * 8
+        for j, ub in enumerate(["AAAAAA", "CCCCCC"]):
+            records.append(
+                make_record(
+                    name=f"{cb}.{ub}.{j}", cb=cb, cr=cb, cy="IIII",
+                    ub=ub, ur=ub, uy="IIII", ge="G1", xf="CODING",
+                    nh=1, pos=100 + i,
+                )
+            )
+    write_bam(str(path), records)
+
+
+# ----------------------------------------------------------- admission
+
+def test_admission_depth_bound_and_release():
+    admission = AdmissionController(max_depth=2)
+    assert admission.admit("a") and admission.admit("a")
+    assert admission.depth("a") == 2
+    assert not admission.admit("a")  # bound holds
+    admission.release("a")
+    assert admission.admit("a")
+    admission.release("a")
+    admission.release("a")
+    assert admission.depth("a") == 0
+    assert admission.snapshot() == {"max_depth": 2, "in_flight": {}}
+
+
+def test_admission_select_is_round_robin_fair():
+    admission = AdmissionController(max_depth=1)
+    queued = {"a": ["1", "2", "3"], "b": ["4"], "c": ["5"]}
+    picked = []
+    while True:
+        tenant = admission.select(queued)
+        if tenant is None:
+            break
+        assert admission.admit(tenant)
+        picked.append(tenant)
+    # one turn per tenant, however deep a's backlog is
+    assert picked == ["a", "b", "c"]
+    admission.release("b")
+    assert admission.select(queued) == "b"
+
+
+def test_admission_select_skips_blocked_and_empty_tenants():
+    admission = AdmissionController(max_depth=1)
+    assert admission.admit("a")
+    assert admission.select({"a": ["1"], "b": []}) is None
+    assert admission.select({}) is None
+
+
+# ------------------------------------------------------------ grouping
+
+def _serve_task(tenant, name):
+    return make_task(
+        SERVE_TASK_KIND, f"{tenant}/{name}",
+        ServeJob(tenant, f"/in/{name}.bam", f"/out/{name}").payload(),
+    )
+
+
+def test_group_open_jobs_buckets_by_tenant_in_name_order():
+    tasks = {t.id: t for t in [
+        _serve_task("b", "j1"), _serve_task("a", "j2"),
+        _serve_task("a", "j1"),
+        make_task("touch", "not-serve", {"tenant": "a"}),
+    ]}
+    grouped = group_open_jobs(tasks, {}, now=0.0)
+    assert sorted(grouped) == ["a", "b"]
+    names = [tasks[tid].name for tid in grouped["a"]]
+    assert names == ["a/j1", "a/j2"]  # stable per-tenant order
+    assert len(grouped["b"]) == 1
+
+
+def test_group_open_jobs_excludes_terminal_and_backoff_keeps_leased():
+    rows = [
+        ("committed", TaskState(state=COMMITTED), False),
+        ("quarantined", TaskState(state="quarantined"), False),
+        ("backoff", TaskState(state="failed", not_before=100.0), False),
+        # a leased task MUST stay claimable: the lease broker (not the
+        # journal) decides whether the lease is live or steal-able
+        ("leased", TaskState(state="leased"), True),
+        ("failed-ready", TaskState(state="failed", not_before=1.0), True),
+        ("untouched", None, True),
+    ]
+    tasks, states, want = {}, {}, set()
+    for name, state, claimable in rows:
+        task = _serve_task("t", name)
+        tasks[task.id] = task
+        if state is not None:
+            states[task.id] = state
+        if claimable:
+            want.add(task.id)
+    grouped = group_open_jobs(tasks, states, now=50.0)
+    assert set(grouped.get("t", [])) == want
+
+
+def test_serve_job_payload_round_trip():
+    job = ServeJob("acme", "/data/in.bam", "/data/out")
+    assert ServeJob.from_payload(job.payload()) == job
+
+
+def test_entry_markers_are_runtime_attributes():
+    assert getattr(ServeWorker.serve_forever, "__scx_serve_entry__", False)
+    assert getattr(ServeWorker.warmup, "__scx_warmup_step__", False)
+    @serve_entry
+    def handler():
+        pass
+    @warmup_step
+    def warm():
+        pass
+    assert handler.__scx_serve_entry__ and warm.__scx_warmup_step__
+
+
+# ------------------------------------------------------------ manifest
+
+def test_committed_manifest_loads_and_names_precompile_set():
+    manifest = load_manifest()
+    assert manifest["version"] == 1
+    assert validate_loaded_manifest(manifest) == []
+    sites = precompile_sites(manifest)
+    assert sites and set(sites) <= set(manifest["sites"])
+    assert all(manifest["sites"][name]["precompile"] for name in sites)
+
+
+def test_load_manifest_rejects_missing_and_tampered(tmp_path):
+    with pytest.raises(ManifestError, match="--emit-aot-manifest"):
+        load_manifest(str(tmp_path / "missing.json"))
+    manifest = load_manifest()
+    manifest["contract_hash"] = "0" * 64
+    tampered = tmp_path / "tampered.json"
+    tampered.write_text(json.dumps(manifest))
+    with pytest.raises(ManifestError, match="hash mismatch"):
+        load_manifest(str(tampered))
+
+
+def test_validate_loaded_manifest_problem_classes():
+    assert validate_loaded_manifest({"version": 99}) == [
+        "manifest version 99 != 1",
+        "manifest missing embedded contract or hash",
+    ]
+    manifest = load_manifest()
+    del manifest["sites"]
+    assert validate_loaded_manifest(manifest) == [
+        "manifest missing sites table"
+    ]
+
+
+def test_aot_cache_dir_keyed_by_hash_with_env_override(monkeypatch):
+    manifest = load_manifest()
+    monkeypatch.delenv("SCTOOLS_TPU_AOT_CACHE", raising=False)
+    default = aot_cache_dir(manifest)
+    digest = manifest["contract_hash"][:12]
+    assert os.path.basename(default) == f".aot_cache-{digest}"
+    assert os.path.dirname(default) == os.path.dirname(
+        os.path.abspath(DEFAULT_MANIFEST_PATH)
+    )
+    monkeypatch.setenv("SCTOOLS_TPU_AOT_CACHE", "/tmp/elsewhere")
+    assert aot_cache_dir(manifest) == "/tmp/elsewhere"
+
+
+# -------------------------------------------------------------- packer
+
+def test_artifact_path_suffixes():
+    assert artifact_path("/out/part", compress=True) == "/out/part.csv.gz"
+    assert artifact_path("/out/part", compress=False) == "/out/part.csv"
+    assert artifact_path("/out/part.csv", compress=False) == "/out/part.csv"
+
+
+def test_estimate_records_from_size_and_missing(tmp_path):
+    bam = tmp_path / "sized.bam"
+    bam.write_bytes(b"\0" * (48 * 100))
+    assert estimate_records(str(bam)) == 100
+    assert estimate_records(str(tmp_path / "absent.bam")) == 1
+
+
+def _sized_job(tmp_path, tenant, name, est_records):
+    bam = tmp_path / f"{tenant}.{name}.bam"
+    bam.write_bytes(b"\0" * (48 * est_records))
+    return ServeJob(tenant, str(bam), str(tmp_path / f"{tenant}.{name}"))
+
+
+def test_plan_packs_first_fit_decreasing(tmp_path):
+    jobs = [
+        _sized_job(tmp_path, "t0", "big", 3000),
+        _sized_job(tmp_path, "t1", "mid", 2000),
+        _sized_job(tmp_path, "t2", "small", 1000),
+        _sized_job(tmp_path, "t3", "tiny", 500),
+    ]
+    plans = plan_packs(jobs, batch_records=4096)
+    packs = [
+        tuple(job.tenant for job in plan.jobs) for plan in plans
+    ]
+    # FFD into 4096-capacity bins: 3000+1000 and 2000+500
+    assert sorted(packs) == [("t0", "t2"), ("t1", "t3")]
+    for plan in plans:
+        assert plan.estimated_records <= 4096
+        assert list(plan.jobs) == sorted(
+            plan.jobs, key=lambda j: (j.tenant, j.bam)
+        )
+
+
+def test_plan_packs_oversize_job_gets_own_capped_bin(tmp_path):
+    jobs = [
+        _sized_job(tmp_path, "t0", "huge", 9000),
+        _sized_job(tmp_path, "t1", "small", 100),
+    ]
+    plans = plan_packs(jobs, batch_records=4096)
+    # the estimate is clamped to capacity, so the small job still packs
+    # with it — streaming splits the actual records across buckets
+    assert len(plans) == 1 or all(
+        plan.estimated_records <= 4096 for plan in plans
+    )
+    assert sum(len(plan.jobs) for plan in plans) == 2
+
+
+def test_plan_packs_deterministic(tmp_path):
+    jobs = [
+        _sized_job(tmp_path, f"t{i}", "job", 700 + 13 * i) for i in range(6)
+    ]
+    first = plan_packs(jobs, batch_records=4096)
+    second = plan_packs(list(reversed(jobs)), batch_records=4096)
+    as_names = lambda plans: [  # noqa: E731
+        tuple(job.tenant for job in plan.jobs) for plan in plans
+    ]
+    assert as_names(first) == as_names(second)
+
+
+def test_run_packed_degrades_to_solo_on_entity_collision(tmp_path):
+    # both tenants share barcode prefix "AA" → same entities → packing
+    # would merge their rows; run_packed must fall back to solo runs
+    bam_a, bam_b = tmp_path / "a.bam", tmp_path / "b.bam"
+    _tenant_bam(bam_a, "AA")
+    _tenant_bam(bam_b, "AA")
+    jobs = [
+        ServeJob("ta", str(bam_a), str(tmp_path / "out_a")),
+        ServeJob("tb", str(bam_b), str(tmp_path / "out_b")),
+    ]
+    artifacts, packed = run_packed(jobs, compress=False, batch_records=4096)
+    assert not packed
+    assert [os.path.basename(a) for a in artifacts] == [
+        "out_a.csv", "out_b.csv",
+    ]
+    for artifact in artifacts:
+        assert os.path.exists(artifact)
+    # no inflight debris from the aborted packed attempt
+    assert not [p for p in os.listdir(tmp_path) if "inflight" in p]
+
+
+def test_run_packed_creates_missing_output_directories(tmp_path):
+    # tenants submit output stems from another host: the worker must
+    # materialize the parent directory instead of quarantining the job
+    # on the inflight CSV's FileNotFoundError
+    bam_a, bam_b = tmp_path / "a.bam", tmp_path / "b.bam"
+    _tenant_bam(bam_a, "AA")
+    _tenant_bam(bam_b, "CC")
+    jobs = [
+        ServeJob("ta", str(bam_a), str(tmp_path / "out" / "ta" / "part")),
+        ServeJob("tb", str(bam_b), str(tmp_path / "out" / "tb" / "part")),
+    ]
+    artifacts, _ = run_packed(jobs, compress=False, batch_records=4096)
+    for artifact in artifacts:
+        assert os.path.exists(artifact)
+
+
+# -------------------------------------------------------------- engine
+
+def test_serve_forever_requires_warmup(tmp_path):
+    with ServeWorker(str(tmp_path / "journal")) as worker:
+        with pytest.raises(RuntimeError, match="warm"):
+            worker.serve_forever(max_jobs=1)
+
+
+def test_worker_drains_journal_and_commits(tmp_path, monkeypatch):
+    monkeypatch.setenv("SCTOOLS_TPU_AOT_CACHE", str(tmp_path / "aot"))
+    journal_dir = str(tmp_path / "journal")
+    bam_a, bam_b = tmp_path / "a.bam", tmp_path / "b.bam"
+    _tenant_bam(bam_a, "AA")
+    _tenant_bam(bam_b, "CC")
+    jobs = [
+        ServeJob("ta", str(bam_a), str(tmp_path / "out_a")),
+        ServeJob("tb", str(bam_b), str(tmp_path / "out_b")),
+    ]
+    assert submit_jobs(journal_dir, jobs) == 2
+    assert submit_jobs(journal_dir, jobs) == 0  # content-hashed: idempotent
+    with ServeWorker(
+        journal_dir, worker_id="unit", batch_records=4096,
+        compress=False, lease_ttl=5.0, poll_interval=0.05,
+    ) as worker:
+        worker.warmup()
+        committed = worker.serve_forever(drain=True, idle_timeout_s=30.0)
+    assert committed == 2
+    assert worker.first_result_s is not None and worker.packs_run >= 1
+    journal = Journal(journal_dir, worker_id="check")
+    try:
+        tasks, states = journal.replay()
+        meta = journal.worker_meta()
+    finally:
+        journal.close()
+    assert len(tasks) == 2
+    assert all(st.state == COMMITTED for st in states.values())
+    for st in states.values():
+        assert st.part and os.path.exists(st.part) and st.sha256
+    assert meta["unit"]["serve"]["max_depth"] == DEFAULT_ADMISSION_DEPTH
+
+
+def test_run_serve_task_solo_runner(tmp_path):
+    bam = tmp_path / "solo.bam"
+    _tenant_bam(bam, "GG")
+    task = make_task(
+        SERVE_TASK_KIND, "t/solo",
+        ServeJob("t", str(bam), str(tmp_path / "solo_out")).payload(),
+    )
+    artifact = run_serve_task(task)
+    assert artifact.endswith("solo_out.csv.gz") and os.path.exists(artifact)
+
+
+# ----------------------------------------------------------------- CLI
+
+def test_serve_cli_submit(tmp_path, capsys):
+    journal_dir = str(tmp_path / "journal")
+    rc = serve_cli_main(
+        ["submit", journal_dir, "--job", "acme", "/in.bam", "/out"]
+    )
+    assert rc == 0
+    assert "registered 1 new job(s)" in capsys.readouterr().out
+    assert serve_cli_main(["submit", journal_dir]) == 2
+
+
+def test_sched_status_renders_serve_view(tmp_path, capsys):
+    journal_dir = str(tmp_path / "journal")
+    jobs = [
+        ServeJob("acme", "/in/a.bam", "/out/a"),
+        ServeJob("acme", "/in/b.bam", "/out/b"),
+        ServeJob("zenith", "/in/z.bam", "/out/z"),
+    ]
+    submit_jobs(journal_dir, jobs)
+    journal = Journal(journal_dir, worker_id="w0")
+    try:
+        tasks, _ = journal.replay()
+        by_name = {tasks[tid].name: tid for tid in tasks}
+        journal.record(by_name["acme/a"], "leased", attempt=1)
+        journal.record(by_name["acme/b"], "leased", attempt=1)
+        journal.record(by_name["acme/b"], "committed", attempt=1)
+        journal.announce_worker(
+            {
+                "serve": {"max_depth": 4, "in_flight": {"acme": 1}},
+                "warm": True,
+            }
+        )
+    finally:
+        journal.close()
+    assert sched_cli.main(["status", journal_dir]) == 1  # open work
+    out = capsys.readouterr().out
+    assert "serve tenant acme: queued=0 running=1 committed=1" in out
+    assert "serve tenant zenith: queued=1 running=0 committed=0" in out
+    assert "serve admission w0: depth=1 (max 4/tenant) acme=1 [warm]" in out
+
+
+# ---------------------------------------------------------------------------
+# executable store (xprof AOT dispatch)
+
+
+def test_executable_store_round_trip(tmp_path):
+    """Persist on first compile, then a fresh enable dispatches the
+    stored module — same output, no jit path."""
+    import numpy as np
+
+    from sctools_tpu.obs import xprof
+
+    jnp = pytest.importorskip("jax.numpy")
+    store = str(tmp_path / "exec")
+    x = jnp.arange(16, dtype=jnp.float32)
+
+    def fn(v):
+        return v * 2.0 + 1.0
+
+    origin = xprof.instrument_jit(fn, name="serve.test_store_site")
+    xprof.enable_executable_store(store)
+    try:
+        out_origin = origin(x)  # compiles via jit, exports into the store
+        entries = [p for p in os.listdir(store) if p.endswith(".jaxexec")]
+        assert entries, "first compile did not persist an executable"
+
+        # a fresh replica: new enable (clears the origin's local marker),
+        # new wrapper object for the same site
+        xprof.disable_executable_store()
+        xprof.enable_executable_store(store)
+        before = xprof.executable_store_stats()
+        replica = xprof.instrument_jit(fn, name="serve.test_store_site")
+        out_replica = replica(x)
+        after = xprof.executable_store_stats()
+        np.testing.assert_array_equal(
+            np.asarray(out_origin), np.asarray(out_replica)
+        )
+        assert after["loads"] == before["loads"] + 1
+        assert after["hits"] == before["hits"] + 1
+    finally:
+        xprof.disable_executable_store()
+
+
+def test_executable_store_miss_falls_back_to_jit(tmp_path):
+    """A signature with no store entry dispatches through jit and then
+    persists it; disabling the store restores plain dispatch."""
+    import numpy as np
+
+    from sctools_tpu.obs import xprof
+
+    jnp = pytest.importorskip("jax.numpy")
+    store = str(tmp_path / "exec")
+    site = xprof.instrument_jit(lambda v: v - 3.0, name="serve.test_store_miss")
+    x = jnp.arange(4, dtype=jnp.float32)
+    xprof.enable_executable_store(store)
+    try:
+        out = site(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x) - 3.0)
+        assert xprof.executable_store_dir() == store
+    finally:
+        xprof.disable_executable_store()
+    assert xprof.executable_store_dir() is None
+    out2 = site(x)
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(x) - 3.0)
