@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck aotcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck aotcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke elastic-smoke perf-gate docs clean
 
 ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke perf-gate
 
@@ -224,13 +224,20 @@ mesh-smoke:
 # journal under continuous cross-tenant packing; one worker is
 # SIGTERM'd mid-job and a replacement spawned — zero lost jobs, every
 # per-tenant CSV byte-identical to a solo reference run, 0 retraces in
-# the merged xprof registries, and every observed signature inside the
-# committed AOT manifest's contract (tests/serve_smoke.py;
-# docs/serving.md).
+# the merged xprof registries, every observed signature inside the
+# committed AOT manifest's contract, and a complete scx-slo distributed
+# trace per committed job (legs sum to the leased->committed span, 0
+# unattributed device-seconds, stolen jobs stitched across lineages)
+# (tests/serve_smoke.py; docs/serving.md).
 serve-smoke:
 	rm -rf /tmp/sctools_tpu_serve_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_SERVE_SMOKE_DIR=/tmp/sctools_tpu_serve_smoke \
 	$(PY) tests/serve_smoke.py
+
+# the elastic-fleet gate IS the serve smoke: SIGTERM mid-traffic, a
+# replacement joins, zero lost jobs, and every stolen job's trace
+# stitches across the worker-lineage boundary
+elastic-smoke: serve-smoke
 
 # perf-regression gate self-test: bench.py --check must fail a
 # synthetically-degraded result and pass a trajectory-consistent one

@@ -83,7 +83,7 @@ import sys
 import tempfile
 
 from sctools_tpu import obs
-from sctools_tpu.obs import pulse, xprof
+from sctools_tpu.obs import pulse, slo, xprof
 
 CHECK_EXIT_CODE = 4  # distinct from crashes: "ran fine, but regressed"
 DEFAULT_TOLERANCE = 0.5
@@ -123,6 +123,11 @@ FRAME_OVERHEAD_CEILING = 1.02
 # check — the always-on telemetry plane's presence-but-off cost, gated
 # like the guard/frame disciplines because heartbeats ride every batch
 PULSE_OVERHEAD_CEILING = 1.02
+# scx-slo off-mode ceiling: with SCTOOLS_TPU_SLO unset every probe()
+# call hands out the cached no-op singleton after one bool check — the
+# pack-phase mark probe rides every serve dispatch, so its
+# presence-but-off cost is gated exactly like the pulse plane's
+SLO_OVERHEAD_CEILING = 1.02
 # scx-pulse bubble ceiling: the fraction of the bench window where the
 # device leg (compute + d2h drain) sat idle while decode/transfer ran
 # uncovered. The decode/H2D/compute/D2H overlap PRs 6 and 11 built is
@@ -1096,6 +1101,56 @@ def bench_pulse_overhead(rounds: int = 3, calls: int = 80) -> dict:
     }
 
 
+def bench_slo_overhead(rounds: int = 3, calls: int = 80) -> dict:
+    """Off-mode cost of the scx-slo pack-phase probe on the dispatch path.
+
+    Same interleaved shape and min-across-repeats summary as the
+    guard/frame/pulse legs: the instrumented leg runs the per-pack probe
+    call sequence the serve engine makes (probe handout, the pack_start
+    and pack_done wall marks, the marks() drain the commit extras carry)
+    around a numpy-sort work unit; the direct leg runs the work unit
+    alone. With ``SCTOOLS_TPU_SLO`` unset every call is the cached no-op
+    singleton after one bool check, and that presence-but-off cost is
+    what the ``slo_overhead <= 1.02`` gate holds. A run with slo ON
+    measures the instrumented cost instead; the gate skips it
+    (``slo_on``), mirroring ``pulse_on``/``frame_debug``.
+    """
+    import numpy as np
+
+    # off must be OFF: the cached no-op singleton, not a recording
+    # probe — otherwise this leg measures the instrumented cost and the
+    # <= 1.02 ceiling would be meaningless
+    if not slo.enabled():
+        probe = slo.probe()
+        assert probe is slo.NOOP, (
+            f"slo probe active without {slo.ENV_FLAG}=1: {type(probe)}"
+        )
+
+    payload = np.arange(1 << 19, dtype=np.int32)[::-1].copy()
+
+    def work() -> int:
+        return int(np.sort(payload)[0])
+
+    def probed() -> int:
+        probe = slo.probe()
+        probe.mark("pack_start")
+        value = work()
+        probe.mark("pack_done")
+        probe.marks()
+        return value
+
+    work()
+    probed()
+    ratios = _interleaved_ratios(work, probed, rounds, calls)
+    return {
+        "overhead": _summarize_overhead_ratios(ratios),
+        "ratios": [round(r, 4) for r in ratios],
+        "rounds": rounds,
+        "calls_per_round": calls,
+        "slo_on": slo.enabled(),
+    }
+
+
 def _percentile(values, q: float):
     """Nearest-rank percentile of a small sample; None when empty."""
     ordered = sorted(values)
@@ -1190,6 +1245,10 @@ def bench_serve() -> dict:
         env["SCTOOLS_TPU_AOT_CACHE"] = os.path.join(workdir, "aot_cache")
         env["SCTOOLS_TPU_TRACE"] = os.path.join(workdir, "obs")
         env["SCTOOLS_TPU_TRACE_WORKER"] = phase
+        # pulse heartbeats feed the scx-slo trace stitch: without rings
+        # the per-job device legs (and the trace-completeness gate)
+        # have nothing to match against the journal
+        env["SCTOOLS_TPU_PULSE"] = "1"
         env.pop("SCTOOLS_TPU_FAULTS", None)
         proc = subprocess.run(
             [
@@ -1222,6 +1281,12 @@ def bench_serve() -> dict:
     ttfr_cold = float(cold["first_result_s"])
     ttfr_warm = float(warm["first_result_s"])
     n_cells = SERVE_TENANTS * SERVE_CELLS_PER_TENANT
+    # scx-slo trace stitch over BOTH phases' journals + the shared pulse
+    # rings: every committed job must yield a complete per-leg trace
+    # (--check gates trace_complete == 1.0) and every device-second a
+    # heartbeat recorded must land on some job's bill
+    view = slo.stitch_run(workdir)
+    fleet = view["fleet"]
     return {
         "tenants": SERVE_TENANTS,
         "jobs": 2 * SERVE_TENANTS,
@@ -1242,6 +1307,17 @@ def bench_serve() -> dict:
             cold["packs_degraded"] + warm["packs_degraded"]
         ),
         "retraces": retraces,
+        "slo": {
+            "trace_complete": fleet["complete_fraction"],
+            "unattributed_device_s": fleet["unattributed_device_s"],
+            "tenants": {
+                tenant: {
+                    "p50_s": row["p50_s"],
+                    "p95_s": row["p95_s"],
+                }
+                for tenant, row in view["tenants"].items()
+            },
+        },
     }
 
 
@@ -1510,6 +1586,20 @@ def check_result(
                 value=round(float(gated), 4),
                 ceiling=PULSE_OVERHEAD_CEILING,
             )
+    # scx-slo OFF-MODE cost, same discipline as pulse_overhead: the
+    # pack-phase probe rides every serve dispatch, so its
+    # presence-but-off cost is gated; an slo-enabled run measures the
+    # instrumented cost instead and the gate skips it
+    slo_info = result.get("slo")
+    if isinstance(slo_info, dict) and not slo_info.get("slo_on"):
+        gated = _gated_overhead(slo_info)
+        if isinstance(gated, (int, float)):
+            add(
+                "slo_overhead",
+                gated <= SLO_OVERHEAD_CEILING,
+                value=round(float(gated), 4),
+                ceiling=SLO_OVERHEAD_CEILING,
+            )
     # scx-pulse bubble attribution, held whenever the result carries it:
     # the measured share of the bench window where the device leg idled
     # while decode/transfer ran uncovered. Above the ceiling, the
@@ -1553,6 +1643,27 @@ def check_result(
                 "serve_retraces", serve_retraces == 0,
                 value=serve_retraces, floor=0,
             )
+        # scx-slo trace gates, held whenever the serve result carries
+        # the stitch: every committed job must yield a COMPLETE
+        # distributed trace (submit->lease->device->commit all matched
+        # to heartbeats), and every device-second a heartbeat recorded
+        # must be attributed to some job — an incomplete trace or an
+        # unbilled device-second means the cost-attribution plane has a
+        # hole a crashed lineage or dropped ring could hide in
+        serve_slo = serve.get("slo")
+        if isinstance(serve_slo, dict):
+            complete = serve_slo.get("trace_complete")
+            if isinstance(complete, (int, float)):
+                add(
+                    "serve_trace_complete", complete >= 1.0,
+                    value=round(float(complete), 4), floor=1.0,
+                )
+            unattributed = serve_slo.get("unattributed_device_s")
+            if isinstance(unattributed, (int, float)):
+                add(
+                    "serve_unattributed_device_s", unattributed == 0,
+                    value=unattributed, ceiling=0,
+                )
     return verdict
 
 
@@ -1667,6 +1778,20 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         "metric": metric, "value": reference, "vs_baseline": 5.0,
         "pulse": {"overhead": 1.3, "pulse_on": True},
     }
+    # scx-slo probe overhead shares the pulse gate's off-mode-only
+    # semantics: heavy off-mode fails, light passes, slo-on skips
+    slo_heavy = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "slo": {"overhead": 1.2, "slo_on": False},
+    }
+    slo_light = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "slo": {"overhead": 1.004, "slo_on": False},
+    }
+    slo_probe_on = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "slo": {"overhead": 1.3, "slo_on": True},
+    }
     # scx-pulse bubble attribution: a pipeline whose device leg idles
     # behind uncovered decode/transfer most of the window must fail
     bubbly = {
@@ -1695,6 +1820,30 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
     serve_healthy = {
         "metric": metric, "value": reference, "vs_baseline": 5.0,
         "serve": {"ttfr_speedup": 8.0, "lost_jobs": 0, "retraces": 0},
+    }
+    # scx-slo trace gates: a torn trace (one committed job whose legs
+    # never matched a heartbeat) and an unbilled device-second are each
+    # independently fatal; the fully-stitched shape passes
+    serve_torn_trace = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "serve": {
+            "ttfr_speedup": 8.0, "lost_jobs": 0, "retraces": 0,
+            "slo": {"trace_complete": 0.875, "unattributed_device_s": 0},
+        },
+    }
+    serve_unbilled_device = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "serve": {
+            "ttfr_speedup": 8.0, "lost_jobs": 0, "retraces": 0,
+            "slo": {"trace_complete": 1.0, "unattributed_device_s": 0.4},
+        },
+    }
+    serve_stitched = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "serve": {
+            "ttfr_speedup": 8.0, "lost_jobs": 0, "retraces": 0,
+            "slo": {"trace_complete": 1.0, "unattributed_device_s": 0},
+        },
     }
     # platform comparability: the fingerprints literally committed in
     # the trajectory files (BENCH_r02-r05 are axon points, r06 the
@@ -1782,6 +1931,14 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         failures.append(
             "pulse-on overhead was gated (ceiling is off-mode only)"
         )
+    if check_result(slo_heavy, repo_dir)["ok"]:
+        failures.append("over-ceiling slo overhead passed the gate")
+    if not check_result(slo_light, repo_dir)["ok"]:
+        failures.append("healthy slo overhead failed the gate")
+    if not check_result(slo_probe_on, repo_dir)["ok"]:
+        failures.append(
+            "slo-on overhead was gated (ceiling is off-mode only)"
+        )
     if check_result(bubbly, repo_dir)["ok"]:
         failures.append("bubble-bound pipeline (0.8) passed the gate")
     if not check_result(streaming, repo_dir)["ok"]:
@@ -1796,6 +1953,16 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         failures.append("retracing serve result passed the gate")
     if not check_result(serve_healthy, repo_dir)["ok"]:
         failures.append("healthy serve result failed the gate")
+    if check_result(serve_torn_trace, repo_dir)["ok"]:
+        failures.append(
+            "serve result with a torn trace (0.875 complete) passed"
+        )
+    if check_result(serve_unbilled_device, repo_dir)["ok"]:
+        failures.append(
+            "serve result with unattributed device-seconds passed"
+        )
+    if not check_result(serve_stitched, repo_dir)["ok"]:
+        failures.append("fully-stitched serve result failed the gate")
     if not check_result(cpu_result, repo_dir)["ok"]:
         failures.append(
             "same-platform-healthy CPU result failed the gate "
@@ -1936,6 +2103,7 @@ def main(argv=None):
     result["guard"] = bench_guard_overhead()
     result["frame"] = bench_frame_overhead()
     result["pulse"] = bench_pulse_overhead()
+    result["slo"] = bench_slo_overhead()
     print(json.dumps(result))
     if args.check:
         # the result line above stays the ONE stdout JSON line (the

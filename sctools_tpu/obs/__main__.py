@@ -17,6 +17,12 @@ low-occupancy diagnosis), the critical chain of tasks that bounded the
 run, and crashed-worker flight records (obs.fleet;
 docs/observability.md).
 
+``python -m sctools_tpu.obs slo <run_dir>`` stitches per-job
+distributed traces (submit -> lease -> pack -> device -> commit) out of
+the serve journal and the pulse rings, and prints per-tenant SLO rows
+(p50/p95/p99, queue-age, error-budget burn) with pro-rata device-cost
+attribution (obs.slo; docs/serving.md).
+
 ``python -m sctools_tpu.obs efficiency <run_dir>`` merges the workers'
 xprof registries into the device-efficiency report: per jit call site,
 compile/retrace counts (with triggering signatures), padding occupancy,
@@ -351,6 +357,54 @@ def _pulse(args, out=None, err=None) -> int:
         rings, view = frame()
 
 
+def _slo(args, out=None, err=None) -> int:
+    out = out if out is not None else sys.stdout
+    err = err if err is not None else sys.stderr
+    from . import slo as slomod
+
+    window_s = (
+        args.window if args.window is not None and args.window > 0 else None
+    )
+    if not slomod.find_journal_dirs(args.run_dir):
+        print(
+            f"obs slo: no sched journal under {args.run_dir} (serve runs "
+            "journal their jobs; point this at the run/work directory)",
+            file=err,
+        )
+        return 2
+
+    def frame():
+        return slomod.stitch_run(
+            args.run_dir,
+            window_s=window_s,
+            target_s=args.target,
+            objective=args.objective,
+        )
+
+    view = frame()
+    if args.as_json:
+        print(json.dumps(view, separators=(",", ":")), file=out)
+        return 0
+    if not args.watch:
+        print(slomod.render_slo(view), end="", file=out)
+        return 0
+    import time as _time
+
+    frames = 0
+    while True:
+        frames += 1
+        if hasattr(out, "isatty") and out.isatty():
+            out.write("\x1b[2J\x1b[H")
+        print(slomod.render_slo(view), end="", file=out)
+        if args.frames and frames >= args.frames:
+            return 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        view = frame()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sctools_tpu.obs",
@@ -449,6 +503,48 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="serve the merged view on 127.0.0.1:PORT/metrics in "
         "Prometheus exposition format instead of rendering (0 = any port)",
     )
+    slo_cmd = sub.add_parser(
+        "slo",
+        help="per-job distributed traces + per-tenant SLO/cost "
+        "attribution for a serve run (scx-slo)",
+    )
+    slo_cmd.add_argument(
+        "run_dir",
+        help="run/work directory holding the serve journal(s) and the "
+        "workers' pulse.<worker>.ring heartbeat rings",
+    )
+    slo_cmd.add_argument(
+        "--target", type=float, default=None,
+        help="end-to-end latency target in seconds the error budget "
+        "burns against (default: SCTOOLS_TPU_SLO_TARGET_S or 30)",
+    )
+    slo_cmd.add_argument(
+        "--objective", type=float, default=0.99,
+        help="SLO objective as a fraction of jobs inside the target "
+        "(default 0.99; burn 1.0 = violations at the sustainable rate)",
+    )
+    slo_cmd.add_argument(
+        "--window", type=float, default=None,
+        help="trailing SLO window in seconds (default: whole run; "
+        "0 = whole run)",
+    )
+    slo_cmd.add_argument(
+        "--watch", action="store_true",
+        help="refresh the view every --interval seconds (live TUI)",
+    )
+    slo_cmd.add_argument(
+        "--interval", type=float, default=2.0,
+        help="--watch refresh period in seconds (default 2)",
+    )
+    slo_cmd.add_argument(
+        "--frames", type=int, default=0,
+        help="stop --watch after N refreshes (0 = until interrupted)",
+    )
+    slo_cmd.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="the stitched per-job/per-tenant/fleet view as one JSON "
+        "object",
+    )
     args = parser.parse_args(argv)
     if args.command == "summarize":
         return _summarize(args)
@@ -456,6 +552,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _efficiency(args)
     if args.command == "pulse":
         return _pulse(args)
+    if args.command == "slo":
+        return _slo(args)
     return _timeline(args)
 
 

@@ -636,6 +636,24 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
             "mesh": run.worker_meshes.get(worker),
         }
 
+    # --- scx-slo: per-job serve traces (submit->lease->pack->device->
+    # commit decomposition + pro-rata device cost), only when the
+    # journal carries serve jobs; a stitch failure degrades to absence
+    serve_slo = None
+    try:
+        from . import slo as _slo
+
+        if any(
+            getattr(task, "kind", None) == _slo.SERVE_KIND
+            for task in run.tasks.values()
+        ):
+            serve_slo = _slo.stitch(
+                run.tasks, run.events, run.pulse_rings,
+                run_dir=run.run_dir,
+            )
+    except Exception:  # noqa: BLE001 - telemetry must not kill the timeline
+        serve_slo = None
+
     wall_start = min((l["start"] for l in lanes.values()), default=0.0)
     wall_end = max((l["end"] for l in lanes.values()), default=0.0)
     flights = [
@@ -666,6 +684,7 @@ def analyze(run: FleetRun) -> Dict[str, Any]:
             for row in task_rows.values()
         },
         "occupancy_median": occupancy_median,
+        "serve_slo": serve_slo,
         "pulse": pulse_workers,
         "collectives": collective_workers,
         "worker_meshes": dict(run.worker_meshes),
@@ -790,6 +809,45 @@ def render_timeline(run: FleetRun, analysis: Dict[str, Any]) -> str:
                 f"limited by {row.get('limiting_stage') or '-'}"
                 + (" (from flight record)" if row["source"] == "flight"
                    else "")
+            )
+        lines.append("")
+    serve_slo = analysis.get("serve_slo") or {}
+    if serve_slo.get("jobs"):
+        fleet_slo = serve_slo.get("fleet") or {}
+        complete = fleet_slo.get("complete_fraction")
+        lines.append(
+            "serve jobs (scx-slo traces; `obs slo` for the full view): "
+            + (
+                f"trace {100 * complete:.0f}% complete"
+                if complete is not None
+                else "trace -"
+            )
+        )
+        for job in serve_slo["jobs"]:
+            legs = job.get("legs")
+            if legs:
+                detail = (
+                    f"queue {legs['queue_wait']:.2f} "
+                    f"pack {legs['pack_wait']:.2f} "
+                    f"device {legs['device']:.2f} "
+                    f"writeback {legs['writeback']:.2f} "
+                    f"commit {legs['commit']:.2f}"
+                )
+            else:
+                detail = "incomplete trace"
+            e2e = job.get("e2e_s")
+            cost = job.get("cost") or {}
+            lines.append(
+                f"  {job['name']}  "
+                + (f"{e2e:.2f}s" if e2e is not None else "-")
+                + f"  [{detail}]  "
+                f"dev {cost.get('device_s', 0.0):.3f}s"
+                + (
+                    f"  pack x{job['pack_size']}"
+                    if job.get("pack_size")
+                    else ""
+                )
+                + (" (stolen)" if job.get("stolen") else "")
             )
         lines.append("")
     collective_rows = analysis.get("collectives") or {}

@@ -986,6 +986,26 @@ def ring_now(ring: dict) -> Optional[float]:
     return (time.time() - wall) + mono  # scx-lint: disable=SCX109 -- cross-process anchor translation, not a duration
 
 
+def mono_to_wall(ring: dict, t: float) -> Optional[float]:
+    """Translate a ring-local monotonic timestamp onto the wall clock.
+
+    The inverse companion of :func:`ring_now`: heartbeat leg intervals
+    are recorded on the writing worker's monotonic clock, but journal
+    events carry wall-clock timestamps — scx-slo stitches the two via
+    the ring header's wall/mono anchor pair.  Returns None when the
+    ring predates the anchor (older writer) — the trace then degrades
+    to journal-only legs instead of guessing.
+    """
+    meta = ring.get("meta") or {}
+    wall = meta.get("wall")
+    mono = meta.get("mono")
+    if not isinstance(wall, (int, float)) or not isinstance(
+        mono, (int, float)
+    ):
+        return None
+    return wall + (t - mono)
+
+
 def fleet_pulse(
     run_dir: str,
     window_s: Optional[float] = None,

@@ -17,7 +17,10 @@ Two modes:
 - **run-dir**: serve the merged view of every ``pulse.*.ring`` under a
   run directory — what ``python -m sctools_tpu.obs pulse <run_dir>
   --serve`` uses, giving a whole fleet one scrape target without
-  touching the workers.
+  touching the workers.  When the run dir holds a serve journal the
+  scrape also carries the per-tenant scx-slo gauges
+  (:func:`sctools_tpu.obs.slo.render_slo_metrics`): p50/p95/p99,
+  queue-age, error-budget burn, attributed device-seconds.
 
 Binds 127.0.0.1 only: telemetry is not an open network service. For
 scrape-less setups the atomic textfile export
@@ -61,7 +64,19 @@ class PulseExporter:
 
         if self._run_dir is not None:
             view = pulse.fleet_pulse(self._run_dir, window_s=self._window_s)
-            return pulse.render_pulse_metrics(view)
+            body = pulse.render_pulse_metrics(view)
+            # per-tenant scx-slo gauges ride the same scrape when the
+            # run dir holds a serve journal; an empty stitch adds
+            # nothing, and a stitch failure must not kill the pulse
+            # scrape (label collisions still raise: fail loudly, never
+            # merge two tenants into one series)
+            from . import slo
+
+            if slo.find_journal_dirs(self._run_dir):
+                body += slo.render_slo_metrics(
+                    slo.stitch_run(self._run_dir, window_s=self._window_s)
+                )
+            return body
         # live mode: the process's own counters/spans plus its pulse
         # gauges — render_metrics() raises on name-mangling collisions
         # (PR 4), and render_pulse_metrics applies the same discipline

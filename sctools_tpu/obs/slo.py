@@ -1,0 +1,1063 @@
+"""scx-slo: per-job distributed tracing + per-tenant SLO/cost attribution.
+
+Every other observability surface in this repo is batch-, site-, or
+task-granular; the serving plane needs the *tenant's* view — "my job
+took 12 seconds: where did they go, and how much device did I actually
+use?".  This module stitches one end-to-end trace per committed serve
+job out of artifacts that already exist, across process boundaries:
+
+- the **submit timestamp** the tenant-side CLI stamps into the ServeJob
+  payload (``serve submit``; rides the payload, not the task identity);
+- the scx-sched **journal events** — ``leased``/``committed`` wall
+  timestamps, plus the packer's plan the engine journals verbatim on
+  each commit (``pack``/``pack_members``/``pack_rows``/
+  ``pack_degraded``/``pack_bucket``/``pack_execs``);
+- the scx-pulse **heartbeats** of the dispatches that actually executed
+  the pack, matched via the ring's existing 16-byte task field: the
+  engine stamps every device run's *execution id* (the member task id
+  for a solo run, :func:`~sctools_tpu.serve.packer.pack_exec_id` for a
+  packed one) into the obs context, and the gatherer's heartbeats carry
+  it out through the ring.
+
+Heartbeat leg intervals live on the writing worker's monotonic clock;
+journal events live on the wall clock.  The ring header's wall/mono
+anchor pair (:func:`~sctools_tpu.obs.pulse.mono_to_wall`) joins them,
+yielding per committed job the decomposition
+
+    queue_wait + pack_wait + device(compute∪d2h) + writeback + commit
+
+where the four post-lease legs sum EXACTLY to the journal's
+leased→committed span by construction (the device window is clipped to
+it; ``writeback`` is the host-side gaps inside the window, ``commit``
+the tail after the last device interval).
+
+Cost attribution is pro-rata: a pack's heartbeat totals (device-seconds
+as the union of compute∪d2h intervals, h2d/d2h bytes, wasted pad bytes)
+split across its members by the packer's streamed per-member row counts
+— float shares close exactly on the last member, integer shares use
+largest-remainder — so summing members reproduces the pack totals
+*exactly* (pinned by test).  Collision-degraded jobs are charged solo;
+a collision-ABORTED packed attempt and any crashed lineage's orphaned
+dispatches (matched through the plan announcements the engine writes as
+worker meta events) are real device time and split equally — nothing is
+silently dropped, and ``unattributed_device_s`` stays 0 on a healthy
+run (the serve-smoke CI assertion).
+
+On top: per-tenant sliding-window SLO accounting — p50/p95/p99 end-to-
+end latency, queue-age of the oldest open job (the admission-starvation
+signal), throughput, and error-budget burn against a configurable
+latency target.  Surfaced four ways: ``python -m sctools_tpu.obs slo
+<run_dir>`` (text/--json/--watch), per-tenant gauges on the
+``obs/serve.py`` /metrics endpoint, the serve block of ``sched
+status``, and per-job rows in the fleet timeline.  The per-pack records
+expose ``occupancy`` and ``limiting_stage`` verbatim from
+:func:`~sctools_tpu.obs.pulse.attribute_bubbles` — the signal layer the
+pulse-steered online batching control loop (ROADMAP item 3) actuates
+on.
+
+The host-side :func:`probe` (pack phase marks the engine attaches to
+commit events) follows the scx-pulse overhead discipline: off by
+default, a cached no-op singleton when disabled (one branch on the hot
+path; ``bench.py`` pins ``slo_overhead <= 1.02``), on via
+``SCTOOLS_TPU_SLO=1`` — read once at import, never per request (the
+SCX903 rule the serve path is subject to).
+
+Pure stdlib + obs.pulse: a journal and its rings stitch anywhere.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob as globmod
+import json
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import pulse as _pulse
+
+__all__ = [
+    "DEFAULT_OBJECTIVE",
+    "DEFAULT_TARGET_S",
+    "ENV_FLAG",
+    "ENV_TARGET",
+    "NOOP",
+    "attribute_pack",
+    "enabled",
+    "find_journal_dirs",
+    "pack_totals",
+    "probe",
+    "render_slo",
+    "render_slo_metrics",
+    "split_prorata",
+    "split_prorata_int",
+    "stitch",
+    "stitch_run",
+]
+
+#: kept in lockstep with ``sctools_tpu.serve.api.SERVE_TASK_KIND``
+#: (asserted by test); duplicated so this module never imports the
+#: serve package (obs analyzes captures on hosts with no engine)
+SERVE_KIND = "serve_cell_metrics"
+
+#: the warmup calibration run's context task id — device time that is
+#: deliberately nobody's (the engine tags it so it never reads as
+#: unattributed tenant cost)
+WARMUP_EXEC = "warmup"
+
+ENV_FLAG = "SCTOOLS_TPU_SLO"
+ENV_TARGET = "SCTOOLS_TPU_SLO_TARGET_S"
+
+#: default end-to-end latency target (seconds) the error budget burns
+#: against; override per surface (--target) or fleet-wide (ENV_TARGET)
+DEFAULT_TARGET_S = 30.0
+
+#: default SLO objective: 99% of jobs inside the target — burn 1.0
+#: means violations arrive exactly at the sustainable rate
+DEFAULT_OBJECTIVE = 0.99
+
+
+# ----------------------------------------------------------------- probe
+
+
+class _NoopProbe:
+    """The disabled probe: a cached singleton, no state, no clock reads."""
+
+    __slots__ = ()
+
+    def mark(self, name: str) -> None:
+        return None
+
+    def marks(self) -> Dict[str, float]:
+        return {}
+
+
+NOOP = _NoopProbe()
+
+
+class _Probe:
+    """Host-side phase marks (wall clock) for one pack execution."""
+
+    __slots__ = ("_marks",)
+
+    def __init__(self):
+        self._marks: Dict[str, float] = {}
+
+    def mark(self, name: str) -> None:
+        self._marks[str(name)] = round(time.time(), 6)  # scx-lint: disable=SCX109 -- trace mark, joined against journal wall timestamps
+
+    def marks(self) -> Dict[str, float]:
+        return dict(self._marks)
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "").strip() not in ("", "0")
+
+
+# read ONCE at import (a resident worker must not consult per-request
+# host state); tests/bench flip it via force()
+_enabled = _env_enabled()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def probe():
+    """A phase-mark probe — the cached no-op singleton when disabled."""
+    if not _enabled:
+        return NOOP
+    return _Probe()
+
+
+@contextlib.contextmanager
+def force(on: bool = True):
+    """Temporarily force the probe on/off (tests and bench only)."""
+    global _enabled
+    prior = _enabled
+    _enabled = bool(on)
+    try:
+        yield
+    finally:
+        _enabled = prior
+
+
+def target_from_env(default: float = DEFAULT_TARGET_S) -> float:
+    raw = os.environ.get(ENV_TARGET, "").strip()
+    if not raw:
+        return default
+    try:
+        value = float(raw)
+        return value if value > 0 else default
+    except ValueError:
+        return default
+
+
+# ----------------------------------------------------- pro-rata splitting
+
+
+def _normal_weights(weights: Optional[Sequence[float]], n: int) -> List[float]:
+    if weights is not None and len(weights) == n:
+        cleaned = [max(float(w), 0.0) for w in weights]
+        if sum(cleaned) > 0:
+            return cleaned
+    return [1.0] * n
+
+
+def split_prorata(total: float, weights: Sequence[float]) -> List[float]:
+    """Split a float total by weights; shares sum to ``total`` EXACTLY.
+
+    The last share is computed as the remainder, so float rounding can
+    never leak cost — the conservation property the attribution tests
+    pin.
+    """
+    n = len(weights)
+    if n == 0:
+        return []
+    weights = _normal_weights(weights, n)
+    denom = sum(weights)
+    shares: List[float] = []
+    acc = 0.0
+    for w in weights[:-1]:
+        share = total * (w / denom)
+        shares.append(share)
+        acc += share
+    shares.append(total - acc)
+    return shares
+
+
+def split_prorata_int(total: int, weights: Sequence[float]) -> List[int]:
+    """Largest-remainder split of an integer total; sums exactly."""
+    n = len(weights)
+    if n == 0:
+        return []
+    weights = _normal_weights(weights, n)
+    denom = sum(weights)
+    quotas = [total * (w / denom) for w in weights]
+    shares = [int(q) for q in quotas]
+    leftover = total - sum(shares)
+    order = sorted(
+        range(n), key=lambda i: (-(quotas[i] - shares[i]), i)
+    )
+    for i in order[: max(leftover, 0)]:
+        shares[i] += 1
+    return shares
+
+
+# -------------------------------------------------- heartbeat aggregation
+
+
+def _device_intervals(record: dict) -> List[Tuple[float, float]]:
+    out = []
+    for leg in ("compute", "d2h"):
+        start, end = (record.get("legs") or {}).get(leg, (0.0, 0.0))
+        if end > start:
+            out.append((float(start), float(end)))
+    return out
+
+
+def pack_totals(records: Iterable[dict]) -> Dict[str, Any]:
+    """One execution's heartbeat totals — the quantity to attribute.
+
+    ``device_s`` is the union of compute∪d2h intervals (concurrent legs
+    are not double-billed), bytes are plain sums, and
+    ``wasted_pad_bytes`` is each dispatch's h2d bytes scaled by its pad
+    fraction — the bytes moved for rows nobody asked for.
+    """
+    intervals: List[Tuple[float, float]] = []
+    bytes_h2d = 0
+    bytes_d2h = 0
+    wasted = 0
+    real = 0
+    padded = 0
+    heartbeats = 0
+    for record in records:
+        heartbeats += 1
+        intervals.extend(_device_intervals(record))
+        h2d = int(record.get("bytes_h2d") or 0)
+        bytes_h2d += h2d
+        bytes_d2h += int(record.get("bytes_d2h") or 0)
+        p = int(record.get("padded_rows") or 0)
+        r = int(record.get("real_rows") or 0)
+        real += r
+        padded += p
+        if p > 0:
+            wasted += int(round(h2d * (p - min(r, p)) / p))
+    return {
+        "heartbeats": heartbeats,
+        "device_s": round(_pulse._total(_pulse._union(intervals)), 9),
+        "bytes_h2d": bytes_h2d,
+        "bytes_d2h": bytes_d2h,
+        "wasted_pad_bytes": wasted,
+        "real_rows": real,
+        "padded_rows": padded,
+    }
+
+
+def attribute_pack(
+    totals: Dict[str, Any], weights: Sequence[float]
+) -> List[Dict[str, Any]]:
+    """Pro-rata member shares of one execution's totals (conserving).
+
+    Float quantities close on the last member, integer quantities use
+    largest-remainder — summing the returned shares reproduces
+    ``totals`` exactly, whatever the weights.
+    """
+    device = split_prorata(float(totals.get("device_s") or 0.0), weights)
+    h2d = split_prorata_int(int(totals.get("bytes_h2d") or 0), weights)
+    d2h = split_prorata_int(int(totals.get("bytes_d2h") or 0), weights)
+    pad = split_prorata_int(
+        int(totals.get("wasted_pad_bytes") or 0), weights
+    )
+    return [
+        {
+            "device_s": device[i],
+            "bytes_h2d": h2d[i],
+            "bytes_d2h": d2h[i],
+            "wasted_pad_bytes": pad[i],
+        }
+        for i in range(len(weights))
+    ]
+
+
+# -------------------------------------------------------------- stitching
+
+
+def _get(obj: Any, key: str, default: Any = None) -> Any:
+    """Field access over raw journal dicts AND sched.journal dataclasses."""
+    if isinstance(obj, dict):
+        return obj.get(key, default)
+    return getattr(obj, key, default)
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    ordered = sorted(values)
+    if not ordered:
+        return None
+    index = min(int(q * (len(ordered) - 1) + 0.5), len(ordered) - 1)
+    return ordered[index]
+
+
+def _heartbeat_index(
+    rings: Dict[str, dict]
+) -> Dict[str, List[Tuple[dict, dict]]]:
+    """exec id -> [(ring, record)] for every task-stamped heartbeat."""
+    index: Dict[str, List[Tuple[dict, dict]]] = {}
+    for ring in rings.values():
+        for record in ring.get("records") or []:
+            exec_id = record.get("task_id") or ""
+            if exec_id:
+                index.setdefault(exec_id, []).append((ring, record))
+    return index
+
+
+def _wall_device_intervals(
+    matched: List[Tuple[dict, dict]]
+) -> Optional[List[Tuple[float, float]]]:
+    """Matched heartbeats' device intervals on the wall clock.
+
+    None when any ring lacks the wall/mono anchor — the trace then
+    degrades to journal-only legs rather than guessing an offset.
+    """
+    out: List[Tuple[float, float]] = []
+    for ring, record in matched:
+        for start, end in _device_intervals(record):
+            wall_start = _pulse.mono_to_wall(ring, start)
+            wall_end = _pulse.mono_to_wall(ring, end)
+            if wall_start is None or wall_end is None:
+                return None
+            out.append((wall_start, wall_end))
+    return out
+
+
+def _clip(
+    intervals: List[Tuple[float, float]], lo: float, hi: float
+) -> List[Tuple[float, float]]:
+    return [
+        (max(start, lo), min(end, hi))
+        for start, end in intervals
+        if min(end, hi) > max(start, lo)
+    ]
+
+
+def stitch(
+    tasks: Dict[str, Any],
+    events: List[dict],
+    rings: Dict[str, dict],
+    now: Optional[float] = None,
+    window_s: Optional[float] = None,
+    target_s: Optional[float] = None,
+    objective: float = DEFAULT_OBJECTIVE,
+    run_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """The trace join: journal + payload + heartbeats -> the SLO view.
+
+    Pure over its inputs (tests inject fabricated journals and rings);
+    :func:`stitch_run` does the on-disk discovery.  Returns one
+    JSON-serializable dict: per-job traces with the five-leg
+    decomposition and attributed costs, per-pack records (occupancy +
+    limiting stage verbatim from the heartbeats), per-tenant SLO rows,
+    and fleet roll-ups (trace completeness, unattributed device time).
+    """
+    target = target_s if target_s is not None else target_from_env()
+    objective = min(max(float(objective), 0.0), 0.999999)
+
+    serve_tasks = {
+        tid: task
+        for tid, task in tasks.items()
+        if _get(task, "kind") == SERVE_KIND
+    }
+    by_tid: Dict[str, List[dict]] = {}
+    plans: Dict[str, Dict[str, Any]] = {}
+    max_ts = 0.0
+    for event in events:
+        ts = event.get("ts")
+        if isinstance(ts, (int, float)):
+            max_ts = max(max_ts, float(ts))
+        if event.get("event") == "worker":
+            plan = event.get("pack_plan")
+            if isinstance(plan, dict) and plan.get("exec_id"):
+                plans.setdefault(str(plan["exec_id"]), plan)
+            continue
+        tid = event.get("id")
+        if isinstance(tid, str) and tid in serve_tasks:
+            by_tid.setdefault(tid, []).append(event)
+    if now is None:
+        now = max_ts
+
+    index = _heartbeat_index(rings)
+
+    # --- executions: what actually ran on the device, from the commit
+    # extras (authoritative: membership + row weights) plus the plan
+    # announcements (orphaned lineages a crash never committed)
+    executions: Dict[str, Dict[str, Any]] = {}
+
+    def note_exec(
+        exec_id: str,
+        tids: List[str],
+        rows: Optional[List[int]],
+        degraded: Optional[str],
+        aborted: bool,
+        orphaned: bool,
+    ) -> None:
+        entry = executions.get(exec_id)
+        if entry is None:
+            executions[exec_id] = {
+                "exec_id": exec_id,
+                "tids": list(tids),
+                "rows": list(rows) if rows else None,
+                "degraded": degraded,
+                "aborted": aborted,
+                "orphaned": orphaned,
+            }
+        elif entry["orphaned"] and not orphaned:
+            # a commit's view of the same execution beats the plan's
+            entry.update(
+                tids=list(tids),
+                rows=list(rows) if rows else None,
+                degraded=degraded,
+                aborted=aborted,
+                orphaned=False,
+            )
+
+    commits: Dict[str, dict] = {}
+    leases: Dict[str, dict] = {}
+    for tid, seq in by_tid.items():
+        commit = next(
+            (e for e in seq if e.get("event") == "committed"), None
+        )
+        if commit is not None:
+            commits[tid] = commit
+            commit_ts = float(commit.get("ts") or 0.0)
+            worker = commit.get("worker")
+            candidates = [
+                e
+                for e in seq
+                if e.get("event") == "leased"
+                and float(e.get("ts") or 0.0) <= commit_ts
+            ]
+            lineage = [e for e in candidates if e.get("worker") == worker]
+            pick = (lineage or candidates)[-1] if (
+                lineage or candidates
+            ) else None
+            if pick is not None:
+                leases[tid] = pick
+            for seg in commit.get("pack_execs") or []:
+                if isinstance(seg, dict) and seg.get("exec_id"):
+                    note_exec(
+                        str(seg["exec_id"]),
+                        [str(t) for t in seg.get("tids") or [tid]],
+                        seg.get("rows"),
+                        seg.get("degraded"),
+                        bool(seg.get("aborted")),
+                        orphaned=False,
+                    )
+            if not commit.get("pack_execs"):
+                # pre-slo journal (or `sched resume`): the solo exec id
+                # IS the task id — stitch what the ring offers
+                note_exec(tid, [tid], None, None, False, orphaned=False)
+    for exec_id, plan in plans.items():
+        if exec_id in index:  # only orphans that left heartbeats matter
+            note_exec(
+                exec_id,
+                [str(t) for t in plan.get("tids") or []],
+                None,
+                None,
+                False,
+                orphaned=exec_id not in executions,
+            )
+    # a crashed lineage's degrade-solo (or `sched resume`) dispatches
+    # carry the member task id itself — attributable by identity
+    for exec_id in index:
+        if exec_id in serve_tasks and exec_id not in executions:
+            note_exec(exec_id, [exec_id], None, None, False, orphaned=True)
+
+    # --- per-execution totals + pro-rata member shares
+    packs: List[Dict[str, Any]] = []
+    cost_by_tid: Dict[str, Dict[str, Any]] = {}
+    attributed_device = 0.0
+    for exec_id in sorted(executions):
+        entry = executions[exec_id]
+        matched = index.get(exec_id, [])
+        records = [record for _, record in matched]
+        totals = pack_totals(records)
+        bubbles = _pulse.attribute_bubbles(records)
+        tids = entry["tids"]
+        weights = entry["rows"] or [1.0] * len(tids)
+        shares = attribute_pack(totals, weights)
+        tenants = []
+        for tid in tids:
+            payload = _get(serve_tasks.get(tid), "payload") or {}
+            tenants.append(str(payload.get("tenant", "?")))
+        packs.append(
+            {
+                "exec_id": exec_id,
+                "tids": list(tids),
+                "tenants": tenants,
+                "rows": entry["rows"],
+                "degraded": entry["degraded"],
+                "aborted": entry["aborted"],
+                "orphaned": entry["orphaned"],
+                "totals": totals,
+                # verbatim from the heartbeats: the ROADMAP item 3
+                # signal pair (how full was the bucket, what bounded it)
+                "occupancy": (
+                    totals["real_rows"] / totals["padded_rows"]
+                    if totals["padded_rows"]
+                    else None
+                ),
+                "limiting_stage": bubbles["limiting_stage"],
+                "bubble_fraction": bubbles["bubble_fraction"],
+            }
+        )
+        attributed_device += totals["device_s"]
+        for tid, share in zip(tids, shares):
+            cost = cost_by_tid.setdefault(
+                tid,
+                {
+                    "device_s": 0.0,
+                    "bytes_h2d": 0,
+                    "bytes_d2h": 0,
+                    "wasted_pad_bytes": 0,
+                },
+            )
+            cost["device_s"] += share["device_s"]
+            cost["bytes_h2d"] += share["bytes_h2d"]
+            cost["bytes_d2h"] += share["bytes_d2h"]
+            cost["wasted_pad_bytes"] += share["wasted_pad_bytes"]
+
+    # --- unattributed device time: heartbeats claiming an exec nobody
+    # owns (and untagged gatherer dispatches) — 0 on a healthy run
+    known = set(executions) | {WARMUP_EXEC}
+    orphan_intervals: List[Tuple[float, float]] = []
+    for ring in rings.values():
+        ring_orphans: List[Tuple[float, float]] = []
+        for record in ring.get("records") or []:
+            stage = str(record.get("stage") or "")
+            exec_id = record.get("task_id") or ""
+            if exec_id in known:
+                continue
+            if exec_id or stage.startswith("gatherer."):
+                ring_orphans.extend(_device_intervals(record))
+        orphan_intervals.extend(_pulse._union(ring_orphans))
+    unattributed_device_s = round(_pulse._total(orphan_intervals), 9)
+
+    # --- per-job traces
+    jobs: List[Dict[str, Any]] = []
+    for tid in sorted(commits, key=lambda t: _get(serve_tasks[t], "name")):
+        task = serve_tasks[tid]
+        payload = _get(task, "payload") or {}
+        tenant = str(payload.get("tenant", "?"))
+        submitted = payload.get("submitted")
+        submitted = (
+            float(submitted)
+            if isinstance(submitted, (int, float))
+            else None
+        )
+        commit = commits[tid]
+        lease = leases.get(tid)
+        t_commit = float(commit.get("ts") or 0.0)
+        t_lease = float(lease.get("ts")) if lease else None
+        segs = [
+            executions[eid]
+            for eid in executions
+            if tid in executions[eid]["tids"]
+            and not executions[eid]["orphaned"]
+        ]
+        matched = [
+            pair for seg in segs for pair in index.get(seg["exec_id"], [])
+        ]
+        wall = _wall_device_intervals(matched)
+        legs = None
+        if (
+            submitted is not None
+            and t_lease is not None
+            and wall is not None
+            and wall
+        ):
+            device_union = _clip(
+                _pulse._union(wall), t_lease, t_commit
+            )
+            if device_union:
+                d_start = device_union[0][0]
+                d_end = device_union[-1][1]
+                device_s = _pulse._total(device_union)
+                legs = {
+                    "queue_wait": round(max(t_lease - submitted, 0.0), 6),
+                    "pack_wait": round(d_start - t_lease, 6),
+                    "device": round(device_s, 6),
+                    "writeback": round(
+                        (d_end - d_start) - device_s, 6
+                    ),
+                    "commit": round(t_commit - d_end, 6),
+                }
+        primary = next(
+            (seg for seg in segs if not seg["aborted"]), None
+        )
+        jobs.append(
+            {
+                "id": tid,
+                "name": _get(task, "name"),
+                "tenant": tenant,
+                "submitted": submitted,
+                "leased": t_lease,
+                "committed": t_commit,
+                "worker": commit.get("worker"),
+                "stolen": bool((lease or {}).get("stolen")),
+                "attempt": commit.get("attempt"),
+                "e2e_s": (
+                    round(t_commit - submitted, 6)
+                    if submitted is not None
+                    else None
+                ),
+                "span_s": (
+                    round(t_commit - t_lease, 6)
+                    if t_lease is not None
+                    else None
+                ),
+                "complete": legs is not None,
+                "legs": legs,
+                "pack": primary["exec_id"] if primary else None,
+                "pack_size": len(primary["tids"]) if primary else None,
+                "pack_degraded": commit.get("pack_degraded"),
+                "cost": cost_by_tid.get(
+                    tid,
+                    {
+                        "device_s": 0.0,
+                        "bytes_h2d": 0,
+                        "bytes_d2h": 0,
+                        "wasted_pad_bytes": 0,
+                    },
+                ),
+            }
+        )
+
+    # --- per-tenant SLO accounting over the (optional) trailing window
+    terminal = set(commits)
+    for tid, seq in by_tid.items():
+        if any(e.get("event") == "quarantined" for e in seq):
+            terminal.add(tid)
+    tenants: Dict[str, Dict[str, Any]] = {}
+
+    def tenant_row(tenant: str) -> Dict[str, Any]:
+        return tenants.setdefault(
+            tenant,
+            {
+                "committed": 0,
+                "open": 0,
+                "complete": 0,
+                "violations": 0,
+                "queue_age_s": None,
+                "_latencies": [],
+                "device_s": 0.0,
+                "wasted_pad_bytes": 0,
+            },
+        )
+
+    cutoff = (now - window_s) if (window_s and now) else None
+    for job in jobs:
+        if cutoff is not None and job["committed"] < cutoff:
+            continue
+        row = tenant_row(job["tenant"])
+        row["committed"] += 1
+        if job["complete"]:
+            row["complete"] += 1
+        if job["e2e_s"] is not None:
+            row["_latencies"].append(job["e2e_s"])
+            if job["e2e_s"] > target:
+                row["violations"] += 1
+        row["device_s"] += job["cost"]["device_s"]
+        row["wasted_pad_bytes"] += job["cost"]["wasted_pad_bytes"]
+    for tid, task in serve_tasks.items():
+        if tid in terminal:
+            continue
+        payload = _get(task, "payload") or {}
+        row = tenant_row(str(payload.get("tenant", "?")))
+        row["open"] += 1
+        submitted = payload.get("submitted")
+        if isinstance(submitted, (int, float)) and now:
+            age = max(now - float(submitted), 0.0)
+            if row["queue_age_s"] is None or age > row["queue_age_s"]:
+                row["queue_age_s"] = round(age, 6)
+    for tenant, row in tenants.items():
+        latencies = row.pop("_latencies")
+        row["p50_s"] = _percentile(latencies, 0.50)
+        row["p95_s"] = _percentile(latencies, 0.95)
+        row["p99_s"] = _percentile(latencies, 0.99)
+        row["complete_fraction"] = (
+            row["complete"] / row["committed"] if row["committed"] else None
+        )
+        span = window_s
+        if not span and latencies and now:
+            first = min(
+                j["submitted"]
+                for j in jobs
+                if j["tenant"] == tenant and j["submitted"] is not None
+            )
+            span = max(now - first, 1e-9)
+        row["throughput_per_s"] = (
+            round(row["committed"] / span, 6) if span else None
+        )
+        row["error_budget_burn"] = (
+            round(
+                (row["violations"] / row["committed"]) / (1.0 - objective),
+                4,
+            )
+            if row["committed"]
+            else None
+        )
+        row["device_s"] = round(row["device_s"], 9)
+
+    committed_jobs = len(jobs)
+    complete_jobs = sum(1 for j in jobs if j["complete"])
+    view = {
+        "run_dir": run_dir,
+        "now": now,
+        "window_s": window_s,
+        "target_s": target,
+        "objective": objective,
+        "jobs": jobs,
+        "packs": packs,
+        "tenants": dict(sorted(tenants.items())),
+        "fleet": {
+            "committed": committed_jobs,
+            "open": sum(r["open"] for r in tenants.values()),
+            "complete_fraction": (
+                complete_jobs / committed_jobs if committed_jobs else None
+            ),
+            "attributed_device_s": round(attributed_device, 9),
+            "unattributed_device_s": unattributed_device_s,
+            "wasted_pad_bytes": sum(
+                p["totals"]["wasted_pad_bytes"] for p in packs
+            ),
+            "packs": len(packs),
+            "packs_degraded": sum(1 for p in packs if p["degraded"]),
+            "packs_orphaned": sum(1 for p in packs if p["orphaned"]),
+        },
+    }
+    return view
+
+
+# -------------------------------------------------------------- discovery
+
+
+def find_journal_dirs(run_dir: str) -> List[str]:
+    """Every journal under ``run_dir`` (one dir deep), deduped.
+
+    A bench workdir holds several (``journal-cold``/``journal-warm``);
+    a smoke run one; `sched status` callers skip this and pass their
+    journal directly.  Mirrors the fleet/pulse discovery walk.
+    """
+    run_dir = os.path.abspath(run_dir)
+    candidates = [run_dir, os.path.join(run_dir, "sched-journal")]
+    for sub in sorted(globmod.glob(os.path.join(run_dir, "*"))):
+        if os.path.isdir(sub):
+            candidates.append(sub)
+            candidates.append(os.path.join(sub, "sched-journal"))
+    out: List[str] = []
+    seen = set()
+    for candidate in candidates:
+        path = os.path.abspath(candidate)
+        if path in seen:
+            continue
+        seen.add(path)
+        if globmod.glob(os.path.join(path, "tasks-*.jsonl")):
+            out.append(path)
+    return out
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    out: List[dict] = []
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return out
+    for raw in data.split(b"\n"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            record = json.loads(raw)
+        except ValueError:
+            continue  # torn/garbled line: degrade, never raise
+        if isinstance(record, dict):
+            out.append(record)
+    return out
+
+
+def load_journal(
+    journal_dir: str,
+) -> Tuple[Dict[str, dict], List[dict]]:
+    """Raw (tasks by id, events in replay order) from one journal dir.
+
+    First registration wins (the journal's register discipline); events
+    sort by ``(ts, seq, worker)`` — the same fold order ``replay``
+    uses.
+    """
+    tasks: Dict[str, dict] = {}
+    for path in sorted(
+        globmod.glob(os.path.join(journal_dir, "tasks-*.jsonl"))
+    ):
+        for spec in _read_jsonl(path):
+            tid = spec.get("id")
+            if isinstance(tid, str) and tid not in tasks:
+                tasks[tid] = spec
+    events: List[dict] = []
+    for path in sorted(
+        globmod.glob(os.path.join(journal_dir, "events-*.jsonl"))
+    ):
+        events.extend(_read_jsonl(path))
+    events.sort(
+        key=lambda e: (e.get("ts", 0.0), e.get("seq", 0), e.get("worker", ""))
+    )
+    return tasks, events
+
+
+def stitch_run(
+    run_dir: str,
+    window_s: Optional[float] = None,
+    target_s: Optional[float] = None,
+    objective: float = DEFAULT_OBJECTIVE,
+    now: Optional[float] = None,
+    rings: Optional[Dict[str, dict]] = None,
+) -> Dict[str, Any]:
+    """Discover journals + pulse rings under ``run_dir`` and stitch."""
+    run_dir = os.path.abspath(run_dir)
+    tasks: Dict[str, Any] = {}
+    events: List[dict] = []
+    for journal_dir in find_journal_dirs(run_dir):
+        more_tasks, more_events = load_journal(journal_dir)
+        for tid, spec in more_tasks.items():
+            tasks.setdefault(tid, spec)
+        events.extend(more_events)
+    events.sort(
+        key=lambda e: (e.get("ts", 0.0), e.get("seq", 0), e.get("worker", ""))
+    )
+    if rings is None:
+        rings = _pulse.load_rings(run_dir)
+    return stitch(
+        tasks,
+        events,
+        rings,
+        now=now,
+        window_s=window_s,
+        target_s=target_s,
+        objective=objective,
+        run_dir=run_dir,
+    )
+
+
+# -------------------------------------------------------------- rendering
+
+
+def _fmt_s(value: Optional[float]) -> str:
+    return f"{value:7.3f}" if value is not None else "      -"
+
+
+def render_slo(view: Dict[str, Any]) -> str:
+    """The human-facing ``obs slo`` report."""
+    lines: List[str] = []
+    fleet = view["fleet"]
+    window = view.get("window_s")
+    lines.append(
+        f"slo: {view.get('run_dir') or '(in-memory)'}  "
+        f"target {view['target_s']:g}s @ {100 * view['objective']:g}%"
+        + (f"  (window {window:g}s)" if window else "  (whole run)")
+    )
+    tenants = view["tenants"]
+    if not tenants:
+        lines.append("no serve jobs found (journal empty or not a serve run)")
+        return "\n".join(lines) + "\n"
+    name_width = max(max(len(t) for t in tenants), 6)
+    lines.append(
+        f"{'tenant'.ljust(name_width)}  done  open  "
+        "p50 s    p95 s    p99 s   q-age s   jobs/s   burn  dev s   trace"
+    )
+    for tenant in sorted(tenants):
+        row = tenants[tenant]
+        burn = row["error_budget_burn"]
+        complete = row["complete_fraction"]
+        lines.append(
+            f"{tenant.ljust(name_width)}  "
+            f"{row['committed']:4d}  {row['open']:4d}  "
+            f"{_fmt_s(row['p50_s'])}  {_fmt_s(row['p95_s'])}  "
+            f"{_fmt_s(row['p99_s'])}  {_fmt_s(row['queue_age_s'])}  "
+            f"{(row['throughput_per_s'] or 0.0):7.2f}  "
+            + (f"{burn:5.2f}" if burn is not None else "    -")
+            + f"  {row['device_s']:6.3f}  "
+            + (f"{100 * complete:3.0f}%" if complete is not None else "  -")
+        )
+    lines.append("")
+    packs = view["packs"]
+    real_packs = [p for p in packs if not p["orphaned"]]
+    degraded = fleet["packs_degraded"]
+    lines.append(
+        f"packs: {len(real_packs)} execution(s)"
+        + (f" ({degraded} degraded)" if degraded else "")
+        + (
+            f" ({fleet['packs_orphaned']} orphaned lineage(s))"
+            if fleet["packs_orphaned"]
+            else ""
+        )
+    )
+    for pack in packs:
+        occupancy = pack["occupancy"]
+        occ = (
+            f"{100 * occupancy:.0f}%" if occupancy is not None else "-"
+        )
+        flags = "".join(
+            [
+                " degraded" if pack["degraded"] else "",
+                " aborted" if pack["aborted"] else "",
+                " orphaned" if pack["orphaned"] else "",
+            ]
+        )
+        lines.append(
+            f"  {pack['exec_id']}  x{len(pack['tids'])} "
+            f"[{','.join(sorted(set(pack['tenants'])))}]  "
+            f"occ {occ}  limited by {pack['limiting_stage'] or '-'}  "
+            f"device {pack['totals']['device_s']:.3f}s  "
+            f"pad-waste {pack['totals']['wasted_pad_bytes'] / 1e6:.2f}MB"
+            + flags
+        )
+    lines.append("")
+    complete = fleet["complete_fraction"]
+    lines.append(
+        f"fleet: {fleet['committed']} committed, {fleet['open']} open, "
+        "trace "
+        + (f"{100 * complete:.0f}%" if complete is not None else "-")
+        + f" complete, device {fleet['attributed_device_s']:.3f}s "
+        f"attributed / {fleet['unattributed_device_s']:.3f}s unattributed, "
+        f"pad-waste {fleet['wasted_pad_bytes'] / 1e6:.2f}MB"
+    )
+    slow = sorted(
+        (j for j in view["jobs"] if j["e2e_s"] is not None),
+        key=lambda j: -j["e2e_s"],
+    )[:5]
+    if slow:
+        lines.append("")
+        lines.append("slowest jobs (end-to-end decomposition):")
+        for job in slow:
+            legs = job["legs"]
+            if legs:
+                detail = (
+                    f"queue {legs['queue_wait']:.3f} + "
+                    f"pack {legs['pack_wait']:.3f} + "
+                    f"device {legs['device']:.3f} + "
+                    f"writeback {legs['writeback']:.3f} + "
+                    f"commit {legs['commit']:.3f}"
+                )
+            else:
+                detail = "incomplete trace (no matched heartbeats)"
+            lines.append(
+                f"  {job['name']}  {job['e2e_s']:.3f}s = {detail}"
+                + (" (stolen)" if job["stolen"] else "")
+                + (
+                    f" [{job['pack_degraded']}]"
+                    if job["pack_degraded"]
+                    else ""
+                )
+            )
+    return "\n".join(lines) + "\n"
+
+
+def render_slo_metrics(view: Dict[str, Any]) -> str:
+    """Per-tenant SLO gauges in Prometheus exposition format.
+
+    Labeled by tenant with the render_pulse_metrics collision
+    discipline: two tenants whose labels sanitize identically raise
+    instead of silently merging into one series.
+    """
+    lines: List[str] = []
+    claimed: Dict[str, str] = {}
+
+    def claim(series: str, source: str) -> None:
+        previous = claimed.setdefault(series, source)
+        if previous != source:
+            raise ValueError(
+                f"slo metric label collision after sanitizing: {previous} "
+                f"and {source} both render as {series!r}"
+            )
+
+    header_done = set()
+
+    def typed(metric: str) -> None:
+        if metric not in header_done:
+            header_done.add(metric)
+            lines.append(f"# TYPE sctools_tpu_slo_{metric} gauge")
+
+    def gauge(metric: str, tenant: Optional[str], value) -> None:
+        if value is None:
+            return
+        name = f"sctools_tpu_slo_{metric}"
+        typed(metric)
+        if tenant is None:
+            claim(name, "(fleet)")
+            lines.append(f"{name} {value}")
+        else:
+            label = _pulse._sanitize_label(tenant)
+            claim(f'{name}{{tenant="{label}"}}', f"tenant {tenant!r}")
+            lines.append(f'{name}{{tenant="{label}"}} {value}')
+
+    for tenant, row in sorted((view.get("tenants") or {}).items()):
+        gauge("committed_jobs", tenant, row["committed"])
+        gauge("open_jobs", tenant, row["open"])
+        gauge("p50_seconds", tenant, row["p50_s"])
+        gauge("p95_seconds", tenant, row["p95_s"])
+        gauge("p99_seconds", tenant, row["p99_s"])
+        gauge("queue_age_seconds", tenant, row["queue_age_s"])
+        gauge("throughput_jobs_per_s", tenant, row["throughput_per_s"])
+        gauge("error_budget_burn", tenant, row["error_budget_burn"])
+        gauge("device_seconds", tenant, row["device_s"])
+        gauge("wasted_pad_bytes", tenant, row["wasted_pad_bytes"])
+    fleet = view.get("fleet") or {}
+    gauge("fleet_trace_complete_fraction", None, fleet.get("complete_fraction"))
+    gauge(
+        "fleet_unattributed_device_seconds",
+        None,
+        fleet.get("unattributed_device_s"),
+    )
+    gauge("fleet_committed_jobs", None, fleet.get("committed"))
+    gauge("fleet_packs_degraded", None, fleet.get("packs_degraded"))
+    return "\n".join(lines) + "\n" if lines else ""

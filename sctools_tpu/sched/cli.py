@@ -128,15 +128,20 @@ def _print_mesh_summary(journal: Journal, out) -> None:
 def _print_serve_summary(journal: Journal, tasks, states, out) -> None:
     """Per-tenant serve-plane view when the journal carries serve jobs.
 
-    One line per tenant (queued/running/committed/quarantined) plus one
-    admission line per resident worker that announced its
-    AdmissionController snapshot — the operator's answer to "who is
-    waiting, who is being starved, and how deep is each replica" without
-    leaving ``sched status``.
+    One line per tenant (queued/running/committed/quarantined, plus the
+    queue-age of its oldest open job — the admission-starvation signal
+    the scx-slo plane reads) plus one admission line per resident worker
+    that announced its AdmissionController snapshot — the operator's
+    answer to "who is waiting, who is being starved, and how deep is
+    each replica" without leaving ``sched status``.  When pulse rings
+    sit in the run dir, a per-tenant scx-slo line (p50/p95, burn) rides
+    along.
     """
     from ..serve.api import SERVE_TASK_KIND
 
+    now = wall_clock()
     per_tenant = {}
+    oldest_open = {}
     for tid in sorted(tasks, key=lambda t: tasks[t].name):
         task = tasks[tid]
         if task.kind != SERVE_TASK_KIND:
@@ -157,6 +162,12 @@ def _print_serve_summary(journal: Journal, tasks, states, out) -> None:
             {"queued": 0, "running": 0, "committed": 0, "quarantined": 0},
         )
         counts[bucket] += 1
+        if bucket in ("queued", "running"):
+            submitted = task.payload.get("submitted")
+            if isinstance(submitted, (int, float)):
+                prior = oldest_open.get(tenant)
+                if prior is None or submitted < prior:
+                    oldest_open[tenant] = float(submitted)
     if not per_tenant:
         return
     for tenant, counts in sorted(per_tenant.items()):
@@ -166,7 +177,11 @@ def _print_serve_summary(journal: Journal, tasks, states, out) -> None:
         )
         if counts["quarantined"]:
             line += f" quarantined={counts['quarantined']}"
+        if tenant in oldest_open:
+            age = max(now - oldest_open[tenant], 0.0)
+            line += f" queue-age={age:.1f}s"
         print(line, file=out)
+    _print_slo_summary(journal, tasks, now, out)
     try:
         meta = journal.worker_meta()
     except Exception:  # noqa: BLE001 - status must never die on telemetry
@@ -187,6 +202,45 @@ def _print_serve_summary(journal: Journal, tasks, states, out) -> None:
             f"(max {serve.get('max_depth', '?')}/tenant) {detail} [{warm}]",
             file=out,
         )
+
+
+def _print_slo_summary(journal: Journal, tasks, now: float, out) -> None:
+    """Per-tenant scx-slo lines when the run dir carries pulse rings.
+
+    The journal conventionally lives at ``<run>/sched-journal`` with the
+    workers' heartbeat rings under the same run dir; stitching both
+    yields the tenant-facing latency/burn headline next to the queue
+    counts.  Any telemetry failure keeps the status alive.
+    """
+    try:
+        from ..obs import pulse as _pulse
+        from ..obs import slo as _slo
+
+        run_dir = os.path.dirname(os.path.abspath(journal.root)) or "."
+        rings = _pulse.load_rings(run_dir)
+        if not rings:
+            return
+        view = _slo.stitch(tasks, journal.events(), rings, now=now)
+        for tenant, row in sorted(view["tenants"].items()):
+            if not row["committed"] or row["p50_s"] is None:
+                continue
+            burn = row["error_budget_burn"]
+            complete = row["complete_fraction"]
+            print(
+                f"serve slo {tenant}: p50={row['p50_s']:.2f}s "
+                f"p95={row['p95_s']:.2f}s burn="
+                + (f"{burn:.2f}" if burn is not None else "-")
+                + " trace="
+                + (
+                    f"{100 * complete:.0f}%"
+                    if complete is not None
+                    else "-"
+                )
+                + " (`python -m sctools_tpu.obs slo` for the full trace)",
+                file=out,
+            )
+    except Exception:  # noqa: BLE001 - status must never die on telemetry
+        return
 
 
 def _print_efficiency_summary(journal_dir: str, out) -> None:

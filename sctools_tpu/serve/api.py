@@ -61,21 +61,41 @@ class ServeJob:
     Jobs ride the scx-sched journal (kind :data:`SERVE_TASK_KIND`) so
     lease/steal/quarantine give tenant isolation and crash recovery for
     free; the payload is exactly this record.
+
+    ``submitted`` is the tenant-side wall timestamp stamped at submit
+    time — the anchor the scx-slo trace decomposes ``queue_wait`` from.
+    It rides the payload but NOT the task identity
+    (:meth:`identity_payload`): resubmitting the same job later must
+    still dedupe to the same content-hashed task id.
     """
 
     tenant: str
     bam: str
     out: str
+    submitted: Optional[float] = None
+
+    def identity_payload(self) -> Dict[str, Any]:
+        """The payload slice that defines the job's content-hashed id."""
+        return {"tenant": self.tenant, "bam": self.bam, "out": self.out}
 
     def payload(self) -> Dict[str, Any]:
-        return {"tenant": self.tenant, "bam": self.bam, "out": self.out}
+        payload = self.identity_payload()
+        if self.submitted is not None:
+            payload["submitted"] = self.submitted
+        return payload
 
     @staticmethod
     def from_payload(payload: Dict[str, Any]) -> "ServeJob":
+        submitted = payload.get("submitted")
         return ServeJob(
             tenant=str(payload["tenant"]),
             bam=str(payload["bam"]),
             out=str(payload["out"]),
+            submitted=(
+                float(submitted)
+                if isinstance(submitted, (int, float))
+                else None
+            ),
         )
 
 

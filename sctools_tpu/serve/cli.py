@@ -31,19 +31,33 @@ from .api import DEFAULT_ADMISSION_DEPTH, SERVE_TASK_KIND, ServeJob
 
 
 def submit_jobs(journal_dir: str, jobs: List[ServeJob]) -> int:
-    """Register jobs (idempotently) in the journal; returns the new count."""
-    from ..sched.journal import Journal, make_task
+    """Register jobs (idempotently) in the journal; returns the new count.
+
+    Each job is stamped with its submit wall timestamp (the scx-slo
+    ``queue_wait`` anchor) — but the task id hashes only the identity
+    payload, so resubmitting the same job at a later time still dedupes
+    to the existing task (the first submit's timestamp wins).
+    """
+    from ..sched.journal import Journal, Task, task_id, wall_clock
 
     journal = Journal(journal_dir, worker_id="serve-submit")
     try:
-        tasks = [
-            make_task(
-                SERVE_TASK_KIND,
-                f"{job.tenant}/{os.path.basename(job.out)}",
-                job.payload(),
+        now = round(wall_clock(), 6)
+        tasks = []
+        for job in jobs:
+            if job.submitted is None:
+                job = ServeJob(job.tenant, job.bam, job.out, submitted=now)
+            name = f"{job.tenant}/{os.path.basename(job.out)}"
+            tasks.append(
+                Task(
+                    id=task_id(
+                        SERVE_TASK_KIND, name, job.identity_payload()
+                    ),
+                    kind=SERVE_TASK_KIND,
+                    name=name,
+                    payload=job.payload(),
+                )
             )
-            for job in jobs
-        ]
         return len(journal.register(tasks))
     finally:
         journal.close()

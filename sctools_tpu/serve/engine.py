@@ -41,7 +41,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import obs
-from ..obs import xprof
+from ..obs import slo, xprof
 from ..metrics.gatherer import DEFAULT_BATCH_RECORDS, GatherCellMetrics
 from ..sched import faults
 from ..sched.commit import sha256_file
@@ -63,7 +63,7 @@ from .manifest import (
     aot_cache_dir,
     load_manifest,
 )
-from .packer import plan_packs, run_packed
+from .packer import PackTrace, _trace_task, plan_packs, run_packed
 
 
 class ServeWorker:
@@ -138,7 +138,10 @@ class ServeWorker:
                     compress=self._compress,
                     batch_records=self._batch_records,
                 )
-                gatherer.extract_metrics()
+                # tag calibration heartbeats so scx-slo never reads
+                # warmup dispatches as unattributed tenant device time
+                with _trace_task("warmup"):
+                    gatherer.extract_metrics()
         self._warm = True
         self._queue.journal.announce_worker(
             {"serve": self._admission.snapshot(), "warm": True}
@@ -313,31 +316,76 @@ class ServeWorker:
     ) -> int:
         for tid, _ in members:
             faults.fire("task.claimed", name=tid)
+        trace = PackTrace(tids=[tid for tid, _ in members])
+        # announce the plan BEFORE running: if this lineage dies mid-pack,
+        # scx-slo can still attribute the orphaned heartbeats to these
+        # members instead of reporting unattributed device time
+        journal.announce_worker(
+            {
+                "serve": self._admission.snapshot(),
+                "pack_plan": {
+                    "exec_id": (
+                        trace.exec_id()
+                        if len(members) > 1
+                        else trace.tids[0]
+                    ),
+                    "tids": list(trace.tids),
+                },
+            }
+        )
+        probe = slo.probe()
         try:
             with obs.span(
                 "serve:pack",
                 jobs=len(members),
                 tenants=len({job.tenant for _, job in members}),
             ):
+                probe.mark("pack_start")
                 artifacts, packed = run_packed(
                     [job for _, job in members],
                     compress=self._compress,
                     batch_records=self._batch_records,
+                    trace=trace,
                 )
+                probe.mark("pack_done")
         except Exception as error:  # noqa: BLE001 - every failure journals
             self._fail_pack(journal, members, attempts, error)
             return 0
         self.packs_run += 1
         if len(members) > 1 and not packed:
             self.packs_degraded += 1
+        degraded = trace.degrade_reason()
+        marks = probe.marks()
         for (tid, _), artifact in zip(members, artifacts):
             faults.fire("task.commit", name=tid)
+            # the committed event carries the packer's plan verbatim —
+            # the journal folds ignore the extras, but scx-slo stitches
+            # them against pulse heartbeats via the exec ids
+            segment = next(
+                (
+                    seg
+                    for seg in trace.executed
+                    if tid in seg["tids"] and not seg.get("aborted")
+                ),
+                None,
+            )
+            extra = {
+                "pack": segment["exec_id"] if segment else None,
+                "pack_members": list(trace.tids),
+                "pack_rows": segment.get("rows") if segment else None,
+                "pack_degraded": degraded,
+                "pack_bucket": trace.bucket,
+                "pack_execs": trace.executed,
+            }
+            if marks:
+                extra["slo_marks"] = marks
             journal.record(
                 tid,
                 "committed",
                 attempt=attempts[tid],
                 part=artifact,
                 sha256=sha256_file(artifact),
+                **extra,
             )
             obs.count("sched_commits")
             self.jobs_committed += 1
@@ -391,5 +439,8 @@ class ServeWorker:
 def run_serve_task(task: Task) -> Optional[str]:
     """Solo runner for ``sched resume``: one serve job, no resident engine."""
     job = ServeJob.from_payload(task.payload)
-    artifacts, _ = run_packed([job])
+    # the trace stamps the task id onto the run's pulse heartbeats, so a
+    # journal drained by `sched resume` still stitches in scx-slo (the
+    # solo exec id IS the task id; no pack extras needed)
+    artifacts, _ = run_packed([job], trace=PackTrace(tids=[task.id]))
     return artifacts[0]

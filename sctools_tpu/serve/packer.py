@@ -33,13 +33,15 @@ agree on reference names (the wire ref column is header-coded).
 
 from __future__ import annotations
 
+import contextlib
+import hashlib
 import os
-from dataclasses import dataclass
-from typing import Dict, List, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import ingest
+from .. import ingest, obs
 from ..io.packed import concat_frames, copy_frame
 from ..io.sam import AlignmentReader
 from ..metrics.gatherer import DEFAULT_BATCH_RECORDS, GatherCellMetrics
@@ -70,6 +72,63 @@ class PackPlan:
 
     jobs: Tuple[ServeJob, ...]
     estimated_records: int
+
+
+def pack_exec_id(tids: Sequence[str]) -> str:
+    """Deterministic 16-hex execution id for a multi-member packed run.
+
+    16 chars — exactly the scx-pulse ring's 16-byte task field, so the
+    id stamped into :func:`obs.set_context` survives the heartbeat
+    round-trip verbatim and scx-slo can match dispatches back to packs.
+    """
+    blob = "pack:" + ",".join(sorted(tids))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+@dataclass
+class PackTrace:
+    """What :func:`run_packed` actually executed, for scx-slo stitching.
+
+    The engine constructs one per pack with the member task ids (aligned
+    with the job list) and journals the filled-in trace on each member's
+    ``committed`` event.  ``executed`` holds one segment per device run:
+
+    - a packed run: one segment with ``exec_id`` = :func:`pack_exec_id`,
+      all member tids, and the per-member streamed row counts (the
+      pro-rata cost-attribution weights);
+    - a collision-aborted packed attempt: same, plus ``aborted`` — its
+      heartbeats are real device time and stay attributable (split
+      equally, rows unknown at abort);
+    - a solo run (single-job pack or collision degrade): ``exec_id`` is
+      the member's own task id, so solo heartbeats need no extra key.
+    """
+
+    tids: List[str]
+    bucket: int = 0
+    executed: List[Dict[str, Any]] = field(default_factory=list)
+
+    def exec_id(self) -> str:
+        return pack_exec_id(self.tids)
+
+    def degrade_reason(self) -> Optional[str]:
+        for segment in self.executed:
+            if segment.get("degraded"):
+                return str(segment["degraded"])
+        return None
+
+
+@contextlib.contextmanager
+def _trace_task(exec_id: Optional[str]):
+    """Stamp the obs context task id (pulse heartbeats inherit it)."""
+    if exec_id is None:
+        yield
+        return
+    prior = obs.get_context().get("task_id")
+    obs.set_context(task_id=exec_id)
+    try:
+        yield
+    finally:
+        obs.set_context(task_id=prior)
 
 
 def estimate_records(bam: str) -> int:
@@ -190,6 +249,8 @@ class PackedCellMetrics(GatherCellMetrics):
             raise ValueError("a pack needs at least one job")
         self._jobs = list(jobs)
         self._membership: Dict[str, int] = {}
+        #: per-member streamed record counts (scx-slo's pro-rata weights)
+        self._owner_rows: List[int] = [0] * len(self._jobs)
         self._router: _RouterWriter = None  # built in _make_writer
         # largest member donates the header for wire-schema probing; the
         # frame source separately refuses packs with skewed headers
@@ -206,6 +267,11 @@ class PackedCellMetrics(GatherCellMetrics):
     def artifacts(self) -> List[str]:
         """Per-job published CSV paths, aligned with the job list."""
         return [artifact_path(job.out, self._compress) for job in self._jobs]
+
+    @property
+    def owner_rows(self) -> List[int]:
+        """Records streamed per member, aligned with the job list."""
+        return list(self._owner_rows)
 
     def _make_writer(self) -> _RouterWriter:
         self._router = _RouterWriter(
@@ -252,6 +318,7 @@ class PackedCellMetrics(GatherCellMetrics):
                 # retains them past the ring window, so copy first
                 frame = copy_frame(frame)
                 self._claim(owner, frame.cell_names)
+                self._owner_rows[owner] += frame.n_records
                 acc = frame if acc is None else concat_frames(acc, frame)
                 if acc.n_records >= capacity:
                     yield acc
@@ -264,6 +331,7 @@ def run_packed(
     jobs: Sequence[ServeJob],
     compress: bool = True,
     batch_records: int = DEFAULT_BATCH_RECORDS,
+    trace: Optional[PackTrace] = None,
 ) -> Tuple[List[str], bool]:
     """Run one pack; returns (per-job artifact paths, actually_packed).
 
@@ -271,6 +339,11 @@ def run_packed(
     the pack degrades to per-job solo runs — the same artifacts, without
     the shared buckets.  Collisions surface while streaming, before any
     member publishes (atomic commit), so the fallback starts clean.
+
+    When ``trace`` is given, every device run executes with its exec id
+    stamped into the obs context (pulse heartbeats inherit it) and the
+    trace's ``executed`` segments record what actually ran — including a
+    collision-aborted packed attempt, whose device time is real cost.
     """
     jobs = list(jobs)
     # tenants submit output stems from another host; the directory is
@@ -279,23 +352,60 @@ def run_packed(
         parent = os.path.dirname(artifact_path(job.out, compress))
         if parent:
             os.makedirs(parent, exist_ok=True)
+    if trace is not None:
+        trace.bucket = bucket_size(batch_records)
+    degraded = None
     if len(jobs) > 1:
         gatherer = PackedCellMetrics(
             jobs, compress=compress, batch_records=batch_records
         )
+        exec_id = trace.exec_id() if trace is not None else None
         try:
-            gatherer.extract_metrics()
+            with _trace_task(exec_id):
+                gatherer.extract_metrics()
+            if trace is not None:
+                trace.executed.append(
+                    {
+                        "exec_id": exec_id,
+                        "tids": list(trace.tids),
+                        "rows": gatherer.owner_rows,
+                        "degraded": None,
+                    }
+                )
             return gatherer.artifacts, True
         except PackEntityCollision:
-            pass  # degrade below; nothing was published
+            # degrade below; nothing was published — but any dispatches
+            # the aborted attempt already ran burned real device time
+            degraded = "entity-collision"
+            if trace is not None:
+                trace.executed.append(
+                    {
+                        "exec_id": exec_id,
+                        "tids": list(trace.tids),
+                        "rows": None,
+                        "degraded": degraded,
+                        "aborted": True,
+                    }
+                )
     artifacts = []
-    for job in jobs:
+    for i, job in enumerate(jobs):
+        exec_id = trace.tids[i] if trace is not None else None
         solo = GatherCellMetrics(
             job.bam,
             job.out,
             compress=compress,
             batch_records=batch_records,
         )
-        solo.extract_metrics()
+        with _trace_task(exec_id):
+            solo.extract_metrics()
         artifacts.append(artifact_path(job.out, compress))
+        if trace is not None:
+            trace.executed.append(
+                {
+                    "exec_id": exec_id,
+                    "tids": [trace.tids[i]],
+                    "rows": None,
+                    "degraded": degraded,
+                }
+            )
     return artifacts, False

@@ -13,8 +13,17 @@ an injected ``delay@task.claimed`` fault) and a replacement spawned:
   AOT persistent cache make every serve-path dispatch a cache hit);
 - every observed runtime signature sits inside the committed AOT
   manifest's shape contract (the scx-aot certification is honest);
-- ``sched status`` renders the serve view (per-tenant counts and the
-  admission line) and exits 0.
+- every committed job yields a COMPLETE scx-slo distributed trace
+  (submit -> lease -> pack -> device -> writeback -> commit stitched
+  from the journal plus the pulse rings), the post-lease legs sum to
+  the leased->committed span within 10%, zero device-seconds go
+  unattributed, and jobs stolen from the dead worker stitch across the
+  lineage boundary;
+- ``sched status`` renders the serve view (per-tenant counts, the
+  admission line, and the per-tenant slo summary) and exits 0.
+
+Because the fleet is elastic here (SIGTERM mid-traffic + replacement),
+``make elastic-smoke`` aliases this gate.
 
 Exit 0 on success; any assertion failure is a gate failure.
 """
@@ -85,6 +94,9 @@ def launch_worker(workdir: str, worker_id: str, fault_spec: str, extra):
     env.pop("XLA_FLAGS", None)
     env["SCTOOLS_TPU_TRACE"] = os.path.join(workdir, "obs")
     env["SCTOOLS_TPU_TRACE_WORKER"] = worker_id
+    # pulse heartbeats feed the scx-slo trace stitch asserted below:
+    # without rings the per-job leg decomposition has nothing to match
+    env["SCTOOLS_TPU_PULSE"] = "1"
     env["SCTOOLS_TPU_AOT_CACHE"] = os.path.join(workdir, "aot_cache")
     if fault_spec:
         env["SCTOOLS_TPU_FAULTS"] = fault_spec
@@ -248,6 +260,52 @@ def main() -> int:
     violations = check_signatures(manifest["contract"], merged["sites"])
     assert not violations, violations
 
+    # scx-slo: the distributed trace must stitch end to end across the
+    # elastic fleet — every committed job carries a complete per-leg
+    # decomposition, the post-lease legs reconstruct the
+    # leased->committed span within 10%, no device-second a heartbeat
+    # recorded goes unbilled, and a job stolen from the SIGTERM'd
+    # victim still stitches across the worker-lineage boundary
+    from sctools_tpu.obs import slo
+
+    view = slo.stitch_run(workdir)
+    assert len(view["jobs"]) == len(JOBS), (len(view["jobs"]), len(JOBS))
+    torn = [j["name"] for j in view["jobs"] if not j["complete"]]
+    assert not torn, f"torn traces (no heartbeat matched): {torn}"
+    for job in view["jobs"]:
+        legs = job["legs"]
+        post_lease = (
+            legs["pack_wait"] + legs["device"]
+            + legs["writeback"] + legs["commit"]
+        )
+        span = job["span_s"]
+        assert abs(post_lease - span) <= max(0.10 * span, 0.05), (
+            job["name"], legs, span,
+        )
+        assert job["cost"]["device_s"] > 0, (job["name"], job["cost"])
+    assert view["fleet"]["unattributed_device_s"] == 0, view["fleet"]
+    # stolen-job stitch: the journal's FIRST lease and the final commit
+    # sit on different workers, and the trace is complete anyway
+    journal = Journal(journal_dir, worker_id="smoke-probe")
+    try:
+        events = journal.events()
+    finally:
+        journal.close()
+    first_leaser = {}
+    for event in events:
+        if event.get("event") == "leased" and isinstance(
+            event.get("id"), str
+        ):
+            first_leaser.setdefault(event["id"], event.get("worker"))
+    crossed = [
+        job for job in view["jobs"]
+        if job["worker"] != first_leaser.get(job["id"])
+    ]
+    assert crossed, (
+        "no job committed on a different lineage than its first lease"
+    )
+    assert all(job["complete"] for job in crossed), crossed
+
     # the serve view of sched status renders and exits 0
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
@@ -259,6 +317,7 @@ def main() -> int:
     assert status.returncode == 0, status.stderr[-2000:]
     assert "serve tenant" in status.stdout, status.stdout[-2000:]
     assert "serve admission" in status.stdout, status.stdout[-2000:]
+    assert "serve slo" in status.stdout, status.stdout[-2000:]
 
     n_parts = len(glob.glob(os.path.join(out_dir, "*.csv")))
     print(
@@ -266,7 +325,9 @@ def main() -> int:
         f"{len({t for t, _, _ in JOBS})} tenant(s), victim SIGTERM'd "
         f"mid-job, {steals} steal(s), {packs_run} pack(s) ({degraded} "
         f"degraded), {n_parts} artifact(s) byte-identical to solo runs, "
-        f"0 retraces, signatures within the AOT manifest"
+        f"0 retraces, signatures within the AOT manifest, "
+        f"{len(view['jobs'])} complete trace(s) ({len(crossed)} stitched "
+        f"across lineages), 0s unattributed device time"
     )
     return 0
 

@@ -40,8 +40,10 @@ from sctools_tpu.serve.manifest import (
 )
 from sctools_tpu.serve.packer import (
     PackEntityCollision,
+    PackTrace,
     artifact_path,
     estimate_records,
+    pack_exec_id,
     plan_packs,
     run_packed,
 )
@@ -297,6 +299,57 @@ def test_run_packed_degrades_to_solo_on_entity_collision(tmp_path):
     assert not [p for p in os.listdir(tmp_path) if "inflight" in p]
 
 
+def test_run_packed_records_trace_segments(tmp_path):
+    # a clean pack leaves ONE executed segment: the pack exec id, every
+    # member, and the per-member row counts scx-slo weights cost by
+    bam_a, bam_b = tmp_path / "a.bam", tmp_path / "b.bam"
+    _tenant_bam(bam_a, "AA")
+    _tenant_bam(bam_b, "CC")
+    jobs = [
+        ServeJob("ta", str(bam_a), str(tmp_path / "out_a")),
+        ServeJob("tb", str(bam_b), str(tmp_path / "out_b")),
+    ]
+    tids = ["a" * 16, "b" * 16]
+    trace = PackTrace(tids=tids)
+    _, packed = run_packed(
+        jobs, compress=False, batch_records=4096, trace=trace
+    )
+    assert packed
+    assert trace.bucket == 4096
+    (seg,) = trace.executed
+    assert seg["exec_id"] == pack_exec_id(tids) == trace.exec_id()
+    assert seg["tids"] == tids
+    assert seg["degraded"] is None and not seg.get("aborted")
+    # per-member decoded rows: 4 cells x 2 ubs x 1 read = 8 each
+    assert seg["rows"] == [8, 8]
+    assert trace.degrade_reason() is None
+
+
+def test_run_packed_trace_records_collision_degrade(tmp_path):
+    # the aborted packed attempt AND the solo re-runs all land in the
+    # trace: the aborted segment carries the collision reason, the solo
+    # segments carry the member task ids as their exec ids
+    bam_a, bam_b = tmp_path / "a.bam", tmp_path / "b.bam"
+    _tenant_bam(bam_a, "AA")
+    _tenant_bam(bam_b, "AA")
+    jobs = [
+        ServeJob("ta", str(bam_a), str(tmp_path / "out_a")),
+        ServeJob("tb", str(bam_b), str(tmp_path / "out_b")),
+    ]
+    tids = ["a" * 16, "b" * 16]
+    trace = PackTrace(tids=tids)
+    _, packed = run_packed(
+        jobs, compress=False, batch_records=4096, trace=trace
+    )
+    assert not packed
+    aborted = [s for s in trace.executed if s.get("aborted")]
+    solos = [s for s in trace.executed if not s.get("aborted")]
+    assert len(aborted) == 1
+    assert aborted[0]["degraded"] == "entity-collision"
+    assert [s["exec_id"] for s in solos] == tids
+    assert trace.degrade_reason() == "entity-collision"
+
+
 def test_run_packed_creates_missing_output_directories(tmp_path):
     # tenants submit output stems from another host: the worker must
     # materialize the parent directory instead of quarantining the job
@@ -345,6 +398,7 @@ def test_worker_drains_journal_and_commits(tmp_path, monkeypatch):
     try:
         tasks, states = journal.replay()
         meta = journal.worker_meta()
+        events = journal.events()
     finally:
         journal.close()
     assert len(tasks) == 2
@@ -352,6 +406,27 @@ def test_worker_drains_journal_and_commits(tmp_path, monkeypatch):
     for st in states.values():
         assert st.part and os.path.exists(st.part) and st.sha256
     assert meta["unit"]["serve"]["max_depth"] == DEFAULT_ADMISSION_DEPTH
+    # scx-slo plumbing: every commit carries the executed-segment trace
+    # extras, and the engine announced each pack plan BEFORE dispatch
+    # (so a crashed lineage's heartbeats stay attributable)
+    commits = [e for e in events if e.get("event") == "committed"]
+    assert len(commits) == 2
+    for event in commits:
+        assert event["pack_members"] and event["id"] in event["pack_members"]
+        assert event["pack_bucket"] == 4096
+        segs = event["pack_execs"]
+        assert segs and all(s["exec_id"] for s in segs)
+        assert event["pack"] in {s["exec_id"] for s in segs}
+    plans = [
+        e for e in events
+        if e.get("event") == "worker" and e.get("pack_plan")
+    ]
+    assert plans, "no pack_plan announcement journaled before dispatch"
+    planned = {p["pack_plan"]["exec_id"] for p in plans}
+    assert {c["pack"] for c in commits} <= planned
+    # the plan announcement must NOT clobber the serve admission
+    # snapshot `sched status` reads (worker meta is last-wins)
+    assert "serve" in meta["unit"]
 
 
 def test_run_serve_task_solo_runner(tmp_path):

@@ -1,0 +1,623 @@
+"""scx-slo: distributed trace stitching and per-tenant cost attribution.
+
+Covers the contracts docs/serving.md ("Per-job tracing & SLOs") and
+docs/observability.md ("scx-slo") document: pro-rata splits conserve
+EXACTLY (floats close on the last share, integers by largest
+remainder), a packed member and a solo run of the same heartbeats are
+billed identically, the five-leg decomposition reconstructs the
+leased->committed span by construction, a crashed lineage's orphan
+heartbeats still land on the members' bills (torn-trace re-stitch
+after a steal), the Prometheus exporter refuses tenant label
+collisions, and the off-mode probe is the cached no-op singleton.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from sctools_tpu.obs import pulse, slo
+from sctools_tpu.sched.journal import Journal, Task
+from sctools_tpu.serve.api import SERVE_TASK_KIND, ServeJob
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ fabricators
+#
+# stitch() is pure over (tasks, events, rings): these build the minimal
+# shapes the serve plane writes — raw journal dicts, ring dicts with the
+# wall/mono anchor, heartbeat records with mono-clock leg intervals.
+
+
+def make_task(tid, tenant, submitted, name=None):
+    return {
+        "id": tid,
+        "kind": SERVE_TASK_KIND,
+        "name": name or f"{tenant}/{tid}",
+        "payload": {
+            "tenant": tenant,
+            "bam": f"/in/{tid}.bam",
+            "out": f"/out/{tid}",
+            "submitted": submitted,
+        },
+    }
+
+
+def make_ring(worker, wall, mono, records):
+    return {
+        "meta": {"worker": worker, "wall": wall, "mono": mono},
+        "records": records,
+    }
+
+
+def make_record(task_id, legs, real_rows=64, padded_rows=128,
+                bytes_h2d=1000, bytes_d2h=100, stage="gatherer.run"):
+    return {
+        "stage": stage,
+        "task_id": task_id,
+        "real_rows": real_rows,
+        "padded_rows": padded_rows,
+        "entities": 1,
+        "bytes_h2d": bytes_h2d,
+        "bytes_d2h": bytes_d2h,
+        "legs": legs,
+    }
+
+
+def commit_event(tid, ts, worker, seg_exec, members, rows, execs,
+                 degraded=None):
+    return {
+        "id": tid,
+        "event": "committed",
+        "ts": ts,
+        "seq": 1,
+        "worker": worker,
+        "pack": seg_exec,
+        "pack_members": members,
+        "pack_rows": rows,
+        "pack_degraded": degraded,
+        "pack_bucket": 4096,
+        "pack_execs": execs,
+    }
+
+
+def lease_event(tid, ts, worker, stolen=False):
+    event = {"id": tid, "event": "leased", "ts": ts, "seq": 0,
+             "worker": worker}
+    if stolen:
+        event["stolen"] = True
+    return event
+
+
+# --------------------------------------------------------- exact splitting
+
+
+def test_serve_kind_lockstep():
+    # slo must not import the serve package (layering): the duplicated
+    # kind constant is pinned here instead
+    assert slo.SERVE_KIND == SERVE_TASK_KIND
+
+
+@pytest.mark.parametrize("total", [0.0, 1.0, 10.0, 3.337, 1e-9, 7200.5])
+@pytest.mark.parametrize("weights", [
+    [1.0], [1.0, 1.0], [1, 2, 3], [0.1, 0.7, 0.2, 0.9],
+    [5, 0, 5], [0, 0, 0], [1e-6, 1.0, 1e6],
+])
+def test_split_prorata_float_conserves_exactly(total, weights):
+    shares = slo.split_prorata(total, weights)
+    assert len(shares) == len(weights)
+    # EXACT equality, not approx: the last share closes the remainder
+    assert sum(shares) == total
+
+
+@pytest.mark.parametrize("total", [0, 1, 7, 1000, 999_999_937])
+@pytest.mark.parametrize("weights", [
+    [1.0], [1, 1, 1], [3, 1, 2], [0, 5, 0], [0, 0], [2, 3, 5, 7, 11],
+])
+def test_split_prorata_int_conserves_exactly(total, weights):
+    shares = slo.split_prorata_int(total, weights)
+    assert len(shares) == len(weights)
+    assert all(isinstance(s, int) for s in shares)
+    assert sum(shares) == total
+
+
+def test_split_prorata_empty():
+    assert slo.split_prorata(5.0, []) == []
+    assert slo.split_prorata_int(5, []) == []
+
+
+def test_attribute_pack_conserves_totals():
+    records = [
+        make_record("p1", {"compute": (10.0, 11.5), "d2h": (11.5, 11.9)},
+                    real_rows=100, padded_rows=128,
+                    bytes_h2d=12_345, bytes_d2h=6_789),
+        make_record("p1", {"compute": (12.0, 12.7)},
+                    real_rows=28, padded_rows=128,
+                    bytes_h2d=9_999, bytes_d2h=1),
+    ]
+    totals = slo.pack_totals(records)
+    for weights in ([60, 40, 28], [1, 1, 1], [0.5, 0.25, 0.25]):
+        shares = slo.attribute_pack(totals, weights)
+        assert sum(s["device_s"] for s in shares) == totals["device_s"]
+        assert sum(s["bytes_h2d"] for s in shares) == totals["bytes_h2d"]
+        assert sum(s["bytes_d2h"] for s in shares) == totals["bytes_d2h"]
+        assert (
+            sum(s["wasted_pad_bytes"] for s in shares)
+            == totals["wasted_pad_bytes"]
+        )
+
+
+def test_pack_totals_device_union_not_double_billed():
+    # overlapping compute and d2h legs bill once: union, not sum
+    records = [
+        make_record("p1", {"compute": (10.0, 12.0), "d2h": (11.0, 13.0)}),
+    ]
+    totals = slo.pack_totals(records)
+    assert totals["device_s"] == pytest.approx(3.0)
+    # pad waste: h2d bytes scaled by the pad fraction
+    assert totals["wasted_pad_bytes"] == round(1000 * (128 - 64) / 128)
+
+
+# ----------------------------------------------------------- trace stitch
+
+
+def _one_job_world(pack_exec=None):
+    """One committed job; exec id either the task id (solo) or a pack."""
+    tid = "a" * 16
+    exec_id = pack_exec or tid
+    tasks = {tid: make_task(tid, "t0", submitted=1000.0)}
+    events = [
+        lease_event(tid, 1005.0, "w0"),
+        commit_event(
+            tid, 1012.0, "w0", exec_id, [tid], [64] if pack_exec else None,
+            execs=[{
+                "exec_id": exec_id, "tids": [tid],
+                "rows": [64] if pack_exec else None, "degraded": None,
+            }],
+        ),
+    ]
+    rings = {
+        "w0": make_ring("w0", wall=1000.0, mono=500.0, records=[
+            make_record(exec_id, {
+                "compute": (505.5, 507.0), "d2h": (507.0, 507.5),
+            }),
+        ]),
+    }
+    return tid, tasks, events, rings
+
+
+def test_stitch_five_leg_decomposition():
+    tid, tasks, events, rings = _one_job_world()
+    view = slo.stitch(tasks, events, rings, now=1012.0)
+    (job,) = view["jobs"]
+    assert job["complete"] is True
+    legs = job["legs"]
+    # wall anchor: mono 505.5..507.5 -> wall 1005.5..1007.5
+    assert legs["queue_wait"] == pytest.approx(5.0)
+    assert legs["pack_wait"] == pytest.approx(0.5)
+    assert legs["device"] == pytest.approx(2.0)
+    assert legs["writeback"] == pytest.approx(0.0)
+    assert legs["commit"] == pytest.approx(4.5)
+    # by construction the post-lease legs reconstruct the span exactly
+    post_lease = (legs["pack_wait"] + legs["device"]
+                  + legs["writeback"] + legs["commit"])
+    assert post_lease == pytest.approx(job["span_s"])
+    assert job["e2e_s"] == pytest.approx(12.0)
+    assert view["fleet"]["unattributed_device_s"] == 0
+    assert view["fleet"]["complete_fraction"] == 1.0
+    # the ROADMAP item 3 signal pair rides each pack verbatim
+    (pack,) = view["packs"]
+    assert pack["occupancy"] == pytest.approx(64 / 128)
+    assert pack["limiting_stage"] in ("decode", "h2d", "compute", "d2h")
+
+
+def test_packed_vs_solo_attribution_parity():
+    # the same heartbeats must be billed identically whether the exec
+    # is a one-member pack or a solo run keyed by the task id
+    tid_solo, tasks_s, events_s, rings_s = _one_job_world(pack_exec=None)
+    view_solo = slo.stitch(tasks_s, events_s, rings_s, now=1012.0)
+    tid_pack, tasks_p, events_p, rings_p = _one_job_world(
+        pack_exec="f" * 16
+    )
+    view_pack = slo.stitch(tasks_p, events_p, rings_p, now=1012.0)
+    (solo_job,) = view_solo["jobs"]
+    (pack_job,) = view_pack["jobs"]
+    assert solo_job["cost"] == pack_job["cost"]
+    assert solo_job["legs"] == pack_job["legs"]
+
+
+def test_stitch_conservation_over_packs():
+    # two tenants in one pack: row-weighted shares sum back to the pack
+    # totals exactly, and the fleet's attributed device time equals the
+    # single pack's device union
+    t1, t2 = "a" * 16, "b" * 16
+    pack = "c" * 16
+    tasks = {
+        t1: make_task(t1, "t0", submitted=1000.0),
+        t2: make_task(t2, "t1", submitted=1001.0),
+    }
+    execs = [{
+        "exec_id": pack, "tids": [t1, t2], "rows": [96, 32],
+        "degraded": None,
+    }]
+    events = [
+        lease_event(t1, 1004.0, "w0"),
+        lease_event(t2, 1004.5, "w0"),
+        commit_event(t1, 1010.0, "w0", pack, [t1, t2], [96, 32], execs),
+        commit_event(t2, 1010.2, "w0", pack, [t1, t2], [96, 32], execs),
+    ]
+    rings = {
+        "w0": make_ring("w0", wall=1000.0, mono=0.0, records=[
+            make_record(pack, {"compute": (5.0, 8.0), "d2h": (8.0, 8.6)},
+                        real_rows=128, padded_rows=128,
+                        bytes_h2d=10_001, bytes_d2h=777),
+        ]),
+    }
+    view = slo.stitch(tasks, events, rings, now=1011.0)
+    (pack_row,) = view["packs"]
+    totals = pack_row["totals"]
+    jobs = {job["id"]: job for job in view["jobs"]}
+    assert (
+        jobs[t1]["cost"]["device_s"] + jobs[t2]["cost"]["device_s"]
+        == totals["device_s"]
+    )
+    assert (
+        jobs[t1]["cost"]["bytes_h2d"] + jobs[t2]["cost"]["bytes_h2d"]
+        == totals["bytes_h2d"]
+    )
+    assert (
+        jobs[t1]["cost"]["bytes_d2h"] + jobs[t2]["cost"]["bytes_d2h"]
+        == totals["bytes_d2h"]
+    )
+    # row-weighted: the 96-row member carries 3x the 32-row member
+    assert jobs[t1]["cost"]["device_s"] == pytest.approx(
+        3 * jobs[t2]["cost"]["device_s"]
+    )
+    assert view["fleet"]["attributed_device_s"] == totals["device_s"]
+    assert view["fleet"]["unattributed_device_s"] == 0
+    # both jobs share the pack id and see the full decomposition
+    assert jobs[t1]["pack"] == pack and jobs[t2]["pack"] == pack
+    assert jobs[t1]["complete"] and jobs[t2]["complete"]
+
+
+def test_torn_trace_restitches_after_steal():
+    # lineage A plans a pack, heartbeats, then crashes WITHOUT
+    # committing; lineage B steals the leases and commits its own exec.
+    # The orphan device time must still land on the members' bills (via
+    # the plan announcement), the legs must come from B's exec only,
+    # and nothing stays unattributed.
+    t1, t2 = "a" * 16, "b" * 16
+    plan_exec, commit_exec = "d" * 16, "e" * 16
+    tasks = {
+        t1: make_task(t1, "t0", submitted=1000.0),
+        t2: make_task(t2, "t1", submitted=1000.0),
+    }
+    execs = [{
+        "exec_id": commit_exec, "tids": [t1, t2], "rows": [64, 64],
+        "degraded": None,
+    }]
+    events = [
+        lease_event(t1, 1001.0, "wA"),
+        lease_event(t2, 1001.0, "wA"),
+        # the dying lineage announced its plan before dispatch
+        {"id": None, "event": "worker", "ts": 1001.5, "seq": 0,
+         "worker": "wA",
+         "pack_plan": {"exec_id": plan_exec, "tids": [t1, t2]}},
+        # the survivor steals and commits
+        lease_event(t1, 1006.0, "wB", stolen=True),
+        lease_event(t2, 1006.0, "wB", stolen=True),
+        commit_event(t1, 1012.0, "wB", commit_exec, [t1, t2],
+                     [64, 64], execs),
+        commit_event(t2, 1012.1, "wB", commit_exec, [t1, t2],
+                     [64, 64], execs),
+    ]
+    rings = {
+        "wA": make_ring("wA", wall=1000.0, mono=0.0, records=[
+            make_record(plan_exec, {"compute": (2.0, 4.0)}),
+        ]),
+        "wB": make_ring("wB", wall=1000.0, mono=0.0, records=[
+            make_record(commit_exec,
+                        {"compute": (7.0, 9.0), "d2h": (9.0, 9.5)}),
+        ]),
+    }
+    view = slo.stitch(tasks, events, rings, now=1013.0)
+    packs = {p["exec_id"]: p for p in view["packs"]}
+    assert packs[plan_exec]["orphaned"] is True
+    assert packs[commit_exec]["orphaned"] is False
+    # the crashed lineage's 2 device-seconds are billed, not dropped
+    assert view["fleet"]["unattributed_device_s"] == 0
+    jobs = {job["id"]: job for job in view["jobs"]}
+    total_device = sum(j["cost"]["device_s"] for j in jobs.values())
+    assert total_device == pytest.approx(2.0 + 2.5)
+    # legs use the COMMITTING lineage only: device is B's 2.5s union,
+    # clipped to B's lease window — A's orphan work is cost, not latency
+    for job in jobs.values():
+        assert job["complete"] is True
+        assert job["worker"] == "wB"
+        assert job["leased"] == 1006.0
+        assert job["legs"]["device"] == pytest.approx(2.5)
+        post_lease = (
+            job["legs"]["pack_wait"] + job["legs"]["device"]
+            + job["legs"]["writeback"] + job["legs"]["commit"]
+        )
+        assert post_lease == pytest.approx(job["span_s"])
+
+
+def test_unplanned_orphan_heartbeats_stay_unattributed():
+    # heartbeats tagged with an exec id nobody planned or committed are
+    # surfaced as unattributed device time (the CI gate's 0 target);
+    # warmup heartbeats are known and excluded
+    tid = "a" * 16
+    tasks = {tid: make_task(tid, "t0", submitted=1000.0)}
+    events = [
+        lease_event(tid, 1001.0, "w0"),
+        commit_event(tid, 1005.0, "w0", tid, [tid], None, execs=[
+            {"exec_id": tid, "tids": [tid], "rows": None, "degraded": None},
+        ]),
+    ]
+    rings = {
+        "w0": make_ring("w0", wall=1000.0, mono=0.0, records=[
+            make_record(tid, {"compute": (2.0, 3.0)}),
+            make_record("f" * 16, {"compute": (3.0, 3.75)}),
+            make_record(slo.WARMUP_EXEC, {"compute": (0.0, 1.0)}),
+        ]),
+    }
+    view = slo.stitch(tasks, events, rings, now=1006.0)
+    assert view["fleet"]["unattributed_device_s"] == pytest.approx(0.75)
+
+
+def test_stitch_degrades_without_ring_anchor():
+    # a ring missing the wall/mono anchor (older writer) degrades the
+    # trace to incomplete — never a guessed offset, never a crash
+    tid, tasks, events, rings = _one_job_world()
+    del rings["w0"]["meta"]["wall"]
+    view = slo.stitch(tasks, events, rings, now=1012.0)
+    (job,) = view["jobs"]
+    assert job["complete"] is False
+    assert job["legs"] is None
+    assert view["fleet"]["complete_fraction"] == 0.0
+    # costs still attribute (mono-clock totals need no anchor)
+    assert job["cost"]["device_s"] == pytest.approx(2.0)
+
+
+def test_stitch_tolerates_aborted_segments_and_empty_rings():
+    # a collision-aborted packed attempt rides pack_execs with no
+    # surviving rows; the solo re-runs carry the members
+    tid = "a" * 16
+    aborted = "f" * 16
+    tasks = {tid: make_task(tid, "t0", submitted=1000.0)}
+    events = [
+        lease_event(tid, 1001.0, "w0"),
+        commit_event(
+            tid, 1009.0, "w0", tid, [tid], None, degraded="entity-collision",
+            execs=[
+                {"exec_id": aborted, "tids": [tid], "rows": None,
+                 "degraded": "entity-collision", "aborted": True},
+                {"exec_id": tid, "tids": [tid], "rows": None,
+                 "degraded": "entity-collision"},
+            ],
+        ),
+    ]
+    rings = {
+        "w0": make_ring("w0", wall=1000.0, mono=0.0, records=[
+            make_record(tid, {"compute": (2.0, 3.0)}),
+        ]),
+    }
+    view = slo.stitch(tasks, events, rings, now=1010.0)
+    (job,) = view["jobs"]
+    assert job["complete"] is True
+    assert job["pack_degraded"] == "entity-collision"
+    # the aborted segment exists as a pack row but contributes no legs
+    assert {p["exec_id"] for p in view["packs"]} == {tid, aborted}
+    assert view["fleet"]["unattributed_device_s"] == 0
+
+
+# ------------------------------------------------------- tenant SLO rows
+
+
+def test_tenant_slo_window_and_burn():
+    tid1, tid2, tid3, tid4 = "a" * 16, "b" * 16, "c" * 16, "d" * 16
+    tasks = {
+        tid1: make_task(tid1, "t0", submitted=1000.0),
+        tid2: make_task(tid2, "t0", submitted=1000.0),
+        tid4: make_task(tid4, "t0", submitted=1000.0),
+        # an open job: submitted, never committed -> queue age
+        tid3: make_task(tid3, "t0", submitted=1030.0),
+    }
+    events = []
+    for tid, lease_ts, commit_ts in (
+        (tid1, 1001.0, 1005.0),  # 5s e2e: inside a 10s target
+        (tid4, 1001.0, 1007.0),  # 7s e2e: inside
+        (tid2, 1001.0, 1050.0),  # 50s e2e: violation
+    ):
+        events.append(lease_event(tid, lease_ts, "w0"))
+        events.append(commit_event(tid, commit_ts, "w0", tid, [tid], None,
+                                   execs=[{"exec_id": tid, "tids": [tid],
+                                           "rows": None, "degraded": None}]))
+    view = slo.stitch(tasks, events, {}, now=1060.0, target_s=10.0,
+                      objective=0.99)
+    row = view["tenants"]["t0"]
+    assert row["committed"] == 3
+    assert row["open"] == 1
+    assert row["violations"] == 1
+    assert row["queue_age_s"] == pytest.approx(30.0)
+    # burn: 1-in-3 violation rate against a 1% error budget
+    assert row["error_budget_burn"] == pytest.approx((1 / 3) / 0.01)
+    assert row["p50_s"] == pytest.approx(7.0)
+    assert row["p99_s"] == pytest.approx(50.0)
+    # a trailing window that excludes the old commit drops it
+    windowed = slo.stitch(tasks, events, {}, now=1060.0, target_s=10.0,
+                          window_s=20.0)
+    assert windowed["tenants"]["t0"]["committed"] == 1
+
+
+# --------------------------------------------------------------- renderers
+
+
+def test_render_slo_metrics_label_collision_raises():
+    tid1, tid2 = "a" * 16, "b" * 16
+    tasks = {
+        tid1: make_task(tid1, "t 1", submitted=1000.0),
+        tid2: make_task(tid2, "t_1", submitted=1000.0),
+    }
+    events = []
+    for tid in (tid1, tid2):
+        events.append(lease_event(tid, 1001.0, "w0"))
+        events.append(commit_event(tid, 1002.0, "w0", tid, [tid], None,
+                                   execs=[{"exec_id": tid, "tids": [tid],
+                                           "rows": None, "degraded": None}]))
+    view = slo.stitch(tasks, events, {}, now=1003.0)
+    with pytest.raises(ValueError, match="collision"):
+        slo.render_slo_metrics(view)
+
+
+def test_render_slo_metrics_exposition_shape():
+    tid, tasks, events, rings = _one_job_world()
+    view = slo.stitch(tasks, events, rings, now=1012.0)
+    text = slo.render_slo_metrics(view)
+    assert '# TYPE sctools_tpu_slo_p95_seconds gauge' in text
+    assert 'sctools_tpu_slo_committed_jobs{tenant="t0"} 1' in text
+    assert 'sctools_tpu_slo_fleet_trace_complete_fraction 1.0' in text
+    # one TYPE header per metric, no duplicates
+    type_lines = [l for l in text.splitlines() if l.startswith("# TYPE")]
+    assert len(type_lines) == len(set(type_lines))
+
+
+def test_render_slo_text_report():
+    tid, tasks, events, rings = _one_job_world()
+    view = slo.stitch(tasks, events, rings, now=1012.0)
+    text = slo.render_slo(view)
+    assert "t0" in text
+    assert "queue" in text  # the leg decomposition of the slowest jobs
+    assert "unattributed" in text
+
+
+# ------------------------------------------------------------ probe modes
+
+
+def test_probe_off_is_the_cached_noop_singleton():
+    with slo.force(False):
+        assert slo.probe() is slo.NOOP
+        assert slo.probe() is slo.probe()
+        slo.NOOP.mark("anything")
+        assert slo.NOOP.marks() == {}
+
+
+def test_probe_on_records_marks():
+    with slo.force(True):
+        probe = slo.probe()
+        assert probe is not slo.NOOP
+        probe.mark("pack_start")
+        probe.mark("pack_done")
+        marks = probe.marks()
+        assert set(marks) == {"pack_start", "pack_done"}
+        assert marks["pack_done"] >= marks["pack_start"]
+    assert slo.probe() is slo.NOOP or slo.enabled()
+
+
+# ----------------------------------------------------- discovery + the CLI
+
+
+def _disk_world(tmp_path):
+    """A real journal on disk (one committed serve job), no rings."""
+    journal_dir = os.path.join(str(tmp_path), "journal")
+    job = ServeJob("t0", "/in/a.bam", "/out/a", submitted=1000.0)
+    tid = "a" * 16
+    journal = Journal(journal_dir, worker_id="w0")
+    try:
+        journal.register([Task(id=tid, kind=SERVE_TASK_KIND,
+                                name="t0/a", payload=job.payload())])
+        journal.record(tid, "leased")
+        journal.record(
+            tid, "committed", pack=tid, pack_members=[tid],
+            pack_rows=None, pack_degraded=None, pack_bucket=4096,
+            pack_execs=[{"exec_id": tid, "tids": [tid], "rows": None,
+                         "degraded": None}],
+        )
+    finally:
+        journal.close()
+    return journal_dir, tid
+
+
+def test_find_journal_dirs_and_stitch_run(tmp_path):
+    journal_dir, tid = _disk_world(tmp_path)
+    found = slo.find_journal_dirs(str(tmp_path))
+    assert found == [os.path.abspath(journal_dir)]
+    assert slo.find_journal_dirs(str(tmp_path / "empty-nowhere")) == []
+    view = slo.stitch_run(str(tmp_path))
+    (job,) = view["jobs"]
+    assert job["id"] == tid
+    assert job["tenant"] == "t0"
+    # no rings on disk: committed but traceless -> incomplete, 0 cost
+    assert job["complete"] is False
+    assert view["fleet"]["committed"] == 1
+
+
+def test_obs_slo_cli_json(tmp_path, capsys):
+    from sctools_tpu.obs.__main__ import main as obs_main
+
+    journal_dir, tid = _disk_world(tmp_path)
+    rc = obs_main(["slo", str(tmp_path), "--json"])
+    assert rc == 0
+    view = json.loads(capsys.readouterr().out)
+    assert view["fleet"]["committed"] == 1
+    assert view["jobs"][0]["id"] == tid
+    # text mode renders the report
+    rc = obs_main(["slo", str(tmp_path), "--target", "10"])
+    assert rc == 0
+    assert "t0" in capsys.readouterr().out
+    # a dir with no journal exits 2 like the other obs subcommands
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rc = obs_main(["slo", str(empty)])
+    assert rc == 2
+    assert "no sched journal" in capsys.readouterr().err
+
+
+def test_sched_status_renders_queue_age_and_slo(tmp_path):
+    # an OPEN serve job (submitted, never leased) must surface its
+    # queue age on the tenant line of `sched status`
+    import io
+
+    journal_dir = os.path.join(str(tmp_path), "journal")
+    job = ServeJob("t9", "/in/z.bam", "/out/z", submitted=1000.0)
+    tid = "f" * 16
+    journal = Journal(journal_dir, worker_id="w0")
+    try:
+        journal.register([Task(id=tid, kind=SERVE_TASK_KIND,
+                                name="t9/z", payload=job.payload())])
+        from sctools_tpu.sched.cli import _print_serve_summary
+
+        tasks, states = journal.replay()
+        out = io.StringIO()
+        _print_serve_summary(journal, tasks, states, out)
+    finally:
+        journal.close()
+    text = out.getvalue()
+    assert "serve tenant t9" in text
+    assert "queue-age=" in text
+
+
+# ---------------------------------------------------- serve-side plumbing
+
+
+def test_servejob_payload_round_trips_submitted():
+    job = ServeJob("t0", "/in/a.bam", "/out/a", submitted=123.456)
+    assert ServeJob.from_payload(job.payload()) == job
+    # identity excludes the submit stamp: resubmitting the same job
+    # later must dedupe to the same task id
+    late = ServeJob("t0", "/in/a.bam", "/out/a", submitted=999.0)
+    assert job.identity_payload() == late.identity_payload()
+
+
+def test_pack_exec_id_is_order_insensitive_and_16hex():
+    from sctools_tpu.serve.packer import pack_exec_id
+
+    a = pack_exec_id(["x" * 16, "y" * 16])
+    b = pack_exec_id(["y" * 16, "x" * 16])
+    assert a == b
+    assert len(a) == 16
+    assert a != pack_exec_id(["x" * 16])
+    int(a, 16)  # hex — fits pulse's 16-byte task-id field
