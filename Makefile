@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck aotcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke elastic-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck aotcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke elastic-smoke steer-smoke perf-gate docs clean
 
-ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke perf-gate
+ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke steer-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -238,6 +238,16 @@ serve-smoke:
 # replacement joins, zero lost jobs, and every stolen job's trace
 # stitches across the worker-lineage boundary
 elastic-smoke: serve-smoke
+
+# scx-steer: the same mixed-tenant traffic drains through a 2-worker
+# fleet twice — static vs armed — and the armed leg must strictly
+# improve padding occupancy with zero lost jobs, zero retraces, and
+# every applied bucket move inside the announced residency ladder
+# (tests/steer_smoke.py; docs/steering.md).
+steer-smoke:
+	rm -rf /tmp/sctools_tpu_steer_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_STEER_SMOKE_DIR=/tmp/sctools_tpu_steer_smoke \
+	$(PY) tests/steer_smoke.py
 
 # perf-regression gate self-test: bench.py --check must fail a
 # synthetically-degraded result and pass a trajectory-consistent one

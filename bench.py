@@ -64,9 +64,17 @@ Prints ONE JSON line. Flags:
               worker`; the JSON reports cold/warm time-to-first-result,
               per-job service latency p50/p95, aggregate cells/sec over
               the warm window, pack counts, lost jobs, and fleet-merged
-              retraces. --check then holds ttfr_speedup >= 5 (the AOT
-              cache must turn first-request compiles into disk loads),
-              lost_jobs == 0, and retraces == 0.
+              retraces; a third STEERED replica (SCTOOLS_TPU_STEER=1,
+              warmup calibration ladder resident) drains traffic shaped
+              so the static bucket floor-pads every solo job. --check
+              then holds ttfr_speedup >= 5 (the AOT cache must turn
+              first-request compiles into disk loads), lost_jobs == 0,
+              retraces == 0, and for the steered leg occupancy >= 0.5
+              (the coalescing upshift must fire), retraces == 0 (the
+              controller chooses only precompiled points), and
+              lost_jobs == 0. The steer controller's off-mode cost is
+              also measured every run and gated <= 1.02
+              (steer_overhead), like the guard/frame/pulse/slo planes.
   --check-selftest  verify the gate's own semantics against synthetic
               degraded/healthy results and exit (cheap; `make ci` leg)
 """
@@ -156,6 +164,46 @@ SERVE_CELLS_PER_TENANT = 256
 SERVE_MOLECULES_PER_CELL = 4
 SERVE_READS_PER_MOLECULE = 2
 SERVE_BATCH_RECORDS = 4096  # the RECORD_BUCKET_MIN floor
+
+# scx-steer off-mode ceiling: with SCTOOLS_TPU_STEER unset the serve
+# engine's per-group controller calls (decide + the three knob
+# accessors) hand out the cached no-op singleton after one bool check —
+# that presence-but-off cost rides every admitted group, gated exactly
+# like the pulse/slo planes
+STEER_OVERHEAD_CEILING = 1.02
+# scx-steer steered-serving occupancy floor: with the controller armed
+# and the warmup ladder calibrated, the steered replica must hold
+# padding occupancy at or above 0.5 under multi-tenant traffic — well
+# above the static OCCUPANCY_FLOOR, because the coalescing upshift
+# exists exactly to lift floor-padded fragments into full buckets
+STEER_OCCUPANCY_FLOOR = 0.5
+
+# steered serving traffic shape: each job decodes 2700 real records
+# (675 cells x 2 molecules x 2 reads) and ESTIMATES ~2420 (size/48 at
+# seq_len 48), so exactly ONE job packs per 4096 bucket statically
+# (every dispatch cuts at the last entity boundary, so a solo job costs
+# a 4096 main dispatch PLUS a floor-padded 4-record tail: 2700/8192 =
+# 0.33 occupancy) while THREE coalesce into the calibrated 8192 rung
+# (8100 real -> an 8096 main dispatch at 8192 plus the 4096 tail:
+# 8100/12288 = 0.66) — the upshift the steered leg must find and apply
+# online, with zero retraces. Short reads are deliberate: longer reads
+# inflate the size/48 estimate past what three jobs can bin at 8192.
+STEER_CELLS_PER_JOB = 675
+STEER_MOLECULES_PER_CELL = 2
+STEER_READS_PER_MOLECULE = 2
+STEER_SEQ_LEN = 48
+STEER_JOBS_PER_TENANT = 12
+# the controller decides once per admitted group, gated by its epoch;
+# synthetic traffic drains in seconds, so the bench shrinks the epoch
+# to observe multiple control windows inside the run
+STEER_EPOCH_S = 0.1
+# calibration BAM: 10240 records, comfortably past the top ladder rung
+# (8192) so warmup's multi-batch gather genuinely compiles EVERY
+# rung-shaped executable (a smaller BAM would pad everything to the
+# 4096 floor and note_resident would promise a shape never compiled)
+STEER_CALIBRATION_CELLS = 1280
+STEER_CALIBRATION_MOLECULES = 4
+STEER_CALIBRATION_READS = 2
 
 # device workload size
 N_CELLS = 1 << 16  # 65k cells
@@ -1151,6 +1199,59 @@ def bench_slo_overhead(rounds: int = 3, calls: int = 80) -> dict:
     }
 
 
+def bench_steer_overhead(rounds: int = 3, calls: int = 80) -> dict:
+    """Off-mode cost of the scx-steer controller on the serve group path.
+
+    Same interleaved shape and min-across-repeats summary as the
+    guard/frame/pulse/slo legs: the instrumented leg runs the per-group
+    controller call sequence the serve engine makes (one ``decide()``
+    poll plus the three knob accessors) around a numpy-sort work unit;
+    the direct leg runs the work unit alone. With ``SCTOOLS_TPU_STEER``
+    unset every call hits the cached no-op singleton after one bool
+    check, and that presence-but-off cost is what the
+    ``steer_overhead <= 1.02`` gate holds. A run with steering ON
+    measures the live controller instead; the gate skips it
+    (``steer_on``), mirroring ``slo_on``/``pulse_on``.
+    """
+    import numpy as np
+
+    from sctools_tpu import steer
+
+    # off must be OFF: the cached no-op singleton, not a live
+    # controller — otherwise this leg measures the fold cost and the
+    # <= 1.02 ceiling would be meaningless
+    ctrl = steer.controller(SERVE_BATCH_RECORDS)
+    if not steer.enabled():
+        assert ctrl is steer.NOOP, (
+            f"steer controller active without {steer.ENV_FLAG}=1: "
+            f"{type(ctrl)}"
+        )
+
+    payload = np.arange(1 << 19, dtype=np.int32)[::-1].copy()
+
+    def work() -> int:
+        return int(np.sort(payload)[0])
+
+    def steered() -> int:
+        ctrl.decide()
+        ctrl.chunk_records(None)
+        ctrl.batch_records(SERVE_BATCH_RECORDS)
+        value = work()
+        ctrl.prefetch_depth(2)
+        return value
+
+    work()
+    steered()
+    ratios = _interleaved_ratios(work, steered, rounds, calls)
+    return {
+        "overhead": _summarize_overhead_ratios(ratios),
+        "ratios": [round(r, 4) for r in ratios],
+        "rounds": rounds,
+        "calls_per_round": calls,
+        "steer_on": steer.enabled(),
+    }
+
+
 def _percentile(values, q: float):
     """Nearest-rank percentile of a small sample; None when empty."""
     ordered = sorted(values)
@@ -1270,6 +1371,7 @@ def bench_serve() -> dict:
 
     cold = run_worker("cold", submit("cold"))
     warm = run_worker("warm", submit("warm"))
+    steer_leg = _bench_serve_steered()
 
     latencies, window = _serve_latencies(
         os.path.join(workdir, "journal-warm")
@@ -1307,6 +1409,7 @@ def bench_serve() -> dict:
             cold["packs_degraded"] + warm["packs_degraded"]
         ),
         "retraces": retraces,
+        "steer": steer_leg,
         "slo": {
             "trace_complete": fleet["complete_fraction"],
             "unattributed_device_s": fleet["unattributed_device_s"],
@@ -1318,6 +1421,128 @@ def bench_serve() -> dict:
                 for tenant, row in view["tenants"].items()
             },
         },
+    }
+
+
+def _bench_serve_steered() -> dict:
+    """The steered replica: ``SCTOOLS_TPU_STEER=1`` over shaped traffic.
+
+    One worker drains a multi-tenant job set whose shape makes the
+    static policy structurally wasteful: every job's ~2420-record
+    estimate packs exactly ONE job per 4096 bucket, and every flush
+    cuts at the last entity boundary, so a solo 2700-record job costs
+    a 4096 main dispatch PLUS a floor-padded tail-entity dispatch
+    (2700/8192 = 0.33 occupancy). Three jobs coalesce into the 8192
+    rung the calibration ladder made resident (8100 real -> 8096@8192
+    + the 4096 tail: 0.66). The armed controller must find that
+    upshift online from its own heartbeat window — and because it
+    chooses only among precompiled points, the run's merged registries
+    must still show ZERO retraces. --check holds occupancy >= 0.5 (vs
+    the 0.35 static floor), retraces == 0, and lost_jobs == 0.
+    """
+    from sctools_tpu import native
+    from sctools_tpu import steer as steermod
+    from sctools_tpu.serve.api import ServeJob
+    from sctools_tpu.serve.cli import submit_jobs
+    from sctools_tpu.serve.manifest import DEFAULT_MANIFEST_PATH
+
+    workdir = tempfile.mkdtemp(prefix="sctools_tpu_bench_steer.")
+    obs_dir = os.path.join(workdir, "obs")
+    out_dir = os.path.join(workdir, "out")
+    os.makedirs(obs_dir, exist_ok=True)
+    os.makedirs(out_dir, exist_ok=True)
+    calibration = os.path.join(workdir, "calibration.bam")
+    native.synth_bam_native(
+        calibration,
+        n_cells=STEER_CALIBRATION_CELLS,
+        molecules_per_cell=STEER_CALIBRATION_MOLECULES,
+        reads_per_molecule=STEER_CALIBRATION_READS,
+        n_genes=256,
+        seed=SYNTH_SEED + 200,
+        compress_level=1,
+    )
+    # one BAM per JOB on a disjoint barcode range (cell_offset), so
+    # cross-job packs can never hit an entity collision and degrade
+    jobs = []
+    for i in range(SERVE_TENANTS):
+        for j in range(STEER_JOBS_PER_TENANT):
+            bam = os.path.join(workdir, f"tenant{i:02d}-job{j}.bam")
+            index = i * STEER_JOBS_PER_TENANT + j
+            native.synth_bam_native(
+                bam,
+                n_cells=STEER_CELLS_PER_JOB,
+                molecules_per_cell=STEER_MOLECULES_PER_CELL,
+                reads_per_molecule=STEER_READS_PER_MOLECULE,
+                n_genes=256,
+                seq_len=STEER_SEQ_LEN,
+                seed=SYNTH_SEED + 300 + index,
+                compress_level=1,
+                cell_offset=index * STEER_CELLS_PER_JOB,
+            )
+            jobs.append(
+                ServeJob(
+                    f"tenant{i:02d}", bam,
+                    os.path.join(out_dir, f"tenant{i:02d}-job{j}"),
+                )
+            )
+    journal_dir = os.path.join(workdir, "journal-steer")
+    submit_jobs(journal_dir, jobs)
+    env = dict(os.environ)
+    env["SCTOOLS_TPU_AOT_CACHE"] = os.path.join(workdir, "aot_cache")
+    env["SCTOOLS_TPU_TRACE"] = obs_dir
+    env["SCTOOLS_TPU_TRACE_WORKER"] = "steered"
+    env["SCTOOLS_TPU_PULSE"] = "1"
+    env["SCTOOLS_TPU_STEER"] = "1"
+    env.pop("SCTOOLS_TPU_FAULTS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "sctools_tpu.serve", "worker",
+            journal_dir, "--worker-id", "steered", "--drain",
+            "--manifest", DEFAULT_MANIFEST_PATH,
+            "--calibration-bam", calibration,
+            "--idle-timeout", "120", "--poll-interval", "0.05",
+            "--batch-records", str(SERVE_BATCH_RECORDS),
+            "--steer-epoch", str(STEER_EPOCH_S),
+        ],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench --serve: steered worker failed "
+            f"(rc {proc.returncode}):\n{proc.stdout[-2000:]}"
+            f"\n{proc.stderr[-2000:]}"
+        )
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    # occupancy over the run's own tenant heartbeats — the same fold
+    # discipline the controller uses (warmup calibration beats excluded)
+    real = padded = 0
+    for ring in pulse.load_rings(workdir).values():
+        for record in ring["records"]:
+            if record.get("task_id") == "warmup":
+                continue
+            real += int(record.get("real_rows") or 0)
+            padded += int(record.get("padded_rows") or 0)
+    merged = xprof.merge_registries(xprof.load_registries(workdir))
+    retraces = sum(
+        int(site.get("retraces") or 0) for site in merged["sites"].values()
+    )
+    snapshot = steermod.latest_snapshots(workdir).get("steered") or {}
+    return {
+        "jobs": len(jobs),
+        "lost_jobs": len(jobs) - summary["jobs_committed"],
+        "occupancy": round(real / padded, 4) if padded else None,
+        "real_rows": real,
+        "padded_rows": padded,
+        "retraces": retraces,
+        "packs_run": summary["packs_run"],
+        "packs_degraded": summary["packs_degraded"],
+        "mode": snapshot.get("mode"),
+        "bucket": snapshot.get("bucket"),
+        "resident": snapshot.get("resident"),
+        "applied": snapshot.get("applied"),
+        "refused": snapshot.get("refused"),
+        "held": snapshot.get("held"),
+        "degraded": snapshot.get("degraded"),
     }
 
 
@@ -1600,6 +1825,21 @@ def check_result(
                 value=round(float(gated), 4),
                 ceiling=SLO_OVERHEAD_CEILING,
             )
+    # scx-steer OFF-MODE cost, same discipline as slo_overhead: the
+    # controller's decide-plus-knob-accessor sequence rides every
+    # admitted serve group, so its presence-but-off cost is gated; a
+    # steering-enabled run measures the live fold instead and the gate
+    # skips it
+    steer_info = result.get("steer")
+    if isinstance(steer_info, dict) and not steer_info.get("steer_on"):
+        gated = _gated_overhead(steer_info)
+        if isinstance(gated, (int, float)):
+            add(
+                "steer_overhead",
+                gated <= STEER_OVERHEAD_CEILING,
+                value=round(float(gated), 4),
+                ceiling=STEER_OVERHEAD_CEILING,
+            )
     # scx-pulse bubble attribution, held whenever the result carries it:
     # the measured share of the bench window where the device leg idled
     # while decode/transfer ran uncovered. Above the ceiling, the
@@ -1663,6 +1903,37 @@ def check_result(
                 add(
                     "serve_unattributed_device_s", unattributed == 0,
                     value=unattributed, ceiling=0,
+                )
+        # scx-steer steered-serving gates, held whenever the serve
+        # result carries the steered leg: the armed controller must
+        # LIFT occupancy (>= 0.5, twice the honesty of the static 0.35
+        # floor — the coalescing upshift is the whole point), must
+        # never have bought that lift with a retrace (it chooses only
+        # among precompiled points), and must not lose a job while
+        # adapting
+        serve_steer = serve.get("steer")
+        if isinstance(serve_steer, dict):
+            steer_occ = serve_steer.get("occupancy")
+            if isinstance(steer_occ, (int, float)):
+                add(
+                    "steer_occupancy",
+                    steer_occ >= STEER_OCCUPANCY_FLOOR,
+                    value=steer_occ,
+                    floor=STEER_OCCUPANCY_FLOOR,
+                    bucket=serve_steer.get("bucket"),
+                    applied=serve_steer.get("applied"),
+                )
+            steer_retraces = serve_steer.get("retraces")
+            if isinstance(steer_retraces, int):
+                add(
+                    "steer_retraces", steer_retraces == 0,
+                    value=steer_retraces, floor=0,
+                )
+            steer_lost = serve_steer.get("lost_jobs")
+            if isinstance(steer_lost, int):
+                add(
+                    "steer_lost_jobs", steer_lost == 0,
+                    value=steer_lost, floor=0,
                 )
     return verdict
 
@@ -1792,6 +2063,20 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         "metric": metric, "value": reference, "vs_baseline": 5.0,
         "slo": {"overhead": 1.3, "slo_on": True},
     }
+    # scx-steer controller overhead shares the slo gate's off-mode-only
+    # semantics: heavy off-mode fails, light passes, steering-on skips
+    steer_heavy = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "steer": {"overhead": 1.2, "steer_on": False},
+    }
+    steer_light = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "steer": {"overhead": 1.004, "steer_on": False},
+    }
+    steer_armed = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "steer": {"overhead": 1.3, "steer_on": True},
+    }
     # scx-pulse bubble attribution: a pipeline whose device leg idles
     # behind uncovered decode/transfer most of the window must fail
     bubbly = {
@@ -1845,6 +2130,28 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
             "slo": {"trace_complete": 1.0, "unattributed_device_s": 0},
         },
     }
+    # scx-steer steered-serving gates: an armed controller that LEFT
+    # occupancy at the static floor-padded level has failed at its one
+    # job; a steered run that retraced broke the never-retrace
+    # invariant; a lost job under adaptation is fatal; the healthy
+    # steered shape (upshift found, occupancy lifted, zero retraces)
+    # passes
+    def _steered(occupancy, retraces=0, lost=0):
+        return {
+            "metric": metric, "value": reference, "vs_baseline": 5.0,
+            "serve": {
+                "ttfr_speedup": 8.0, "lost_jobs": 0, "retraces": 0,
+                "steer": {
+                    "occupancy": occupancy, "retraces": retraces,
+                    "lost_jobs": lost, "bucket": 8192, "applied": 1,
+                },
+            },
+        }
+
+    serve_steer_padded = _steered(0.42)
+    serve_steer_retracing = _steered(0.62, retraces=2)
+    serve_steer_lossy = _steered(0.62, lost=1)
+    serve_steer_healthy = _steered(0.62)
     # platform comparability: the fingerprints literally committed in
     # the trajectory files (BENCH_r02-r05 are axon points, r06 the
     # CPU-only container point)
@@ -1939,6 +2246,14 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         failures.append(
             "slo-on overhead was gated (ceiling is off-mode only)"
         )
+    if check_result(steer_heavy, repo_dir)["ok"]:
+        failures.append("over-ceiling steer overhead passed the gate")
+    if not check_result(steer_light, repo_dir)["ok"]:
+        failures.append("healthy steer overhead failed the gate")
+    if not check_result(steer_armed, repo_dir)["ok"]:
+        failures.append(
+            "steering-on overhead was gated (ceiling is off-mode only)"
+        )
     if check_result(bubbly, repo_dir)["ok"]:
         failures.append("bubble-bound pipeline (0.8) passed the gate")
     if not check_result(streaming, repo_dir)["ok"]:
@@ -1963,6 +2278,19 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         )
     if not check_result(serve_stitched, repo_dir)["ok"]:
         failures.append("fully-stitched serve result failed the gate")
+    if check_result(serve_steer_padded, repo_dir)["ok"]:
+        failures.append(
+            "steered serve that left occupancy floor-padded (0.42) passed"
+        )
+    if check_result(serve_steer_retracing, repo_dir)["ok"]:
+        failures.append(
+            "steered serve that retraced passed the gate "
+            "(never-retrace invariant not enforced)"
+        )
+    if check_result(serve_steer_lossy, repo_dir)["ok"]:
+        failures.append("steered serve that lost a job passed the gate")
+    if not check_result(serve_steer_healthy, repo_dir)["ok"]:
+        failures.append("healthy steered serve result failed the gate")
     if not check_result(cpu_result, repo_dir)["ok"]:
         failures.append(
             "same-platform-healthy CPU result failed the gate "
@@ -2097,13 +2425,15 @@ def main(argv=None):
     if args.serve:
         result["serve"] = bench_serve()
     # always measured (cheap): the guard ladder's no-fault cost, the
-    # frame witness's off-mode handout cost, and the pulse plane's
-    # off-mode heartbeat cost ride the trajectory so --check can hold
-    # all three to their <= 2% ceilings
+    # frame witness's off-mode handout cost, the pulse plane's off-mode
+    # heartbeat cost, the slo probe's off-mode cost, and the steer
+    # controller's off-mode cost ride the trajectory so --check can
+    # hold each to its <= 2% ceiling
     result["guard"] = bench_guard_overhead()
     result["frame"] = bench_frame_overhead()
     result["pulse"] = bench_pulse_overhead()
     result["slo"] = bench_slo_overhead()
+    result["steer"] = bench_steer_overhead()
     print(json.dumps(result))
     if args.check:
         # the result line above stays the ONE stdout JSON line (the

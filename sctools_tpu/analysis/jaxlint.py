@@ -118,6 +118,7 @@ JAX_RULES = {
     "SCX112": "device-put-outside-ingest",
     "SCX113": "unguarded-device-boundary",
     "SCX114": "device-pull-outside-wire",
+    "SCX1001": "unguarded-actuation",
 }
 
 # files allowed to mutate process-global jax.config (SCX106)
@@ -145,6 +146,16 @@ DEVICE_PULL_OWNER_DIRS = ("ingest",)
 # loops ARE the sanctioned broad handlers every other call site routes
 # through
 GUARD_OWNER_DIRS = ("guard",)
+# steering-actuated knobs (SCX1001): bucket floors, prefetch/ring depth.
+# Only scx-steer's contract-checked apply path may write them at runtime;
+# the owner files are the modules that DEFINE the knobs (segments.py pins
+# the floors the offline --retune rewriter edits as text, prefetch.py
+# hosts the override cell steer/ flips).
+STEER_OWNER_DIRS = ("steer",)
+STEER_OWNERS = ("prefetch.py", "segments.py")
+_STEER_KNOB_CONSTANTS = ("RECORD_BUCKET_MIN", "ENTITY_BUCKET_MIN")
+_STEER_KNOB_CALLS = ("set_depth_override",)
+_STEER_KNOB_ENVS = ("SCTOOLS_TPU_PREFETCH_DEPTH",)
 # function names that cross the device boundary (SCX113): the engine
 # dispatches and the one upload choke point. Matched as a call's terminal
 # name (`ingest.upload(...)` additionally requires an ingest-module root,
@@ -1223,6 +1234,95 @@ class JaxLinter:
                     span=handler,
                 )
 
+    # -- SCX1001 -----------------------------------------------------------
+
+    def _check_unguarded_actuation(self) -> None:
+        """Writes to steering-actuated knobs outside the apply path.
+
+        The scx-steer controller owns three knobs at runtime: the packer
+        bucket (via the pinned bucket floors), the lease-group chunk
+        target, and the prefetch/ring depth.  A write anywhere else —
+        rebinding ``RECORD_BUCKET_MIN``/``ENTITY_BUCKET_MIN``, calling
+        ``set_depth_override``, or mutating the depth env var in-process
+        — bypasses the contract/residency validation that makes online
+        actuation retrace-free, so it is a finding.  Ownership follows
+        the SCX112 model: the ``steer`` package (immediate parent only)
+        plus the knob-defining modules themselves.
+        """
+        if os.path.basename(self.path) in STEER_OWNERS:
+            return
+        parts = os.path.normpath(self.path).split(os.sep)
+        # only the IMMEDIATE parent confers ownership (the SCX112 line)
+        if len(parts) >= 2 and parts[-2] in STEER_OWNER_DIRS:
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for target in targets:
+                    name = None
+                    if isinstance(target, ast.Name):
+                        name = target.id
+                    elif isinstance(target, ast.Attribute):
+                        name = target.attr
+                    if name in _STEER_KNOB_CONSTANTS:
+                        self._report(
+                            "SCX1001", node,
+                            f"write to steering-actuated knob `{name}` "
+                            "outside steer/'s contract-checked apply "
+                            "path: rebinding a pinned bucket floor at "
+                            "runtime bypasses the shape-contract and "
+                            "residency validation (use `python -m "
+                            "sctools_tpu.analysis --retune` offline, or "
+                            "the scx-steer controller online)",
+                        )
+                    elif isinstance(target, ast.Subscript):
+                        base = target.value
+                        key = target.slice
+                        if (
+                            isinstance(base, ast.Attribute)
+                            and base.attr == "environ"
+                            and isinstance(key, ast.Constant)
+                            and key.value in _STEER_KNOB_ENVS
+                        ):
+                            self._report(
+                                "SCX1001", node,
+                                f"in-process write to {key.value}: the "
+                                "prefetch/ring depth is a steering-"
+                                "actuated knob; only steer/'s validated "
+                                "apply path may change it at runtime",
+                            )
+            elif isinstance(node, ast.Call):
+                func = node.func
+                called = (
+                    func.id if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute)
+                    else None
+                )
+                if called in _STEER_KNOB_CALLS:
+                    self._report(
+                        "SCX1001", node,
+                        f"`{called}` outside steer/'s contract-checked "
+                        "apply path: the prefetch depth override is a "
+                        "steering actuation and must go through the "
+                        "controller's validated decision loop",
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.endswith("prefetch") and any(
+                    alias.name in _STEER_KNOB_CALLS
+                    for alias in node.names
+                ):
+                    self._report(
+                        "SCX1001", node,
+                        "importing set_depth_override outside steer/: "
+                        "the prefetch depth override is a steering "
+                        "actuation; read prefetch_depth() instead",
+                    )
+
     # -- driver ------------------------------------------------------------
 
     def run(self) -> List[Finding]:
@@ -1236,6 +1336,7 @@ class JaxLinter:
         self._check_device_put()
         self._check_device_pull()
         self._check_unguarded_boundary()
+        self._check_unguarded_actuation()
         return self.findings
 
 

@@ -203,6 +203,14 @@ def retune(
 
     report = efficiency_report(run_dir)
     suggestions = suggest_buckets(report, target=target)
+    # the scx-steer controller's journaled refusals join the registry
+    # evidence: an online downshift the pinned floor refused is a
+    # recorded argument for a lower floor, in the same row schema
+    from .. import steer as _steer
+
+    suggestions = suggestions + _steer.suggest_from_decisions(
+        _steer.load_decisions(run_dir), target=target
+    )
     constants = derive_constants(suggestions, current)
     changed = {
         name: entry["derived"]

@@ -156,9 +156,9 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.scx_synth_bam.restype = ctypes.c_long
         lib.scx_synth_bam.argtypes = [
-            ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_ulonglong, ctypes.c_int,
-            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_ulonglong,
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ]
         lib.scx_tagsort.restype = ctypes.c_long
         lib.scx_tagsort.argtypes = [
@@ -518,11 +518,14 @@ def synth_bam_native(
     seq_len: int = 98,
     seed: int = 42,
     compress_level: int = 1,
+    cell_offset: int = 0,
 ) -> int:
     """Write a cell-sorted fully tagged synthetic BAM at native speed.
 
     Used by bench.py and large-scale streaming tests to build
-    north-star-sized inputs. Returns records written. Raises RuntimeError
+    north-star-sized inputs. ``cell_offset`` shifts the barcode space so
+    files written with disjoint cell ranges share no barcode (packable
+    multi-job traffic). Returns records written. Raises RuntimeError
     when the native layer is unavailable (callers fall back to the Python
     writer in tests/helpers or skip).
     """
@@ -532,8 +535,8 @@ def synth_bam_native(
     errbuf = ctypes.create_string_buffer(256)
     with obs.span("native:synth_bam") as sp:
         written = lib.scx_synth_bam(
-            path.encode(), n_cells, molecules_per_cell, reads_per_molecule,
-            n_genes, seq_len, seed, compress_level,
+            path.encode(), n_cells, cell_offset, molecules_per_cell,
+            reads_per_molecule, n_genes, seq_len, seed, compress_level,
             errbuf, ctypes.sizeof(errbuf),
         )
         if written < 0:  # raise inside the span so it carries the error

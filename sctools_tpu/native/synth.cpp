@@ -74,10 +74,14 @@ const uint8_t kSeqCode[4] = {1, 2, 4, 8};
 extern "C" {
 
 // Returns records written, or -1 with errbuf filled.
-long scx_synth_bam(const char* path, long n_cells, int molecules_per_cell,
-                   int reads_per_molecule, int n_genes, int seq_len,
-                   unsigned long long seed, int compress_level, char* errbuf,
-                   int errbuf_len) {
+// cell_offset shifts the barcode space: barcodes encode cell_offset+i,
+// so two files written with disjoint [offset, offset+n_cells) ranges
+// share no cell barcode — multi-job serving tests pack them into one
+// device batch without tripping the entity-collision guard.
+long scx_synth_bam(const char* path, long n_cells, long cell_offset,
+                   int molecules_per_cell, int reads_per_molecule,
+                   int n_genes, int seq_len, unsigned long long seed,
+                   int compress_level, char* errbuf, int errbuf_len) {
   scx::BgzfWriter out;
   if (!out.open(path, compress_level)) {
     if (errbuf && errbuf_len > 0)
@@ -111,7 +115,7 @@ long scx_synth_bam(const char* path, long n_cells, int molecules_per_cell,
   long written = 0;
 
   for (long cell = 0; cell < n_cells; ++cell) {
-    encode_base4(static_cast<uint64_t>(cell), 16, cb);
+    encode_base4(static_cast<uint64_t>(cell_offset + cell), 16, cb);
     for (int mol = 0; mol < molecules_per_cell; ++mol) {
       encode_base4(static_cast<uint64_t>(mol), 10, ub);
       uint32_t gene = rng.below(static_cast<uint32_t>(n_genes));

@@ -206,6 +206,16 @@ def _efficiency(args, out=None, err=None) -> int:
         return 2
     if args.suggest:
         suggestions = suggest_buckets(report, target=args.target)
+        # the online controller's journaled refusals are a second
+        # evidence source: a refused downshift means the loop SAW
+        # sagging occupancy and wanted a bucket the pinned floor forbids
+        # — exactly what the offline --retune pass should consider.
+        # Same row schema as suggest_buckets, sites named steer:<worker>.
+        from .. import steer as steermod
+
+        suggestions = suggestions + steermod.suggest_from_decisions(
+            steermod.load_decisions(args.run_dir), target=args.target
+        )
         if args.as_json:
             payload = {"target": args.target, "suggestions": suggestions}
             print(json.dumps(payload, separators=(",", ":")), file=out)

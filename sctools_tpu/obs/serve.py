@@ -76,6 +76,11 @@ class PulseExporter:
                 body += slo.render_slo_metrics(
                     slo.stitch_run(self._run_dir, window_s=self._window_s)
                 )
+                # scx-steer controller gauges ride the same scrape when
+                # any worker journaled steering state (empty otherwise)
+                from .. import steer
+
+                body += steer.render_steer_metrics(self._run_dir)
             return body
         # live mode: the process's own counters/spans plus its pulse
         # gauges — render_metrics() raises on name-mangling collisions

@@ -202,6 +202,27 @@ def _print_serve_summary(journal: Journal, tasks, states, out) -> None:
             f"(max {serve.get('max_depth', '?')}/tenant) {detail} [{warm}]",
             file=out,
         )
+    for worker, info in sorted(meta.items()):
+        steering = info.get("steer")
+        if not isinstance(steering, dict) or "mode" not in steering:
+            continue
+        mode = steering.get("mode")
+        line = f"serve steer {worker}: mode={mode}"
+        if mode != "off":
+            line += (
+                f" bucket={steering.get('bucket', '?')}"
+                f"/{steering.get('static', '?')}"
+            )
+            if steering.get("prefetch_override") is not None:
+                line += f" prefetch={steering['prefetch_override']}"
+            line += (
+                f" decisions={steering.get('decisions', 0)} "
+                f"(applied={steering.get('applied', 0)} "
+                f"refused={steering.get('refused', 0)} "
+                f"held={steering.get('held', 0)} "
+                f"degraded={steering.get('degraded', 0)})"
+            )
+        print(line, file=out)
 
 
 def _print_slo_summary(journal: Journal, tasks, now: float, out) -> None:

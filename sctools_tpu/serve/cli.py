@@ -76,6 +76,7 @@ def _cmd_worker(args, out) -> int:
         compress=not args.no_compress,
         lease_ttl=args.lease_ttl,
         poll_interval=args.poll_interval,
+        steer_epoch_s=args.steer_epoch,
     ) as worker:
         worker.warmup(calibration_bam=args.calibration_bam)
         committed = worker.serve_forever(
@@ -160,6 +161,13 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
         help="streaming batch size (bucket capacity for packing)",
     )
     worker.add_argument("--no-compress", action="store_true")
+    worker.add_argument(
+        "--steer-epoch",
+        type=float,
+        default=None,
+        help="scx-steer decision cadence in seconds (default: the "
+        "controller's own; benches shrink it to match synthetic drains)",
+    )
     worker.add_argument("--lease-ttl", type=float, default=30.0)
     worker.add_argument("--poll-interval", type=float, default=0.25)
     worker.set_defaults(fn=_cmd_worker)

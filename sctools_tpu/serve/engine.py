@@ -40,7 +40,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from .. import obs
+from .. import obs, steer
 from ..obs import slo, xprof
 from ..metrics.gatherer import DEFAULT_BATCH_RECORDS, GatherCellMetrics
 from ..sched import faults
@@ -63,7 +63,13 @@ from .manifest import (
     aot_cache_dir,
     load_manifest,
 )
-from .packer import PackTrace, _trace_task, plan_packs, run_packed
+from .packer import (
+    PackTrace,
+    _trace_task,
+    estimate_records,
+    plan_packs,
+    run_packed,
+)
 
 
 class ServeWorker:
@@ -79,6 +85,7 @@ class ServeWorker:
         compress: bool = True,
         lease_ttl: float = 30.0,
         poll_interval: float = 0.25,
+        steer_epoch_s: Optional[float] = None,
     ):
         self._queue = WorkQueue(
             journal_dir,
@@ -91,6 +98,14 @@ class ServeWorker:
         self._manifest: Optional[Dict] = None
         self._batch_records = batch_records
         self._compress = compress
+        # decision cadence override: benches and smokes drain synthetic
+        # traffic far faster than production, so they shrink the epoch
+        # to let the control loop observe more than one window
+        self._steer_epoch_s = steer_epoch_s
+        # the scx-steer controller (NOOP until warmup builds the real
+        # one against the manifest's shape contract, and always NOOP
+        # with SCTOOLS_TPU_STEER off — the accessors are identity)
+        self._steer = steer.NOOP
         self._warm = False
         self._started = time.perf_counter()
         #: seconds from worker construction to the first committed result
@@ -129,22 +144,44 @@ class ServeWorker:
         # executables directly, skipping per-process tracing — the first
         # replica to compile a signature persists it for the fleet
         xprof.enable_executable_store(os.path.join(cache_dir, "exec"))
+        # the scx-steer controller over this worker's own heartbeats,
+        # validated against the SAME contract the manifest certifies —
+        # the apply path can then only choose contract-admissible points
+        steer_opts = {}
+        if self._steer_epoch_s is not None:
+            steer_opts["epoch_s"] = self._steer_epoch_s
+        self._steer = steer.controller(
+            self._batch_records,
+            contract=manifest.get("contract"),
+            **steer_opts,
+        )
         if calibration_bam:
+            # residency ladder: calibrate every bucket the controller
+            # may later choose (static plus one rung down/up), so every
+            # steerable (site, signature) point is resident BEFORE the
+            # first request — adaptation can then never compile
+            rungs = self._steer.ladder() or [self._batch_records]
             with tempfile.TemporaryDirectory(prefix="serve-warm-") as tmp:
-                stem = os.path.join(tmp, "calibration")
-                gatherer = GatherCellMetrics(
-                    calibration_bam,
-                    stem,
-                    compress=self._compress,
-                    batch_records=self._batch_records,
-                )
-                # tag calibration heartbeats so scx-slo never reads
-                # warmup dispatches as unattributed tenant device time
-                with _trace_task("warmup"):
-                    gatherer.extract_metrics()
+                for rung in rungs:
+                    stem = os.path.join(tmp, f"calibration-{rung}")
+                    gatherer = GatherCellMetrics(
+                        calibration_bam,
+                        stem,
+                        compress=self._compress,
+                        batch_records=rung,
+                    )
+                    # tag calibration heartbeats so scx-slo never reads
+                    # warmup dispatches as unattributed tenant device time
+                    with _trace_task("warmup"):
+                        gatherer.extract_metrics()
+                    self._steer.note_resident(rung)
         self._warm = True
         self._queue.journal.announce_worker(
-            {"serve": self._admission.snapshot(), "warm": True}
+            {
+                "serve": self._admission.snapshot(),
+                "warm": True,
+                "steer": self._steer.snapshot(),
+            }
         )
 
     # ----------------------------------------------------------- serving
@@ -173,6 +210,19 @@ class ServeWorker:
         while True:
             tasks, states = journal.replay()
             queued = group_open_jobs(tasks, states, wall_clock())
+            # one control epoch between groups: fold the worker's own
+            # heartbeats, maybe actuate, and journal the decision —
+            # every applied/refused/degraded verdict is on the record
+            decision = self._steer.decide()
+            if decision is not None:
+                journal.announce_worker(
+                    {
+                        "serve": self._admission.snapshot(),
+                        "warm": True,
+                        "steer": self._steer.snapshot(),
+                        "steer_decision": decision,
+                    }
+                )
             group = self._admit_group(queued, tasks, states)
             # `worked` counts tasks actually held under a lease — an
             # admitted group whose leases are all live with a peer is
@@ -180,8 +230,17 @@ class ServeWorker:
             worked = self._run_group(group) if group else 0
             if worked:
                 idle_since = time.perf_counter()
+                # worker meta is last-announcement-wins: every engine
+                # announcement must carry the steer snapshot or the
+                # `sched status` steer line vanishes whenever this (or
+                # the pack_plan) announcement lands after the last
+                # decision epoch
                 journal.announce_worker(
-                    {"serve": self._admission.snapshot(), "warm": True}
+                    {
+                        "serve": self._admission.snapshot(),
+                        "warm": True,
+                        "steer": self._steer.snapshot(),
+                    }
                 )
             if max_jobs is not None and self.jobs_committed >= max_jobs:
                 break
@@ -218,12 +277,29 @@ class ServeWorker:
         """
         queues = {tenant: list(ids) for tenant, ids in queued.items()}
         group: List[Tuple[str, ServeJob]] = []
+        # knob 1 (next-lease chunk sizing): with steering live, stop
+        # coalescing BEFORE the group's estimated decoded rows would
+        # cross the controller's chunk target — the group lands near a
+        # bucket boundary instead of just past one (a job admitted past
+        # the boundary would strand its tail into a floor-padded pack).
+        # chunk_records(None) is None for the no-op controller and in
+        # degraded mode, so the static admission behaviour is untouched.
+        chunk = self._steer.chunk_records(None)
+        estimated = 0
         while True:
             tenant = self._admission.select(queues)
-            if tenant is None or not self._admission.admit(tenant):
+            if tenant is None:
                 break
-            tid = queues[tenant].pop(0)
-            group.append((tid, ServeJob.from_payload(tasks[tid].payload)))
+            tid = queues[tenant][0]
+            job = ServeJob.from_payload(tasks[tid].payload)
+            est = estimate_records(job.bam)
+            if chunk is not None and group and estimated + est > chunk:
+                break
+            if not self._admission.admit(tenant):
+                break
+            queues[tenant].pop(0)
+            estimated += est
+            group.append((tid, job))
         return group
 
     # -------------------------------------------------------- group runs
@@ -296,9 +372,14 @@ class ServeWorker:
         try:
             jobs = [job for _, job in ready]
             tid_of = {id(job): tid for (tid, job) in ready}
-            for plan in plan_packs(jobs, self._batch_records):
+            # knob 2 (bucket selection): read the steered capacity ONCE
+            # per group so planning and running agree; the controller
+            # only returns contract-admissible resident buckets, and the
+            # static value verbatim when off or degraded
+            capacity = self._steer.batch_records(self._batch_records)
+            for plan in plan_packs(jobs, capacity):
                 members = [(tid_of[id(job)], job) for job in plan.jobs]
-                self._run_pack(journal, members, attempts)
+                self._run_pack(journal, members, attempts, capacity)
         finally:
             stop.set()
             beat.join(timeout=5.0)
@@ -313,7 +394,10 @@ class ServeWorker:
         journal,
         members: Sequence[Tuple[str, ServeJob]],
         attempts: Dict[str, int],
+        batch_records: Optional[int] = None,
     ) -> int:
+        if batch_records is None:
+            batch_records = self._batch_records
         for tid, _ in members:
             faults.fire("task.claimed", name=tid)
         trace = PackTrace(tids=[tid for tid, _ in members])
@@ -323,6 +407,7 @@ class ServeWorker:
         journal.announce_worker(
             {
                 "serve": self._admission.snapshot(),
+                "steer": self._steer.snapshot(),
                 "pack_plan": {
                     "exec_id": (
                         trace.exec_id()
@@ -344,7 +429,7 @@ class ServeWorker:
                 artifacts, packed = run_packed(
                     [job for _, job in members],
                     compress=self._compress,
-                    batch_records=self._batch_records,
+                    batch_records=batch_records,
                     trace=trace,
                 )
                 probe.mark("pack_done")

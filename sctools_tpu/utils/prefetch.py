@@ -49,8 +49,32 @@ _DEPTH_ENV = "SCTOOLS_TPU_PREFETCH_DEPTH"
 MAX_PREFETCH_DEPTH = 64
 
 
+# scx-steer's live actuation point: the online controller may deepen the
+# pipeline when limiting_stage names decode/h2d. Consulted before the env
+# so an applied decision takes effect at the next queue construction;
+# None means "no override" (the env/default path). The ONLY sanctioned
+# writer is steer/'s contract-checked apply path — SCX1001
+# (unguarded-actuation) flags any other caller.
+_depth_override: Optional[int] = None
+
+
+def set_depth_override(depth: Optional[int]) -> None:
+    """Install (or with None clear) the steering depth override."""
+    global _depth_override
+    if depth is not None:
+        depth = int(depth)
+        if not 1 <= depth <= MAX_PREFETCH_DEPTH:
+            raise ValueError(
+                f"prefetch depth override {depth} outside "
+                f"[1, {MAX_PREFETCH_DEPTH}]"
+            )
+    _depth_override = depth
+
+
 def prefetch_depth() -> int:
     """Configured decode-ahead depth (SCTOOLS_TPU_PREFETCH_DEPTH, default 2)."""
+    if _depth_override is not None:
+        return _depth_override
     env = os.environ.get(_DEPTH_ENV)
     if env:
         try:

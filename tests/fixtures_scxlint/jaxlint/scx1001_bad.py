@@ -1,0 +1,26 @@
+"""SCX1001 bad twin: knob writes outside steer/'s apply path."""
+
+import os
+
+from sctools_tpu.utils.prefetch import set_depth_override  # finding
+
+from sctools_tpu.ops import segments
+
+
+def widen_pipeline():
+    # direct depth actuation outside the controller: finding
+    set_depth_override(8)
+
+
+def deepen_via_env():
+    # in-process env mutation of a steering-actuated knob: finding
+    os.environ["SCTOOLS_TPU_PREFETCH_DEPTH"] = "16"
+
+
+def lower_floor():
+    # rebinding a pinned bucket floor at runtime: finding
+    segments.RECORD_BUCKET_MIN = 1024
+
+
+def lower_entity_floor():
+    ENTITY_BUCKET_MIN = 16  # noqa: F841 - the rebind IS the finding

@@ -1,0 +1,14 @@
+"""SCX1001 clean twin: reading the knobs is always allowed."""
+
+import os
+
+from sctools_tpu.ops.segments import RECORD_BUCKET_MIN, bucket_size
+from sctools_tpu.utils.prefetch import prefetch_depth
+
+
+def plan_capacity(n_records):
+    # reads of the floors and the depth are not actuations
+    floor = RECORD_BUCKET_MIN
+    depth = prefetch_depth()
+    configured = os.environ.get("SCTOOLS_TPU_PREFETCH_DEPTH")
+    return bucket_size(max(n_records, floor)), depth, configured

@@ -20,7 +20,14 @@ an injected ``delay@task.claimed`` fault) and a replacement spawned:
   unattributed, and jobs stolen from the dead worker stitch across the
   lineage boundary;
 - ``sched status`` renders the serve view (per-tenant counts, the
-  admission line, and the per-tenant slo summary) and exits 0.
+  admission line, and the per-tenant slo summary) and exits 0;
+- steering is ARMED (``SCTOOLS_TPU_STEER=1``) through the whole
+  elastic episode: every worker lineage journals decisions from a
+  fresh controller (seq starts at 1 — no stale-controller carryover
+  into the replacement), the thin traffic draws downshift proposals
+  that are REFUSED at the pinned floor (the journaled ``--retune``
+  evidence), no bucket ever moves off the static point, and ``sched
+  status`` renders the ``serve steer`` line per worker.
 
 Because the fleet is elastic here (SIGTERM mid-traffic + replacement),
 ``make elastic-smoke`` aliases this gate.
@@ -98,6 +105,9 @@ def launch_worker(workdir: str, worker_id: str, fault_spec: str, extra):
     # without rings the per-job leg decomposition has nothing to match
     env["SCTOOLS_TPU_PULSE"] = "1"
     env["SCTOOLS_TPU_AOT_CACHE"] = os.path.join(workdir, "aot_cache")
+    # steering armed through SIGTERM + replacement: the elastic episode
+    # must not leak controller state across worker lineages
+    env["SCTOOLS_TPU_STEER"] = "1"
     if fault_spec:
         env["SCTOOLS_TPU_FAULTS"] = fault_spec
     else:
@@ -112,6 +122,7 @@ def launch_worker(workdir: str, worker_id: str, fault_spec: str, extra):
         "--no-compress",
         "--lease-ttl", LEASE_TTL,
         "--poll-interval", "0.1",
+        "--steer-epoch", "0.1",
     ] + list(extra)
     return subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -306,6 +317,39 @@ def main() -> int:
     )
     assert all(job["complete"] for job in crossed), crossed
 
+    # scx-steer across the elastic episode: every lineage ran a FRESH
+    # controller (decision seq restarts at 1 — a replacement must
+    # re-derive its state from live telemetry, never inherit the dead
+    # worker's), the thin traffic drew downshift proposals that the
+    # pinned floor REFUSED (journaled --retune evidence), and no bucket
+    # ever actuated off the static point (the byte-identity assertion
+    # above already proved packing stayed static-shaped)
+    from sctools_tpu import steer
+
+    decisions = steer.load_decisions(workdir)
+    assert decisions, "steering armed but no decision journaled"
+    by_worker = {}
+    for decision in decisions:
+        by_worker.setdefault(decision["worker"], []).append(decision)
+    for worker, rows in by_worker.items():
+        assert min(row["seq"] for row in rows) == 1, (
+            f"{worker}: stale controller carryover (first seq != 1)"
+        )
+    assert "wC" in by_worker, sorted(by_worker)
+    refused = [d for d in decisions if d["verdict"] == "refused"]
+    assert refused, "thin traffic journaled no floor refusal"
+    assert all(
+        d["proposal"]["knob"] == "bucket"
+        and d["proposal"]["to"] < BATCH_RECORDS
+        for d in refused
+    ), refused
+    assert not any(d["verdict"] == "applied" for d in decisions), [
+        d for d in decisions if d["verdict"] == "applied"
+    ]
+    snapshots = steer.latest_snapshots(workdir)
+    for worker, snapshot in snapshots.items():
+        assert snapshot["bucket"] == snapshot["static"], (worker, snapshot)
+
     # the serve view of sched status renders and exits 0
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
@@ -318,6 +362,7 @@ def main() -> int:
     assert "serve tenant" in status.stdout, status.stdout[-2000:]
     assert "serve admission" in status.stdout, status.stdout[-2000:]
     assert "serve slo" in status.stdout, status.stdout[-2000:]
+    assert "serve steer" in status.stdout, status.stdout[-2000:]
 
     n_parts = len(glob.glob(os.path.join(out_dir, "*.csv")))
     print(
@@ -327,7 +372,9 @@ def main() -> int:
         f"degraded), {n_parts} artifact(s) byte-identical to solo runs, "
         f"0 retraces, signatures within the AOT manifest, "
         f"{len(view['jobs'])} complete trace(s) ({len(crossed)} stitched "
-        f"across lineages), 0s unattributed device time"
+        f"across lineages), 0s unattributed device time, "
+        f"{len(decisions)} steer decision(s) across {len(by_worker)} "
+        f"fresh controller(s) ({len(refused)} floor refusal(s), 0 applied)"
     )
     return 0
 

@@ -1,0 +1,233 @@
+"""steer-smoke: the CI gate for scx-steer (`make steer-smoke`).
+
+The same mixed-tenant traffic drains twice through a 2-worker fleet —
+once with ``SCTOOLS_TPU_STEER=0`` (the static policy) and once armed —
+and the armed leg must be measurably, safely better:
+
+- zero lost jobs in BOTH legs (steering never costs correctness);
+- the armed leg's padding occupancy (real/padded over the pulse rings,
+  warmup calibration beats excluded) STRICTLY exceeds the static leg's:
+  the traffic is shaped so each job solo-packs into a floor bucket plus
+  a floor-padded tail-entity dispatch, and only the online coalescing
+  upshift (three jobs into the calibrated 8192 rung) recovers the
+  waste;
+- at least one ``applied`` decision is journaled, and every applied
+  bucket move lands inside the residency ladder its worker announced —
+  adaptation only ever chooses precompiled points;
+- the merged xprof registries of the ARMED leg show zero retraces, and
+  every observed signature sits inside the committed AOT manifest's
+  shape contract: adaptation never compiled anything new.
+
+Exit 0 on success; any assertion failure is a gate failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+MANIFEST = os.path.join(
+    REPO_ROOT, "sctools_tpu", "serve", "aot_manifest.json"
+)
+BATCH_RECORDS = 4096
+TENANTS = 4
+JOBS_PER_TENANT = 6
+# each job: 2700 real records (675 cells x 2 molecules x 2 reads) whose
+# size/48 estimate (~2420 at seq_len 48) packs exactly ONE job per 4096
+# bucket statically and THREE per calibrated 8192 rung — the upshift the
+# armed leg must find online (see bench.py's STEER_* constants)
+CELLS_PER_JOB = 675
+SEQ_LEN = 48
+# calibration: comfortably past the top ladder rung (8192) so warmup
+# genuinely compiles every rung-shaped executable
+CALIBRATION_CELLS = 1280
+
+
+def _run_leg(workdir: str, leg: str, armed: bool, bams) -> dict:
+    """Drain the given jobs through two workers; return leg telemetry."""
+    from sctools_tpu.obs import pulse, xprof
+    from sctools_tpu.serve.api import ServeJob
+    from sctools_tpu.serve.cli import submit_jobs
+
+    leg_dir = os.path.join(workdir, leg)
+    obs_dir = os.path.join(leg_dir, "obs")
+    out_dir = os.path.join(leg_dir, "out")
+    journal_dir = os.path.join(leg_dir, "journal")
+    os.makedirs(obs_dir, exist_ok=True)
+    os.makedirs(out_dir, exist_ok=True)
+    jobs = [
+        ServeJob(
+            tenant, bam,
+            os.path.join(out_dir, f"{tenant}-{j}"),
+        )
+        for tenant, j, bam in bams
+    ]
+    fresh = submit_jobs(journal_dir, jobs)
+    assert fresh == len(jobs), (fresh, len(jobs))
+
+    procs = []
+    for worker_id in ("w0", "w1"):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.pop("SCTOOLS_TPU_FAULTS", None)
+        env["SCTOOLS_TPU_TRACE"] = obs_dir
+        env["SCTOOLS_TPU_TRACE_WORKER"] = worker_id
+        env["SCTOOLS_TPU_PULSE"] = "1"
+        # one AOT cache across BOTH legs: the comparison is policy vs
+        # policy, not cold-compile vs warm-cache
+        env["SCTOOLS_TPU_AOT_CACHE"] = os.path.join(workdir, "aot_cache")
+        env["SCTOOLS_TPU_STEER"] = "1" if armed else "0"
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "sctools_tpu.serve", "worker",
+                    journal_dir,
+                    "--worker-id", worker_id,
+                    "--manifest", MANIFEST,
+                    "--calibration-bam",
+                    os.path.join(workdir, "calibration.bam"),
+                    "--batch-records", str(BATCH_RECORDS),
+                    "--steer-epoch", "0.1",
+                    "--idle-timeout", "120",
+                    "--poll-interval", "0.05",
+                    "--drain",
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            )
+        )
+    committed = 0
+    for proc in procs:
+        out, _ = proc.communicate(timeout=600)
+        assert proc.returncode == 0, f"{leg} worker failed:\n{out[-2000:]}"
+        committed += json.loads(out.strip().splitlines()[-1])[
+            "jobs_committed"
+        ]
+
+    real = padded = 0
+    for ring in pulse.load_rings(leg_dir).values():
+        for record in ring["records"]:
+            if record.get("task_id") == "warmup":
+                continue
+            real += int(record.get("real_rows") or 0)
+            padded += int(record.get("padded_rows") or 0)
+    assert padded, f"{leg}: no tenant heartbeats in the pulse rings"
+    merged = xprof.merge_registries(xprof.load_registries(leg_dir))
+    retraces = sum(
+        int(site.get("retraces") or 0)
+        for site in merged["sites"].values()
+    )
+    return {
+        "jobs": len(jobs),
+        "committed": committed,
+        "occupancy": real / padded,
+        "retraces": retraces,
+        "sites": merged["sites"],
+    }
+
+
+def main() -> int:
+    from sctools_tpu import native, steer
+    from sctools_tpu.analysis.shardcheck import check_signatures
+
+    workdir = os.environ.get(
+        "SCTOOLS_TPU_STEER_SMOKE_DIR"
+    ) or tempfile.mkdtemp(prefix="sctools_tpu_steer_smoke.")
+    os.makedirs(workdir, exist_ok=True)
+
+    native.synth_bam_native(
+        os.path.join(workdir, "calibration.bam"),
+        n_cells=CALIBRATION_CELLS,
+        molecules_per_cell=4,
+        reads_per_molecule=2,
+        n_genes=256,
+        seed=4242,
+        compress_level=1,
+    )
+    # one BAM per job on a disjoint barcode range (cell_offset), so
+    # cross-job packs never trip the entity-collision guard
+    bams = []
+    for i in range(TENANTS):
+        for j in range(JOBS_PER_TENANT):
+            bam = os.path.join(workdir, f"tenant{i:02d}-job{j}.bam")
+            index = i * JOBS_PER_TENANT + j
+            native.synth_bam_native(
+                bam,
+                n_cells=CELLS_PER_JOB,
+                molecules_per_cell=2,
+                reads_per_molecule=2,
+                n_genes=256,
+                seq_len=SEQ_LEN,
+                seed=5000 + index,
+                compress_level=1,
+                cell_offset=index * CELLS_PER_JOB,
+            )
+            bams.append((f"tenant{i:02d}", j, bam))
+
+    static_leg = _run_leg(workdir, "static", armed=False, bams=bams)
+    steered_leg = _run_leg(workdir, "steered", armed=True, bams=bams)
+
+    # zero lost jobs, both policies
+    for leg, result in (("static", static_leg), ("steered", steered_leg)):
+        assert result["committed"] == result["jobs"], (leg, result)
+
+    # the armed controller must strictly improve occupancy on the SAME
+    # traffic — that improvement is the whole point of the subsystem
+    assert steered_leg["occupancy"] > static_leg["occupancy"], (
+        f"steered occupancy {steered_leg['occupancy']:.4f} did not beat "
+        f"static {static_leg['occupancy']:.4f}"
+    )
+
+    # adaptation actually happened, and only onto resident rungs
+    decisions = steer.load_decisions(os.path.join(workdir, "steered"))
+    applied = [d for d in decisions if d["verdict"] == "applied"]
+    assert applied, "armed leg journaled no applied decision"
+    snapshots = steer.latest_snapshots(os.path.join(workdir, "steered"))
+    resident = {
+        rung
+        for snapshot in snapshots.values()
+        for rung in snapshot.get("resident") or []
+    }
+    bucket_moves = [
+        d for d in applied if d["proposal"]["knob"] == "bucket"
+    ]
+    assert bucket_moves, "no bucket actuation in the applied decisions"
+    for decision in bucket_moves:
+        assert decision["proposal"]["to"] in resident, (
+            decision, sorted(resident),
+        )
+
+    # never-retrace invariant under live adaptation, and every observed
+    # signature inside the committed manifest's contract
+    assert steered_leg["retraces"] == 0, steered_leg["retraces"]
+    with open(MANIFEST, encoding="utf-8") as f:
+        manifest = json.load(f)
+    violations = check_signatures(
+        manifest["contract"], steered_leg["sites"]
+    )
+    assert not violations, violations
+
+    print(
+        f"steer-smoke OK: {steered_leg['jobs']} job(s) x 2 legs across "
+        f"{TENANTS} tenant(s) on 2 workers, occupancy "
+        f"{static_leg['occupancy']:.3f} static -> "
+        f"{steered_leg['occupancy']:.3f} steered, "
+        f"{len(applied)} applied decision(s) (buckets within the "
+        f"residency ladder), 0 retraces, signatures within the AOT "
+        f"manifest"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,7 +72,7 @@ TREE = [
 ]
 
 JAX_RULE_IDS = [f"SCX10{i}" for i in range(1, 10)] + [
-    "SCX110", "SCX111", "SCX112", "SCX113", "SCX114",
+    "SCX110", "SCX111", "SCX112", "SCX113", "SCX114", "SCX1001",
 ]
 
 
@@ -145,6 +145,61 @@ def test_scx114_ingest_dir_is_exempt(tmp_path):
     assert {f.rule for f in lint_file(str(nested / "wirelike.py"))} == {
         "SCX114"
     }
+
+
+def test_scx1001_steer_dir_is_exempt(tmp_path):
+    # SCX1001 is about ownership like SCX112: the steer package IS the
+    # contract-checked apply path, wherever the checkout lives
+    src = (
+        "from sctools_tpu.utils.prefetch import set_depth_override\n\n\n"
+        "def apply(depth):\n    set_depth_override(depth)\n"
+    )
+    steer_dir = tmp_path / "steer"
+    steer_dir.mkdir()
+    (steer_dir / "apply.py").write_text(src)
+    assert lint_file(str(steer_dir / "apply.py")) == []
+    (tmp_path / "apply.py").write_text(src)
+    findings = lint_file(str(tmp_path / "apply.py"))
+    assert {f.rule for f in findings} == {"SCX1001"}
+    # only the IMMEDIATE parent confers ownership (the SCX112 line)
+    nested = steer_dir / "sub"
+    nested.mkdir()
+    (nested / "apply.py").write_text(src)
+    assert {f.rule for f in lint_file(str(nested / "apply.py"))} == {
+        "SCX1001"
+    }
+
+
+def test_scx1001_knob_owners_are_exempt(tmp_path):
+    # the modules that DEFINE the knobs stay lintable: prefetch.py hosts
+    # the override cell, segments.py pins the floors
+    (tmp_path / "prefetch.py").write_text(
+        "_depth_override = None\n\n\ndef set_depth_override(depth):\n"
+        "    global _depth_override\n    _depth_override = depth\n"
+    )
+    assert lint_file(str(tmp_path / "prefetch.py")) == []
+    (tmp_path / "segments.py").write_text("RECORD_BUCKET_MIN = 4096\n")
+    assert lint_file(str(tmp_path / "segments.py")) == []
+
+
+def test_scx1001_real_tree_is_clean():
+    # the live tree must only actuate knobs through steer/'s apply path;
+    # a regression here means someone added an unguarded knob write
+    for root in TREE:
+        paths = []
+        if os.path.isfile(root):
+            paths = [root]
+        else:
+            for dirpath, _, names in os.walk(root):
+                paths.extend(
+                    os.path.join(dirpath, n)
+                    for n in names if n.endswith(".py")
+                )
+        for path in paths:
+            findings = [
+                f for f in lint_file(path) if f.rule == "SCX1001"
+            ]
+            assert findings == [], [f.render() for f in findings]
 
 
 def test_scx114_bad_fixture_marks_exact_lines():

@@ -1,0 +1,569 @@
+"""scx-steer: the online occupancy controller's contracts.
+
+Covers docs/steering.md: hysteresis band entry/exit, the bounded
+actuation rate, the contract/floor/residency refusal path, loud
+degrade-to-static on telemetry loss and torn rings, recovery when
+telemetry returns, the off-mode cached no-op singleton, deterministic
+replay from a canned heartbeat sequence, the refused-downshift ->
+offline-suggestion schema (the vocabulary ``obs efficiency --suggest``
+and ``--retune`` share with scx-xprof), and the journal round-trip the
+gauges and ``sched status`` read.
+"""
+
+import pytest
+
+from sctools_tpu import steer
+from sctools_tpu.ops.segments import RECORD_BUCKET_MIN
+from sctools_tpu.sched.journal import Journal, Task
+from sctools_tpu.utils import prefetch
+
+
+# ------------------------------------------------------------ fabricators
+
+
+def beat(ts, real, padded, leg="compute", dt=0.01, retrace=False,
+         stage="gatherer.batch", task_id="job"):
+    """One pulse heartbeat record in the ring schema the fold reads."""
+    return {
+        "ts": ts,
+        "legs": {leg: (ts, ts + dt)},
+        "real_rows": real,
+        "padded_rows": padded,
+        "entities": 4,
+        "bytes_h2d": 0,
+        "bytes_d2h": 0,
+        "retrace": retrace,
+        "stage": stage,
+        "task_id": task_id,
+    }
+
+
+def window(real, padded, n=10, start=0.0, **kwargs):
+    return [beat(start + 0.1 * i, real, padded, **kwargs) for i in range(n)]
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def make_controller(static=8192, feed=None, clock=None, **kwargs):
+    clock = clock or Clock()
+    feed = feed if feed is not None else []
+    controller = steer.SteerController(
+        static,
+        records_fn=lambda: feed,
+        clock=clock,
+        **kwargs,
+    )
+    return controller, feed, clock
+
+
+@pytest.fixture(autouse=True)
+def _clean_override():
+    yield
+    # tests that drive the prefetch knob must not leak the override
+    prefetch._depth_override = None
+
+
+# ----------------------------------------------------------- off mode
+
+
+def test_off_mode_is_the_cached_noop_singleton(monkeypatch):
+    monkeypatch.setattr(steer, "_enabled", False)
+    assert steer.controller(8192) is steer.NOOP
+    assert steer.controller(4096) is steer.NOOP  # cached, not per-call
+    assert steer.NOOP.decide() is None
+    assert steer.NOOP.batch_records(8192) == 8192
+    assert steer.NOOP.chunk_records(None) is None
+    assert steer.NOOP.prefetch_depth(3) == 3
+    assert steer.NOOP.ladder() == []
+    assert steer.NOOP.snapshot() == {"mode": "off"}
+    assert steer.NOOP.decisions() == []
+    assert not hasattr(steer.NOOP, "__dict__")  # __slots__ pin
+
+
+def test_force_restores_import_state():
+    was = steer.enabled()
+    with steer.force(True):
+        assert steer.enabled()
+        assert steer.controller(8192).enabled
+    assert steer.enabled() == was
+
+
+# ------------------------------------------------------------ hysteresis
+
+
+def test_low_occupancy_enters_downshift():
+    controller, feed, clock = make_controller()
+    controller.note_resident(4096)
+    feed.extend(window(1000, 8192))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "applied"
+    assert decision["proposal"] == {
+        "knob": "bucket", "from": 8192, "to": 4096,
+    }
+    assert controller.batch_records(8192) == 4096
+    assert controller.chunk_records(None) == 4096
+
+
+def test_band_interior_is_steady():
+    controller, feed, clock = make_controller()
+    controller.note_resident(4096)
+    # 0.7 occupancy sits between the 0.5/0.85 bands: no move either way
+    feed.extend(window(5734, 8192))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "steady"
+    assert decision["proposal"] is None
+    assert controller.batch_records(8192) == 8192
+
+
+def test_sagging_occupancy_with_ample_traffic_coalesces_up():
+    # padding is pow2-of-content clamped to the pinned floor: sagging
+    # occupancy under ample windowed traffic means floor-padded
+    # fragments, and the online fix is a BIGGER bucket, not a smaller
+    # one — dispatches of 1900 real rows each pad to the 4096 floor
+    # (0.46 occupancy); three coalesce into a resident 8192 at 0.70
+    controller, feed, clock = make_controller(static=4096)
+    controller.note_resident(8192)
+    feed.extend(window(1900, 4096, n=6))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "applied"
+    assert decision["proposal"] == {
+        "knob": "bucket", "from": 4096, "to": 8192,
+    }
+    assert controller.batch_records(4096) == 8192
+    assert controller.chunk_records(None) == 8192
+
+
+def test_sagging_occupancy_with_thin_traffic_still_downshifts():
+    # the same sag with too little windowed traffic to fill a bigger
+    # bucket is genuinely thin: the honest proposal is the downshift
+    # (refused at the floor -> the journaled --retune evidence)
+    controller, feed, clock = make_controller(static=4096)
+    controller.note_resident(8192)
+    feed.extend(window(1900, 4096, n=2))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "refused"
+    assert decision["proposal"] == {
+        "knob": "bucket", "from": 4096, "to": 2048,
+    }
+    assert "RECORD_BUCKET_MIN" in decision["reason"]
+
+
+def test_coalesce_needs_a_resident_up_rung():
+    # ample sagging traffic but warmup never calibrated the up rung:
+    # the upshift is refused at validation (never a retrace), and the
+    # journaled refusal is evidence warmup should calibrate the ladder
+    controller, feed, clock = make_controller(static=4096)
+    feed.extend(window(1900, 4096, n=6))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["proposal"]["to"] == 8192
+    assert decision["verdict"] == "refused"
+    assert "resident" in decision["reason"]
+    assert controller.batch_records(4096) == 4096
+
+
+def test_coalescing_ceiling_holds_instead_of_flapping():
+    # after the upshift lands, stale low-occupancy beats still dominate
+    # the window while the bucket sits at the coalescing ceiling
+    # (static*2): the controller must HOLD, not propose the downshift
+    # that would flap against the upshift it just applied
+    controller, feed, clock = make_controller(static=4096)
+    controller.note_resident(8192)
+    feed.extend(window(1900, 4096, n=6))
+    clock.advance(2.0)
+    assert controller.decide()["verdict"] == "applied"
+    assert controller.batch_records(4096) == 8192
+    feed.extend(window(1900, 4096, n=6, start=clock.t))
+    clock.advance(2.5)
+    decision = controller.decide()
+    assert decision["verdict"] == "steady"
+    assert decision["proposal"] is None
+    assert controller.batch_records(4096) == 8192
+
+
+def test_high_occupancy_exits_back_up():
+    controller, feed, clock = make_controller()
+    controller.note_resident(4096)
+    feed.extend(window(1000, 8192))
+    clock.advance(2.0)
+    assert controller.decide()["verdict"] == "applied"
+    assert controller.batch_records(8192) == 4096
+    # occupancy recovers past the HIGH band: the controller climbs back
+    feed[:] = window(4000, 4096, start=clock.t)
+    clock.advance(4.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "applied"
+    assert decision["proposal"]["to"] == 8192
+    assert controller.batch_records(8192) == 8192
+
+
+def test_epoch_gate_bounds_fold_rate():
+    controller, feed, clock = make_controller()
+    feed.extend(window(1000, 8192))
+    clock.advance(2.0)
+    assert controller.decide() is not None
+    # inside the epoch: no fold, no decision, no journal entry
+    clock.advance(0.1)
+    assert controller.decide() is None
+    assert len(controller.decisions()) == 1
+
+
+def test_bounded_actuation_rate_holds():
+    controller, feed, clock = make_controller(static=16384)
+    controller.note_resident(8192)
+    controller.note_resident(4096)
+    feed.extend(window(1000, 16384))
+    clock.advance(2.0)
+    assert controller.decide()["verdict"] == "applied"
+    # next epoch still wants to move, but the action interval (2s) has
+    # not elapsed: the proposal is HELD, not applied
+    clock.advance(0.6)
+    feed[:] = window(1000, 8192, start=clock.t)
+    decision = controller.decide()
+    assert decision["verdict"] == "held"
+    assert "rate bound" in decision["reason"]
+    assert controller.batch_records(16384) == 8192  # unchanged by the hold
+    # once the interval elapses the move applies
+    clock.advance(2.1)
+    feed[:] = window(1000, 8192, start=clock.t)
+    assert controller.decide()["verdict"] == "applied"
+    assert controller.batch_records(16384) == 4096
+
+
+# ---------------------------------------------------------- refusal path
+
+
+def test_floor_refusal_is_journaled():
+    controller, feed, clock = make_controller(static=RECORD_BUCKET_MIN)
+    feed.extend(window(100, RECORD_BUCKET_MIN))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "refused"
+    assert "RECORD_BUCKET_MIN" in decision["reason"]
+    assert controller.batch_records(RECORD_BUCKET_MIN) == RECORD_BUCKET_MIN
+    assert controller.snapshot()["refused"] == 1
+
+
+def test_non_resident_bucket_is_refused():
+    controller, feed, clock = make_controller(static=16384)
+    # 8192 is pow2 and above the floor, but warmup never calibrated it
+    feed.extend(window(1000, 16384))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "refused"
+    assert "resident" in decision["reason"]
+    assert controller.batch_records(16384) == 16384
+
+
+def test_contract_rejection_is_refused():
+    # a contract whose bucket universe starts above the proposal: the
+    # downshift is pow2 and >= the floor but outside the contract
+    contract = {"small_dim_max": 16, "pow2_min": 16384}
+    controller, feed, clock = make_controller(
+        static=16384, contract=contract
+    )
+    controller.note_resident(8192)
+    feed.extend(window(1000, 16384))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "refused"
+    assert "contract" in decision["reason"]
+    assert controller.batch_records(16384) == 16384
+
+
+def test_ladder_is_contract_filtered():
+    contract = {"small_dim_max": 16, "pow2_min": 8}
+    controller, _, _ = make_controller(static=8192, contract=contract)
+    assert controller.ladder() == [4096, 8192, 16384]
+    tight = {"small_dim_max": 16, "pow2_min": 16384}
+    controller, _, _ = make_controller(static=16384, contract=tight)
+    assert controller.ladder() == [16384, 32768]
+
+
+# ------------------------------------------------------ degrade-to-static
+
+
+def test_telemetry_loss_degrades_to_static(capsys):
+    controller, feed, clock = make_controller()
+    controller.note_resident(4096)
+    feed.extend(window(1000, 8192))
+    clock.advance(2.0)
+    assert controller.decide()["verdict"] == "applied"
+    assert controller.batch_records(8192) == 4096
+    # rings go dark: the bucket snaps back to static, loudly
+    feed.clear()
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "degraded"
+    assert decision["mode"] == steer.MODE_STATIC
+    assert controller.batch_records(8192) == 8192
+    assert controller.chunk_records(None) is None
+    assert "degrading to static" in capsys.readouterr().err
+
+
+def test_torn_ring_degrades():
+    controller, feed, clock = make_controller()
+    controller._records_fn = lambda: (window(1000, 8192), 2)
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "degraded"
+    assert "torn" in decision["reason"]
+
+
+def test_observed_retrace_degrades():
+    controller, feed, clock = make_controller()
+    feed.extend(window(1000, 8192, retrace=True))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "degraded"
+    assert "retrace" in decision["reason"]
+
+
+def test_degraded_controller_rearms_on_healthy_telemetry():
+    controller, feed, clock = make_controller()
+    controller.note_resident(4096)
+    feed.extend(window(1000, 8192))
+    clock.advance(2.0)
+    assert controller.decide()["verdict"] == "applied"
+    feed.clear()
+    clock.advance(2.0)
+    assert controller.decide()["verdict"] == "degraded"
+    feed.extend(window(1000, 8192, start=clock.t))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "applied"
+    assert decision["mode"] == steer.MODE_STEERING
+
+
+def test_empty_window_before_first_beat_is_quiet(capsys):
+    # not-yet-telemetry is not telemetry LOSS: an idle worker that has
+    # never dispatched waits at the static point without degrading
+    controller, feed, clock = make_controller()
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "steady"
+    assert decision["mode"] == steer.MODE_STEERING
+    assert "degrading" not in capsys.readouterr().err
+    # once real beats HAVE flowed, an empty window is a loss: loud
+    feed.extend(window(5734, 8192, start=clock.t))
+    clock.advance(2.0)
+    assert controller.decide()["verdict"] == "steady"
+    feed.clear()
+    clock.advance(2.0)
+    assert controller.decide()["verdict"] == "degraded"
+
+
+def test_warmup_calibration_beats_are_filtered():
+    # the warmup ladder's calibration dispatches carry task_id=warmup;
+    # folding them would steer against the ladder, not the tenants
+    controller, feed, clock = make_controller()
+    feed.extend(window(100, 8192, task_id="warmup"))
+    clock.advance(2.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "steady"
+    assert decision["proposal"] is None
+    assert controller.batch_records(8192) == 8192
+
+
+def test_degrade_clears_prefetch_override():
+    controller, feed, clock = make_controller()
+    # decode-limited with a high bubble: the prefetch knob deepens
+    slow = [
+        beat(0.4 * i, 7000, 8192, leg="decode", dt=0.3)
+        for i in range(10)
+    ]
+    feed.extend(slow)
+    clock.advance(4.0)
+    decision = controller.decide()
+    assert decision["verdict"] == "applied"
+    assert decision["proposal"]["knob"] == "prefetch"
+    assert prefetch.prefetch_depth() == decision["proposal"]["to"]
+    assert controller.prefetch_depth(2) == decision["proposal"]["to"]
+    feed.clear()
+    clock.advance(2.0)
+    assert controller.decide()["verdict"] == "degraded"
+    assert prefetch.prefetch_depth() == prefetch.DEFAULT_PREFETCH_DEPTH
+    assert controller.prefetch_depth(2) == 2
+
+
+# ------------------------------------------------------ deterministic replay
+
+
+def test_canned_sequence_replays_deterministically():
+    def run():
+        controller, feed, clock = make_controller(static=8192)
+        controller.note_resident(4096)
+        verdicts = []
+        script = [
+            window(1000, 8192),            # sagging -> downshift
+            window(1000, 8192),            # held (rate bound)
+            window(3400, 4096),            # inside the bands -> steady
+            [],                            # telemetry loss -> degraded
+            window(1000, 8192),            # recovers sagging -> downshift
+        ]
+        for step in script:
+            feed[:] = [
+                dict(record, ts=clock.t + i * 0.01)
+                for i, record in enumerate(step)
+            ]
+            clock.advance(1.0)
+            decision = controller.decide()
+            verdicts.append(decision["verdict"])
+        return verdicts, controller.snapshot()
+
+    first, snap_a = run()
+    second, snap_b = run()
+    assert first == second
+    assert first == ["applied", "held", "steady", "degraded", "applied"]
+    assert snap_a == snap_b
+    assert snap_a["applied"] == 2 and snap_a["degraded"] == 1
+
+
+# ------------------------------------------------- offline evidence schema
+
+
+def refusal_decision(seq=1, worker="w0", to=2048, real=1100, padded=4096):
+    return {
+        "seq": seq,
+        "t": 1.0 * seq,
+        "mode": steer.MODE_STEERING,
+        "bucket": 4096,
+        "inputs": {
+            "occupancy": real / padded,
+            "bubble_fraction": 0.1,
+            "limiting_stage": "compute",
+            "heartbeats": 10,
+            "real_rows": real * 10,
+            "padded_rows": padded * 10,
+            "retraces": 0,
+            "torn": 0,
+        },
+        "proposal": {"knob": "bucket", "from": 4096, "to": to},
+        "verdict": "refused",
+        "reason": "bucket 2048 below the pinned RECORD_BUCKET_MIN floor",
+        "worker": worker,
+    }
+
+
+#: the row vocabulary shared with xprof.suggest_buckets — pinned: the
+#: offline --retune derive step and `obs efficiency --suggest` read
+#: these keys verbatim from BOTH evidence sources
+SUGGESTION_KEYS = {
+    "site", "dispatches", "mean_real_rows", "mean_padded_rows",
+    "occupancy", "suggested_pad", "projected_occupancy", "meets_target",
+    "unit", "constant",
+}
+
+
+def test_refused_downshifts_become_floor_suggestions():
+    decisions = [refusal_decision(seq=i) for i in range(1, 4)]
+    rows = steer.suggest_from_decisions(decisions, target=0.35)
+    assert len(rows) == 1
+    row = rows[0]
+    assert set(row) == SUGGESTION_KEYS
+    assert row["site"] == "steer:w0"
+    assert row["dispatches"] == 3
+    assert row["suggested_pad"] == 2048
+    assert row["constant"] == "RECORD_BUCKET_MIN"
+    assert row["unit"] == "records"
+    assert row["mean_real_rows"] == 1100.0
+    assert row["mean_padded_rows"] == 4096.0
+    assert row["projected_occupancy"] == pytest.approx(1100 / 2048, abs=1e-3)
+    assert row["meets_target"] is True
+
+
+def test_only_refused_downshifts_count_as_evidence():
+    applied = dict(refusal_decision(), verdict="applied")
+    upshift = refusal_decision()
+    upshift["proposal"] = {"knob": "bucket", "from": 4096, "to": 8192}
+    prefetch_ref = refusal_decision()
+    prefetch_ref["proposal"] = {"knob": "prefetch", "from": 2, "to": 3}
+    assert steer.suggest_from_decisions([applied, upshift, prefetch_ref]) \
+        == []
+
+
+def test_suggestions_feed_derive_constants():
+    from sctools_tpu.analysis.retune import derive_constants
+
+    rows = steer.suggest_from_decisions(
+        [refusal_decision(seq=i) for i in range(1, 3)]
+    )
+    constants = derive_constants(
+        rows, {"RECORD_BUCKET_MIN": 4096, "ENTITY_BUCKET_MIN": 64}
+    )
+    assert constants["RECORD_BUCKET_MIN"]["derived"] == 2048
+    assert "steer:w0" in constants["RECORD_BUCKET_MIN"]["sites"]
+
+
+# --------------------------------------------------- journal round-trip
+
+
+def test_decisions_round_trip_through_the_journal(tmp_path):
+    run_dir = tmp_path / "run"
+    journal_dir = run_dir / "sched-journal"
+    journal = Journal(str(journal_dir), worker_id="w0")
+    journal.register([Task(id="t1", kind="x", name="t1", payload={})])
+    controller, feed, clock = make_controller(static=RECORD_BUCKET_MIN)
+    feed.extend(window(100, RECORD_BUCKET_MIN))
+    clock.advance(2.0)
+    decision = controller.decide()
+    journal.announce_worker(
+        {"steer": controller.snapshot(), "steer_decision": decision}
+    )
+    loaded = steer.load_decisions(str(run_dir))
+    assert len(loaded) == 1
+    assert loaded[0]["worker"] == "w0"
+    assert loaded[0]["verdict"] == "refused"
+    assert loaded[0]["proposal"] == decision["proposal"]
+    snapshots = steer.latest_snapshots(str(run_dir))
+    assert snapshots["w0"]["refused"] == 1
+    suggestions = steer.suggest_from_decisions(loaded)
+    assert suggestions and suggestions[0]["site"] == "steer:w0"
+
+
+def test_render_steer_metrics_gauges(tmp_path):
+    run_dir = tmp_path / "run"
+    journal = Journal(str(run_dir / "sched-journal"), worker_id="w0")
+    journal.register([Task(id="t1", kind="x", name="t1", payload={})])
+    controller, feed, clock = make_controller()
+    controller.note_resident(4096)
+    feed.extend(window(1000, 8192))
+    clock.advance(2.0)
+    decision = controller.decide()
+    journal.announce_worker(
+        {"steer": controller.snapshot(), "steer_decision": decision}
+    )
+    body = steer.render_steer_metrics(str(run_dir))
+    assert '# TYPE sctools_tpu_steer_mode gauge' in body
+    assert 'sctools_tpu_steer_mode{worker="w0"} 1' in body
+    assert 'sctools_tpu_steer_bucket_records{worker="w0"} 4096' in body
+    assert 'sctools_tpu_steer_applied_total{worker="w0"} 1' in body
+    # no steering journaled -> empty body, the exporter appends nothing
+    assert steer.render_steer_metrics(str(tmp_path / "empty")) == ""
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_static_bucket_must_be_in_vocabulary():
+    with pytest.raises(ValueError):
+        steer.SteerController(8192, occupancy_low=0.9, occupancy_high=0.5)
+
+
+def test_ladder_respects_floor():
+    controller, _, _ = make_controller(static=RECORD_BUCKET_MIN)
+    assert RECORD_BUCKET_MIN // 2 not in controller.ladder()
