@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck aotcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke elastic-smoke steer-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck aotcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke delta-smoke mesh-smoke serve-smoke elastic-smoke steer-smoke perf-gate docs clean
 
-ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke mesh-smoke serve-smoke steer-smoke perf-gate
+ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke delta-smoke mesh-smoke serve-smoke steer-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -205,6 +205,21 @@ pulse-smoke:
 	rm -rf /tmp/sctools_tpu_pulse_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_PULSE_SMOKE_DIR=/tmp/sctools_tpu_pulse_smoke \
 	$(PY) tests/pulse_smoke.py
+
+# regression-attribution gate: two real 2-worker runs, the second
+# deliberately degraded on the feed side (SCTOOLS_TPU_PREFETCH_DEPTH=1
+# plus a deterministic decode stall at the ingest.decode fault site) —
+# both run dirs must distill schema-valid RunProfiles, `obs delta` must
+# rank the injected decode/h2d cause as the TOP suspect, the attributed
+# per-leg deltas must conserve to the end-to-end delta within 10%, a
+# cross-platform pair must refuse loudly (exit 3) instead of fabricating
+# a speedup claim, and the committed BENCH_r* trajectory must render
+# with its backfilled stub points (tests/delta_smoke.py;
+# docs/observability.md "scx-delta").
+delta-smoke:
+	rm -rf /tmp/sctools_tpu_delta_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_DELTA_SMOKE_DIR=/tmp/sctools_tpu_delta_smoke \
+	$(PY) tests/delta_smoke.py
 
 # collective-schedule gate: a 2-worker mesh-sharded run under
 # SCTOOLS_TPU_MESH_DEBUG=1 against the static collective schedule — both

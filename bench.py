@@ -57,6 +57,15 @@ Prints ONE JSON line. Flags:
               measured pipeline bubble fraction (scx-pulse attribution
               over the timed runs' heartbeats) to <= 0.35
               (bubble_fraction gate, with the limiting stage named).
+              A trajectory regression no longer exits 4 bare: the
+              verdict diffs this run's embedded scx-delta RunProfile
+              (distilled post-run from the same heartbeats; also written
+              beside the result, SCTOOLS_TPU_PROFILE_OUT) against the
+              newest same-fingerprint trajectory point and prints the
+              top-ranked suspect(s) to stderr — or an honest
+              "attribution unavailable/refused" when no comparable
+              baseline exists (docs/performance.md "Reading a delta
+              report").
   --serve     include the resident-serving scenario (docs/serving.md):
               a cold replica (fresh AOT executable cache) and a warm one
               (same cache, pre-populated by the cold run) each drain a
@@ -82,7 +91,6 @@ Prints ONE JSON line. Flags:
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import statistics
@@ -91,7 +99,7 @@ import sys
 import tempfile
 
 from sctools_tpu import obs
-from sctools_tpu.obs import pulse, slo, xprof
+from sctools_tpu.obs import delta, pulse, slo, trajectory, xprof
 
 CHECK_EXIT_CODE = 4  # distinct from crashes: "ran fine, but regressed"
 DEFAULT_TOLERANCE = 0.5
@@ -330,10 +338,15 @@ def bench_end_to_end(bam_path: str, profile: bool = False) -> dict:
             # defensible single-number summary where any one draw is weather
             timed = statistics.median(run() for _ in range(3))
         steady_after = _steady_counters()
-        bubble = pulse.attribute_bubbles(pulse_records[warm_heartbeats:])
+        timed_records = list(pulse_records[warm_heartbeats:])
+        bubble = pulse.attribute_bubbles(timed_records)
     padded = steady_after["padded_rows"] - steady_before["padded_rows"]
     real = steady_after["real_rows"] - steady_before["real_rows"]
     return {
+        # the timed runs' heartbeats ride along (popped before the JSON
+        # is printed) so main() can distill the scx-delta RunProfile
+        # from the SAME records the bubble attribution judged
+        "_pulse_records": timed_records,
         "end_to_end_s": timed,
         "warm_s": warm,
         # any compile AFTER the warm run is a steady-state retrace: the
@@ -1546,81 +1559,15 @@ def _bench_serve_steered() -> dict:
     }
 
 
-def _platform_fingerprint(mesh=None) -> dict:
-    """The machine-enforced comparability key every result carries.
-
-    (jax backend, device kind, device count): the BENCH_r06 lesson — a
-    CPU-only container's point landed in the same trajectory as the axon
-    device points with only a prose note separating them. The gate now
-    compares a result's trajectory/median ONLY against same-fingerprint
-    points, so cross-platform numbers can never gate each other.
-
-    ``mesh`` (a ``jax.sharding.Mesh``) stamps the MESH SHAPE (axis names
-    + sizes) into the fingerprint — the MULTICHIP_r* lesson:
-    ``dryrun_multichip`` forces the host platform, so every multichip
-    point reads cpu×8 and backend/device-kind alone cannot separate an
-    8-way mesh run from a 4-way one. Platform comparison is dict
-    equality, so a mesh-stamped point gates only against points recorded
-    on an identical topology.
-    """
-    import jax
-
-    devices = jax.devices()
-    fingerprint = {
-        "backend": str(jax.default_backend()),
-        "device_kind": str(devices[0].device_kind) if devices else "unknown",
-        "device_count": len(devices),
-    }
-    if mesh is not None:
-        fingerprint["mesh"] = {
-            "axes": [str(a) for a in mesh.axis_names],
-            "sizes": [int(mesh.shape[a]) for a in mesh.axis_names],
-        }
-    return fingerprint
-
+# the trajectory loader and platform fingerprint moved to the shared
+# sctools_tpu.obs.trajectory module (scx-delta) so the module CLIs can
+# read the committed series without importing this repo-root script;
+# the local names stay — everything in this file (and its tests) keeps
+# calling them unchanged
+_platform_fingerprint = trajectory.platform_fingerprint
+load_trajectory = trajectory.load_trajectory
 
 REPO_DIR = os.path.dirname(os.path.abspath(__file__))
-
-
-def load_trajectory(
-    repo_dir: str, metric: str, pattern: str = "BENCH_r*.json"
-) -> list:
-    """The trajectory history points matching ``metric``.
-
-    Each round's driver appends one BENCH_rNN.json with the parsed result;
-    together they are the repo's own performance trajectory — the gate's
-    reference. Unreadable or metric-mismatched files are skipped (the
-    headline metric changed once already, r01 -> r02). ``pattern``
-    selects the point family: ``"MULTICHIP_r*.json"`` loads the
-    multichip points (mesh-aware fingerprints: each carries the mesh
-    shape, so same-platform filtering separates topologies).
-    """
-    entries = []
-    for path in sorted(glob.glob(os.path.join(repo_dir, pattern))):
-        try:
-            with open(path) as f:
-                data = json.load(f)
-        except (OSError, ValueError):
-            continue
-        parsed = data.get("parsed") or {}
-        if parsed.get("metric") == metric and isinstance(
-            parsed.get("value"), (int, float)
-        ):
-            entries.append(
-                {
-                    "source": os.path.basename(path),
-                    "value": float(parsed["value"]),
-                    "unit": parsed.get("unit"),
-                    # comparability fingerprint (jax backend, device kind,
-                    # device count); None on pre-fingerprint points
-                    "platform": (
-                        parsed.get("platform")
-                        if isinstance(parsed.get("platform"), dict)
-                        else None
-                    ),
-                }
-            )
-    return entries
 
 
 def _published_reference(repo_dir: str, metric: str):
@@ -1632,6 +1579,90 @@ def _published_reference(repo_dir: str, metric: str):
         return None
     value = published.get(metric)
     return float(value) if isinstance(value, (int, float)) else None
+
+
+def _regression_attribution(
+    result: dict, metric: str, platform, repo_dir: str
+):
+    """The scx-delta attribution attached to a failed trajectory check.
+
+    Reference side: the newest committed same-platform trajectory point
+    that carries a COMPLETE RunProfile (stubs can't attribute legs).
+    Candidate side: the failing result's own profile. Returns the delta
+    view, or a ``{"unavailable": reason}`` marker when either side has
+    no complete profile — the gate still fails, it just says why it
+    can't name a suspect.
+    """
+    candidate = delta.profile_from_result(result, source="this run")
+    if not candidate.get("complete"):
+        return {
+            "unavailable": (
+                "result carries no complete RunProfile (pre-delta JSON "
+                "or legless stub); re-run bench.py to distill one"
+            )
+        }
+    reference_profile = None
+    for point in reversed(
+        trajectory.load_trajectory_points(
+            repo_dir, pattern="BENCH_r*.json", metric=metric
+        )
+    ):
+        if isinstance(platform, dict) and point["platform"] != platform:
+            continue
+        profile = point.get("profile")
+        if isinstance(profile, dict) and profile.get("complete"):
+            reference_profile = dict(profile)
+            reference_profile.setdefault("source", point["source"])
+            break
+    if reference_profile is None:
+        return {
+            "unavailable": (
+                "no same-platform trajectory point carries a complete "
+                "RunProfile to attribute against (backfilled stubs "
+                "cannot fold legs)"
+            )
+        }
+    return delta.attribute_delta(reference_profile, candidate)
+
+
+def _print_attribution(verdict: dict, stream) -> None:
+    """The named-suspect lines a failing --check prints (never a bare 4)."""
+    attribution = verdict.get("attribution")
+    if not isinstance(attribution, dict):
+        return
+    if attribution.get("unavailable"):
+        print(
+            f"bench --check: attribution unavailable: "
+            f"{attribution['unavailable']}",
+            file=stream,
+        )
+        return
+    if not attribution.get("comparable"):
+        print(
+            f"bench --check: attribution refused: "
+            f"{attribution.get('refusal')}",
+            file=stream,
+        )
+        return
+    suspects = attribution.get("suspects") or []
+    if not suspects:
+        print(
+            "bench --check: attribution found no slower leg "
+            "(regression not explained by exposed wall)",
+            file=stream,
+        )
+        return
+    for i, suspect in enumerate(suspects[:3]):
+        label = "suspect" if i == 0 else "   also"
+        print(f"bench --check: {label}: {suspect['detail']}", file=stream)
+    conservation = attribution.get("conservation") or {}
+    if conservation and not conservation.get("conserved"):
+        print(
+            "bench --check: WARNING: leg deltas do not conserve to the "
+            f"end-to-end delta (error {conservation.get('error')}) — "
+            "profile bookkeeping is suspect",
+            file=stream,
+        )
 
 
 def check_result(
@@ -1684,14 +1715,25 @@ def check_result(
     if comparable:
         reference = statistics.median(e["value"] for e in comparable)
         floor = reference * (1.0 - tolerance)
+        trajectory_ok = value >= floor
         add(
             "trajectory",
-            value >= floor,
+            trajectory_ok,
             reference=round(reference, 2),
             floor=round(floor, 2),
             points=len(comparable),
             platform_filtered=isinstance(platform, dict),
         )
+        if not trajectory_ok:
+            # scx-delta: a trajectory regression must NAME its suspect,
+            # not just exit 4 — attribute the result's profile against
+            # the newest same-platform trajectory point carrying a
+            # complete profile. Stub-vs-stub pairs degrade to the
+            # structural diff inside the attribution (never a fabricated
+            # claim); a result with no profile at all records why.
+            verdict["attribution"] = _regression_attribution(
+                result, metric, platform, repo_dir
+            )
     elif entries:
         add(
             "trajectory", True,
@@ -2178,6 +2220,88 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         },
     }
     failures = []
+    # scx-delta: a trajectory regression must print a NAMED suspect, not
+    # a bare exit 4. Proven against a synthetic repo dir: one committed
+    # point carrying a complete RunProfile (healthy leg mix), then a
+    # regressed result whose profile shows decode's exposed wall
+    # ballooning — the verdict must carry a comparable attribution whose
+    # top suspect names decode, with the leg deltas conserving to the
+    # end-to-end delta. A regressed result with NO profile must instead
+    # record why attribution is unavailable.
+    with tempfile.TemporaryDirectory(
+        prefix="sctools_tpu_delta_selftest."
+    ) as synth_repo:
+        synth_fp = {
+            "backend": "selftest", "device_kind": "selftest",
+            "device_count": 1,
+        }
+        baseline_profile = delta.synthetic_profile(
+            {"decode": 0.05, "h2d": 0.02, "compute": 0.30, "d2h": 0.03,
+             "overlap": 0.10},
+            kcells=1.0, platform=synth_fp, metric=metric, value=2000.0,
+        )
+        with open(os.path.join(synth_repo, "BENCH_r01.json"), "w") as f:
+            json.dump(
+                {
+                    "n": 1,
+                    "parsed": {
+                        "metric": metric, "value": 2000.0,
+                        "unit": "cells/sec", "platform": synth_fp,
+                        "profile": baseline_profile,
+                    },
+                },
+                f,
+            )
+        regressed_profile = delta.synthetic_profile(
+            {"decode": 0.60, "h2d": 0.04, "compute": 0.32, "d2h": 0.03,
+             "overlap": 0.02},
+            kcells=1.0, platform=synth_fp, metric=metric, value=500.0,
+        )
+        regressed = {
+            "metric": metric, "value": 500.0, "vs_baseline": 5.0,
+            "platform": synth_fp, "profile": regressed_profile,
+        }
+        verdict = check_result(regressed, synth_repo)
+        attribution = verdict.get("attribution")
+        if verdict["ok"]:
+            failures.append(
+                "synthetic-repo regression passed the trajectory gate"
+            )
+        elif not isinstance(attribution, dict):
+            failures.append(
+                "trajectory regression carried no delta attribution"
+            )
+        elif not attribution.get("comparable"):
+            failures.append(
+                "same-platform attribution refused: "
+                f"{attribution.get('refusal') or attribution}"
+            )
+        else:
+            suspects = attribution.get("suspects") or []
+            if not suspects or suspects[0].get("name") != "decode":
+                failures.append(
+                    "attribution's top suspect did not name decode: "
+                    f"{[s.get('name') for s in suspects]}"
+                )
+            if not attribution["conservation"]["conserved"]:
+                failures.append(
+                    "attribution's leg deltas did not conserve to the "
+                    "end-to-end delta"
+                )
+        profileless = {
+            "metric": metric, "value": 500.0, "vs_baseline": 5.0,
+            "platform": synth_fp,
+        }
+        verdict = check_result(profileless, synth_repo)
+        if verdict["ok"]:
+            failures.append(
+                "profileless synthetic regression passed the gate"
+            )
+        elif not (verdict.get("attribution") or {}).get("unavailable"):
+            failures.append(
+                "profileless regression did not record why attribution "
+                "is unavailable"
+            )
     if not check_result(healthy, repo_dir)["ok"]:
         failures.append("healthy result failed the gate")
     if check_result(degraded, repo_dir)["ok"]:
@@ -2347,7 +2471,10 @@ def main(argv=None):
             return 2
         verdict = check_result(result, tolerance=args.tolerance)
         print(json.dumps(verdict))
-        return 0 if verdict["ok"] else CHECK_EXIT_CODE
+        if not verdict["ok"]:
+            _print_attribution(verdict, sys.stderr)
+            return CHECK_EXIT_CODE
+        return 0
 
     profile = args.profile
     breakdown = args.breakdown or profile
@@ -2434,6 +2561,28 @@ def main(argv=None):
     result["pulse"] = bench_pulse_overhead()
     result["slo"] = bench_slo_overhead()
     result["steer"] = bench_steer_overhead()
+    # scx-delta: distill the canonical RunProfile from the timed runs'
+    # heartbeats + the gate values just assembled, embed it in the
+    # result (the driver commits the parsed result as BENCH_rNN.json,
+    # so every trajectory point becomes machine-diffable), and persist
+    # it beside the result. Strictly post-run — nothing here touched
+    # the timed path.
+    result["profile"] = delta.profile_from_records(
+        timings.pop("_pulse_records", []),
+        source="bench",
+        platform=result["platform"],
+        metric=result["metric"],
+        value=result["value"],
+        unit=result["unit"],
+        gates=delta.gates_from_result(result),
+    )
+    profile_out = os.environ.get(
+        "SCTOOLS_TPU_PROFILE_OUT", "/tmp/sctools_tpu_bench_profile.json"
+    )
+    try:
+        delta.write_profile(result["profile"], profile_out)
+    except OSError as exc:
+        print(f"bench: profile sidecar not written: {exc}", file=sys.stderr)
     print(json.dumps(result))
     if args.check:
         # the result line above stays the ONE stdout JSON line (the
@@ -2441,6 +2590,7 @@ def main(argv=None):
         verdict = check_result(result, tolerance=args.tolerance)
         print(json.dumps(verdict), file=sys.stderr)
         if not verdict["ok"]:
+            _print_attribution(verdict, sys.stderr)
             return CHECK_EXIT_CODE
     return 0
 

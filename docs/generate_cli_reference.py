@@ -42,7 +42,7 @@ MODULE_CLIS = (
     (
         "python -m sctools_tpu.obs",
         "sctools_tpu.obs.__main__",
-        ("summarize", "timeline", "efficiency", "pulse", "slo"),
+        ("summarize", "timeline", "efficiency", "pulse", "slo", "delta"),
     ),
     (
         "python -m sctools_tpu.sched",

@@ -65,6 +65,7 @@ from ..obs import pulse
 from ..analysis.witness import make_lock
 from ..guard import degrade
 from ..guard.errors import NativeDecodeError
+from ..sched import faults
 from ..guard.watchdog import guarded_iter
 from ..io.packed import DEFAULT_TAG_KEYS, ReadFrame
 from ..utils.prefetch import prefetch_depth, prefetch_iterator
@@ -173,6 +174,11 @@ def _produce_arena_frames(stream, arenas, batch_records: int, want_qname: bool):
             )
             decode_start = pulse.clock() if pulse.enabled() else 0.0
             with obs.span("decode", slot=k % n_slots) as sp:
+                # fault site INSIDE the timed decode window: a delay here
+                # is attributed to the decode leg (pulse.note_decode
+                # below), so tests can make the feed side deliberately
+                # heavy — delta-smoke's stand-in for slow storage
+                faults.fire("ingest.decode", name=str(k))
                 try:
                     n = stream.next(batch_records)
                     if n == 0:

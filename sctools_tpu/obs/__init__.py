@@ -45,6 +45,15 @@ All of the above is post-hoc; the LIVE half is :mod:`.pulse`
 flight, windowed rates, a localhost Prometheus exporter
 (:mod:`.serve`), and pipeline bubble attribution — read with
 ``python -m sctools_tpu.obs pulse <run_dir>``.
+
+The run-over-run half is :mod:`.delta` (scx-delta): every run distills
+to a schema-pinned RunProfile (per-leg exposed wall from the rings,
+per-site compile/occupancy and the transfer ledger from xprof, tenant
+summaries from slo, gate values, platform fingerprint), and
+``python -m sctools_tpu.obs delta <A> <B>`` attributes the difference
+between two of them — ranked suspects with an explicit conservation
+property, refusing loudly across platforms instead of fabricating a
+speedup claim (docs/observability.md "scx-delta").
 """
 
 from __future__ import annotations

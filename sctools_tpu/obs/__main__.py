@@ -29,6 +29,15 @@ compile/retrace counts (with triggering signatures), padding occupancy,
 estimated FLOPs (real vs padding-wasted), the H2D/D2H transfer ledger,
 and device-memory watermarks (docs/performance.md walks through one).
 
+``python -m sctools_tpu.obs delta <A> <B>`` attributes the
+throughput/latency delta between two runs (scx-delta): each side is a
+run directory, a RunProfile JSON, a bench-result JSON, or a committed
+BENCH_r*/MULTICHIP_r* trajectory point; the report ranks suspects
+(exposed-wall legs, site occupancy/retraces, transfer waste) with an
+explicit conservation check, refuses cross-platform pairs loudly
+(structural diff only), and ``--trajectory`` walks the whole committed
+series instead (docs/observability.md).
+
 Pure stdlib — usable on any host with the capture files, no jax required.
 """
 
@@ -415,6 +424,95 @@ def _slo(args, out=None, err=None) -> int:
         view = frame()
 
 
+def _delta_side(path: str, err) -> Optional[dict]:
+    """A RunProfile from one CLI operand (dir or any committed JSON).
+
+    A run-dir operand is distilled here and now, so it is stamped with
+    THIS host's fingerprint (rings record no platform of their own).
+    To diff runs from different hosts, persist profiles on each host
+    (``bench.py`` sidecars, serve workers' ``profile.<id>.json``) and
+    diff the JSONs — those carry their original fingerprints and a
+    cross-platform pair will refuse rather than fabricate.
+    """
+    from . import delta as deltamod
+    from . import trajectory as trajmod
+
+    if os.path.isdir(path):
+        try:
+            platform = trajmod.platform_fingerprint()
+        except Exception:  # noqa: BLE001 - jax may be absent/broken
+            platform = None
+        return deltamod.profile_from_run_dir(
+            path, source=path, platform=platform
+        )
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"obs delta: cannot read {path}: {exc}", file=err)
+        return None
+    if not isinstance(data, dict):
+        print(f"obs delta: {path} is not a JSON object", file=err)
+        return None
+    return deltamod.profile_from_result(
+        data, source=os.path.basename(path)
+    )
+
+
+def _delta(args, out=None, err=None) -> int:
+    out = out if out is not None else sys.stdout
+    err = err if err is not None else sys.stderr
+    from . import delta as deltamod
+
+    if args.trajectory:
+        repo_dir = args.paths[0] if args.paths else "."
+        if not os.path.isdir(repo_dir):
+            print(
+                f"obs delta: --trajectory expects a repo directory, "
+                f"got {repo_dir}",
+                file=err,
+            )
+            return 2
+        view = deltamod.trajectory_view(
+            repo_dir,
+            metric=args.metric,
+            pattern=args.pattern,
+            tolerance=args.tolerance,
+        )
+        if not view["points"]:
+            print(
+                f"obs delta: no {args.pattern} points under {repo_dir}",
+                file=err,
+            )
+            return 2
+        if args.as_json:
+            print(json.dumps(view, separators=(",", ":")), file=out)
+        else:
+            print(deltamod.render_trajectory(view), end="", file=out)
+        return 0
+    if len(args.paths) != 2:
+        print(
+            "obs delta: expected exactly two operands <A> <B> "
+            "(run dirs, profile JSONs, bench results, or trajectory "
+            "points), or --trajectory [REPO_DIR]",
+            file=err,
+        )
+        return 2
+    a = _delta_side(args.paths[0], err)
+    b = _delta_side(args.paths[1], err)
+    if a is None or b is None:
+        return 2
+    view = deltamod.attribute_delta(a, b, tolerance=args.tolerance)
+    if args.as_json:
+        print(json.dumps(view, separators=(",", ":")), file=out)
+    else:
+        print(deltamod.render_delta(view), end="", file=out)
+    # exit 3 = loud refusal: the pair does not compare (cross-platform
+    # or stub profiles); distinct from 2 (unreadable operands) so
+    # scripts can tell "can't read" from "won't fabricate"
+    return 0 if view["comparable"] else 3
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sctools_tpu.obs",
@@ -555,6 +653,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="the stitched per-job/per-tenant/fleet view as one JSON "
         "object",
     )
+    delta_cmd = sub.add_parser(
+        "delta",
+        help="run-over-run regression attribution between two runs, or "
+        "the committed trajectory series (scx-delta)",
+    )
+    delta_cmd.add_argument(
+        "paths", nargs="*",
+        help="two sides <A> <B> (each a run dir, RunProfile JSON, bench "
+        "result JSON, or committed BENCH_r*/MULTICHIP_r* point); with "
+        "--trajectory, one optional repo directory (default: .)",
+    )
+    delta_cmd.add_argument(
+        "--trajectory", action="store_true",
+        help="trend mode: attribute each committed trajectory point "
+        "against the previous same-fingerprint point with a complete "
+        "profile, rendering the whole series (stub points included)",
+    )
+    delta_cmd.add_argument(
+        "--metric", default=None,
+        help="with --trajectory: only points for this metric "
+        "(default: all; points with no parsed metric always render)",
+    )
+    delta_cmd.add_argument(
+        "--pattern", default="BENCH_r*.json",
+        help="with --trajectory: the point family glob "
+        "(default: BENCH_r*.json; use MULTICHIP_r*.json for the "
+        "mesh series)",
+    )
+    delta_cmd.add_argument(
+        "--tolerance", type=float, default=0.10,
+        help="conservation tolerance: attributed per-leg deltas must "
+        "sum to the end-to-end delta within this fraction "
+        "(default 0.10)",
+    )
+    delta_cmd.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="the attribution view (or trajectory series) as one JSON "
+        "object",
+    )
     args = parser.parse_args(argv)
     if args.command == "summarize":
         return _summarize(args)
@@ -564,6 +701,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _pulse(args)
     if args.command == "slo":
         return _slo(args)
+    if args.command == "delta":
+        return _delta(args)
     return _timeline(args)
 
 

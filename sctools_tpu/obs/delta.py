@@ -1,0 +1,1063 @@
+"""scx-delta: canonical run profiles + run-over-run regression attribution.
+
+The telemetry plane records everything — per-batch heartbeats
+(scx-pulse), per-site compile/occupancy/transfer registries (scx-xprof),
+per-job SLO stitches (scx-slo) — but when a number regresses, a human
+still cross-reads four reports by hand. scx-delta is the diagnosis
+layer those planes were built to feed:
+
+- **RunProfile**: ONE schema-pinned artifact distilled from any run dir
+  or bench-result JSON. Per-leg exposed wall folded from the pulse
+  rings (plus two synthetic legs, ``overlap`` and ``idle``, so the legs
+  sum to the wall EXACTLY — the conservation property below is
+  structural, not aspirational), per-site device efficiency and the
+  transfer ledger from xprof, pack/tenant/steer summaries from the
+  journal + slo stitch, the gate values, and the platform fingerprint.
+  ``bench.py`` embeds one beside every result, so every committed
+  BENCH_r*.json point is machine-diffable forever.
+
+- **attribute_delta(a, b)**: ranked attribution of a throughput/latency
+  delta between two profiles, normalized to seconds-per-kilocell so
+  differently-sized runs compare. Conservation is explicit: the
+  attributed per-leg deltas sum to the end-to-end delta within
+  tolerance (default 10%), and the report SAYS so — an attribution
+  that doesn't add up is reported as unconserved, never silently
+  renormalized. Fingerprint-aware: a cross-platform pair degrades
+  LOUDLY to a structural-only diff (leg availability, site set, gate
+  values) and never fabricates a speedup claim.
+
+- **trajectory mode**: the same attribution walked over the committed
+  BENCH_r*/MULTICHIP_r* series (``obs delta --trajectory``), pairing
+  each point with the previous same-fingerprint point that carries a
+  complete profile.
+
+Distillation is strictly post-run — nothing here rides the hot path;
+the ``*_overhead <= 1.02`` gates are untouched by construction.
+
+Pure stdlib: a committed profile diffs on any host, no jax required.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import pulse as _pulse
+
+PROFILE_VERSION = 1
+PROFILE_KIND = "run_profile"
+DELTA_KIND = "run_delta"
+DEFAULT_TOLERANCE = 0.10
+
+# the four pulse legs plus two synthetic ones. ``overlap`` is wall time
+# covered by >= 2 legs at once (counted once here, so per-leg EXPOSED
+# walls plus overlap reconstruct the covered wall without double
+# counting); ``idle`` is wall time no leg covered. Together:
+#     wall_s == sum(exposed_s over LEG_NAMES)   (exact, by construction)
+# which is what makes the conservation property checkable instead of
+# hopeful.
+LEG_NAMES = ("decode", "h2d", "compute", "d2h", "overlap", "idle")
+FEED_LEGS = ("decode", "h2d")
+
+# the schema pin: key -> allowed types. test_delta holds profiles to
+# EXACTLY this key set, so growing the schema is a conscious, versioned
+# act (bump PROFILE_VERSION when a key changes meaning).
+PROFILE_SCHEMA: Dict[str, tuple] = {
+    "profile_version": (int,),
+    "kind": (str,),
+    "source": (str,),
+    "platform": (dict, type(None)),
+    "metric": (str, type(None)),
+    "value": (int, float, type(None)),
+    "unit": (str, type(None)),
+    "wall_s": (int, float),
+    "kcells": (int, float),
+    "legs": (dict,),
+    "bubble_fraction": (int, float, type(None)),
+    "limiting_stage": (str, type(None)),
+    "workers": (int,),
+    "heartbeats": (int,),
+    "sites": (dict,),
+    "transfers": (dict,),
+    "serve": (dict, type(None)),
+    "gates": (dict,),
+    "journal_wall_s": (int, float, type(None)),
+    "complete": (bool,),
+}
+LEG_SCHEMA: Dict[str, tuple] = {
+    "exposed_s": (int, float),
+    "busy_s": (int, float),
+    "available": (bool,),
+}
+SITE_KEYS = (
+    "compiles", "retraces", "dispatches", "occupancy",
+    "real_rows", "padded_rows", "est_flops_total",
+)
+
+# flat numeric gate values lifted off a bench result; the overhead
+# gates ride inside sub-dicts so they get dotted names
+_GATE_FIELDS = (
+    "value", "vs_baseline", "occupancy", "retraces_steady_state",
+    "bubble_fraction", "link_MBps",
+)
+_GATE_SUBFIELDS = (
+    ("guard", "overhead"), ("frame", "overhead"), ("pulse", "overhead"),
+    ("slo", "overhead"), ("steer", "overhead"),
+    ("ingest", "ring_vs_probe"), ("wire", "pull_vs_probe"),
+    ("serve", "ttfr_speedup"), ("serve", "lost_jobs"),
+    ("serve", "retraces"),
+)
+
+
+# --------------------------------------------------------- distillation
+
+
+def _empty_legs(available: bool = False) -> Dict[str, dict]:
+    return {
+        leg: {"exposed_s": 0.0, "busy_s": 0.0, "available": available}
+        for leg in LEG_NAMES
+    }
+
+
+def _base_profile(source: str) -> dict:
+    return {
+        "profile_version": PROFILE_VERSION,
+        "kind": PROFILE_KIND,
+        "source": source,
+        "platform": None,
+        "metric": None,
+        "value": None,
+        "unit": None,
+        "wall_s": 0.0,
+        "kcells": 0.0,
+        "legs": _empty_legs(),
+        "bubble_fraction": None,
+        "limiting_stage": None,
+        "workers": 0,
+        "heartbeats": 0,
+        "sites": {},
+        "transfers": {},
+        "serve": None,
+        "gates": {},
+        "journal_wall_s": None,
+        "complete": False,
+    }
+
+
+def stub_profile(
+    source: str,
+    platform: Optional[dict] = None,
+    metric: Optional[str] = None,
+    value: Optional[float] = None,
+    unit: Optional[str] = None,
+    gates: Optional[dict] = None,
+) -> dict:
+    """A legs-unavailable profile for points that predate scx-delta.
+
+    The backfilled BENCH_r01–r06 / MULTICHIP_r* points carry these:
+    platform fingerprint and gate values were committed from day one,
+    but no pulse rings survive to fold legs from, so every leg is
+    marked ``available: False`` and the profile ``complete: False`` —
+    delta against a stub degrades to the structural diff, loudly.
+    """
+    profile = _base_profile(source)
+    profile["platform"] = platform
+    profile["metric"] = metric
+    profile["value"] = float(value) if isinstance(value, (int, float)) else None
+    profile["unit"] = unit
+    profile["gates"] = dict(gates or {})
+    return profile
+
+
+def _fold_worker_legs(records: List[dict]) -> dict:
+    """One worker's interval math: exposed/busy per leg + window span.
+
+    All intervals in one worker's records share that worker's monotonic
+    clock, so union/subtract math is valid WITHIN a worker and summed
+    ACROSS workers (never unioned across — different workers' clocks
+    have different epochs).
+    """
+    unions: Dict[str, List[Tuple[float, float]]] = {}
+    for leg in _pulse.LEGS:
+        intervals = []
+        for record in records:
+            start, end = record["legs"].get(leg, (0.0, 0.0))
+            if end > start:
+                intervals.append((start, end))
+        unions[leg] = _pulse._union(intervals)
+    all_intervals = [i for u in unions.values() for i in u]
+    covered = _pulse._union(all_intervals)
+    covered_s = _pulse._total(covered)
+    if covered:
+        window_s = covered[-1][1] - covered[0][0]
+    else:
+        window_s = 0.0
+    exposed = {}
+    busy = {}
+    for leg in _pulse.LEGS:
+        others = _pulse._union(
+            [i for other in _pulse.LEGS if other != leg for i in unions[other]]
+        )
+        exposed[leg] = _pulse._total(_pulse._subtract(unions[leg], others))
+        busy[leg] = _pulse._total(unions[leg])
+    overlap_s = max(0.0, covered_s - sum(exposed.values()))
+    idle_s = max(0.0, window_s - covered_s)
+    exposed["overlap"] = overlap_s
+    exposed["idle"] = idle_s
+    busy["overlap"] = overlap_s
+    busy["idle"] = idle_s
+    bubble = _pulse.attribute_bubbles(records)
+    return {
+        "exposed": exposed,
+        "busy": busy,
+        "window_s": window_s,
+        "bubble_s": bubble["bubble_s"],
+        "heartbeats": len(records),
+        "entities": sum(r["entities"] for r in records),
+    }
+
+
+def profile_from_records(
+    records: List[dict],
+    source: str = "memory",
+    platform: Optional[dict] = None,
+    metric: Optional[str] = None,
+    value: Optional[float] = None,
+    unit: Optional[str] = None,
+    gates: Optional[dict] = None,
+    workers: int = 1,
+) -> dict:
+    """Distill a RunProfile from in-memory heartbeat records (one clock).
+
+    The ``bench.py`` path: the memory session's records all share the
+    bench process's clock, so this is the single-worker fold. Run-dir
+    distillation (:func:`profile_from_run_dir`) calls this per ring and
+    sums.
+    """
+    profile = stub_profile(
+        source, platform=platform, metric=metric, value=value, unit=unit,
+        gates=gates,
+    )
+    folds = [_fold_worker_legs(records)] if records else []
+    return _apply_folds(profile, folds, workers=workers if records else 0)
+
+
+def _apply_folds(profile: dict, folds: List[dict], workers: int) -> dict:
+    if not folds:
+        return profile
+    legs = _empty_legs(available=True)
+    wall_s = 0.0
+    bubble_s = 0.0
+    heartbeats = 0
+    entities = 0
+    for fold in folds:
+        wall_s += fold["window_s"]
+        bubble_s += fold["bubble_s"]
+        heartbeats += fold["heartbeats"]
+        entities += fold["entities"]
+        for leg in LEG_NAMES:
+            legs[leg]["exposed_s"] += fold["exposed"][leg]
+            legs[leg]["busy_s"] += fold["busy"][leg]
+    for leg in LEG_NAMES:
+        legs[leg]["exposed_s"] = round(legs[leg]["exposed_s"], 9)
+        legs[leg]["busy_s"] = round(legs[leg]["busy_s"], 9)
+    pulse_legs = [leg for leg in _pulse.LEGS]
+    limiting = max(
+        pulse_legs,
+        key=lambda leg: (legs[leg]["exposed_s"], legs[leg]["busy_s"]),
+    )
+    profile["legs"] = legs
+    profile["wall_s"] = round(wall_s, 9)
+    profile["kcells"] = round(entities / 1000.0, 6)
+    profile["bubble_fraction"] = (
+        round(bubble_s / wall_s, 4) if wall_s > 0 else None
+    )
+    profile["limiting_stage"] = limiting
+    profile["workers"] = workers
+    profile["heartbeats"] = heartbeats
+    profile["complete"] = wall_s > 0 and entities > 0
+    return profile
+
+
+def _distill_sites(merged: dict) -> Dict[str, dict]:
+    sites = {}
+    for name, row in (merged.get("sites") or {}).items():
+        occupancy = row.get("occupancy")
+        sites[name] = {
+            "compiles": int(row.get("compiles") or 0),
+            "retraces": int(row.get("retraces") or 0),
+            "dispatches": int(row.get("dispatches") or 0),
+            "occupancy": (
+                round(float(occupancy), 4) if occupancy is not None else None
+            ),
+            "real_rows": int(row.get("real_rows") or 0),
+            "padded_rows": int(row.get("padded_rows") or 0),
+            "est_flops_total": (
+                float(row["est_flops_total"])
+                if isinstance(row.get("est_flops_total"), (int, float))
+                else None
+            ),
+        }
+    return sites
+
+
+def _distill_transfers(merged: dict) -> Dict[str, dict]:
+    transfers = {}
+    for direction, total in (merged.get("ledger") or {}).items():
+        transfers[direction] = {
+            "bytes": int(total.get("bytes") or 0),
+            "seconds": round(float(total.get("seconds") or 0.0), 6),
+            "events": int(total.get("events") or 0),
+            "wasted": int(total.get("wasted") or 0),
+        }
+    return transfers
+
+
+def _journal_wall_s(run_dir: str) -> Optional[float]:
+    from . import slo as _slo
+
+    spans = []
+    for journal_dir in _slo.find_journal_dirs(run_dir):
+        _, events = _slo.load_journal(journal_dir)
+        ts = [
+            e["ts"] for e in events
+            if e.get("event") in ("leased", "committed")
+            and isinstance(e.get("ts"), (int, float))
+        ]
+        if len(ts) >= 2:
+            spans.append(max(ts) - min(ts))
+    return round(max(spans), 6) if spans else None
+
+
+def _distill_serve(run_dir: str) -> Optional[dict]:
+    """Tenant/pack/steer summary when the run dir holds a serve journal.
+
+    Every piece degrades independently: a metrics-only run has no
+    journal (returns None), a serve run without steering omits the
+    steer block.
+    """
+    from . import slo as _slo
+
+    try:
+        if not _slo.find_journal_dirs(run_dir):
+            return None
+        view = _slo.stitch_run(run_dir)
+    except Exception:
+        return None
+    tenants = {}
+    for tenant, row in (view.get("tenants") or {}).items():
+        tenants[tenant] = {
+            "jobs": row.get("jobs"),
+            "p50_s": row.get("p50_s"),
+            "p95_s": row.get("p95_s"),
+        }
+    fleet = view.get("fleet") or {}
+    serve = {
+        "tenants": tenants,
+        "trace_complete": fleet.get("complete_fraction"),
+        "unattributed_device_s": fleet.get("unattributed_device_s"),
+    }
+    try:
+        from .. import steer as _steer
+
+        decisions = _steer.load_decisions(run_dir)
+        if decisions:
+            applied = sum(1 for d in decisions if d.get("applied"))
+            serve["steer"] = {
+                "decisions": len(decisions),
+                "applied": applied,
+            }
+    except Exception:
+        pass
+    return serve
+
+
+def profile_from_run_dir(
+    run_dir: str,
+    source: Optional[str] = None,
+    platform: Optional[dict] = None,
+    metric: Optional[str] = None,
+    value: Optional[float] = None,
+    unit: Optional[str] = None,
+    gates: Optional[dict] = None,
+) -> dict:
+    """Distill a RunProfile from a run directory's committed telemetry.
+
+    Folds whatever the run left behind: ``pulse.<worker>.ring`` files
+    (per-leg exposed wall, per worker then summed — never unioned
+    across workers' distinct monotonic clocks), ``xprof*.json``
+    registries (per-site efficiency + the transfer ledger), the sched
+    journal (wall span, serve/tenant stitch). Strictly post-run: this
+    reads artifacts, it never instruments.
+    """
+    from . import xprof as _xprof
+
+    profile = stub_profile(
+        source or run_dir, platform=platform, metric=metric, value=value,
+        unit=unit, gates=gates,
+    )
+    rings = _pulse.load_rings(run_dir)
+    folds = [
+        _fold_worker_legs(ring["records"])
+        for _, ring in sorted(rings.items())
+        if ring["records"]
+    ]
+    profile = _apply_folds(profile, folds, workers=len(folds))
+    registries = _xprof.load_registries(run_dir)
+    if registries:
+        merged = _xprof.merge_registries(registries)
+        profile["sites"] = _distill_sites(merged)
+        profile["transfers"] = _distill_transfers(merged)
+    profile["journal_wall_s"] = _journal_wall_s(run_dir)
+    profile["serve"] = _distill_serve(run_dir)
+    return profile
+
+
+def gates_from_result(result: dict) -> Dict[str, float]:
+    """The flat gate-value vector a bench result carries.
+
+    These survive into stub profiles (they were committed with every
+    historical point), so even a legs-unavailable delta can still say
+    "occupancy 0.77 -> 0.41" — structural facts, not speedup claims.
+    """
+    gates: Dict[str, float] = {}
+    for field in _GATE_FIELDS:
+        value = result.get(field)
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            gates[field] = float(value)
+    for parent, child in _GATE_SUBFIELDS:
+        sub = result.get(parent)
+        if isinstance(sub, dict):
+            value = sub.get(child)
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                gates[f"{parent}.{child}"] = float(value)
+    return gates
+
+
+def profile_from_result(result: dict, source: str = "result") -> dict:
+    """The RunProfile view of any committed JSON shape.
+
+    Accepts, in sniffing order: a RunProfile itself; a driver trajectory
+    point (``{"parsed": {...}}`` wrapper, BENCH_r*/MULTICHIP_r* shape);
+    a raw bench result (with or without an embedded ``profile``). A
+    result with no embedded profile yields a stub — gate values and
+    fingerprint preserved, legs unavailable.
+    """
+    if result.get("kind") == PROFILE_KIND:
+        profile = dict(result)
+        profile.setdefault("source", source)
+        return profile
+    parsed = result
+    if isinstance(result.get("parsed"), dict):
+        parsed = result["parsed"]
+    embedded = parsed.get("profile")
+    if not isinstance(embedded, dict):
+        embedded = (
+            result.get("profile")
+            if isinstance(result.get("profile"), dict)
+            else None
+        )
+    if isinstance(embedded, dict) and embedded.get("kind") == PROFILE_KIND:
+        profile = dict(embedded)
+        profile["source"] = source
+        return profile
+    platform = parsed.get("platform")
+    if not isinstance(platform, dict):
+        platform = (
+            result.get("platform")
+            if isinstance(result.get("platform"), dict)
+            else None
+        )
+    return stub_profile(
+        source,
+        platform=platform,
+        metric=parsed.get("metric"),
+        value=(
+            parsed.get("value")
+            if isinstance(parsed.get("value"), (int, float))
+            else None
+        ),
+        unit=parsed.get("unit"),
+        gates=gates_from_result(parsed),
+    )
+
+
+def validate_profile(profile: dict) -> List[str]:
+    """Schema-pin check: [] when the profile matches exactly."""
+    problems: List[str] = []
+    if not isinstance(profile, dict):
+        return ["profile is not a dict"]
+    keys = set(profile)
+    expected = set(PROFILE_SCHEMA)
+    for missing in sorted(expected - keys):
+        problems.append(f"missing key: {missing}")
+    for extra in sorted(keys - expected):
+        problems.append(f"unknown key: {extra}")
+    for key, types in PROFILE_SCHEMA.items():
+        if key in profile and not isinstance(profile[key], types):
+            problems.append(
+                f"{key}: expected {'/'.join(t.__name__ for t in types)}, "
+                f"got {type(profile[key]).__name__}"
+            )
+    legs = profile.get("legs")
+    if isinstance(legs, dict):
+        if set(legs) != set(LEG_NAMES):
+            problems.append(
+                f"legs: expected exactly {sorted(LEG_NAMES)}, "
+                f"got {sorted(legs)}"
+            )
+        for leg, row in legs.items():
+            if not isinstance(row, dict) or set(row) != set(LEG_SCHEMA):
+                problems.append(f"legs.{leg}: wrong key set")
+                continue
+            for key, types in LEG_SCHEMA.items():
+                if not isinstance(row[key], types):
+                    problems.append(f"legs.{leg}.{key}: wrong type")
+    if profile.get("kind") != PROFILE_KIND:
+        problems.append(f"kind: expected {PROFILE_KIND!r}")
+    if profile.get("profile_version") != PROFILE_VERSION:
+        problems.append(
+            f"profile_version: expected {PROFILE_VERSION}, "
+            f"got {profile.get('profile_version')}"
+        )
+    return problems
+
+
+def synthetic_profile(
+    exposed: Dict[str, float],
+    kcells: float = 1.0,
+    platform: Optional[dict] = None,
+    source: str = "synthetic",
+    metric: Optional[str] = "synthetic_metric",
+    value: Optional[float] = None,
+    gates: Optional[dict] = None,
+    sites: Optional[dict] = None,
+) -> dict:
+    """A complete profile from explicit per-leg exposed seconds.
+
+    The test/selftest constructor: ``wall_s`` is DEFINED as the sum of
+    the given legs (missing legs are 0), so conservation holds exactly
+    and tests can then perturb single fields to prove the checker
+    notices.
+    """
+    profile = stub_profile(
+        source, platform=platform, metric=metric, value=value, gates=gates,
+    )
+    legs = _empty_legs(available=True)
+    for leg, seconds in exposed.items():
+        if leg not in legs:
+            raise ValueError(f"unknown leg {leg!r}")
+        legs[leg]["exposed_s"] = float(seconds)
+        legs[leg]["busy_s"] = float(seconds)
+    profile["legs"] = legs
+    profile["wall_s"] = round(
+        sum(row["exposed_s"] for row in legs.values()), 9
+    )
+    profile["kcells"] = float(kcells)
+    profile["workers"] = 1
+    profile["heartbeats"] = 1
+    profile["limiting_stage"] = max(
+        _pulse.LEGS, key=lambda leg: legs[leg]["exposed_s"]
+    )
+    feed = sum(legs[leg]["exposed_s"] for leg in FEED_LEGS)
+    profile["bubble_fraction"] = (
+        round(feed / profile["wall_s"], 4) if profile["wall_s"] else None
+    )
+    if sites:
+        profile["sites"] = sites
+    profile["complete"] = profile["wall_s"] > 0 and kcells > 0
+    return profile
+
+
+# ---------------------------------------------------------- attribution
+
+
+def _structural_diff(a: dict, b: dict) -> dict:
+    """The cross-platform / incomplete-profile fallback: facts only.
+
+    Set differences and committed gate values — never a normalized
+    per-leg delta, never a speedup claim.
+    """
+    a_sites, b_sites = set(a.get("sites") or {}), set(b.get("sites") or {})
+    a_legs = {
+        leg for leg, row in (a.get("legs") or {}).items() if row["available"]
+    }
+    b_legs = {
+        leg for leg, row in (b.get("legs") or {}).items() if row["available"]
+    }
+    gates = {}
+    for name in sorted(set(a.get("gates") or {}) | set(b.get("gates") or {})):
+        gates[name] = {
+            "a": (a.get("gates") or {}).get(name),
+            "b": (b.get("gates") or {}).get(name),
+        }
+    return {
+        "platform_a": a.get("platform"),
+        "platform_b": b.get("platform"),
+        "legs_available_a": sorted(a_legs),
+        "legs_available_b": sorted(b_legs),
+        "sites_only_a": sorted(a_sites - b_sites),
+        "sites_only_b": sorted(b_sites - a_sites),
+        "gates": gates,
+    }
+
+
+def _site_suspects(a: dict, b: dict) -> List[dict]:
+    suspects = []
+    a_sites = a.get("sites") or {}
+    b_sites = b.get("sites") or {}
+    for name in sorted(set(a_sites) & set(b_sites)):
+        occ_a = a_sites[name].get("occupancy")
+        occ_b = b_sites[name].get("occupancy")
+        if (
+            isinstance(occ_a, (int, float))
+            and isinstance(occ_b, (int, float))
+            and occ_a - occ_b > 0.05
+        ):
+            suspects.append(
+                {
+                    "kind": "site_occupancy",
+                    "name": name,
+                    "detail": (
+                        f"site {name} occupancy {occ_a:.2f}→{occ_b:.2f}"
+                    ),
+                    "score": float(occ_a - occ_b),
+                }
+            )
+        retr_a = int(a_sites[name].get("retraces") or 0)
+        retr_b = int(b_sites[name].get("retraces") or 0)
+        if retr_b > retr_a:
+            suspects.append(
+                {
+                    "kind": "site_retraces",
+                    "name": name,
+                    "detail": (
+                        f"site {name} retraces {retr_a}→{retr_b}"
+                    ),
+                    "score": float(retr_b - retr_a),
+                }
+            )
+    return suspects
+
+
+def _transfer_suspects(a: dict, b: dict) -> List[dict]:
+    suspects = []
+    a_tr = a.get("transfers") or {}
+    b_tr = b.get("transfers") or {}
+    for direction in sorted(set(a_tr) & set(b_tr)):
+        wasted_a = int(a_tr[direction].get("wasted") or 0)
+        wasted_b = int(b_tr[direction].get("wasted") or 0)
+        bytes_a = int(a_tr[direction].get("bytes") or 0)
+        bytes_b = int(b_tr[direction].get("bytes") or 0)
+        if bytes_a and wasted_b - wasted_a > 0.05 * bytes_a:
+            suspects.append(
+                {
+                    "kind": "transfer_waste",
+                    "name": direction,
+                    "detail": (
+                        f"{direction} wasted pad bytes "
+                        f"{wasted_a}→{wasted_b}"
+                    ),
+                    "score": (wasted_b - wasted_a) / bytes_a,
+                }
+            )
+        if bytes_a and bytes_b > 1.2 * bytes_a:
+            suspects.append(
+                {
+                    "kind": "transfer_bytes",
+                    "name": direction,
+                    "detail": (
+                        f"{direction} bytes {bytes_a}→{bytes_b} "
+                        f"(+{100.0 * (bytes_b - bytes_a) / bytes_a:.0f}%)"
+                    ),
+                    "score": (bytes_b - bytes_a) / bytes_a,
+                }
+            )
+    return suspects
+
+
+def _leg_detail(leg: str, per_a: float, per_b: float) -> str:
+    if per_a > 0:
+        pct = 100.0 * (per_b - per_a) / per_a
+        return (
+            f"{leg} exposed wall {pct:+.0f}% "
+            f"({per_a:.4f}→{per_b:.4f} s/kcell)"
+        )
+    return f"{leg} exposed wall {per_a:.4f}→{per_b:.4f} s/kcell"
+
+
+def attribute_delta(
+    a: dict, b: dict, tolerance: float = DEFAULT_TOLERANCE
+) -> dict:
+    """Ranked attribution of the end-to-end delta between two profiles.
+
+    ``a`` is the reference (before), ``b`` the candidate (after); all
+    per-leg numbers are normalized to seconds-per-kilocell so runs of
+    different sizes compare. The conservation property is explicit in
+    the output: ``sum(leg deltas) == end-to-end delta`` within
+    ``tolerance`` (it holds exactly for profiles distilled by this
+    module — the overlap/idle legs close the books by construction — so
+    a conservation failure means a profile was hand-edited or a
+    version-skewed distiller dropped a leg).
+
+    Refusal cases (``comparable: False``, structural diff only, loud
+    ``refusal`` string, NO numeric speedup claims): mismatched platform
+    fingerprints, either profile incomplete (stub/backfilled legs), or
+    degenerate kcells.
+    """
+    view: Dict[str, Any] = {
+        "kind": DELTA_KIND,
+        "comparable": True,
+        "refusal": None,
+        "tolerance": tolerance,
+        "a": _side_summary(a),
+        "b": _side_summary(b),
+        "structural": _structural_diff(a, b),
+    }
+    refusal = None
+    if not a.get("complete") or not b.get("complete"):
+        incomplete = [
+            side["source"]
+            for side, profile in (
+                (view["a"], a), (view["b"], b)
+            )
+            if not profile.get("complete")
+        ]
+        refusal = (
+            "profile(s) incomplete (no folded pulse legs): "
+            + ", ".join(incomplete)
+            + " — structural diff only, no speedup claim"
+        )
+    elif not isinstance(a.get("platform"), dict) or not isinstance(
+        b.get("platform"), dict
+    ):
+        refusal = (
+            "missing platform fingerprint — structural diff only, "
+            "no speedup claim"
+        )
+    elif a["platform"] != b["platform"]:
+        refusal = (
+            f"platform fingerprints differ ({a['platform']} vs "
+            f"{b['platform']}) — cross-platform numbers never compare; "
+            "structural diff only, no speedup claim"
+        )
+    elif not a.get("kcells") or not b.get("kcells"):
+        refusal = (
+            "degenerate work count (kcells == 0) — structural diff only"
+        )
+    if refusal:
+        view["comparable"] = False
+        view["refusal"] = refusal
+        view["suspects"] = []
+        return view
+
+    ka, kb = float(a["kcells"]), float(b["kcells"])
+    e2e_a = a["wall_s"] / ka
+    e2e_b = b["wall_s"] / kb
+    e2e_delta = e2e_b - e2e_a
+    legs_view: Dict[str, dict] = {}
+    sum_delta = 0.0
+    for leg in LEG_NAMES:
+        per_a = a["legs"][leg]["exposed_s"] / ka
+        per_b = b["legs"][leg]["exposed_s"] / kb
+        delta = per_b - per_a
+        sum_delta += delta
+        legs_view[leg] = {
+            "a_s_per_kcell": round(per_a, 6),
+            "b_s_per_kcell": round(per_b, 6),
+            "delta_s_per_kcell": round(delta, 6),
+            "share": (
+                round(delta / e2e_delta, 4) if abs(e2e_delta) > 1e-12 else None
+            ),
+        }
+    error = abs(sum_delta - e2e_delta) / max(abs(e2e_delta), 1e-9)
+    view["end_to_end"] = {
+        "a_s_per_kcell": round(e2e_a, 6),
+        "b_s_per_kcell": round(e2e_b, 6),
+        "delta_s_per_kcell": round(e2e_delta, 6),
+        "pct": (
+            round(100.0 * e2e_delta / e2e_a, 2) if e2e_a > 0 else None
+        ),
+    }
+    view["legs"] = legs_view
+    view["conservation"] = {
+        "sum_leg_delta_s_per_kcell": round(sum_delta, 6),
+        "end_to_end_delta_s_per_kcell": round(e2e_delta, 6),
+        "error": round(error, 6),
+        "tolerance": tolerance,
+        "conserved": error <= tolerance,
+    }
+
+    # ---- ranked suspects. Leg suspects are the legs that GOT SLOWER
+    # (positive delta), by magnitude — with one principled override: a
+    # materially GROWN bubble fraction means the pipeline re-serialized,
+    # and the bubble is BY DEFINITION feed work (decode/h2d) the device
+    # sat idle behind, so the feed leg with the largest growth leads
+    # even when serialization also inflated compute's exposed wall (the
+    # symptom, not the cause). Site/transfer evidence rides after the
+    # legs.
+    suspects: List[dict] = []
+    bub_a = a.get("bubble_fraction")
+    bub_b = b.get("bubble_fraction")
+    bubble_grew = (
+        isinstance(bub_a, (int, float))
+        and isinstance(bub_b, (int, float))
+        and bub_b - bub_a > 0.05
+    )
+    leg_rank = sorted(
+        (
+            (leg, legs_view[leg]["delta_s_per_kcell"])
+            for leg in LEG_NAMES
+            if leg != "idle" and legs_view[leg]["delta_s_per_kcell"] > 0
+        ),
+        key=lambda item: -item[1],
+    )
+    if bubble_grew:
+        feed_rank = [item for item in leg_rank if item[0] in FEED_LEGS]
+        rest = [item for item in leg_rank if item[0] not in FEED_LEGS]
+        leg_rank = feed_rank + rest
+    for leg, delta in leg_rank:
+        row = legs_view[leg]
+        detail = _leg_detail(leg, row["a_s_per_kcell"], row["b_s_per_kcell"])
+        if bubble_grew and leg in FEED_LEGS:
+            detail += (
+                f"; pipeline bubble {100 * bub_a:.0f}%→"
+                f"{100 * bub_b:.0f}% (feed no longer hidden)"
+            )
+        suspects.append(
+            {"kind": "leg", "name": leg, "detail": detail,
+             "score": float(delta)}
+        )
+    suspects.extend(
+        sorted(_site_suspects(a, b), key=lambda s: -s["score"])
+    )
+    suspects.extend(
+        sorted(_transfer_suspects(a, b), key=lambda s: -s["score"])
+    )
+    view["suspects"] = suspects
+    return view
+
+
+def _side_summary(profile: dict) -> dict:
+    return {
+        "source": profile.get("source"),
+        "metric": profile.get("metric"),
+        "value": profile.get("value"),
+        "unit": profile.get("unit"),
+        "wall_s": profile.get("wall_s"),
+        "kcells": profile.get("kcells"),
+        "workers": profile.get("workers"),
+        "complete": bool(profile.get("complete")),
+        "platform": profile.get("platform"),
+    }
+
+
+def top_suspect(view: dict) -> Optional[str]:
+    """The one-line 'suspect: ...' string the check gate prints."""
+    suspects = view.get("suspects") or []
+    if not suspects:
+        return None
+    return suspects[0]["detail"]
+
+
+# ------------------------------------------------------ trajectory mode
+
+
+def _platform_key(platform: Optional[dict]) -> str:
+    if not isinstance(platform, dict):
+        return "(unfingerprinted)"
+    key = (
+        f"{platform.get('backend')}/{platform.get('device_kind')}"
+        f"×{platform.get('device_count')}"
+    )
+    mesh = platform.get("mesh")
+    if isinstance(mesh, dict):
+        sizes = "x".join(str(s) for s in mesh.get("sizes") or [])
+        key += f" mesh[{sizes}]"
+    return key
+
+
+def trajectory_view(
+    repo_dir: str,
+    metric: Optional[str] = None,
+    pattern: str = "BENCH_r*.json",
+    tolerance: float = DEFAULT_TOLERANCE,
+) -> dict:
+    """The committed series with per-point deltas vs the previous
+    same-fingerprint point.
+
+    Every committed point renders — backfilled stubs included, marked
+    ``legs unavailable`` — and each point carrying a complete profile is
+    attributed against the nearest PRECEDING point on the same platform
+    that also carries one. Cross-platform neighbors never pair (the
+    fingerprint groups them apart), so the axon series and the CPU
+    container series each trend against themselves.
+    """
+    from . import trajectory as _trajectory
+
+    points = _trajectory.load_trajectory_points(
+        repo_dir, pattern=pattern, metric=metric
+    )
+    out_points: List[dict] = []
+    last_complete: Dict[str, dict] = {}
+    for point in points:
+        profile = (
+            point["profile"]
+            if isinstance(point.get("profile"), dict)
+            else None
+        )
+        if profile is None:
+            profile = profile_from_result(point, source=point["source"])
+        else:
+            profile = dict(profile)
+            profile.setdefault("source", point["source"])
+        key = _platform_key(point.get("platform"))
+        row = {
+            "source": point["source"],
+            "metric": point.get("metric"),
+            "value": point.get("value"),
+            "unit": point.get("unit"),
+            "platform_key": key,
+            "profile_complete": bool(profile.get("complete")),
+            "delta": None,
+            "note": None,
+        }
+        if not profile.get("complete"):
+            row["note"] = "legs unavailable (stub profile)"
+        elif key in last_complete:
+            row["delta"] = attribute_delta(
+                last_complete[key], profile, tolerance=tolerance
+            )
+        else:
+            row["note"] = "first complete profile on this platform"
+        if profile.get("complete"):
+            last_complete[key] = profile
+        out_points.append(row)
+    return {
+        "kind": "trajectory",
+        "repo_dir": os.path.abspath(repo_dir),
+        "pattern": pattern,
+        "metric": metric,
+        "points": out_points,
+    }
+
+
+# ------------------------------------------------------------ rendering
+
+
+def render_delta(view: dict) -> str:
+    lines: List[str] = []
+    a, b = view["a"], view["b"]
+    lines.append(f"delta: {a['source']}  →  {b['source']}")
+    if not view["comparable"]:
+        lines.append(f"NOT COMPARABLE: {view['refusal']}")
+        structural = view["structural"]
+        lines.append(
+            f"  platform a: {structural['platform_a']}"
+        )
+        lines.append(
+            f"  platform b: {structural['platform_b']}"
+        )
+        lines.append(
+            "  legs available: "
+            f"a={structural['legs_available_a'] or '-'} "
+            f"b={structural['legs_available_b'] or '-'}"
+        )
+        if structural["sites_only_a"] or structural["sites_only_b"]:
+            lines.append(
+                f"  sites only in a: {structural['sites_only_a'] or '-'}; "
+                f"only in b: {structural['sites_only_b'] or '-'}"
+            )
+        for name, pair in structural["gates"].items():
+            if pair["a"] != pair["b"]:
+                lines.append(
+                    f"  gate {name}: {pair['a']} → {pair['b']}"
+                )
+        return "\n".join(lines) + "\n"
+    e2e = view["end_to_end"]
+    pct = f" ({e2e['pct']:+.1f}%)" if e2e["pct"] is not None else ""
+    lines.append(
+        f"end-to-end: {e2e['a_s_per_kcell']:.4f} → "
+        f"{e2e['b_s_per_kcell']:.4f} s/kcell{pct}"
+    )
+    lines.append(
+        f"{'leg':8}  {'a s/kcell':>10}  {'b s/kcell':>10}  "
+        f"{'delta':>10}  {'share':>6}"
+    )
+    for leg in LEG_NAMES:
+        row = view["legs"][leg]
+        share = (
+            f"{100 * row['share']:5.1f}%" if row["share"] is not None else "    -"
+        )
+        lines.append(
+            f"{leg:8}  {row['a_s_per_kcell']:10.4f}  "
+            f"{row['b_s_per_kcell']:10.4f}  "
+            f"{row['delta_s_per_kcell']:+10.4f}  {share}"
+        )
+    conservation = view["conservation"]
+    verdict = "conserved" if conservation["conserved"] else "NOT CONSERVED"
+    lines.append(
+        f"conservation: sum(legs) "
+        f"{conservation['sum_leg_delta_s_per_kcell']:+.4f} vs end-to-end "
+        f"{conservation['end_to_end_delta_s_per_kcell']:+.4f} s/kcell "
+        f"(error {100 * conservation['error']:.1f}% "
+        f"≤ {100 * conservation['tolerance']:.0f}%: {verdict})"
+    )
+    if view["suspects"]:
+        for i, suspect in enumerate(view["suspects"][:8]):
+            prefix = "suspect:" if i == 0 else "        "
+            lines.append(f"{prefix} {suspect['detail']}")
+    else:
+        lines.append("suspect: none (no leg got slower)")
+    return "\n".join(lines) + "\n"
+
+
+def render_trajectory(view: dict) -> str:
+    lines = [
+        f"trajectory: {view['pattern']} under {view['repo_dir']}"
+        + (f" (metric {view['metric']})" if view["metric"] else "")
+    ]
+    if not view["points"]:
+        lines.append("(no committed points)")
+        return "\n".join(lines) + "\n"
+    width = max(len(p["source"]) for p in view["points"])
+    for point in view["points"]:
+        value = (
+            f"{point['value']:.2f} {point['unit'] or ''}".strip()
+            if point["value"] is not None
+            else "-"
+        )
+        line = (
+            f"{point['source'].ljust(width)}  {point['platform_key']:24}  "
+            f"{value:>18}  "
+        )
+        if point["delta"] is not None:
+            delta = point["delta"]
+            if delta["comparable"]:
+                e2e = delta["end_to_end"]
+                pct = (
+                    f"{e2e['pct']:+.1f}%" if e2e["pct"] is not None else "?"
+                )
+                suspect = top_suspect(delta)
+                line += f"e2e {pct} vs {delta['a']['source']}"
+                if suspect:
+                    line += f"; {suspect}"
+            else:
+                line += f"not comparable: {delta['refusal']}"
+        else:
+            line += point["note"] or ""
+        lines.append(line)
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------- persistence
+
+
+def write_profile(profile: dict, path: str) -> str:
+    """Atomic single-file profile write (tmp + rename), returns path."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(profile, f, separators=(",", ":"), sort_keys=True)
+    os.replace(tmp, path)
+    return path

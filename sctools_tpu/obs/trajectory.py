@@ -1,0 +1,161 @@
+"""scx-delta: the committed performance-trajectory series, as a library.
+
+The driver appends one ``BENCH_rNN.json`` (and, for mesh runs,
+``MULTICHIP_rNN.json``) per round; together they are the repo's own
+performance history — the reference the ``bench.py --check`` gate judges
+against and the series ``python -m sctools_tpu.obs delta --trajectory``
+renders. This module is the ONE loader for that series, shared by the
+repo-root bench script (which re-imports it) and the module CLIs (which
+must not import a repo-root script to read committed data).
+
+Also home to :func:`platform_fingerprint`, the machine-enforced
+comparability key every result carries — trajectory filtering, the
+check gate, and delta attribution all compare fingerprints by dict
+equality, so the definition has to live in exactly one place.
+
+Pure stdlib except for :func:`platform_fingerprint` (which imports jax
+lazily, at call time): reading the committed series works on any host.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List, Optional
+
+
+def platform_fingerprint(mesh=None) -> dict:
+    """The machine-enforced comparability key every result carries.
+
+    (jax backend, device kind, device count): the BENCH_r06 lesson — a
+    CPU-only container's point landed in the same trajectory as the axon
+    device points with only a prose note separating them. The gate now
+    compares a result's trajectory/median ONLY against same-fingerprint
+    points, so cross-platform numbers can never gate each other.
+
+    ``mesh`` (a ``jax.sharding.Mesh``) stamps the MESH SHAPE (axis names
+    + sizes) into the fingerprint — the MULTICHIP_r* lesson:
+    ``dryrun_multichip`` forces the host platform, so every multichip
+    point reads cpu×8 and backend/device-kind alone cannot separate an
+    8-way mesh run from a 4-way one. Platform comparison is dict
+    equality, so a mesh-stamped point gates only against points recorded
+    on an identical topology.
+    """
+    import jax
+
+    devices = jax.devices()
+    fingerprint = {
+        "backend": str(jax.default_backend()),
+        "device_kind": str(devices[0].device_kind) if devices else "unknown",
+        "device_count": len(devices),
+    }
+    if mesh is not None:
+        fingerprint["mesh"] = {
+            "axes": [str(a) for a in mesh.axis_names],
+            "sizes": [int(mesh.shape[a]) for a in mesh.axis_names],
+        }
+    return fingerprint
+
+
+def load_trajectory(
+    repo_dir: str, metric: str, pattern: str = "BENCH_r*.json"
+) -> list:
+    """The trajectory history points matching ``metric``.
+
+    Each round's driver appends one BENCH_rNN.json with the parsed result;
+    together they are the repo's own performance trajectory — the gate's
+    reference. Unreadable or metric-mismatched files are skipped (the
+    headline metric changed once already, r01 -> r02). ``pattern``
+    selects the point family: ``"MULTICHIP_r*.json"`` loads the
+    multichip points (mesh-aware fingerprints: each carries the mesh
+    shape, so same-platform filtering separates topologies).
+    """
+    entries = []
+    for path in sorted(glob.glob(os.path.join(repo_dir, pattern))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = data.get("parsed") or {}
+        if parsed.get("metric") == metric and isinstance(
+            parsed.get("value"), (int, float)
+        ):
+            entries.append(
+                {
+                    "source": os.path.basename(path),
+                    "value": float(parsed["value"]),
+                    "unit": parsed.get("unit"),
+                    # comparability fingerprint (jax backend, device kind,
+                    # device count); None on pre-fingerprint points
+                    "platform": (
+                        parsed.get("platform")
+                        if isinstance(parsed.get("platform"), dict)
+                        else None
+                    ),
+                }
+            )
+    return entries
+
+
+def load_trajectory_points(
+    repo_dir: str,
+    pattern: str = "BENCH_r*.json",
+    metric: Optional[str] = None,
+) -> List[dict]:
+    """Every committed point under ``pattern``, profiles riding along.
+
+    The richer sibling of :func:`load_trajectory` for scx-delta's
+    trajectory mode: where the gate only needs (value, platform) pairs,
+    delta attribution needs the WHOLE point — the parsed result, the
+    embedded RunProfile (or its backfilled stub), and the file it came
+    from — and it needs metric-less points too (MULTICHIP_r01–r06 record
+    skipped rounds with a platform but no parsed value; the series
+    renders them instead of silently starting at r07). ``metric``
+    filters to matching points when given; points with no parsed metric
+    always survive the filter so skipped rounds stay visible.
+    """
+    points: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(repo_dir, pattern))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(data, dict):
+            continue
+        parsed = data.get("parsed") if isinstance(data.get("parsed"), dict) else {}
+        point_metric = parsed.get("metric")
+        if metric is not None and point_metric not in (None, metric):
+            continue
+        platform = parsed.get("platform")
+        if not isinstance(platform, dict):
+            platform = (
+                data.get("platform")
+                if isinstance(data.get("platform"), dict)
+                else None
+            )
+        profile = parsed.get("profile")
+        if not isinstance(profile, dict):
+            profile = (
+                data.get("profile")
+                if isinstance(data.get("profile"), dict)
+                else None
+            )
+        points.append(
+            {
+                "source": os.path.basename(path),
+                "metric": point_metric,
+                "value": (
+                    float(parsed["value"])
+                    if isinstance(parsed.get("value"), (int, float))
+                    else None
+                ),
+                "unit": parsed.get("unit"),
+                "platform": platform,
+                "profile": profile,
+                "parsed": parsed,
+            }
+        )
+    return points

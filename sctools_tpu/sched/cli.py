@@ -87,6 +87,7 @@ def _status(journal_dir: str, out, journal: Optional[Journal] = None) -> int:
     _print_serve_summary(journal, tasks, states, out)
     _print_efficiency_summary(journal_dir, out)
     _print_pulse_summary(journal_dir, out)
+    _print_profile_summary(journal_dir, out)
     _print_quarantined_records(journal_dir, out)
     if totals.get(QUARANTINED):
         return 2
@@ -300,6 +301,38 @@ def _print_efficiency_summary(journal_dir: str, out) -> None:
     except Exception:  # noqa: BLE001 - status must never die on telemetry
         # a torn/hand-edited registry is a telemetry problem, never a
         # reason to lose the journal status an operator came for
+        return
+    print(line, file=out)
+
+
+def _print_profile_summary(journal_dir: str, out) -> None:
+    """One scx-delta line when the run dir distills a complete profile.
+
+    The diagnosis pointer next to the raw telemetry lines: the per-leg
+    exposed wall the RunProfile folded from this run's rings, plus the
+    command that diffs it against any other run or the committed
+    trajectory. Post-run only (the distiller reads artifacts; a run
+    with no rings prints nothing).
+    """
+    from ..obs import delta
+
+    run_dir = os.path.dirname(os.path.abspath(journal_dir)) or "."
+    try:
+        profile = delta.profile_from_run_dir(run_dir)
+        if not profile["complete"]:
+            return
+        exposed = "  ".join(
+            f"{leg}={profile['legs'][leg]['exposed_s']:.2f}s"
+            for leg in delta.LEG_NAMES
+            if leg != "idle"
+        )
+        line = (
+            f"profile: {exposed} over {profile['kcells']:.1f} kcell(s) "
+            f"({profile['workers']} worker(s); "
+            "`python -m sctools_tpu.obs delta <A> <B>` to attribute a "
+            "regression)"
+        )
+    except Exception:  # noqa: BLE001 - status must never die on telemetry
         return
     print(line, file=out)
 

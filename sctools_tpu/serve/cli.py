@@ -84,6 +84,29 @@ def _cmd_worker(args, out) -> int:
             idle_timeout_s=args.idle_timeout,
             drain=args.drain,
         )
+        # scx-delta: distill this replica's RunProfile AFTER draining
+        # (strictly post-run — the serving hot path is untouched) and
+        # persist it beside the trace captures so `obs delta` can diff
+        # replicas/runs without re-deriving from rings. Telemetry-off
+        # runs leave no rings and write nothing; a distiller error must
+        # never fail a drained worker.
+        profile_path = None
+        try:
+            from ..obs import delta as _delta
+
+            run_dir = (
+                os.path.dirname(os.path.abspath(args.journal_dir)) or "."
+            )
+            profile = _delta.profile_from_run_dir(run_dir)
+            if profile["complete"]:
+                profile_path = _delta.write_profile(
+                    profile,
+                    os.path.join(
+                        run_dir, f"profile.{worker.worker_id}.json"
+                    ),
+                )
+        except Exception:  # noqa: BLE001 - summary must print regardless
+            profile_path = None
         print(
             json.dumps(
                 {
@@ -92,6 +115,7 @@ def _cmd_worker(args, out) -> int:
                     "first_result_s": worker.first_result_s,
                     "packs_run": worker.packs_run,
                     "packs_degraded": worker.packs_degraded,
+                    "profile": profile_path,
                 }
             ),
             file=out,

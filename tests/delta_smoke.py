@@ -1,0 +1,224 @@
+"""delta-smoke: the CI gate for scx-delta (`make delta-smoke`).
+
+Two REAL 2-worker runs of the chunk-metrics pipeline (the pulse-smoke
+scenario), telemetry on: run A with the default config, run B
+deliberately degraded on the feed side — ``SCTOOLS_TPU_PREFETCH_DEPTH=1``
+(no decode-ahead) plus a deterministic per-batch decode delay injected
+at the ``ingest.decode`` fault site (the stand-in for slow storage; the
+delay lands INSIDE the ring's timed decode window, so it is the decode
+leg's wall, not anonymous idle). The feed side's exposed wall grows and
+the pipeline bubble opens. Then the attribution engine is held to its
+contracts:
+
+- both run dirs distill COMPLETE RunProfiles (schema-valid, legs
+  folded from the rings, fingerprint stamped);
+- ``attribute_delta`` ranks the injected cause first: the top-ranked
+  suspect names the decode/h2d stage;
+- conservation: the attributed per-leg deltas sum to the end-to-end
+  delta within 10% (exact by construction for distilled profiles —
+  this catches bookkeeping drift, dropped legs, normalization bugs);
+- a cross-platform pair REFUSES loudly (structural diff, exit 3 from
+  the CLI) instead of fabricating a claim;
+- the ``obs delta`` CLI front door works on the persisted profiles
+  (text and --json), and ``--trajectory`` renders the repo's committed
+  series including the backfilled stub points.
+
+Profile distillation is strictly post-run: the workers run with
+exactly the same telemetry as pulse-smoke; nothing new rides the hot
+path.
+
+Exit 0 on success; any assertion failure is a gate failure.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "sched_worker.py"
+)
+
+
+def fail(message: str) -> None:
+    print(f"delta-smoke: FAIL: {message}", file=sys.stderr)
+    sys.exit(1)
+
+
+# run B's feed-side degradation: no decode-ahead, and every ring batch
+# pays a 0.6 s decode stall (delay@ingest.decode fires inside the timed
+# decode window, so the stall IS decode wall). ~2 chunk decodes per
+# worker x 2 workers ≈ +2.4 s of injected feed time — far above the
+# compute leg's compile/trace noise (±0.3 s), so the ranking assertion
+# is deterministic, not a coin flip.
+DEGRADED_ENV = {
+    "SCTOOLS_TPU_PREFETCH_DEPTH": "1",
+    "SCTOOLS_TPU_FAULTS": "delay@ingest.decode:secs=0.6,times=99",
+}
+
+
+def launch(workdir: str, process_id: int, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("SCTOOLS_TPU_FAULTS", None)
+    env.pop("SCTOOLS_TPU_PREFETCH_DEPTH", None)
+    env["SCTOOLS_TPU_TRACE"] = os.path.join(workdir, "obs")
+    env["SCTOOLS_TPU_TRACE_WORKER"] = f"p{process_id}"
+    env["SCTOOLS_TPU_PULSE"] = "1"
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, WORKER, workdir, str(process_id), "2", "5.0",
+         "3", "0.1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def run_fleet(workdir: str, bam: str, extra_env=None) -> None:
+    from sctools_tpu.platform import GenericPlatform
+
+    os.makedirs(workdir, exist_ok=True)
+    chunk_dir = os.path.join(workdir, "chunks")
+    os.makedirs(chunk_dir, exist_ok=True)
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", os.path.join(chunk_dir, "chunk"), "-s", "0.002",
+         "-t", "CB"]
+    )
+    n_chunks = len(glob.glob(os.path.join(chunk_dir, "*.bam")))
+    assert n_chunks >= 2, f"need >=2 chunks, got {n_chunks}"
+    procs = [
+        launch(workdir, 0, extra_env),
+        launch(workdir, 1, extra_env),
+    ]
+    for proc in procs:
+        out, _ = proc.communicate(timeout=300)
+        if proc.returncode != 0:
+            fail(f"worker exited {proc.returncode}:\n{out[-2000:]}")
+
+
+def main() -> int:
+    workdir = os.environ.get(
+        "SCTOOLS_TPU_DELTA_SMOKE_DIR"
+    ) or tempfile.mkdtemp(prefix="sctools_tpu_delta_smoke.")
+    os.makedirs(workdir, exist_ok=True)
+    bam = os.path.join(workdir, "input.bam")
+
+    from sched_smoke import make_input
+
+    from sctools_tpu.obs import delta, trajectory
+    from sctools_tpu.obs.__main__ import main as obs_cli
+
+    make_input(bam)
+    platform = trajectory.platform_fingerprint()
+
+    run_a = os.path.join(workdir, "run_a")
+    run_b = os.path.join(workdir, "run_b")
+    run_fleet(run_a, bam)
+    run_fleet(run_b, bam, extra_env=DEGRADED_ENV)
+
+    # ---- both run dirs distill complete, schema-valid profiles
+    profile_a = delta.profile_from_run_dir(
+        run_a, source="run_a", platform=platform
+    )
+    profile_b = delta.profile_from_run_dir(
+        run_b, source="run_b", platform=platform
+    )
+    for name, profile in (("run_a", profile_a), ("run_b", profile_b)):
+        problems = delta.validate_profile(profile)
+        if problems:
+            fail(f"{name} profile schema: {problems}")
+        if not profile["complete"]:
+            fail(f"{name} profile incomplete: {profile}")
+        if profile["workers"] < 2:
+            fail(f"{name}: expected 2 worker folds, got {profile['workers']}")
+    path_a = delta.write_profile(
+        profile_a, os.path.join(workdir, "profile_a.json")
+    )
+    path_b = delta.write_profile(
+        profile_b, os.path.join(workdir, "profile_b.json")
+    )
+    print(
+        "delta-smoke: profiles distilled "
+        f"(A {profile_a['heartbeats']} beat(s) "
+        f"{profile_a['kcells']:.2f} kcell, "
+        f"B {profile_b['heartbeats']} beat(s) "
+        f"{profile_b['kcells']:.2f} kcell)"
+    )
+
+    # ---- the injected cause is the top-ranked suspect, and the legs
+    # conserve. The degraded run's single-slot ring serializes the feed
+    # side: decode/h2d exposed wall must lead the ranking.
+    view = delta.attribute_delta(profile_a, profile_b, tolerance=0.10)
+    if not view["comparable"]:
+        fail(f"same-platform pair refused: {view['refusal']}")
+    print(delta.render_delta(view), end="")
+    if not view["conservation"]["conserved"]:
+        fail(
+            "leg deltas do not conserve to the end-to-end delta: "
+            f"{view['conservation']}"
+        )
+    suspects = view["suspects"]
+    if not suspects:
+        fail("degraded run produced no suspects")
+    top = suspects[0]
+    if not (top["kind"] == "leg" and top["name"] in ("decode", "h2d")):
+        fail(
+            "top suspect did not name the injected decode/h2d cause: "
+            f"{[(s['kind'], s['name']) for s in suspects[:4]]}"
+        )
+    print(f"delta-smoke: top suspect: {top['detail']}")
+
+    # ---- cross-platform refusal is loud, never a fabricated claim
+    foreign = dict(profile_b)
+    foreign["platform"] = {
+        "backend": "tpu9", "device_kind": "tpu9", "device_count": 64,
+    }
+    refused = delta.attribute_delta(profile_a, foreign)
+    if refused["comparable"] or not refused["refusal"]:
+        fail("cross-platform pair did not refuse")
+    if "end_to_end" in refused:
+        fail("refused pair still carried numeric end-to-end claims")
+
+    # ---- CLI front doors: profile pair (text + --json + exit codes),
+    # run-dir pair, and the committed trajectory series (stub points
+    # from the backfill must render, not be skipped)
+    if obs_cli(["delta", path_a, path_b]) != 0:
+        fail("obs delta <profileA> <profileB> exited non-zero")
+    if obs_cli(["delta", run_a, run_b, "--json"]) != 0:
+        fail("obs delta <runA> <runB> --json exited non-zero")
+    foreign_path = os.path.join(workdir, "foreign.json")
+    with open(foreign_path, "w") as f:
+        json.dump(foreign, f)
+    if obs_cli(["delta", path_a, foreign_path]) != 3:
+        fail("cross-platform CLI pair did not exit 3 (loud refusal)")
+    if obs_cli(["delta", "--trajectory", REPO_ROOT]) != 0:
+        fail("obs delta --trajectory exited non-zero")
+    traj = delta.trajectory_view(REPO_ROOT, pattern="BENCH_r*.json")
+    if not traj["points"]:
+        fail("trajectory view rendered no committed points")
+    stubs = [p for p in traj["points"] if not p["profile_complete"]]
+    if not stubs:
+        fail(
+            "no stub points in the committed series (backfill missing?)"
+        )
+    for point in stubs:
+        if point["delta"] is not None:
+            fail(f"stub point {point['source']} got a numeric delta")
+
+    print(
+        f"delta-smoke: OK (conservation error "
+        f"{view['conservation']['error']:.4f} <= 0.10, "
+        f"{len(traj['points'])} trajectory point(s) rendered, "
+        f"{len(stubs)} stub(s))"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

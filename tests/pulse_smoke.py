@@ -247,6 +247,69 @@ def main() -> int:
         if row["source"] != "ring":
             fail(f"{worker}: expected ring-sourced pulse, got {row}")
 
+    # ---- count-workload pulse gating (ROADMAP item 2's partial wiring,
+    # finished): count.py emits `count`/`count.sharded` heartbeats; a
+    # pulse-on CountMatrix run must land them in a ring with occupancy
+    # recorded and zero torn records. Small batch_records forces
+    # multiple dispatches so the heartbeat stream is a stream, not one
+    # beat.
+    count_dir = os.path.join(workdir, "count")
+    os.makedirs(count_dir, exist_ok=True)
+    count_env = dict(os.environ)
+    count_env["PYTHONPATH"] = (
+        REPO_ROOT + os.pathsep + count_env.get("PYTHONPATH", "")
+    )
+    count_env["JAX_PLATFORMS"] = "cpu"
+    count_env.pop("XLA_FLAGS", None)
+    count_env.pop("SCTOOLS_TPU_FAULTS", None)
+    count_env["SCTOOLS_TPU_TRACE"] = count_dir
+    count_env["SCTOOLS_TPU_TRACE_WORKER"] = "count0"
+    count_env["SCTOOLS_TPU_PULSE"] = "1"
+    count_script = (
+        "from sctools_tpu.count import CountMatrix\n"
+        f"cm = CountMatrix.from_sorted_tagged_bam({bam!r}, "
+        "{'G1': 0, 'G2': 1}, backend='device', batch_records=64)\n"
+        "assert cm.matrix.sum() > 0, 'count produced an empty matrix'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", count_script],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=count_env, timeout=300,
+    )
+    if proc.returncode != 0:
+        fail(f"count worker exited {proc.returncode}:\n{proc.stdout[-2000:]}")
+    count_rings = pulse.load_rings(count_dir)
+    if not count_rings:
+        fail("count run wrote no pulse ring")
+    count_records = [
+        record
+        for ring in count_rings.values()
+        for record in ring["records"]
+        if record["stage"] == "count"
+    ]
+    if not count_records:
+        fail(
+            "no `count` heartbeats in the ring; stages seen: "
+            f"{sorted({r['stage'] for ring in count_rings.values() for r in ring['records']})}"
+        )
+    for ring_worker, ring in count_rings.items():
+        if ring["torn"]:
+            fail(
+                f"count ring {ring_worker}: {ring['torn']} torn "
+                "record(s) after clean exit"
+            )
+    occupancy_beats = [
+        r for r in count_records if r["padded_rows"] and r["real_rows"]
+    ]
+    if not occupancy_beats:
+        fail("count heartbeats carry no real/padded occupancy rows")
+    if not any(r["entities"] for r in count_records):
+        fail("count heartbeats attribute no entities (cells)")
+    print(
+        f"pulse-smoke: count pass OK ({len(count_records)} `count` "
+        f"heartbeat(s), occupancy recorded on {len(occupancy_beats)})"
+    )
+
     print(
         f"pulse-smoke: OK ({total_heartbeats} heartbeat(s), "
         f"{len(rings)} ring(s), bubble "

@@ -1,0 +1,389 @@
+"""scx-delta: RunProfile schema pin, conservation, refusal, CLI, bench
+--check attribution.
+
+Covers the contracts docs/observability.md ("scx-delta") documents: the
+schema-pinned profile artifact (EXACT key set — growing it is a
+conscious, versioned act), the conservation property (per-leg deltas sum
+to the end-to-end delta, exact by construction for distilled profiles),
+the fingerprint-aware refusal (cross-platform pairs degrade loudly to a
+structural diff, never a fabricated speedup claim), the ``obs delta``
+CLI exit-code taxonomy (0 attribution / 2 unreadable / 3 refusal), and
+``bench.py --check`` printing a named suspect instead of a bare exit 4.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from sctools_tpu.obs import delta, trajectory
+from sctools_tpu.obs.__main__ import main as obs_cli
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FP = {"backend": "cpu", "device_kind": "cpu", "device_count": 1}
+FP_OTHER = {"backend": "tpu", "device_kind": "TPU v9", "device_count": 64}
+
+
+# ------------------------------------------------------------ schema pin
+
+
+def test_profile_schema_exact_key_set():
+    profile = delta.synthetic_profile({"compute": 1.0}, platform=FP)
+    assert delta.validate_profile(profile) == []
+    assert set(profile) == set(delta.PROFILE_SCHEMA)
+    for leg, row in profile["legs"].items():
+        assert set(row) == set(delta.LEG_SCHEMA), leg
+
+
+def test_profile_schema_types_pinned():
+    profile = delta.synthetic_profile({"compute": 1.0}, platform=FP)
+    for key, types in delta.PROFILE_SCHEMA.items():
+        assert isinstance(profile[key], types), key
+
+
+def test_validate_rejects_extra_and_missing_keys():
+    profile = delta.synthetic_profile({"compute": 1.0})
+    profile["speedup_promise"] = 2.0
+    assert any(
+        "unknown key: speedup_promise" in p
+        for p in delta.validate_profile(profile)
+    )
+    del profile["speedup_promise"]
+    del profile["wall_s"]
+    assert any(
+        "missing key: wall_s" in p for p in delta.validate_profile(profile)
+    )
+
+
+def test_validate_rejects_wrong_leg_set_and_version():
+    profile = delta.synthetic_profile({"compute": 1.0})
+    profile["legs"].pop("idle")
+    assert any("legs:" in p for p in delta.validate_profile(profile))
+    profile = delta.synthetic_profile({"compute": 1.0})
+    profile["profile_version"] = 99
+    assert any(
+        "profile_version" in p for p in delta.validate_profile(profile)
+    )
+
+
+def test_stub_profile_is_schema_valid_but_incomplete():
+    stub = delta.stub_profile(
+        "BENCH_r01.json", platform=FP, metric="cells_per_s", value=100.0
+    )
+    assert delta.validate_profile(stub) == []
+    assert not stub["complete"]
+    assert all(not row["available"] for row in stub["legs"].values())
+
+
+def test_committed_trajectory_points_carry_valid_stub_profiles():
+    """The backfill satellite: every committed BENCH_r*/MULTICHIP_r*
+    point must carry a schema-valid profile so --trajectory renders the
+    full series."""
+    points = trajectory.load_trajectory_points(
+        REPO_ROOT, pattern="BENCH_r*.json"
+    ) + trajectory.load_trajectory_points(
+        REPO_ROOT, pattern="MULTICHIP_r*.json"
+    )
+    assert len(points) >= 13
+    for point in points:
+        assert isinstance(point["profile"], dict), point["source"]
+        assert delta.validate_profile(point["profile"]) == [], point["source"]
+        assert point["profile"]["platform"], point["source"]
+
+
+def test_write_profile_round_trips(tmp_path):
+    profile = delta.synthetic_profile({"compute": 2.0, "h2d": 0.5},
+                                      platform=FP)
+    path = delta.write_profile(profile, str(tmp_path / "p.json"))
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded == profile
+    assert delta.profile_from_result(loaded, source="x")["wall_s"] == 2.5
+
+
+def test_profile_from_result_sniffs_wrapper_and_stub():
+    profile = delta.synthetic_profile({"compute": 1.0}, platform=FP)
+    wrapped = {"parsed": {"metric": "m", "profile": profile}}
+    assert delta.profile_from_result(wrapped)["complete"]
+    bare = {"metric": "cells_per_s", "value": 5.0, "platform": FP}
+    stub = delta.profile_from_result(bare)
+    assert delta.validate_profile(stub) == []
+    assert not stub["complete"]
+
+
+# ---------------------------------------------------------- conservation
+
+
+# (exposed legs) mixes: fully serialized, feed-hidden, idle-heavy
+LEG_MIXES = [
+    {"decode": 0.4, "h2d": 0.2, "compute": 1.0, "d2h": 0.1},
+    {"decode": 0.0, "h2d": 0.1, "compute": 2.0, "d2h": 0.2, "overlap": 0.9},
+    {"compute": 1.5, "idle": 0.8},
+    {"decode": 1.2, "h2d": 0.4, "compute": 0.3, "d2h": 0.1, "overlap": 0.2,
+     "idle": 0.3},
+]
+
+
+@pytest.mark.parametrize("mix_a", LEG_MIXES)
+@pytest.mark.parametrize("mix_b", LEG_MIXES)
+def test_conservation_exact_for_synthetic_profiles(mix_a, mix_b):
+    a = delta.synthetic_profile(mix_a, kcells=2.0, platform=FP)
+    b = delta.synthetic_profile(mix_b, kcells=3.0, platform=FP)
+    view = delta.attribute_delta(a, b)
+    assert view["comparable"]
+    con = view["conservation"]
+    assert con["conserved"]
+    # view numbers are rounded to 6 decimals, so "exact" means within
+    # one rounding ulp per leg
+    assert con["error"] == pytest.approx(0.0, abs=1e-4)
+    assert sum(
+        row["delta_s_per_kcell"] for row in view["legs"].values()
+    ) == pytest.approx(con["end_to_end_delta_s_per_kcell"], abs=1e-5)
+
+
+def make_record(legs, entities=100):
+    return {"legs": legs, "entities": entities}
+
+
+@pytest.mark.parametrize(
+    "records",
+    [
+        # serialized: decode then h2d then compute then d2h
+        [make_record({"decode": (0.0, 0.4), "h2d": (0.4, 0.6),
+                      "compute": (0.6, 1.6), "d2h": (1.6, 1.7)})],
+        # overlapped: decode/h2d hidden under compute
+        [make_record({"decode": (0.0, 0.4), "h2d": (0.2, 0.6),
+                      "compute": (0.1, 1.4), "d2h": (1.4, 1.5)})],
+        # pipelined across heartbeats with an idle gap
+        [
+            make_record({"decode": (0.0, 0.2), "h2d": (0.2, 0.3),
+                         "compute": (0.3, 0.9), "d2h": (0.9, 1.0)}),
+            make_record({"decode": (0.5, 0.8), "h2d": (0.8, 0.95),
+                         "compute": (1.4, 2.0), "d2h": (2.0, 2.1)}),
+        ],
+    ],
+)
+def test_wall_equals_leg_sum_for_distilled_records(records):
+    """The 6-leg design: overlap + idle close the books EXACTLY."""
+    profile = delta.profile_from_records(records, platform=FP)
+    assert profile["complete"]
+    leg_sum = sum(
+        row["exposed_s"] for row in profile["legs"].values()
+    )
+    assert leg_sum == pytest.approx(profile["wall_s"], abs=1e-6)
+
+
+def test_conservation_flags_hand_edited_profile():
+    a = delta.synthetic_profile({"compute": 1.0}, platform=FP)
+    b = delta.synthetic_profile({"compute": 2.0}, platform=FP)
+    b["wall_s"] = 5.0  # books no longer balance
+    view = delta.attribute_delta(a, b)
+    assert not view["conservation"]["conserved"]
+
+
+# ------------------------------------------------- suspects and ranking
+
+
+def test_feed_regression_ranks_feed_leg_first():
+    a = delta.synthetic_profile(
+        {"decode": 0.05, "h2d": 0.02, "compute": 0.30, "d2h": 0.03,
+         "overlap": 0.10},
+        platform=FP,
+    )
+    b = delta.synthetic_profile(
+        {"decode": 0.60, "h2d": 0.04, "compute": 0.32, "d2h": 0.03,
+         "overlap": 0.02},
+        platform=FP,
+    )
+    view = delta.attribute_delta(a, b)
+    assert view["suspects"][0]["name"] == "decode"
+    assert "bubble" in view["suspects"][0]["detail"]
+    assert delta.top_suspect(view)
+
+
+def test_site_occupancy_drop_and_retraces_become_suspects():
+    sites_a = {"gatherer.dispatch": {
+        "compiles": 1, "retraces": 0, "dispatches": 10, "occupancy": 0.99,
+        "real_rows": 990, "padded_rows": 1000, "est_flops_total": 1e9,
+    }}
+    sites_b = {"gatherer.dispatch": {
+        "compiles": 1, "retraces": 3, "dispatches": 10, "occupancy": 0.41,
+        "real_rows": 410, "padded_rows": 1000, "est_flops_total": 1e9,
+    }}
+    a = delta.synthetic_profile({"compute": 1.0}, platform=FP,
+                                sites=sites_a)
+    b = delta.synthetic_profile({"compute": 1.3}, platform=FP,
+                                sites=sites_b)
+    view = delta.attribute_delta(a, b)
+    kinds = {s["kind"] for s in view["suspects"]}
+    assert "site_occupancy" in kinds
+    assert "site_retraces" in kinds
+    occ = next(s for s in view["suspects"] if s["kind"] == "site_occupancy")
+    assert "0.99→0.41" in occ["detail"]
+
+
+# -------------------------------------------------------------- refusal
+
+
+def test_cross_platform_pair_refuses_without_numbers():
+    a = delta.synthetic_profile({"compute": 1.0}, platform=FP)
+    b = delta.synthetic_profile({"compute": 0.1}, platform=FP_OTHER)
+    view = delta.attribute_delta(a, b)
+    assert not view["comparable"]
+    assert "platform" in view["refusal"]
+    assert "end_to_end" not in view
+    assert "legs" not in view
+    assert view["suspects"] == []
+    assert view["structural"]["platform_b"] == FP_OTHER
+
+
+def test_stub_profile_pair_refuses():
+    a = delta.stub_profile("old", platform=FP, value=1.0)
+    b = delta.synthetic_profile({"compute": 1.0}, platform=FP)
+    assert not delta.attribute_delta(a, b)["comparable"]
+    assert not delta.attribute_delta(b, a)["comparable"]
+
+
+def test_missing_fingerprint_refuses():
+    a = delta.synthetic_profile({"compute": 1.0})
+    b = delta.synthetic_profile({"compute": 2.0})
+    view = delta.attribute_delta(a, b)
+    assert not view["comparable"]
+    assert "fingerprint" in view["refusal"]
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def cli(args, capsys):
+    code = obs_cli(args)
+    captured = capsys.readouterr()
+    return code, captured.out, captured.err
+
+
+def write(tmp_path, name, profile):
+    return delta.write_profile(profile, str(tmp_path / name))
+
+
+def test_cli_pair_json_and_exit_zero(tmp_path, capsys):
+    a = write(tmp_path, "a.json",
+              delta.synthetic_profile({"compute": 1.0}, platform=FP))
+    b = write(tmp_path, "b.json",
+              delta.synthetic_profile({"compute": 2.0, "decode": 0.5},
+                                      platform=FP))
+    code, out, _ = cli(["delta", a, b, "--json"], capsys)
+    assert code == 0
+    view = json.loads(out)
+    assert view["kind"] == delta.DELTA_KIND
+    assert view["comparable"]
+    assert view["conservation"]["conserved"]
+    code, out, _ = cli(["delta", a, b], capsys)
+    assert code == 0
+    assert "conservation" in out
+    assert "suspect" in out
+
+
+def test_cli_refusal_exits_three(tmp_path, capsys):
+    a = write(tmp_path, "a.json",
+              delta.synthetic_profile({"compute": 1.0}, platform=FP))
+    b = write(tmp_path, "b.json",
+              delta.synthetic_profile({"compute": 1.0}, platform=FP_OTHER))
+    code, out, _ = cli(["delta", a, b], capsys)
+    assert code == 3
+    assert "NOT COMPARABLE" in out
+
+
+def test_cli_unreadable_operand_exits_two(tmp_path, capsys):
+    a = write(tmp_path, "a.json",
+              delta.synthetic_profile({"compute": 1.0}, platform=FP))
+    code, _, err = cli(["delta", a, str(tmp_path / "missing.json")], capsys)
+    assert code == 2
+    assert "cannot read" in err
+
+
+def test_cli_wrong_operand_count_exits_two(tmp_path, capsys):
+    code, _, err = cli(["delta"], capsys)
+    assert code == 2
+    assert "exactly two operands" in err
+
+
+def test_cli_trajectory_renders_committed_series(capsys):
+    code, out, _ = cli(["delta", "--trajectory", REPO_ROOT], capsys)
+    assert code == 0
+    assert "BENCH_r01.json" in out
+    assert "legs unavailable" in out
+    code, out, _ = cli(
+        ["delta", "--trajectory", REPO_ROOT, "--pattern",
+         "MULTICHIP_r*.json", "--json"],
+        capsys,
+    )
+    assert code == 0
+    view = json.loads(out)
+    assert len(view["points"]) == 7
+
+
+def test_cli_trajectory_empty_dir_exits_two(tmp_path, capsys):
+    code, _, err = cli(["delta", "--trajectory", str(tmp_path)], capsys)
+    assert code == 2
+
+
+# ------------------------------------------------- bench --check wiring
+
+
+def bench_module():
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    return bench
+
+
+def test_trajectory_helpers_shared_with_bench():
+    bench = bench_module()
+    assert bench.load_trajectory is trajectory.load_trajectory
+    assert bench._platform_fingerprint is trajectory.platform_fingerprint
+
+
+def test_regression_attribution_names_suspect(tmp_path):
+    bench = bench_module()
+    baseline = delta.synthetic_profile(
+        {"decode": 0.05, "h2d": 0.02, "compute": 0.30, "d2h": 0.03,
+         "overlap": 0.10},
+        platform=FP, metric="cells_per_s", value=2000.0,
+    )
+    point = {
+        "n": 1, "cmd": "x", "rc": 0, "tail": [],
+        "parsed": {"metric": "cells_per_s", "value": 2000.0,
+                   "unit": "cells/sec", "platform": FP,
+                   "profile": baseline},
+    }
+    with open(tmp_path / "BENCH_r01.json", "w") as f:
+        json.dump(point, f)
+    regressed = {
+        "metric": "cells_per_s", "value": 400.0, "unit": "cells/sec",
+        "platform": FP,
+        "profile": delta.synthetic_profile(
+            {"decode": 0.9, "h2d": 0.04, "compute": 0.32, "d2h": 0.03},
+            platform=FP, metric="cells_per_s", value=400.0,
+        ),
+    }
+    verdict = bench._regression_attribution(
+        regressed, "cells_per_s", FP, str(tmp_path)
+    )
+    assert verdict["comparable"]
+    assert verdict["suspects"][0]["name"] == "decode"
+    # profileless result: attribution degrades loudly, never invents
+    bare = {"metric": "cells_per_s", "value": 400.0, "platform": FP}
+    unavailable = bench._regression_attribution(
+        bare, "cells_per_s", FP, str(tmp_path)
+    )
+    assert "unavailable" in unavailable
+
+
+def test_check_selftest_covers_attribution():
+    """The acceptance tooth: the selftest battery (run by perf-gate)
+    includes the attribution case — a synthetic trajectory regression
+    must produce a comparable verdict naming the injected decode leg."""
+    bench = bench_module()
+    assert bench.check_selftest(REPO_ROOT) == 0
