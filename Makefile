@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck aotcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke delta-smoke mesh-smoke serve-smoke elastic-smoke steer-smoke perf-gate docs clean
+.PHONY: ci ci-deep native native-tsan native-asan native-ubsan lint racecheck shardcheck lifecheck costcheck meshcheck aotcheck modelcheck test test-threads tpu-test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke delta-smoke mesh-smoke serve-smoke elastic-smoke steer-smoke audit-smoke perf-gate docs clean
 
-ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke delta-smoke mesh-smoke serve-smoke steer-smoke perf-gate
+ci: native lint modelcheck test obs-smoke sched-smoke fleet-smoke xprof-smoke ingest-smoke guard-smoke pulse-smoke delta-smoke mesh-smoke serve-smoke steer-smoke audit-smoke perf-gate
 
 native:
 	$(MAKE) -C sctools_tpu/native
@@ -205,6 +205,19 @@ pulse-smoke:
 	rm -rf /tmp/sctools_tpu_pulse_smoke
 	JAX_PLATFORMS=cpu SCTOOLS_TPU_PULSE_SMOKE_DIR=/tmp/sctools_tpu_pulse_smoke \
 	$(PY) tests/pulse_smoke.py
+
+# record-conservation gate: a 2-worker run under crash + steal +
+# corrupt_record must audit to EXACT conservation (`obs audit` exit 0,
+# 0 unexplained records) with the quarantine sidecar ranges matching
+# the audit's loss set record for record, `obs explain` must resolve a
+# quarantined record, the stolen task's two attempts, and an emitted
+# barcode to its output file:row, and deleting the sidecars must flip
+# the SAME run to UNBALANCED (tests/audit_smoke.py;
+# docs/observability.md "scx-audit").
+audit-smoke:
+	rm -rf /tmp/sctools_tpu_audit_smoke
+	JAX_PLATFORMS=cpu SCTOOLS_TPU_AUDIT_SMOKE_DIR=/tmp/sctools_tpu_audit_smoke \
+	$(PY) tests/audit_smoke.py
 
 # regression-attribution gate: two real 2-worker runs, the second
 # deliberately degraded on the feed side (SCTOOLS_TPU_PREFETCH_DEPTH=1

@@ -84,6 +84,12 @@ Prints ONE JSON line. Flags:
               lost_jobs == 0. The steer controller's off-mode cost is
               also measured every run and gated <= 1.02
               (steer_overhead), like the guard/frame/pulse/slo planes.
+              The scx-audit conservation ledger is gated twice: its
+              ALWAYS-ON append cost <= 1.02 (audit_overhead — there is
+              no off mode, record accounting is not opt-in), and the
+              serve scenario's `obs audit` over the drained workdir
+              must balance exactly — unexplained_records == 0
+              (audit_conservation_exact).
   --check-selftest  verify the gate's own semantics against synthetic
               degraded/healthy results and exit (cheap; `make ci` leg)
 """
@@ -179,6 +185,12 @@ SERVE_BATCH_RECORDS = 4096  # the RECORD_BUCKET_MIN floor
 # that presence-but-off cost rides every admitted group, gated exactly
 # like the pulse/slo planes
 STEER_OVERHEAD_CEILING = 1.02
+# scx-audit ledger ceiling: the conservation ledger is ALWAYS ON (record
+# accounting is not an opt-in plane), so unlike the off-mode ceilings
+# above this gates the INSTRUMENTED cost — the per-batch integer adds the
+# ring/gatherer/writer make must cost <= 2% of a representative batch
+AUDIT_OVERHEAD_CEILING = 1.02
+
 # scx-steer steered-serving occupancy floor: with the controller armed
 # and the warmup ladder calibrated, the steered replica must hold
 # padding occupancy at or above 0.5 under multi-tenant traffic — well
@@ -1265,6 +1277,52 @@ def bench_steer_overhead(rounds: int = 3, calls: int = 80) -> dict:
     }
 
 
+def bench_audit_overhead(rounds: int = 3, calls: int = 80) -> dict:
+    """Hot-path cost of the scx-audit conservation ledger, per batch.
+
+    Same interleaved shape and min-across-repeats summary as the
+    guard/frame/pulse/slo/steer legs, but the ledger has no off mode —
+    conservation accounting is unconditional — so this measures the
+    INSTRUMENTED cost directly: the instrumented leg runs the per-batch
+    add sequence the pipeline makes (ingested at the ring handoff,
+    decoded at the consumer, computed at the guard dispatch, the
+    rows.computed/rows.emitted pair at finalize/write) around a
+    numpy-sort work unit; the direct leg runs the work unit alone. The
+    ``audit_overhead <= 1.02`` gate holds that cost: integer adds under
+    one lock per BATCH, never per record.
+    """
+    import numpy as np
+
+    from sctools_tpu.obs import audit as auditmod
+
+    payload = np.arange(1 << 19, dtype=np.int32)[::-1].copy()
+
+    def work() -> int:
+        return int(np.sort(payload)[0])
+
+    def audited() -> int:
+        auditmod.add("records.ingested", 1 << 19, task_id="bench")
+        auditmod.add("records.decoded", 1 << 19, task_id="bench")
+        auditmod.add("records.computed", 1 << 19, task_id="bench")
+        value = work()
+        auditmod.add("rows.computed", 1 << 10, task_id="bench")
+        auditmod.add("rows.emitted", 1 << 10, task_id="bench")
+        return value
+
+    work()
+    audited()
+    try:
+        ratios = _interleaved_ratios(work, audited, rounds, calls)
+    finally:
+        auditmod.discard("bench")
+    return {
+        "overhead": _summarize_overhead_ratios(ratios),
+        "ratios": [round(r, 4) for r in ratios],
+        "rounds": rounds,
+        "calls_per_round": calls,
+    }
+
+
 def _percentile(values, q: float):
     """Nearest-rank percentile of a small sample; None when empty."""
     ordered = sorted(values)
@@ -1402,6 +1460,13 @@ def bench_serve() -> dict:
     # heartbeat recorded must land on some job's bill
     view = slo.stitch_run(workdir)
     fleet = view["fleet"]
+    # scx-audit conservation over BOTH phases' journals: every row a
+    # worker emitted must be claimed by an output entity, with zero
+    # unexplained records — --check gates audit_conservation_exact
+    from sctools_tpu.obs import audit as auditmod
+
+    audit_report = auditmod.audit_run(workdir)
+    audit_fleet = audit_report["fleet"]
     return {
         "tenants": SERVE_TENANTS,
         "jobs": 2 * SERVE_TENANTS,
@@ -1423,6 +1488,13 @@ def bench_serve() -> dict:
         ),
         "retraces": retraces,
         "steer": steer_leg,
+        "audit": {
+            "exact": audit_fleet["exact"],
+            "unexplained": audit_fleet["unexplained"],
+            "rows_emitted": audit_fleet["rows"]["emitted"],
+            "records_decoded": audit_fleet["records"]["decoded"],
+            "jobs_audited": audit_fleet["tasks_audited"],
+        },
         "slo": {
             "trace_complete": fleet["complete_fraction"],
             "unattributed_device_s": fleet["unattributed_device_s"],
@@ -1882,6 +1954,21 @@ def check_result(
                 value=round(float(gated), 4),
                 ceiling=STEER_OVERHEAD_CEILING,
             )
+    # scx-audit ledger cost, held whenever the result carries the
+    # microbench: the conservation ledger has no off mode — its
+    # per-batch integer adds ride the ring handoff, the guard dispatch,
+    # and the writer — so the INSTRUMENTED cost itself is gated to the
+    # same <= 2% ceiling as the off-mode planes
+    audit_info = result.get("audit")
+    if isinstance(audit_info, dict):
+        gated = _gated_overhead(audit_info)
+        if isinstance(gated, (int, float)):
+            add(
+                "audit_overhead",
+                gated <= AUDIT_OVERHEAD_CEILING,
+                value=round(float(gated), 4),
+                ceiling=AUDIT_OVERHEAD_CEILING,
+            )
     # scx-pulse bubble attribution, held whenever the result carries it:
     # the measured share of the bench window where the device leg idled
     # while decode/transfer ran uncovered. Above the ceiling, the
@@ -1945,6 +2032,23 @@ def check_result(
                 add(
                     "serve_unattributed_device_s", unattributed == 0,
                     value=unattributed, ceiling=0,
+                )
+        # scx-audit conservation gate, held whenever the serve result
+        # carries the audit fold: the serving plane must account for
+        # every record EXACTLY — one unexplained record means rows were
+        # created or lost somewhere the ledger cannot name, the failure
+        # mode the conservation plane exists to make un-hideable
+        serve_audit = serve.get("audit")
+        if isinstance(serve_audit, dict):
+            unexplained = serve_audit.get("unexplained")
+            if isinstance(unexplained, (int, float)):
+                add(
+                    "audit_conservation_exact",
+                    unexplained == 0,
+                    value=unexplained,
+                    ceiling=0,
+                    rows_emitted=serve_audit.get("rows_emitted"),
+                    jobs_audited=serve_audit.get("jobs_audited"),
                 )
         # scx-steer steered-serving gates, held whenever the serve
         # result carries the steered leg: the armed controller must
@@ -2119,6 +2223,21 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         "metric": metric, "value": reference, "vs_baseline": 5.0,
         "steer": {"overhead": 1.3, "steer_on": True},
     }
+    # scx-audit ledger cost: always-on (no skip mode), so a heavy
+    # instrumented cost fails and a light one passes — and the gate
+    # shares the ratios-min contention rejection
+    audit_heavy = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "audit": {"overhead": 1.2},
+    }
+    audit_light = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "audit": {"overhead": 1.004},
+    }
+    audit_contended = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "audit": {"overhead": 1.05, "ratios": [1.05, 1.01, 1.09]},
+    }
     # scx-pulse bubble attribution: a pipeline whose device leg idles
     # behind uncovered decode/transfer most of the window must fail
     bubbly = {
@@ -2170,6 +2289,29 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         "serve": {
             "ttfr_speedup": 8.0, "lost_jobs": 0, "retraces": 0,
             "slo": {"trace_complete": 1.0, "unattributed_device_s": 0},
+        },
+    }
+    # scx-audit conservation gate: one unexplained record is fatal —
+    # the conservation contract is exact or it is broken; the exact
+    # shape passes
+    serve_leaky_ledger = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "serve": {
+            "ttfr_speedup": 8.0, "lost_jobs": 0, "retraces": 0,
+            "audit": {
+                "exact": False, "unexplained": 1, "rows_emitted": 2048,
+                "jobs_audited": 8,
+            },
+        },
+    }
+    serve_conserved = {
+        "metric": metric, "value": reference, "vs_baseline": 5.0,
+        "serve": {
+            "ttfr_speedup": 8.0, "lost_jobs": 0, "retraces": 0,
+            "audit": {
+                "exact": True, "unexplained": 0, "rows_emitted": 2048,
+                "jobs_audited": 8,
+            },
         },
     }
     # scx-steer steered-serving gates: an armed controller that LEFT
@@ -2378,6 +2520,15 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         failures.append(
             "steering-on overhead was gated (ceiling is off-mode only)"
         )
+    if check_result(audit_heavy, repo_dir)["ok"]:
+        failures.append("over-ceiling audit ledger overhead passed the gate")
+    if not check_result(audit_light, repo_dir)["ok"]:
+        failures.append("healthy audit ledger overhead failed the gate")
+    if not check_result(audit_contended, repo_dir)["ok"]:
+        failures.append(
+            "audit overhead with one clean round failed the gate "
+            "(ratios-min not applied to the audit gate)"
+        )
     if check_result(bubbly, repo_dir)["ok"]:
         failures.append("bubble-bound pipeline (0.8) passed the gate")
     if not check_result(streaming, repo_dir)["ok"]:
@@ -2402,6 +2553,12 @@ def check_selftest(repo_dir: str = REPO_DIR) -> int:
         )
     if not check_result(serve_stitched, repo_dir)["ok"]:
         failures.append("fully-stitched serve result failed the gate")
+    if check_result(serve_leaky_ledger, repo_dir)["ok"]:
+        failures.append(
+            "serve result with an unexplained record passed the gate"
+        )
+    if not check_result(serve_conserved, repo_dir)["ok"]:
+        failures.append("exactly-conserved serve result failed the gate")
     if check_result(serve_steer_padded, repo_dir)["ok"]:
         failures.append(
             "steered serve that left occupancy floor-padded (0.42) passed"
@@ -2553,14 +2710,16 @@ def main(argv=None):
         result["serve"] = bench_serve()
     # always measured (cheap): the guard ladder's no-fault cost, the
     # frame witness's off-mode handout cost, the pulse plane's off-mode
-    # heartbeat cost, the slo probe's off-mode cost, and the steer
-    # controller's off-mode cost ride the trajectory so --check can
-    # hold each to its <= 2% ceiling
+    # heartbeat cost, the slo probe's off-mode cost, the steer
+    # controller's off-mode cost, and the audit ledger's ALWAYS-ON
+    # append cost ride the trajectory so --check can hold each to its
+    # <= 2% ceiling
     result["guard"] = bench_guard_overhead()
     result["frame"] = bench_frame_overhead()
     result["pulse"] = bench_pulse_overhead()
     result["slo"] = bench_slo_overhead()
     result["steer"] = bench_steer_overhead()
+    result["audit"] = bench_audit_overhead()
     # scx-delta: distill the canonical RunProfile from the timed runs'
     # heartbeats + the gate values just assembled, embed it in the
     # result (the driver commits the parsed result as BENCH_rNN.json,

@@ -42,7 +42,10 @@ MODULE_CLIS = (
     (
         "python -m sctools_tpu.obs",
         "sctools_tpu.obs.__main__",
-        ("summarize", "timeline", "efficiency", "pulse", "slo", "delta"),
+        (
+            "summarize", "timeline", "efficiency", "pulse", "slo",
+            "delta", "audit", "explain",
+        ),
     ),
     (
         "python -m sctools_tpu.sched",

@@ -498,10 +498,23 @@ class CountMatrix:
             want_qname=True,
             tag_keys=tag_keys,
         )
+
+        def counted(stream):
+            # conservation ledger: each ring frame enters the counting
+            # path exactly once here (carry/slice below conserve), so
+            # the audit balances decoded == computed + quarantined
+            from .obs import audit
+
+            for decoded in stream:
+                # int() detaches the scalar from the frame for
+                # scx-life: the ledger retains a count, never a view
+                audit.add("records.decoded", int(decoded.n_records))
+                yield decoded
+
         carry = None
         offset = 0
         multi_batch = False
-        iterator = iter(frames)
+        iterator = iter(counted(frames))
         frame = next(iterator, None)
         while frame is not None:
             if carry is not None:

@@ -48,6 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import obs
 from ..analysis.witness import make_lock
+from ..obs import audit
 from . import degrade, quarantine, watchdog
 from .errors import (
     FATAL,
@@ -510,10 +511,15 @@ def _attempt_range(
                 _device_fault(site, name)
                 value = fn(filtered, offset)
                 done = True
+            # conservation ledger: these records were ACTUALLY computed
+            # (post poison-filter, post bisection) — counted only on
+            # dispatch success, so a retried attempt never double-counts
+            audit.add("records.computed", filtered.n_records)
             results.append(value)
             return
         except Exception as error:  # noqa: BLE001 - classified below
             if done and isinstance(error, Stall):
+                audit.add("records.computed", filtered.n_records)
                 results.append(value)
                 return
             kind = classify(error)

@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional
 
 from .. import obs
 from ..analysis.witness import make_lock
+from ..obs import audit
 
 ENV_DIR = "SCTOOLS_TPU_GUARD_QUARANTINE"
 
@@ -85,6 +86,14 @@ def record_quarantine(
     """
     obs.count("guard_quarantined_ranges")
     obs.count("guard_poison_records", max(0, record_stop - record_start))
+    # conservation ledger: every quarantined record is a NAMED loss (the
+    # reason's exception class), so the audit report balances decoded ==
+    # computed + quarantined and never reads the drop as unexplained
+    audit.add(
+        "records.quarantined",
+        max(0, record_stop - record_start),
+        reason=reason.split(":", 1)[0].strip() or "unknown",
+    )
     context = obs.get_context()
     entry = {
         "task": context.get("task"),

@@ -61,7 +61,7 @@ import threading
 from typing import Iterable, Iterator, Optional
 
 from .. import obs
-from ..obs import pulse
+from ..obs import audit, pulse
 from ..analysis.witness import make_lock
 from ..guard import degrade
 from ..guard.errors import NativeDecodeError
@@ -123,15 +123,27 @@ def ring_slots(depth: Optional[int] = None) -> int:
     return depth + 1 + _CONSUMER_SLOTS
 
 
-def _wrap_source(source: Iterable[ReadFrame], depth: int) -> Iterator[ReadFrame]:
+def _counted_ingest(source: Iterable[ReadFrame]) -> Iterator[ReadFrame]:
+    """Ledger tap: count records the ring hands off (conservation audit)."""
+    for frame in source:
+        audit.add("records.ingested", frame.n_records)
+        yield frame
+
+
+def _wrap_source(
+    source: Iterable[ReadFrame], depth: int, audited: bool = True
+) -> Iterator[ReadFrame]:
     """The fallback ring: Python-decoded frames behind the prefetch queue."""
+    if audited:
+        source = _counted_ingest(source)
     return guarded_iter(
         prefetch_iterator(
             # pulse sees each decoded batch's wall interval even on the
             # Python-decoder path (the native path notes it explicitly)
             pulse.iter_decode(
                 obs.iter_spans(
-                    "decode", source, records=lambda f: f.n_records
+                    "decode", source,
+                    records=lambda f: f.n_records,
                 )
             ),
             depth=depth,
@@ -140,7 +152,10 @@ def _wrap_source(source: Iterable[ReadFrame], depth: int) -> Iterator[ReadFrame]
     )
 
 
-def _produce_arena_frames(stream, arenas, batch_records: int, want_qname: bool):
+def _produce_arena_frames(
+    stream, arenas, batch_records: int, want_qname: bool,
+    audited: bool = True,
+):
     """Cycle the ring's arenas, filling one per decoded batch (producer side).
 
     Runs on the prefetch thread: the ``decode`` spans here time actual
@@ -204,6 +219,14 @@ def _produce_arena_frames(stream, arenas, batch_records: int, want_qname: bool):
                         str(error), batch_index=k, record_offset=consumed
                     ) from error
                 sp.add(records=n)
+            # conservation ledger: records the ring HANDED OFF — the
+            # consumer's records.decoded must match exactly (a dropped
+            # or duplicated frame shows up as audit skew, not silence).
+            # audited=False marks an INNER ring feeding another ring
+            # (the serve packer's per-member streams): only the outer
+            # handoff counts, or every record would ledger twice
+            if audited:
+                audit.add("records.ingested", n)
             if pulse.enabled():
                 # the heartbeat of the dispatch that consumes this batch
                 # adopts the interval (pulse.Heartbeat.decode_from_ring)
@@ -258,6 +281,7 @@ def ring_frames(
     source: Optional[Iterable[ReadFrame]] = None,
     depth: Optional[int] = None,
     slots: Optional[int] = None,
+    audited: bool = True,
 ) -> Iterator[ReadFrame]:
     """Yield decoded ReadFrames through the prefetch ring.
 
@@ -269,13 +293,19 @@ def ring_frames(
     e.g. the fused tag-sort merge), the ring only adds the prefetch
     stage — the frames are the source's own and carry no retention limit
     beyond the source's.
+
+    ``audited=False`` keeps this ring's frames OFF the scx-audit
+    ``records.ingested`` ledger: pass it when the frames feed ANOTHER
+    ring (the serve packer's per-member streams feeding the pack's
+    ``source=`` ring) so the handoff to the consumer is counted exactly
+    once, at the outer ring.
     """
     if depth is None:
         depth = prefetch_depth()
     if source is not None:
         if bam_path is not None:
             raise ValueError("pass bam_path or source, not both")
-        return _wrap_source(source, depth)
+        return _wrap_source(source, depth, audited)
     if bam_path is None:
         raise ValueError("ring_frames needs a bam_path or a source")
     if batch_records < 1:
@@ -293,6 +323,7 @@ def ring_frames(
                 want_qname=want_qname, tag_keys=keys,
             ),
             depth,
+            audited,
         )
 
     if keys != DEFAULT_TAG_KEYS or mode == "r" or not bgzf.is_gzip(bam_path):
@@ -310,7 +341,9 @@ def ring_frames(
     arenas = [
         ColumnArena(arena_capacity(batch_records)) for _ in range(slots)
     ]
-    produced = _produce_arena_frames(stream, arenas, batch_records, want_qname)
+    produced = _produce_arena_frames(
+        stream, arenas, batch_records, want_qname, audited
+    )
     # probe the first batch eagerly: a native decode failure at the head of
     # the file (bad magic, truncated header) falls back to the Python
     # decoder and its diagnostics, matching iter_frames_from_bam; failures
@@ -360,10 +393,17 @@ def ring_frames(
                 )
                 sys.stderr.flush()
             try:
-                yield from _python_frames_from(
+                for frame in _python_frames_from(
                     bam_path, batch_records, mode, want_qname, keys,
                     consumed,
-                )
+                ):
+                    # the downgrade tail bypasses the arena producer, so
+                    # its handed-off records join the ledger here — the
+                    # consumer's stream stays gap-free and so must the
+                    # ingested count
+                    if audited:
+                        audit.add("records.ingested", frame.n_records)
+                    yield frame
             except Exception as tail_error:
                 # truly corrupt bytes: the Python decoder failed in the
                 # same region — surface ITS error with the native one

@@ -58,7 +58,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import ingest, obs
-from ..obs import xprof
+from ..obs import audit, xprof
 from ..ops import segments as seg
 from ..parallel import collective
 from ..parallel.mesh import make_mesh
@@ -436,6 +436,13 @@ def collective_merge_parts(
                 )
                 n_rows += 1
         merge_span.add(records=n_rows)
+    # merge accounting (scx-audit): the collective file merge is
+    # fold-free by construction (parts hold disjoint entities), so
+    # rows_in must equal rows_out — any skew is loss, not a collision
+    audit.record_merge(
+        journal_dir, "collective_merge_parts", output_path,
+        len(paths), sum(len(names) for names in part_names), n_rows,
+    )
     return n_rows
 
 
@@ -497,8 +504,10 @@ class CollectiveMergeCellMetrics(MergeMetrics):
     values, same ``to_csv``).
     """
 
-    def __init__(self, metric_files, output_file: str, mesh=None):
-        super().__init__(metric_files, output_file)
+    def __init__(
+        self, metric_files, output_file: str, mesh=None, journal_dir=None
+    ):
+        super().__init__(metric_files, output_file, journal_dir=journal_dir)
         self._mesh = mesh
 
     def execute(self) -> None:
@@ -524,6 +533,11 @@ class CollectiveMergeCellMetrics(MergeMetrics):
             )
         merged = pd.concat(pieces, axis=0)
         merged.to_csv(self._output_file, compression="gzip")
+        self._record_audit(
+            "collective_merge_cell_metrics",
+            rows_in=sum(len(f) for f in frames),
+            rows_out=len(merged),
+        )
 
 
 class CollectiveMergeGeneMetrics(MergeMetrics):
@@ -540,8 +554,10 @@ class CollectiveMergeGeneMetrics(MergeMetrics):
     to the device's before the device values land in the output.
     """
 
-    def __init__(self, metric_files, output_file: str, mesh=None):
-        super().__init__(metric_files, output_file)
+    def __init__(
+        self, metric_files, output_file: str, mesh=None, journal_dir=None
+    ):
+        super().__init__(metric_files, output_file, journal_dir=journal_dir)
         self._mesh = mesh
 
     def execute(self) -> None:
@@ -613,8 +629,13 @@ class CollectiveMergeGeneMetrics(MergeMetrics):
                 )
             )
         nucleus = rebuilt[0]
+        collisions = 0
         for leaf in rebuilt[1:]:
+            before = len(nucleus) + len(leaf)
             nucleus = legacy._merge_pair(nucleus, leaf)
+            # same telescoped collision count as the file-level fold:
+            # gene rows present on both sides combine into one
+            collisions += before - len(nucleus)
         if count_columns:
             device_sums = pd.DataFrame(
                 {
@@ -636,3 +657,9 @@ class CollectiveMergeGeneMetrics(MergeMetrics):
                     nucleus[column].dtype
                 )
         nucleus.to_csv(self._output_file, compression="gzip")
+        self._record_audit(
+            "collective_merge_gene_metrics",
+            rows_in=sum(len(f) for f in frames),
+            rows_out=len(nucleus),
+            collisions=collisions,
+        )

@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Set
 import numpy as np
 
 from .. import guard, ingest, obs
-from ..obs import pulse, xprof
+from ..obs import audit, pulse, xprof
 from ..bam import iter_cell_barcodes, iter_genes, iter_molecule_barcodes
 from ..io.packed import (
     FLAG_MITO,
@@ -482,6 +482,12 @@ class MetricGatherer:
         for frame in frames:
             processed += frame.n_records
             obs.count("records_decoded", frame.n_records)
+            # conservation ledger: each record enters the compute path
+            # exactly once here (carry/slice/concat below conserve), so
+            # decoded == computed + quarantined is the task's invariant.
+            # int() detaches the scalar from the frame for scx-life:
+            # the ledger retains a count, never a view
+            audit.add("records.decoded", int(frame.n_records))
             if processed >= next_progress:
                 print(
                     f"[{type(self).__name__}] {processed} records decoded",
@@ -793,6 +799,7 @@ class MetricGatherer:
             xprof.sample_memory()
             obs.count("d2h_bytes", batch_d2h)
             obs.count("entities_written", n_entities)
+            audit.add("rows.computed", n_entities)
             self._do_finalize_device_batch(
                 entity_names, block, n_entities, int_names, float_names, out
             )
@@ -816,6 +823,9 @@ class MetricGatherer:
 
     def _entity_names(self, frame: ReadFrame) -> List[str]:
         return frame.cell_names if self.entity_kind == "cell" else frame.gene_names
+
+    #: audit-ledger reason for rows _filter_rows drops (subclass-named)
+    _filter_reason = "filtered"
 
     def _filter_rows(self, names: np.ndarray):
         """Vectorized row mask (None = keep all); gene path drops multi-genes."""
@@ -849,6 +859,12 @@ class MetricGatherer:
         keep = self._filter_rows(row_names)
         if keep is None:
             keep = slice(None)
+        else:
+            dropped = n_entities - int(np.count_nonzero(keep))
+            if dropped:
+                # conservation ledger: deliberately skipped rows are a
+                # NAMED fold (multi-gene groups), never silent loss
+                audit.add("rows.filtered", dropped, reason=self._filter_reason)
         index = np.where(row_names == "", "None", row_names)[keep]
         def int_col(column):
             return ints[int_of[column], :n_entities][keep].astype(np.int64)
@@ -951,6 +967,7 @@ class GatherGeneMetrics(MetricGatherer):
 
     entity_kind = "gene"
     columns = GENE_COLUMNS
+    _filter_reason = "multi_gene"
 
     def _filter_rows(self, names: np.ndarray):
         # multi-gene "a,b" groups are skipped entirely, like the counting
@@ -967,6 +984,7 @@ class GatherGeneMetrics(MetricGatherer):
                 for gene_iterator, gene_tag in iter_genes(bam_iterator=iter(bam_iterator)):
                     metric_aggregator = GeneMetrics()
                     if gene_tag and len(gene_tag.split(",")) > 1:
+                        audit.add("rows.filtered", 1, reason="multi_gene")
                         continue
                     for cell_iterator, cell_tag in iter_cell_barcodes(bam_iterator=gene_iterator):
                         for molecule_iterator, molecule_tag in iter_molecule_barcodes(

@@ -11,7 +11,7 @@ over the mesh (sctools_tpu.parallel); this module remains the file-boundary
 fallback and the egress format.
 """
 
-from typing import List, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 import pandas as pd
@@ -20,11 +20,31 @@ import pandas as pd
 class MergeMetrics:
     """Merges multiple metrics files into one gzip-compressed csv."""
 
-    def __init__(self, metric_files: Sequence[str], output_file: str):
+    def __init__(
+        self,
+        metric_files: Sequence[str],
+        output_file: str,
+        journal_dir: Optional[str] = None,
+    ):
         self._metric_files = metric_files
         if not output_file.endswith(".csv.gz"):
             output_file += ".csv.gz"
         self._output_file = output_file
+        self._journal_dir = journal_dir
+        # merge accounting (scx-audit): rows_in == rows_out +
+        # merged:collision, so a gene fold reads as a fold in the
+        # conservation report, never as record loss
+        self.audit: Optional[Dict[str, Any]] = None
+
+    def _record_audit(
+        self, op: str, rows_in: int, rows_out: int, collisions: int = 0
+    ) -> None:
+        from ..obs import audit as _audit
+
+        self.audit = _audit.record_merge(
+            self._journal_dir, op, self._output_file,
+            len(self._metric_files), rows_in, rows_out, collisions,
+        )
 
     def execute(self) -> None:
         raise NotImplementedError
@@ -38,6 +58,11 @@ class MergeCellMetrics(MergeMetrics):
         ]
         concatenated_frame: pd.DataFrame = pd.concat(metric_dataframes, axis=0)
         concatenated_frame.to_csv(self._output_file, compression="gzip")
+        self._record_audit(
+            "merge_cell_metrics",
+            rows_in=sum(len(f) for f in metric_dataframes),
+            rows_out=len(concatenated_frame),
+        )
 
 
 class MergeGeneMetrics(MergeMetrics):
@@ -99,7 +124,21 @@ class MergeGeneMetrics(MergeMetrics):
     def execute(self) -> None:
         """Incrementally fold each chunk file into the merged result."""
         nucleus = pd.read_csv(self._metric_files[0], index_col=0)
+        rows_in = len(nucleus)
+        collisions = 0
         for filename in self._metric_files[1:]:
             leaf = pd.read_csv(filename, index_col=0)
+            rows_in += len(leaf)
+            before = len(nucleus) + len(leaf)
             nucleus = self._merge_pair(nucleus, leaf)
+            # each gene present in both sides folds two rows into one:
+            # the telescoped per-fold deltas are exactly the collision
+            # count the conservation report must name
+            collisions += before - len(nucleus)
         nucleus.to_csv(self._output_file, compression="gzip")
+        self._record_audit(
+            "merge_gene_metrics",
+            rows_in=rows_in,
+            rows_out=len(nucleus),
+            collisions=collisions,
+        )

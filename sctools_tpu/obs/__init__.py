@@ -54,6 +54,15 @@ summaries from slo, gate values, platform fingerprint), and
 between two of them — ranked suspects with an explicit conservation
 property, refusing loudly across platforms instead of fabricating a
 speedup claim (docs/observability.md "scx-delta").
+
+Where every module above accounts for TIME, :mod:`.audit` (scx-audit)
+accounts for RECORDS: each stage that creates, splits, drops, or emits
+records increments a per-task conservation ledger (flushed into the
+sched journal's commit extras — no new daemon or wire format), and
+``python -m sctools_tpu.obs audit <run_dir>`` replays the books, exiting
+nonzero on any record it cannot explain;
+``python -m sctools_tpu.obs explain <run_dir> --barcode|--record|--job``
+traces one entity's full journey (docs/observability.md "scx-audit").
 """
 
 from __future__ import annotations

@@ -29,6 +29,20 @@ compile/retrace counts (with triggering signatures), padding occupancy,
 estimated FLOPs (real vs padding-wasted), the H2D/D2H transfer ledger,
 and device-memory watermarks (docs/performance.md walks through one).
 
+``python -m sctools_tpu.obs audit <run_dir>`` renders the record
+conservation report (scx-audit): per-task and fleet-wide balance of
+records ingested/decoded/computed/quarantined and rows computed/
+emitted/filtered, with every loss named by reason (quarantine sidecar
+ranges, row filters, merge collision folds). Exit 0 means EXACT — every
+record the run touched is accounted for; any unexplained record exits 1
+(the CI contract ``make audit-smoke`` gates on).
+
+``python -m sctools_tpu.obs explain <run_dir> --barcode B | --record N
+| --job J`` traces one entity's full journey — chunk -> task ->
+attempts/steals -> batch -> pack membership -> quarantine or output
+file:row — stitched from the journal, the quarantine sidecars, the pack
+plans, and the conservation ledger.
+
 ``python -m sctools_tpu.obs delta <A> <B>`` attributes the
 throughput/latency delta between two runs (scx-delta): each side is a
 run directory, a RunProfile JSON, a bench-result JSON, or a committed
@@ -513,6 +527,57 @@ def _delta(args, out=None, err=None) -> int:
     return 0 if view["comparable"] else 3
 
 
+def _audit(args, out=None, err=None) -> int:
+    out = out if out is not None else sys.stdout
+    err = err if err is not None else sys.stderr
+    from . import audit as auditmod
+
+    try:
+        report = auditmod.audit_run(args.run_dir)
+    except FileNotFoundError as exc:
+        print(f"obs audit: {exc}", file=err)
+        return 2
+    if args.as_json:
+        print(json.dumps(report, separators=(",", ":")), file=out)
+    else:
+        print(auditmod.render_audit_report(report), end="", file=out)
+    # nonzero on ANY unexplained record: the conservation contract is
+    # exact or it is broken — there is no "mostly balanced"
+    return 0 if report["fleet"]["exact"] else 1
+
+
+def _explain(args, out=None, err=None) -> int:
+    out = out if out is not None else sys.stdout
+    err = err if err is not None else sys.stderr
+    from . import audit as auditmod
+
+    if (
+        args.barcode is None
+        and args.record is None
+        and args.job is None
+    ):
+        print(
+            "obs explain: pass at least one of --barcode/--record/--job",
+            file=err,
+        )
+        return 2
+    try:
+        result = auditmod.explain_run(
+            args.run_dir,
+            barcode=args.barcode,
+            record=args.record,
+            job=args.job,
+        )
+    except FileNotFoundError as exc:
+        print(f"obs explain: {exc}", file=err)
+        return 2
+    if args.as_json:
+        print(json.dumps(result, separators=(",", ":")), file=out)
+    else:
+        print(auditmod.render_explain(result), end="", file=out)
+    return 0 if result["found"] else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sctools_tpu.obs",
@@ -692,6 +757,50 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="the attribution view (or trajectory series) as one JSON "
         "object",
     )
+    audit_cmd = sub.add_parser(
+        "audit",
+        help="record conservation report: per-task and fleet-wide "
+        "balance with every loss named by reason; exit 0 only when "
+        "EXACT (scx-audit)",
+    )
+    audit_cmd.add_argument(
+        "run_dir",
+        help="run/work directory holding the sched journal(s), "
+        "quarantine sidecars, and commit-extra conservation ledgers",
+    )
+    audit_cmd.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="the full conservation report as one JSON object",
+    )
+    explain_cmd = sub.add_parser(
+        "explain",
+        help="provenance trace for one entity: chunk -> task -> "
+        "attempts/steals -> pack membership -> quarantine or "
+        "output file:row (scx-audit)",
+    )
+    explain_cmd.add_argument(
+        "run_dir",
+        help="run/work directory holding the sched journal(s) and "
+        "committed output parts",
+    )
+    explain_cmd.add_argument(
+        "--barcode", default=None,
+        help="entity index value (cell barcode / gene name) to locate "
+        "in committed outputs and merge sidecars",
+    )
+    explain_cmd.add_argument(
+        "--record", type=int, default=None,
+        help="absolute input record number to resolve against the "
+        "quarantine sidecar ranges (optionally scoped by --job)",
+    )
+    explain_cmd.add_argument(
+        "--job", default=None,
+        help="task/job name or id to narrate end-to-end from the journal",
+    )
+    explain_cmd.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="the match list as one JSON object",
+    )
     args = parser.parse_args(argv)
     if args.command == "summarize":
         return _summarize(args)
@@ -703,6 +812,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _slo(args)
     if args.command == "delta":
         return _delta(args)
+    if args.command == "audit":
+        return _audit(args)
+    if args.command == "explain":
+        return _explain(args)
     return _timeline(args)
 
 

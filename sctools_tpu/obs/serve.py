@@ -20,7 +20,11 @@ Two modes:
   touching the workers.  When the run dir holds a serve journal the
   scrape also carries the per-tenant scx-slo gauges
   (:func:`sctools_tpu.obs.slo.render_slo_metrics`): p50/p95/p99,
-  queue-age, error-budget burn, attributed device-seconds.
+  queue-age, error-budget burn, attributed device-seconds — and the
+  per-tenant scx-audit conservation gauges
+  (:func:`sctools_tpu.obs.audit.render_audit_metrics`): rows
+  emitted/claimed per tenant, fleet decode/quarantine totals, and the
+  unexplained-record count.
 
 Binds 127.0.0.1 only: telemetry is not an open network service. For
 scrape-less setups the atomic textfile export
@@ -81,6 +85,12 @@ class PulseExporter:
                 from .. import steer
 
                 body += steer.render_steer_metrics(self._run_dir)
+                # per-tenant scx-audit conservation gauges: rows
+                # emitted/claimed per tenant plus the fleet unexplained
+                # count — the "is anyone missing cells" alert series
+                from . import audit
+
+                body += audit.render_audit_metrics(self._run_dir)
             return body
         # live mode: the process's own counters/spans plus its pulse
         # gauges — render_metrics() raises on name-mangling collisions

@@ -22,7 +22,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import ingest, obs
-from ..obs import pulse, xprof
+from ..obs import audit, pulse, xprof
 from ..io.packed import KEY_HI_SHIFT
 from ..sched import faults
 from ..metrics.gatherer import (
@@ -217,6 +217,7 @@ class _ShardedMixin:
             floats = cols[len(int_names):].view(np.float32)
             wb.add(entities=int(cols.shape[1]))
             obs.count("entities_written", int(cols.shape[1]))
+            audit.add("rows.computed", int(cols.shape[1]))
             self._write_device_rows(
                 entity_names, cols.shape[1], int_names, float_names,
                 ints, floats, out,

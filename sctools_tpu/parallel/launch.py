@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import obs
+from ..obs import audit
 from .mesh import DEFAULT_AXIS
 
 
@@ -461,19 +462,30 @@ def merge_sorted_csv_parts(
     # is a k-way streaming merge — O(parts) memory on the rank-0 host, the
     # same shape as the native tag sort's partial-file merge
     n_rows = 0
+    # merge accounting (scx-audit): count rows on the way IN per part, so
+    # the sidecar entry can assert rows_in == rows_out — a text merge
+    # never folds, so any skew is a real loss the audit must flag
+    rows_per_part = [0] * len(paths)
+
+    def _counted(f, part_index: int):
+        for line in f:
+            if line.strip():
+                rows_per_part[part_index] += 1
+                yield line
+
     merge_span = obs.span("distributed:merge_parts", parts=len(paths))
     with merge_span, atomic_output(output_path) as tmp_path, \
             ExitStack() as stack:
         header: Optional[str] = None
         streams = []
-        for path in paths:
+        for part_index, path in enumerate(paths):
             f = stack.enter_context(gzip.open(path, "rt"))
             part_header = f.readline()
             if header is None:
                 header = part_header
             elif part_header != header:
                 raise ValueError(f"part {path} header differs")
-            streams.append(line for line in f if line.strip())
+            streams.append(_counted(f, part_index))
         opener = gzip.open if compress else open
         out = stack.enter_context(opener(tmp_path, "wt"))
         out.write(header)
@@ -483,4 +495,8 @@ def merge_sorted_csv_parts(
             out.write(line)
             n_rows += 1
         merge_span.add(records=n_rows)
+    audit.record_merge(
+        journal_dir, "merge_sorted_csv_parts", output_path,
+        len(paths), sum(rows_per_part), n_rows,
+    )
     return n_rows

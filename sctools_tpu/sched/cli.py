@@ -182,6 +182,7 @@ def _print_serve_summary(journal: Journal, tasks, states, out) -> None:
             age = max(now - oldest_open[tenant], 0.0)
             line += f" queue-age={age:.1f}s"
         print(line, file=out)
+    _print_serve_rows_line(journal, tasks, out)
     _print_slo_summary(journal, tasks, now, out)
     try:
         meta = journal.worker_meta()
@@ -224,6 +225,54 @@ def _print_serve_summary(journal: Journal, tasks, states, out) -> None:
                 f"degraded={steering.get('degraded', 0)})"
             )
         print(line, file=out)
+
+
+def _print_serve_rows_line(journal: Journal, tasks, out) -> None:
+    """The scx-audit rows-balanced headline for the serve view.
+
+    Folds the committed serve events' conservation extras (per-member
+    ``rows_emitted`` vs ``rows_claimed`` from the pack plan) into one
+    line: balanced means every row a tenant's pack membership claimed
+    was emitted into that tenant's output — the instant answer to "is
+    anyone missing cells" without running the full audit report.
+    """
+    from ..serve.api import SERVE_TASK_KIND
+
+    try:
+        events = journal.events()
+    except Exception:  # noqa: BLE001 - status must never die on telemetry
+        return
+    emitted = claimed = audited = 0
+    seen = set()
+    for event in events:
+        tid = event.get("id")
+        if event.get("event") != "committed" or tid in seen:
+            continue
+        seen.add(tid)
+        task = tasks.get(tid)
+        if task is None or task.kind != SERVE_TASK_KIND:
+            continue
+        extra = event.get("audit")
+        if not isinstance(extra, dict):
+            continue
+        audited += 1
+        rows = int(extra.get("rows_emitted") or 0)
+        emitted += rows
+        # solo (unpacked) jobs carry no routing claim: the whole-job
+        # ledger IS the claim, so they balance by construction
+        claim = extra.get("rows_claimed")
+        claimed += int(claim) if claim is not None else rows
+    if not audited:
+        return
+    skew = emitted - claimed
+    verdict = (
+        "balanced" if skew == 0 else f"UNBALANCED (skew={skew:+d})"
+    )
+    print(
+        f"serve rows: emitted={emitted} claimed={claimed} over "
+        f"{audited} audited job(s) — {verdict}",
+        file=out,
+    )
 
 
 def _print_slo_summary(journal: Journal, tasks, now: float, out) -> None:

@@ -39,6 +39,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from .. import obs
+from ..obs import audit
 from . import faults
 from .commit import sha256_file
 from .journal import (
@@ -187,6 +188,9 @@ class WorkQueue:
             faults.fire("task.commit", name=task.name)
         except BaseException as error:  # noqa: BLE001 - every failure journals
             obs.set_context(task=None, task_id=None)
+            # a failed attempt's half-counted ledger must not pollute the
+            # retry's conservation balance
+            audit.discard(task.id)
             stop.set()
             beat.join(timeout=5.0)
             if not isinstance(error, Exception):
@@ -204,9 +208,15 @@ class WorkQueue:
         obs.set_context(task=None, task_id=None)
         stop.set()
         beat.join(timeout=5.0)
+        # the conservation ledger rides the commit record (scx-audit):
+        # counts fold post-run into the existing journal event, so the
+        # transport adds zero hot-path work and no new wire format
+        ledger = audit.take(task.id)
+        extra = {"audit": ledger} if ledger else {}
         self.journal.record(
             task.id, "committed", attempt=attempt, part=artifact,
             sha256=sha256_file(artifact) if artifact else None,
+            **extra,
         )
         obs.count("sched_commits")
         if artifact:

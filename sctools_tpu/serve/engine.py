@@ -41,7 +41,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import obs, steer
-from ..obs import slo, xprof
+from ..obs import audit, slo, xprof
 from ..metrics.gatherer import DEFAULT_BATCH_RECORDS, GatherCellMetrics
 from ..sched import faults
 from ..sched.commit import sha256_file
@@ -434,6 +434,11 @@ class ServeWorker:
                 )
                 probe.mark("pack_done")
         except Exception as error:  # noqa: BLE001 - every failure journals
+            # half-counted audit ledgers from the failed executions must
+            # not pollute the retry's conservation balance
+            audit.discard(trace.exec_id())
+            for tid in trace.tids:
+                audit.discard(tid)
             self._fail_pack(journal, members, attempts, error)
             return 0
         self.packs_run += 1
@@ -462,6 +467,39 @@ class ServeWorker:
                 "pack_bucket": trace.bucket,
                 "pack_execs": trace.executed,
             }
+            if segment is not None:
+                # scx-audit: this member's emitted-row count (and, for a
+                # packed run, the claimed-entity count it must equal) on
+                # the commit record — the per-tenant audit gauges and the
+                # `sched status` rows-balanced line read these
+                member_at = segment["tids"].index(tid)
+                routed = segment.get("rows_routed")
+                claimed = segment.get("rows_claimed")
+                ledger = segment.get("ledger") or {}
+                if routed is not None:
+                    extra["audit"] = {
+                        "rows_emitted": int(routed[member_at]),
+                        "rows_claimed": (
+                            int(claimed[member_at])
+                            if claimed is not None
+                            else None
+                        ),
+                        "records_streamed": (
+                            int(segment["rows"][member_at])
+                            if segment.get("rows")
+                            else None
+                        ),
+                    }
+                else:
+                    # solo execution: the whole ledger belongs to this
+                    # one member
+                    extra["audit"] = {
+                        "rows_emitted": int(
+                            ledger.get("rows.emitted", 0)
+                        ),
+                        "rows_claimed": None,
+                        "records_streamed": ledger.get("records.decoded"),
+                    }
             if marks:
                 extra["slo_marks"] = marks
             journal.record(

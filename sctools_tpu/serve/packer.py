@@ -43,6 +43,7 @@ import numpy as np
 
 from .. import ingest, obs
 from ..io.packed import concat_frames, copy_frame
+from ..obs import audit
 from ..io.sam import AlignmentReader
 from ..metrics.gatherer import DEFAULT_BATCH_RECORDS, GatherCellMetrics
 from ..metrics.writer import MetricCSVWriter
@@ -191,6 +192,8 @@ class _RouterWriter:
     ):
         self._writers = [MetricCSVWriter(job.out, compress) for job in jobs]
         self._membership = membership
+        #: per-member routed row counts (the audit ledger's serve split)
+        self.rows_routed: List[int] = [0] * len(self._writers)
 
     @property
     def filenames(self) -> List[str]:
@@ -215,6 +218,7 @@ class _RouterWriter:
         for j in range(len(self._writers)):
             mask = owners == j
             if mask.any():
+                self.rows_routed[j] += int(mask.sum())
                 self._writers[j].write_block(
                     names_arr[mask], [column[mask] for column in arrays]
                 )
@@ -273,6 +277,23 @@ class PackedCellMetrics(GatherCellMetrics):
         """Records streamed per member, aligned with the job list."""
         return list(self._owner_rows)
 
+    @property
+    def owner_emitted(self) -> List[int]:
+        """CSV rows routed to each member's writer (audit: emitted side)."""
+        if self._router is None:
+            return [0] * len(self._jobs)
+        return list(self._router.rows_routed)
+
+    @property
+    def owner_claimed(self) -> List[int]:
+        """Entities each member claimed while streaming (audit: the
+        conservation counterpart — every claimed entity must come back
+        as exactly one routed row)."""
+        counts = [0] * len(self._jobs)
+        for owner in self._membership.values():
+            counts[owner] += 1
+        return counts
+
     def _make_writer(self) -> _RouterWriter:
         self._router = _RouterWriter(
             self._jobs, self._membership, self._compress
@@ -313,7 +334,12 @@ class PackedCellMetrics(GatherCellMetrics):
         capacity = bucket_size(self._batch_records)
         acc = None
         for owner, job in enumerate(self._jobs):
-            for frame in ingest.ring_frames(job.bam, self._batch_records):
+            # audited=False: these member frames feed the pack's outer
+            # ``source=`` ring, which ledgers the handoff — counting here
+            # too would double every record on the conservation report
+            for frame in ingest.ring_frames(
+                job.bam, self._batch_records, audited=False
+            ):
                 # ring frames alias recycled arena slots; accumulation
                 # retains them past the ring window, so copy first
                 frame = copy_frame(frame)
@@ -364,12 +390,19 @@ def run_packed(
             with _trace_task(exec_id):
                 gatherer.extract_metrics()
             if trace is not None:
+                # the pack's conservation ledger rides the segment the
+                # engine already journals verbatim (scx-audit): the
+                # execution-level counts plus the per-member routed and
+                # claimed splits the fleet report balances against
                 trace.executed.append(
                     {
                         "exec_id": exec_id,
                         "tids": list(trace.tids),
                         "rows": gatherer.owner_rows,
                         "degraded": None,
+                        "ledger": audit.take(exec_id),
+                        "rows_routed": gatherer.owner_emitted,
+                        "rows_claimed": gatherer.owner_claimed,
                     }
                 )
             return gatherer.artifacts, True
@@ -377,6 +410,10 @@ def run_packed(
             # degrade below; nothing was published — but any dispatches
             # the aborted attempt already ran burned real device time
             degraded = "entity-collision"
+            if exec_id is not None:
+                # the aborted attempt's half-counted ledger must not
+                # bleed into the solo reruns' balance
+                audit.discard(exec_id)
             if trace is not None:
                 trace.executed.append(
                     {
@@ -406,6 +443,7 @@ def run_packed(
                     "tids": [trace.tids[i]],
                     "rows": None,
                     "degraded": degraded,
+                    "ledger": audit.take(exec_id),
                 }
             )
     return artifacts, False

@@ -1,0 +1,270 @@
+"""audit-smoke: the CI gate for scx-audit (`make audit-smoke`).
+
+A 2-worker run under the crash + steal + corrupt_record cocktail must
+audit to EXACT record conservation with the quarantined records as the
+only named losses, and the provenance explains must resolve real
+entities end-to-end:
+
+- worker A crashes mid-chunk (leaving a leased journal entry); worker B
+  — a delayed straggler — steals the expired lease and drains the queue,
+  with two poisoned records quarantined along the way;
+- ``python -m sctools_tpu.obs audit <run>`` exits 0 with ``RESULT:
+  EXACT — 0 unexplained records``: every decoded record is computed or
+  quarantined, every computed row is emitted, the merge folds nothing;
+- the audit's loss set matches the quarantine sidecars RECORD FOR
+  RECORD: same task, same ranges, same total — and nothing else is lost;
+- ``obs explain --record N`` resolves a quarantined record to its
+  chunk, task, isolating worker, and reason; ``obs explain --job`` on
+  the STOLEN task narrates both attempts (crashed + stolen) and its
+  committed artifact; ``obs explain --barcode`` resolves an emitted
+  entity to its exact output file:row through both the part and the
+  merged CSV;
+- negative control: deleting the quarantine sidecar makes the SAME run
+  audit UNBALANCED (nonzero exit) — the conservation check actually
+  cross-checks the sidecars against the ledger, it does not just render
+  the ledger.
+
+Exit 0 on success; any assertion failure is a gate failure.
+"""
+
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "sched_worker.py")
+
+LEASE_TTL = "2.0"
+POISON_RECORDS = (3, 10)  # absolute record indices within chunk_0's stream
+
+
+def make_input(path: str, n_cells: int = 32) -> None:
+    import random
+
+    from helpers import make_record, write_bam
+
+    rng = random.Random(7)
+    records = []
+    for cb in sorted(
+        "".join(rng.choice("ACGT") for _ in range(12)) for _ in range(n_cells)
+    ):
+        for ub in sorted(
+            "".join(rng.choice("ACGT") for _ in range(6)) for _ in range(3)
+        ):
+            ge = rng.choice(["G1", "G2"])
+            for i in range(2):
+                records.append(
+                    make_record(
+                        name=f"{cb}{ub}{i}", cb=cb, cr=cb, cy="IIII",
+                        ub=ub, ur=ub, uy="IIII", ge=ge, xf="CODING",
+                        nh=1, pos=rng.randrange(1000),
+                    )
+                )
+    write_bam(path, records)
+
+
+def launch(workdir: str, process_id: int, fault_spec: str):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    if fault_spec:
+        env["SCTOOLS_TPU_FAULTS"] = fault_spec
+    else:
+        env.pop("SCTOOLS_TPU_FAULTS", None)
+    return subprocess.Popen(
+        [
+            sys.executable, WORKER, workdir, str(process_id), "2",
+            LEASE_TTL, "3", "0.1",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def obs_cli(args, workdir=None):
+    """Run `python -m sctools_tpu.obs <args>`; returns (rc, stdout)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("SCTOOLS_TPU_FAULTS", None)
+    result = subprocess.run(
+        [sys.executable, "-m", "sctools_tpu.obs"] + list(args),
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    return result.returncode, result.stdout, result.stderr
+
+
+def main() -> int:
+    workdir = os.environ.get(
+        "SCTOOLS_TPU_AUDIT_SMOKE_DIR"
+    ) or tempfile.mkdtemp(prefix="sctools_tpu_audit_smoke.")
+    os.makedirs(workdir, exist_ok=True)
+    bam = os.path.join(workdir, "input.bam")
+    make_input(bam)
+
+    from sctools_tpu.guard.quarantine import load_quarantine
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+    from sctools_tpu.platform import GenericPlatform
+    from sctools_tpu.sched import COMMITTED, Journal
+
+    chunk_dir = os.path.join(workdir, "chunks")
+    os.makedirs(chunk_dir, exist_ok=True)
+    GenericPlatform.split_bam(
+        ["-b", bam, "-p", os.path.join(chunk_dir, "chunk"), "-s", "0.002",
+         "-t", "CB"]
+    )
+    chunks = sorted(glob.glob(os.path.join(chunk_dir, "*.bam")))
+    n_chunks = len(chunks)
+    assert n_chunks >= 2, f"need >=2 chunks, got {n_chunks}"
+    chunk0 = os.path.basename(chunks[0])
+
+    # ---- the faulted run: crash + steal + corrupt_record ---------------
+    poison = ";".join(
+        f"corrupt_record@gatherer.dispatch:match={chunk0},record={r}"
+        for r in POISON_RECORDS
+    )
+    # A crashes mid-chunk on its first claim, leaving a leased entry; B
+    # (delayed into A's wreckage) waits out the TTL, STEALS the chunk,
+    # hits the same poisons deterministically, and drains the queue
+    proc_a = launch(workdir, 0, "crash@gatherer.batch:times=1;" + poison)
+    out_a, _ = proc_a.communicate(timeout=300)
+    assert proc_a.returncode == 86, f"A should crash (86):\n{out_a[-2000:]}"
+    proc_b = launch(workdir, 1, "delay@task.claimed:secs=0.4;" + poison)
+    out_b, _ = proc_b.communicate(timeout=300)
+    assert proc_b.returncode == 0, f"B should converge:\n{out_b[-2000:]}"
+
+    journal_dir = os.path.join(workdir, "sched-journal")
+    tasks, states = Journal(journal_dir, worker_id="smoke-probe").replay()
+    assert len(tasks) == n_chunks, (len(tasks), n_chunks)
+    assert all(st.state == COMMITTED for st in states.values()), {
+        tasks[t].name: states[t].state for t in tasks
+    }
+    stolen = sorted(
+        tasks[t].name for t, st in states.items() if st.steals
+    )
+    assert stolen, "B never stole the crashed worker's lease"
+
+    # the journal-validated merge (writes the audit-merge sidecar)
+    merged = os.path.join(workdir, "merged.csv.gz")
+    n_rows = merge_sorted_csv_parts(
+        os.path.join(workdir, "metrics.part*.csv.gz"), merged,
+        journal_dir=journal_dir, expected_parts=n_chunks,
+    )
+    assert n_rows > 0
+
+    # ---- the conservation report: EXACT, losses fully named ------------
+    rc, text, errtext = obs_cli(["audit", workdir])
+    assert rc == 0, f"audit rc={rc}:\n{text}\n{errtext}"
+    assert "RESULT: EXACT — 0 unexplained records" in text, text
+
+    rc, payload, _ = obs_cli(["audit", workdir, "--json"])
+    assert rc == 0
+    report = json.loads(payload)
+    fleet = report["fleet"]
+    assert fleet["exact"] is True, fleet
+    assert fleet["unexplained"] == 0, fleet
+    assert fleet["tasks_committed"] == n_chunks, fleet
+    # the ONLY losses are the injected poisons, named by reason
+    assert fleet["losses"] == {
+        "quarantined:PoisonData": len(POISON_RECORDS)
+    }, fleet["losses"]
+    records = fleet["records"]
+    assert records["decoded"] == records["computed"] + records["quarantined"]
+    assert records["ingested"] == records["decoded"]
+    rows = fleet["rows"]
+    assert rows["computed"] == rows["emitted"] + rows["filtered"]
+    # every emitted row survived the merge, nothing collision-folded
+    assert len(report["merges"]) == 1, report["merges"]
+    merge_entry = report["merges"][0]
+    assert merge_entry["rows_in"] == merge_entry["rows_out"] == n_rows
+    assert merge_entry["merged:collision"] == 0
+
+    # ---- sidecar ranges match the audit's loss set record-for-record ---
+    sidecar_entries = load_quarantine(os.path.join(journal_dir, "quarantine"))
+    distinct = sorted(
+        {
+            (e["task"], e["record_start"], e["record_stop"])
+            for e in sidecar_entries
+        }
+    )
+    assert distinct == [
+        ("chunk0000", r, r + 1) for r in sorted(POISON_RECORDS)
+    ], distinct
+    assert report["quarantine"]["records"] == len(POISON_RECORDS), (
+        report["quarantine"]
+    )
+    assert records["quarantined"] == len(POISON_RECORDS)
+
+    # ---- explain: one quarantined record, end-to-end -------------------
+    rc, text, _ = obs_cli(
+        ["explain", workdir, "--record", str(POISON_RECORDS[0])]
+    )
+    assert rc == 0, text
+    assert (
+        f"record {POISON_RECORDS[0]} -> QUARANTINED "
+        f"[{POISON_RECORDS[0]}, {POISON_RECORDS[0] + 1})" in text
+    ), text
+    assert "gatherer.dispatch" in text and "PoisonData" in text, text
+    assert chunk0 in text, text  # the chunk it came from
+    assert "task chunk0000" in text, text
+
+    # ---- explain: the stolen task's full story -------------------------
+    rc, text, _ = obs_cli(["explain", workdir, "--job", stolen[0]])
+    assert rc == 0, text
+    assert "(stolen)" in text, text
+    assert "committed" in text, text
+    assert "attempt" in text, text
+    assert "ledger:" in text, text
+
+    # ---- explain: an emitted entity resolves to its output file:row ----
+    import gzip
+
+    with gzip.open(merged, "rt") as f:
+        f.readline()  # header
+        barcode = f.readline().split(",", 1)[0]
+    rc, text, _ = obs_cli(["explain", workdir, "--barcode", barcode])
+    assert rc == 0, text
+    assert f"barcode {barcode!r} -> " in text, text
+    assert ":row " in text, text
+    # through BOTH the committed part and the merged output
+    assert "metrics.part" in text and "merged.csv.gz" in text, text
+
+    # an entity that never existed is a clean miss (exit 1)
+    rc, text, _ = obs_cli(["explain", workdir, "--barcode", "NOTACELL"])
+    assert rc == 1, (rc, text)
+
+    # ---- negative control: a vanished sidecar breaks conservation -----
+    quarantine_dir = os.path.join(journal_dir, "quarantine")
+    saved = os.path.join(workdir, "quarantine.saved")
+    shutil.move(quarantine_dir, saved)
+    rc, text, _ = obs_cli(["audit", workdir])
+    assert rc == 1, f"audit must fail without the sidecars (rc={rc}):\n{text}"
+    assert "UNBALANCED" in text, text
+    assert "sidecar skew" in text, text
+    shutil.move(saved, quarantine_dir)
+    rc, _, _ = obs_cli(["audit", workdir])
+    assert rc == 0  # restored: exact again
+
+    print(
+        json.dumps(
+            {
+                "audit_smoke": "ok",
+                "chunks": n_chunks,
+                "stolen": stolen,
+                "quarantined": distinct,
+                "merged_rows": n_rows,
+                "losses": fleet["losses"],
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

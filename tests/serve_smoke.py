@@ -20,7 +20,14 @@ an injected ``delay@task.claimed`` fault) and a replacement spawned:
   unattributed, and jobs stolen from the dead worker stitch across the
   lineage boundary;
 - ``sched status`` renders the serve view (per-tenant counts, the
-  admission line, and the per-tenant slo summary) and exits 0;
+  admission line, the per-tenant slo summary, and the scx-audit
+  rows-balanced line) and exits 0;
+- scx-audit holds EXACTLY across the lineage boundary: ``obs audit``
+  exits 0 with zero unexplained records, every job's emitted rows equal
+  its claimed entities (including the jobs stolen from the dead
+  worker), the fleet's emitted total equals the artifact row count on
+  disk, nothing is quarantined, and ``obs explain --job`` narrates the
+  stolen job's two-lineage story;
 - steering is ARMED (``SCTOOLS_TPU_STEER=1``) through the whole
   elastic episode: every worker lineage journals decisions from a
   fresh controller (seq starts at 1 — no stale-controller carryover
@@ -363,6 +370,76 @@ def main() -> int:
     assert "serve admission" in status.stdout, status.stdout[-2000:]
     assert "serve slo" in status.stdout, status.stdout[-2000:]
     assert "serve steer" in status.stdout, status.stdout[-2000:]
+    # the scx-audit rows-balanced line rides the same serve view
+    assert "serve rows:" in status.stdout, status.stdout[-2000:]
+    assert "— balanced" in status.stdout, status.stdout[-2000:]
+
+    # scx-audit: the elastic episode must balance EXACTLY — every row a
+    # survivor emitted for a stolen job is claimed by an output entity,
+    # and conservation holds across the worker-lineage boundary
+    audit = subprocess.run(
+        [sys.executable, "-m", "sctools_tpu.obs", "audit", workdir],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert audit.returncode == 0, (
+        audit.returncode, audit.stdout[-2000:], audit.stderr[-2000:],
+    )
+    assert "RESULT: EXACT — 0 unexplained records" in audit.stdout, (
+        audit.stdout[-2000:]
+    )
+    audit_json = subprocess.run(
+        [sys.executable, "-m", "sctools_tpu.obs", "audit", workdir,
+         "--json"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert audit_json.returncode == 0, audit_json.stderr[-2000:]
+    report = json.loads(audit_json.stdout)
+    fleet_audit = report["fleet"]
+    assert fleet_audit["exact"] is True, fleet_audit
+    assert fleet_audit["unexplained"] == 0, fleet_audit
+    assert fleet_audit["tasks_committed"] == len(JOBS), fleet_audit
+    # a clean serve run loses nothing to quarantine
+    assert not any(
+        reason.startswith("quarantined")
+        for reason in fleet_audit["losses"]
+    ), fleet_audit["losses"]
+    serve_jobs = report["serve_jobs"]
+    assert len(serve_jobs) == len(JOBS), sorted(serve_jobs)
+    for job_audit in serve_jobs.values():
+        assert job_audit["rows_emitted"] is not None, job_audit
+        assert job_audit["rows_emitted"] == job_audit["rows_claimed"], (
+            job_audit
+        )
+        assert not job_audit["problems"], job_audit
+    # the ledger's emitted total must equal what is actually on disk —
+    # the byte-identity check above pins content; this pins the COUNT
+    # through the commit extras instead of the filesystem
+    total_emitted = sum(j["rows_emitted"] for j in serve_jobs.values())
+    artifact_rows = 0
+    for _, _, stem in jobs:
+        with open(stem + ".csv", encoding="utf-8") as f:
+            artifact_rows += sum(1 for _ in f) - 1  # minus header
+    assert total_emitted == artifact_rows, (total_emitted, artifact_rows)
+    # the jobs that crossed the lineage boundary balance like the rest
+    for job in crossed:
+        job_audit = serve_jobs[job["id"]]
+        assert job_audit["rows_emitted"] == job_audit["rows_claimed"], (
+            job["name"], job_audit,
+        )
+        assert job_audit["rows_emitted"] > 0, (job["name"], job_audit)
+
+    # provenance across lineages: explain the stolen job — one story
+    # spanning the dead worker's lease and the survivor's commit
+    explain = subprocess.run(
+        [sys.executable, "-m", "sctools_tpu.obs", "explain", workdir,
+         "--job", crossed[0]["name"]],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert explain.returncode == 0, (
+        explain.returncode, explain.stdout[-2000:], explain.stderr[-2000:],
+    )
+    assert "(stolen)" in explain.stdout, explain.stdout[-2000:]
+    assert "committed" in explain.stdout, explain.stdout[-2000:]
 
     n_parts = len(glob.glob(os.path.join(out_dir, "*.csv")))
     print(
@@ -374,7 +451,8 @@ def main() -> int:
         f"{len(view['jobs'])} complete trace(s) ({len(crossed)} stitched "
         f"across lineages), 0s unattributed device time, "
         f"{len(decisions)} steer decision(s) across {len(by_worker)} "
-        f"fresh controller(s) ({len(refused)} floor refusal(s), 0 applied)"
+        f"fresh controller(s) ({len(refused)} floor refusal(s), 0 applied), "
+        f"audit EXACT ({total_emitted} row(s) emitted == claimed == on disk)"
     )
     return 0
 

@@ -1,0 +1,665 @@
+"""scx-audit: ledger semantics, conservation algebra, merge accounting,
+the run fold, provenance explains, gauges, and the CLI exit taxonomy.
+
+Covers the contracts docs/observability.md ("scx-audit") documents: the
+write-side RecordLedger (per-task buckets keyed through the obs
+context, pop-on-take so retries never inherit a dead attempt's counts),
+the two conservation equations (a missing stage is "not audited", never
+a phantom loss), merge folds read as ``merged:collision`` rather than
+loss (the gene-collision accounting), the journal fold's cross-checks
+(sidecar skew, pack routed-vs-emitted, serve emitted-vs-claimed), the
+explain queries, the per-tenant ``sctools_tpu_audit_*`` gauges, and the
+``obs audit`` / ``obs explain`` exit codes (0 balanced/found,
+1 unbalanced/miss, 2 unreadable).
+"""
+
+import gzip
+import json
+import os
+
+import pandas as pd
+import pytest
+
+from sctools_tpu import obs
+from sctools_tpu.obs import audit
+from sctools_tpu.obs.__main__ import main as obs_cli
+from sctools_tpu.sched.journal import Journal, make_task
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    audit.reset()
+    obs.set_context(task=None, task_id=None)
+    yield
+    audit.reset()
+    obs.set_context(task=None, task_id=None)
+
+
+# ------------------------------------------------------- the write side
+
+
+def test_add_accumulates_under_explicit_task():
+    audit.add("records.decoded", 5, task_id="t1")
+    audit.add("records.decoded", 3, task_id="t1")
+    audit.add("rows.emitted", 2, task_id="t1")
+    assert audit.peek("t1") == {"records.decoded": 8, "rows.emitted": 2}
+
+
+def test_add_attributes_through_obs_context():
+    obs.set_context(task="chunk0", task_id="ctx-task")
+    audit.add("records.computed", 7)
+    assert audit.peek("ctx-task") == {"records.computed": 7}
+    # without any context the counts land in the "" bucket, which is
+    # never journaled and never read back
+    obs.set_context(task=None, task_id=None)
+    audit.add("records.computed", 1)
+    assert audit.peek("ctx-task") == {"records.computed": 7}
+
+
+def test_add_reason_makes_a_tagged_key():
+    audit.add("records.quarantined", 2, reason="PoisonData", task_id="t")
+    audit.add("records.quarantined", 1, reason="Truncated", task_id="t")
+    assert audit.peek("t") == {
+        "records.quarantined:PoisonData": 2,
+        "records.quarantined:Truncated": 1,
+    }
+
+
+def test_add_zero_is_a_noop():
+    audit.add("records.decoded", 0, task_id="t")
+    assert audit.peek("t") == {}
+
+
+def test_take_pops_so_a_retry_starts_clean():
+    audit.add("records.decoded", 4, task_id="t")
+    assert audit.take("t") == {"records.decoded": 4}
+    # the second attempt must not inherit the first attempt's counts
+    assert audit.take("t") == {}
+
+
+def test_discard_drops_a_failed_attempts_partial_counts():
+    audit.add("records.decoded", 4, task_id="t")
+    audit.discard("t")
+    assert audit.peek("t") == {}
+    audit.discard("never-existed")  # idempotent
+
+
+# ------------------------------------------------------- ledger algebra
+
+
+def test_ledger_sum_folds_reason_variants():
+    ledger = {
+        "records.quarantined": 1,
+        "records.quarantined:PoisonData": 2,
+        "records.quarantined:Truncated": 3,
+    }
+    assert audit.ledger_sum(ledger, "records.quarantined") == 6
+    assert audit.ledger_reasons(ledger, "records.quarantined") == {
+        "PoisonData": 2,
+        "Truncated": 3,
+    }
+
+
+def test_balance_exact():
+    result = audit.balance(
+        {
+            "records.ingested": 10,
+            "records.decoded": 10,
+            "records.computed": 8,
+            "records.quarantined:PoisonData": 2,
+            "rows.computed": 5,
+            "rows.emitted": 4,
+            "rows.filtered:multi_gene": 1,
+        }
+    )
+    assert result["unexplained"] == 0
+    assert result["records"]["quarantined_reasons"] == {"PoisonData": 2}
+    assert result["rows"]["filtered_reasons"] == {"multi_gene": 1}
+
+
+def test_balance_names_unexplained_loss():
+    result = audit.balance(
+        {"records.decoded": 10, "records.computed": 7}
+    )
+    assert result["unexplained"] == 3
+
+
+def test_balance_flags_ring_handoff_skew():
+    result = audit.balance(
+        {
+            "records.ingested": 12,
+            "records.decoded": 10,
+            "records.computed": 10,
+        }
+    )
+    assert result["unexplained"] == 2
+
+
+def test_balance_missing_space_is_not_audited():
+    # a row-only ledger (merge-side task) has no record equation to
+    # violate, and vice versa: absence is "not audited", never loss
+    assert audit.balance({"rows.computed": 3, "rows.emitted": 3})[
+        "unexplained"
+    ] == 0
+    assert audit.balance({"records.decoded": 3, "records.computed": 3})[
+        "unexplained"
+    ] == 0
+    assert audit.balance({})["unexplained"] == 0
+
+
+# ----------------------------------------------------- merge accounting
+
+
+def test_record_merge_round_trips_through_sidecar(tmp_path):
+    journal_dir = str(tmp_path / "journal")
+    entry = audit.record_merge(
+        journal_dir, "merge_sorted_csv_parts", "/out.csv.gz",
+        parts=3, rows_in=10, rows_out=10,
+    )
+    assert entry["merged:collision"] == 0
+    loaded = audit.load_merges(journal_dir)
+    assert len(loaded) == 1
+    assert loaded[0]["op"] == "merge_sorted_csv_parts"
+    assert loaded[0]["rows_in"] == loaded[0]["rows_out"] == 10
+
+
+def test_record_merge_without_journal_still_returns_entry(tmp_path):
+    entry = audit.record_merge(
+        None, "merge_gene_metrics", "/g.csv.gz",
+        parts=2, rows_in=5, rows_out=3, collisions=2,
+    )
+    assert entry["rows_in"] == entry["rows_out"] + entry["merged:collision"]
+    assert audit.load_merges(str(tmp_path)) == []
+
+
+def _gene_csv(path, names, seed):
+    import numpy as np
+
+    from sctools_tpu.metrics.merge import MergeGeneMetrics
+
+    rng = np.random.default_rng(seed)
+    cols = {
+        c: rng.integers(1, 50, len(names))
+        for c in MergeGeneMetrics.COUNT_COLUMNS_TO_SUM
+    }
+    for c in MergeGeneMetrics.READ_WEIGHTED_COLUMNS:
+        cols[c] = rng.random(len(names))
+    pd.DataFrame(cols, index=pd.Index(list(names))).to_csv(
+        path, compression="gzip"
+    )
+
+
+def test_cell_merge_audit_is_pure_concat(tmp_path):
+    from sctools_tpu.metrics.merge import MergeCellMetrics
+
+    f1, f2 = str(tmp_path / "a.csv.gz"), str(tmp_path / "b.csv.gz")
+    pd.DataFrame({"n_reads": [3, 4]}, index=pd.Index(["AAA", "CCC"])).to_csv(
+        f1, compression="gzip"
+    )
+    pd.DataFrame({"n_reads": [1, 2]}, index=pd.Index(["GGG", "TTT"])).to_csv(
+        f2, compression="gzip"
+    )
+    merger = MergeCellMetrics([f1, f2], str(tmp_path / "out"))
+    merger.execute()
+    assert merger.audit["rows_in"] == merger.audit["rows_out"] == 4
+    assert merger.audit["merged:collision"] == 0
+
+
+def test_gene_merge_collision_fold_balances(tmp_path):
+    # overlapping genes across parts FOLD: the audit must read the fold
+    # as merged:collision so rows_in == rows_out + collisions exactly,
+    # never as loss
+    from sctools_tpu.metrics.merge import MergeGeneMetrics
+
+    files = []
+    for index, (names, seed) in enumerate(
+        [(["ACT", "TUB", "GAP"], 3), (["TUB", "MYC"], 4),
+         (["ACT", "MYC", "ZZZ"], 5)]
+    ):
+        path = str(tmp_path / f"g{index}.csv.gz")
+        _gene_csv(path, names, seed)
+        files.append(path)
+    journal_dir = str(tmp_path / "journal")
+    merger = MergeGeneMetrics(
+        files, str(tmp_path / "out"), journal_dir=journal_dir
+    )
+    merger.execute()
+    # 8 input rows over 5 distinct genes: 3 collision folds
+    assert merger.audit["rows_in"] == 8
+    assert merger.audit["rows_out"] == 5
+    assert merger.audit["merged:collision"] == 3
+    assert audit.load_merges(journal_dir)[0]["merged:collision"] == 3
+
+
+def test_collective_gene_merge_audit_matches_legacy(tmp_path):
+    from sctools_tpu.metrics.collective import CollectiveMergeGeneMetrics
+    from sctools_tpu.metrics.merge import MergeGeneMetrics
+
+    files = []
+    for index, (names, seed) in enumerate(
+        [(["ACT", "TUB"], 6), (["TUB", "MYC"], 7)]
+    ):
+        path = str(tmp_path / f"g{index}.csv.gz")
+        _gene_csv(path, names, seed)
+        files.append(path)
+    legacy = MergeGeneMetrics(files, str(tmp_path / "legacy"))
+    legacy.execute()
+    coll = CollectiveMergeGeneMetrics(files, str(tmp_path / "coll"))
+    coll.execute()
+    for key in ("rows_in", "rows_out", "merged:collision"):
+        assert coll.audit[key] == legacy.audit[key], key
+    assert coll.audit["rows_in"] == 4
+    assert coll.audit["merged:collision"] == 1
+
+
+def test_merge_sorted_csv_parts_writes_sidecar(tmp_path):
+    from sctools_tpu.parallel.launch import merge_sorted_csv_parts
+
+    journal_dir = str(tmp_path / "journal")
+    parts = []
+    for index, rows in enumerate((["AAA,1", "CCC,2"], ["GGG,3"])):
+        path = str(tmp_path / f"metrics.part{index}.csv.gz")
+        with gzip.open(path, "wt") as f:
+            f.write("barcode,n\n")
+            for row in rows:
+                f.write(row + "\n")
+        parts.append(path)
+    # the merge refuses parts the journal never committed: commit them
+    with Journal(journal_dir, worker_id="w0") as journal:
+        for index, path in enumerate(parts):
+            task = make_task("metrics", f"chunk{index}", {"part": index})
+            journal.register([task])
+            journal.record(task.id, "leased", attempt=1)
+            journal.record(task.id, "committed", part=path)
+    n = merge_sorted_csv_parts(
+        str(tmp_path / "metrics.part*.csv.gz"),
+        str(tmp_path / "merged.csv.gz"),
+        journal_dir=journal_dir,
+    )
+    assert n == 3
+    (entry,) = audit.load_merges(journal_dir)
+    assert entry["rows_in"] == entry["rows_out"] == 3
+    assert entry["parts"] == 2
+    assert entry["merged:collision"] == 0
+
+
+# ------------------------------------------------- the run fold (audit_run)
+
+
+def _write_sidecar(journal_dir, entries):
+    os.makedirs(os.path.join(journal_dir, "quarantine"), exist_ok=True)
+    path = os.path.join(journal_dir, "quarantine", "records-w0.jsonl")
+    with open(path, "a", encoding="utf-8") as f:
+        for entry in entries:
+            f.write(json.dumps(entry) + "\n")
+
+
+def _sidecar_entry(task_id, start, stop, reason="PoisonData"):
+    return {
+        "task": "chunk0",
+        "task_id": task_id,
+        "worker": "w0",
+        "site": "gatherer.dispatch",
+        "name": "chunk0.bam",
+        "record_start": start,
+        "record_stop": stop,
+        "reason": reason,
+        "ts": 1.0,
+    }
+
+
+def _batch_ledger(decoded=10, quarantined=0, rows=4, emitted=None):
+    ledger = {
+        "records.ingested": decoded,
+        "records.decoded": decoded,
+        "records.computed": decoded - quarantined,
+        "rows.computed": rows,
+        "rows.emitted": rows if emitted is None else emitted,
+    }
+    if quarantined:
+        ledger["records.quarantined:PoisonData"] = quarantined
+    return ledger
+
+
+def _make_run(tmp_path, ledger, sidecars=(), part=None):
+    """One committed batch task with ``ledger`` riding its commit extra."""
+    run_dir = str(tmp_path / "run")
+    journal_dir = os.path.join(run_dir, "sched-journal")
+    task = make_task("metrics", "chunk0", {"bam": "chunk0.bam"})
+    with Journal(journal_dir, worker_id="w0") as journal:
+        journal.register([task])
+        journal.record(task.id, "leased", attempt=1)
+        journal.record(task.id, "committed", audit=ledger, part=part)
+    _write_sidecar(journal_dir, [_sidecar_entry(task.id, *r) for r in sidecars])
+    return run_dir, journal_dir, task
+
+
+def test_audit_run_exact_with_named_losses(tmp_path):
+    run_dir, journal_dir, _ = _make_run(
+        tmp_path,
+        _batch_ledger(decoded=10, quarantined=2),
+        sidecars=[(3, 4), (7, 8)],
+    )
+    report = audit.audit_run(run_dir)
+    fleet = report["fleet"]
+    assert fleet["exact"] is True
+    assert fleet["unexplained"] == 0
+    assert fleet["tasks_committed"] == 1
+    assert fleet["losses"] == {"quarantined:PoisonData": 2}
+    assert report["quarantine"] == {"ranges": 2, "records": 2}
+    assert "RESULT: EXACT" in audit.render_audit_report(report)
+
+
+def test_audit_run_flags_ledger_imbalance(tmp_path):
+    run_dir, _, _ = _make_run(
+        tmp_path, _batch_ledger(decoded=10, quarantined=0, emitted=3)
+    )
+    report = audit.audit_run(run_dir)
+    assert report["fleet"]["exact"] is False
+    assert report["fleet"]["unexplained"] == 1
+    rendered = audit.render_audit_report(report)
+    assert "RESULT: UNBALANCED" in rendered
+    assert "ledger imbalance" in rendered
+
+
+def test_audit_run_cross_checks_sidecars_against_ledger(tmp_path):
+    # the ledger claims 2 quarantined but only one sidecar range exists:
+    # the report must call out the skew, not trust the ledger alone
+    run_dir, _, _ = _make_run(
+        tmp_path, _batch_ledger(decoded=10, quarantined=2),
+        sidecars=[(3, 4)],
+    )
+    report = audit.audit_run(run_dir)
+    assert report["fleet"]["exact"] is False
+    assert "sidecar skew" in audit.render_audit_report(report)
+
+
+def test_audit_run_dedupes_retried_sidecar_ranges(tmp_path):
+    # a stolen task re-isolates the same deterministic range on every
+    # attempt; duplicate sidecar lines must collapse before the check
+    run_dir, journal_dir, task = _make_run(
+        tmp_path, _batch_ledger(decoded=10, quarantined=1),
+        sidecars=[(3, 4)],
+    )
+    _write_sidecar(journal_dir, [_sidecar_entry(task.id, 3, 4)])
+    report = audit.audit_run(run_dir)
+    assert report["fleet"]["exact"] is True, report["fleet"]
+
+
+def test_audit_run_merge_entry_must_balance(tmp_path):
+    run_dir, journal_dir, _ = _make_run(tmp_path, _batch_ledger())
+    audit.record_merge(
+        journal_dir, "merge_gene_metrics", "/g.csv.gz",
+        parts=2, rows_in=10, rows_out=6, collisions=4,
+    )
+    assert audit.audit_run(run_dir)["fleet"]["exact"] is True
+    assert audit.audit_run(run_dir)["fleet"]["losses"][
+        "merged:collision"
+    ] == 4
+    # an unbalanced fold is a finding, not a silent delta
+    audit.record_merge(
+        journal_dir, "merge_gene_metrics", "/bad.csv.gz",
+        parts=2, rows_in=10, rows_out=6, collisions=1,
+    )
+    report = audit.audit_run(run_dir)
+    assert report["fleet"]["exact"] is False
+    assert report["fleet"]["unexplained"] == 3
+
+
+def _make_serve_run(tmp_path, emitted=5, claimed=5):
+    run_dir = str(tmp_path / "serve-run")
+    journal_dir = os.path.join(run_dir, "journal")
+    task = make_task("serve", "t0/job0", {"tenant": "t0"})
+    with Journal(journal_dir, worker_id="wA") as journal:
+        journal.register([task])
+        journal.record(task.id, "leased", attempt=1)
+        journal.record(
+            task.id, "committed",
+            pack=None,
+            audit={
+                "rows_emitted": emitted,
+                "rows_claimed": claimed,
+                "records_streamed": 20,
+            },
+            pack_execs=[
+                {
+                    "exec_id": task.id,
+                    "tids": [task.id],
+                    "rows": 20,
+                    "ledger": {
+                        "records.decoded": 20,
+                        "records.computed": 20,
+                        "rows.computed": emitted,
+                        "rows.emitted": emitted,
+                    },
+                }
+            ],
+        )
+    return run_dir, task
+
+
+def test_audit_run_serve_job_emitted_must_equal_claimed(tmp_path):
+    run_dir, task = _make_serve_run(tmp_path, emitted=5, claimed=5)
+    report = audit.audit_run(run_dir)
+    assert report["fleet"]["exact"] is True
+    job = report["serve_jobs"][task.id]
+    assert job["tenant"] == "t0"
+    assert job["rows_emitted"] == job["rows_claimed"] == 5
+
+    run_dir, task = _make_serve_run(
+        tmp_path / "skewed", emitted=5, claimed=3
+    )
+    report = audit.audit_run(run_dir)
+    assert report["fleet"]["exact"] is False
+    assert report["serve_jobs"][task.id]["unexplained"] == 2
+
+
+def test_audit_run_pack_routed_must_sum_to_emitted(tmp_path):
+    run_dir = str(tmp_path / "run")
+    journal_dir = os.path.join(run_dir, "journal")
+    t1 = make_task("serve", "t0/job0", {"tenant": "t0"})
+    t2 = make_task("serve", "t1/job0", {"tenant": "t1"})
+    segment = {
+        "exec_id": "pack01",
+        "tids": [t1.id, t2.id],
+        "rows": 9,
+        "ledger": {
+            "records.decoded": 40,
+            "records.computed": 40,
+            "rows.computed": 9,
+            "rows.emitted": 9,
+        },
+        "rows_routed": [4, 4],  # 8 routed vs 9 emitted: 1 unexplained
+        "rows_claimed": [4, 4],
+    }
+    with Journal(journal_dir, worker_id="wA") as journal:
+        journal.register([t1, t2])
+        for task, routed in ((t1, 4), (t2, 4)):
+            journal.record(task.id, "leased", attempt=1)
+            journal.record(
+                task.id, "committed", pack="pack01",
+                audit={"rows_emitted": routed, "rows_claimed": routed},
+                pack_execs=[segment],
+            )
+    report = audit.audit_run(run_dir)
+    assert report["fleet"]["exact"] is False
+    assert any(
+        "routed" in problem
+        for finding in report["findings"]
+        for problem in finding["problems"]
+    ), report["findings"]
+
+
+def test_audit_run_raises_without_journal(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        audit.audit_run(str(tmp_path / "empty"))
+
+
+# ------------------------------------------------------------- explains
+
+
+def test_explain_job_narrates_attempts_and_ledger(tmp_path):
+    run_dir, _, task = _make_run(
+        tmp_path, _batch_ledger(decoded=10, quarantined=1),
+        sidecars=[(3, 4)],
+    )
+    result = audit.explain_run(run_dir, job="chunk0")
+    assert result["found"] is True
+    (match,) = result["matches"]
+    assert match["kind"] == "job"
+    assert match["task"]["id"] == task.id
+    assert match["task"]["attempts"] == 1
+    assert len(match["quarantined"]) == 1
+    rendered = audit.render_explain(result)
+    assert "chunk0" in rendered
+    assert "ledger" in rendered
+
+
+def test_explain_job_dedupes_reisolated_ranges(tmp_path):
+    run_dir, journal_dir, task = _make_run(
+        tmp_path, _batch_ledger(decoded=10, quarantined=1),
+        sidecars=[(3, 4)],
+    )
+    _write_sidecar(journal_dir, [_sidecar_entry(task.id, 3, 4)])
+    (match,) = audit.explain_run(run_dir, job="chunk0")["matches"]
+    assert len(match["quarantined"]) == 1
+
+
+def test_explain_record_resolves_range_and_task(tmp_path):
+    run_dir, _, task = _make_run(
+        tmp_path, _batch_ledger(decoded=10, quarantined=1),
+        sidecars=[(3, 4)],
+    )
+    result = audit.explain_run(run_dir, record=3)
+    assert result["found"] is True
+    (match,) = result["matches"]
+    assert match["kind"] == "quarantined-record"
+    assert match["range"] == [3, 4]
+    assert match["reason"] == "PoisonData"
+    assert match["task"]["id"] == task.id
+    # off-range indices miss cleanly
+    assert audit.explain_run(run_dir, record=5)["found"] is False
+
+
+def test_explain_barcode_resolves_part_and_merged_row(tmp_path):
+    part = str(tmp_path / "metrics.part0.csv")
+    with open(part, "w", encoding="utf-8") as f:
+        f.write("barcode,n\nAAA,1\nCCC,2\n")
+    run_dir, journal_dir, _ = _make_run(
+        tmp_path, _batch_ledger(), part=part
+    )
+    merged = str(tmp_path / "merged.csv.gz")
+    with gzip.open(merged, "wt") as f:
+        f.write("barcode,n\nAAA,1\nCCC,2\n")
+    audit.record_merge(
+        journal_dir, "merge_sorted_csv_parts", merged,
+        parts=1, rows_in=2, rows_out=2,
+    )
+    result = audit.explain_run(run_dir, barcode="CCC")
+    assert result["found"] is True
+    kinds = {m["kind"]: m for m in result["matches"]}
+    assert kinds["output-row"]["row"] == 2
+    assert kinds["output-row"]["file"] == part
+    assert kinds["merged-row"]["row"] == 2
+    assert audit.explain_run(run_dir, barcode="TTT")["found"] is False
+
+
+# --------------------------------------------------------------- gauges
+
+
+def test_render_audit_metrics_per_tenant_series(tmp_path):
+    run_dir, _ = _make_serve_run(tmp_path, emitted=5, claimed=5)
+    body = audit.render_audit_metrics(run_dir)
+    assert (
+        'sctools_tpu_audit_rows_emitted_total{tenant="t0"} 5' in body
+    ), body
+    assert (
+        'sctools_tpu_audit_rows_claimed_total{tenant="t0"} 5' in body
+    ), body
+    assert "sctools_tpu_audit_unexplained_records 0" in body
+    assert audit.render_audit_metrics(str(tmp_path / "missing")) == ""
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def cli(args, capsys):
+    code = obs_cli(args)
+    captured = capsys.readouterr()
+    return code, captured.out, captured.err
+
+
+def test_cli_audit_exit_codes(tmp_path, capsys):
+    run_dir, _, _ = _make_run(
+        tmp_path, _batch_ledger(decoded=10, quarantined=1),
+        sidecars=[(3, 4)],
+    )
+    code, out, _ = cli(["audit", run_dir], capsys)
+    assert code == 0
+    assert "RESULT: EXACT — 0 unexplained records" in out
+
+    code, out, _ = cli(["audit", run_dir, "--json"], capsys)
+    assert code == 0
+    assert json.loads(out)["fleet"]["exact"] is True
+
+    bad_dir, _, _ = _make_run(
+        tmp_path / "bad", _batch_ledger(emitted=1)
+    )
+    code, out, _ = cli(["audit", bad_dir], capsys)
+    assert code == 1
+    assert "UNBALANCED" in out
+
+    code, _, err = cli(["audit", str(tmp_path / "nope")], capsys)
+    assert code == 2
+    assert "no sched journal" in err
+
+
+def test_cli_explain_exit_codes(tmp_path, capsys):
+    run_dir, _, _ = _make_run(
+        tmp_path, _batch_ledger(decoded=10, quarantined=1),
+        sidecars=[(3, 4)],
+    )
+    code, out, _ = cli(["explain", run_dir, "--record", "3"], capsys)
+    assert code == 0
+    assert "QUARANTINED" in out
+
+    code, out, _ = cli(
+        ["explain", run_dir, "--job", "chunk0", "--json"], capsys
+    )
+    assert code == 0
+    assert json.loads(out)["found"] is True
+
+    code, _, _ = cli(["explain", run_dir, "--record", "999"], capsys)
+    assert code == 1
+
+    code, _, err = cli(["explain", run_dir], capsys)
+    assert code == 2
+    assert "--barcode/--record/--job" in err
+
+
+# ------------------------------------------------------- ring handoff tap
+
+
+class _FakeFrame:
+    def __init__(self, n):
+        self.n_records = n
+
+
+def test_ring_source_ledgers_handoff_once():
+    from sctools_tpu.ingest.ring import ring_frames
+
+    obs.set_context(task=None, task_id=None)
+    for frame in ring_frames(source=iter([_FakeFrame(4), _FakeFrame(3)])):
+        pass
+    assert audit.peek("")["records.ingested"] == 7
+
+
+def test_ring_source_audited_false_stays_off_ledger():
+    from sctools_tpu.ingest.ring import ring_frames
+
+    obs.set_context(task=None, task_id=None)
+    for frame in ring_frames(
+        source=iter([_FakeFrame(4)]), audited=False
+    ):
+        pass
+    assert audit.peek("") == {}
